@@ -1,0 +1,84 @@
+"""Headline benchmark: batched scheduling throughput on one TPU chip.
+
+Config #2 from BASELINE.json: NodeResourcesFit + BalancedAllocation,
+5k nodes / 5k pods, mixed cpu+mem requests — solved by the batched greedy
+kernel (sequential-in-batch semantics identical to the reference's one-
+pod-at-a-time cycle).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is against 100 pods/s — the upstream scheduler's ~SLO
+throughput at 5k nodes (the reference publishes no in-tree absolute
+numbers; see BASELINE.md).  Timing covers the warm end-to-end step the
+scheduler would run per batch: snapshot encode + device solve + readback.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N_NODES = 5_000
+N_PODS = 5_000
+BASELINE_PODS_PER_SEC = 100.0
+
+
+def build_workload():
+    from kubernetes_tpu.ops import schema
+    from kubernetes_tpu.testing.wrappers import GI, MI, make_node, make_pod
+
+    rng = np.random.default_rng(0)
+    nodes = [
+        make_node(f"node-{i}")
+        .capacity(cpu_milli=32000, mem=64 * GI, pods=110)
+        .zone(f"zone-{i % 10}")
+        .obj()
+        for i in range(N_NODES)
+    ]
+    pods = [
+        make_pod(f"pod-{i}")
+        .req(
+            cpu_milli=int(rng.choice([100, 250, 500, 1000, 2000])),
+            mem=int(rng.choice([128, 256, 512, 1024, 2048])) * MI,
+        )
+        .obj()
+        for i in range(N_PODS)
+    ]
+    return nodes, pods
+
+
+def main() -> None:
+    from kubernetes_tpu.ops import assign, schema
+
+    nodes, pods = build_workload()
+    solver = assign.greedy_assign_jit()
+
+    # cold: encode + compile
+    snap, meta = schema.SnapshotBuilder().build(nodes, pods)
+    result = solver(snap)
+    result.assignment.block_until_ready()
+
+    # warm, timed end-to-end (encode + solve + readback)
+    t0 = time.perf_counter()
+    snap, meta = schema.SnapshotBuilder().build(nodes, pods)
+    result = solver(snap)
+    a = np.asarray(result.assignment)[: meta.num_pods]
+    dt = time.perf_counter() - t0
+
+    placed = int((a >= 0).sum())
+    assert placed == N_PODS, f"only {placed}/{N_PODS} pods placed"
+    pods_per_sec = N_PODS / dt
+    print(
+        json.dumps(
+            {
+                "metric": f"scheduling_throughput_{N_NODES}nodes_{N_PODS}pods",
+                "value": round(pods_per_sec, 1),
+                "unit": "pods/s",
+                "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

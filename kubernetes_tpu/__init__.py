@@ -1,0 +1,24 @@
+"""kubernetes_tpu — a TPU-native cluster control plane & batched scheduler.
+
+A ground-up re-design of the capabilities of Kubernetes (reference:
+vonsago/kubernetes) around TPU hardware: cluster state is held as dense,
+statically-shaped tensors; the scheduler's per-node Filter/Score plugin loop
+(reference: pkg/scheduler/schedule_one.go:442-867) becomes a single fused
+JAX/XLA solve over (pending_pods x nodes); multi-chip scale-out shards the
+node axis over a jax.sharding.Mesh.
+
+Layout (mirrors SURVEY.md section 7):
+  api/         object model + in-memory versioned store with watch
+               (the etcd + apiserver + apimachinery equivalent)
+  client/      informers, listers, workqueues (client-go equivalent)
+  ops/         JAX kernels: snapshot tensor schema, filter masks, score
+               kernels, batched assignment solves
+  parallel/    device-mesh sharding of the solve (shard_map over node axis)
+  scheduler/   host-side scheduler: cache, queue, plugin framework, profiles
+  controllers/ control loops (replicaset, deployment, job, nodelifecycle, ...)
+  perf/        scheduler_perf benchmark harness port
+  models/      the flagship end-to-end batched-scheduler "model"
+  utils/       vocab/bitset encoding, clocks, backoff
+"""
+
+__version__ = "0.1.0"

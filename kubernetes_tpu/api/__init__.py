@@ -1,0 +1,1 @@
+"""API layer: object model, versioned in-memory store, watch streams."""

@@ -1,0 +1,462 @@
+"""Core API object model.
+
+The Python-native equivalent of the reference's versioned API types
+(reference: staging/src/k8s.io/api/core/v1/types.go and
+pkg/apis/core/types.go).  Only the fields the control plane and scheduler
+actually consume are modelled; everything is a plain dataclass so objects
+are cheap to construct in tests and benchmarks (the reference's builder
+wrappers, pkg/scheduler/testing/wrappers.go, have an equivalent in
+kubernetes_tpu.testing.wrappers).
+
+Conventions:
+  * cpu is always integer milli-cores, memory/ephemeral-storage integer
+    bytes, every other resource an integer count (the canonical units the
+    reference's resource.Quantity MilliValue()/Value() calls produce).
+  * labels/annotations are plain dicts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Resource names (reference: staging/src/k8s.io/api/core/v1/types.go ResourceName)
+# ---------------------------------------------------------------------------
+
+CPU = "cpu"                      # milli-cores
+MEMORY = "memory"                # bytes
+EPHEMERAL_STORAGE = "ephemeral-storage"  # bytes
+PODS = "pods"                    # count
+
+# Default requests applied for *scoring only* when a pod declares none
+# (reference: pkg/scheduler/util/pod_resources.go:33-36).
+DEFAULT_MILLI_CPU_REQUEST = 100
+DEFAULT_MEMORY_REQUEST = 200 * 1024 * 1024
+
+# Taint effects (reference: api/core/v1/types.go TaintEffect)
+NO_SCHEDULE = "NoSchedule"
+PREFER_NO_SCHEDULE = "PreferNoSchedule"
+NO_EXECUTE = "NoExecute"
+TAINT_EFFECTS = (NO_SCHEDULE, PREFER_NO_SCHEDULE, NO_EXECUTE)
+
+# Well-known taint applied to cordoned nodes
+# (reference: staging/src/k8s.io/api/core/v1/well_known_taints.go).
+TAINT_NODE_UNSCHEDULABLE = "node.kubernetes.io/unschedulable"
+TAINT_NODE_NOT_READY = "node.kubernetes.io/not-ready"
+TAINT_NODE_UNREACHABLE = "node.kubernetes.io/unreachable"
+
+# Well-known labels
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+LABEL_ZONE = "topology.kubernetes.io/zone"
+LABEL_REGION = "topology.kubernetes.io/region"
+
+_uid_counter = itertools.count(1)
+
+
+def _new_uid() -> str:
+    return f"uid-{next(_uid_counter)}"
+
+
+# ---------------------------------------------------------------------------
+# Metadata
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ObjectMeta:
+    """reference: staging/src/k8s.io/apimachinery/pkg/apis/meta/v1/types.go ObjectMeta."""
+
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=_new_uid)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    resource_version: int = 0
+    generation: int = 0
+    creation_timestamp: float = 0.0
+    deletion_timestamp: Optional[float] = None
+    owner_references: List["OwnerReference"] = field(default_factory=list)
+    finalizers: List[str] = field(default_factory=list)
+
+
+@dataclass
+class OwnerReference:
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: bool = False
+
+
+# ---------------------------------------------------------------------------
+# Selectors / affinity (reference: api/core/v1/types.go NodeSelector et al.)
+# ---------------------------------------------------------------------------
+
+OP_IN = "In"
+OP_NOT_IN = "NotIn"
+OP_EXISTS = "Exists"
+OP_DOES_NOT_EXIST = "DoesNotExist"
+OP_GT = "Gt"
+OP_LT = "Lt"
+OP_EQUAL = "Equal"  # toleration operator
+
+
+@dataclass
+class Requirement:
+    """One match expression: NodeSelectorRequirement / LabelSelectorRequirement."""
+
+    key: str
+    op: str = OP_IN
+    values: List[str] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        """Label-set semantics (reference: apimachinery/pkg/labels/selector.go
+        Requirement.Matches — NotIn/DoesNotExist match when the key is absent)."""
+        present = self.key in labels
+        if self.op == OP_IN:
+            return present and labels[self.key] in self.values
+        if self.op == OP_NOT_IN:
+            return (not present) or labels[self.key] not in self.values
+        if self.op == OP_EXISTS:
+            return present
+        if self.op == OP_DOES_NOT_EXIST:
+            return not present
+        if self.op in (OP_GT, OP_LT):
+            # Both the label value and the bound must parse as integers;
+            # otherwise the requirement doesn't match (labels.Requirement
+            # semantics: ParseInt failure => no match).
+            if not present:
+                return False
+            lv = _parse_int(labels[self.key])
+            bound = _parse_int(self.values[0]) if self.values else None
+            if lv is None or bound is None:
+                return False
+            return lv > bound if self.op == OP_GT else lv < bound
+        raise ValueError(f"unknown operator {self.op}")
+
+
+def _parse_int(s: str) -> Optional[int]:
+    try:
+        return int(s)
+    except ValueError:
+        return None
+
+
+@dataclass
+class NodeSelectorTerm:
+    """Expressions are ANDed (reference: v1.NodeSelectorTerm)."""
+
+    match_expressions: List[Requirement] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        return all(r.matches(labels) for r in self.match_expressions)
+
+
+@dataclass
+class NodeSelector:
+    """Terms are ORed (reference: v1.NodeSelector)."""
+
+    terms: List[NodeSelectorTerm] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        return any(t.matches(labels) for t in self.terms)
+
+
+@dataclass
+class PreferredSchedulingTerm:
+    weight: int = 1
+    preference: NodeSelectorTerm = field(default_factory=NodeSelectorTerm)
+
+
+@dataclass
+class LabelSelector:
+    """reference: metav1.LabelSelector — match_labels ANDed with expressions."""
+
+    match_labels: Dict[str, str] = field(default_factory=dict)
+    match_expressions: List[Requirement] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        for k, v in self.match_labels.items():
+            if labels.get(k) != v:
+                return False
+        return all(r.matches(labels) for r in self.match_expressions)
+
+    def requirements(self) -> List[Requirement]:
+        """Canonical AND-of-requirements form."""
+        reqs = [Requirement(k, OP_IN, [v]) for k, v in sorted(self.match_labels.items())]
+        reqs.extend(self.match_expressions)
+        return reqs
+
+
+@dataclass
+class PodAffinityTerm:
+    """reference: v1.PodAffinityTerm."""
+
+    label_selector: Optional[LabelSelector] = None
+    topology_key: str = LABEL_HOSTNAME
+    namespaces: List[str] = field(default_factory=list)  # empty => pod's own ns
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int = 1
+    term: PodAffinityTerm = field(default_factory=PodAffinityTerm)
+
+
+@dataclass
+class PodAffinity:
+    required: List[PodAffinityTerm] = field(default_factory=list)
+    preferred: List[WeightedPodAffinityTerm] = field(default_factory=list)
+
+
+@dataclass
+class PodAntiAffinity:
+    required: List[PodAffinityTerm] = field(default_factory=list)
+    preferred: List[WeightedPodAffinityTerm] = field(default_factory=list)
+
+
+@dataclass
+class NodeAffinity:
+    required: Optional[NodeSelector] = None
+    preferred: List[PreferredSchedulingTerm] = field(default_factory=list)
+
+
+@dataclass
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAntiAffinity] = None
+
+
+@dataclass
+class TopologySpreadConstraint:
+    """reference: v1.TopologySpreadConstraint."""
+
+    max_skew: int = 1
+    topology_key: str = LABEL_ZONE
+    when_unsatisfiable: str = "DoNotSchedule"  # or "ScheduleAnyway"
+    label_selector: Optional[LabelSelector] = None
+    min_domains: Optional[int] = None
+
+
+# ---------------------------------------------------------------------------
+# Taints / tolerations
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = NO_SCHEDULE
+
+
+@dataclass
+class Toleration:
+    """reference: v1.Toleration.ToleratesTaint (api/core/v1/toleration.go)."""
+
+    key: str = ""                 # empty key + Exists tolerates everything
+    op: str = OP_EXISTS           # Exists | Equal
+    value: str = ""
+    effect: str = ""              # empty effect matches all effects
+    toleration_seconds: Optional[int] = None
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key and self.key != taint.key:
+            return False
+        if not self.key:
+            return self.op == OP_EXISTS
+        if self.op == OP_EXISTS:
+            return True
+        return self.value == taint.value
+
+
+def tolerations_tolerate_taint(tols: List[Toleration], taint: Taint) -> bool:
+    return any(t.tolerates(taint) for t in tols)
+
+
+# ---------------------------------------------------------------------------
+# Pods
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ContainerPort:
+    container_port: int = 0
+    host_port: int = 0            # 0 => no host port claim
+    protocol: str = "TCP"
+    host_ip: str = ""             # "" or "0.0.0.0" => wildcard
+
+
+@dataclass
+class Container:
+    name: str = "c"
+    image: str = ""
+    requests: Dict[str, int] = field(default_factory=dict)
+    limits: Dict[str, int] = field(default_factory=dict)
+    ports: List[ContainerPort] = field(default_factory=list)
+
+
+@dataclass
+class PodSpec:
+    node_name: str = ""           # set at bind time
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    overhead: Dict[str, int] = field(default_factory=dict)
+    affinity: Optional[Affinity] = None
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: List[Toleration] = field(default_factory=list)
+    topology_spread_constraints: List[TopologySpreadConstraint] = field(default_factory=list)
+    priority: int = 0
+    preemption_policy: str = "PreemptLowerPriority"  # or "Never"
+    scheduler_name: str = "default-scheduler"
+    scheduling_gates: List[str] = field(default_factory=list)
+    restart_policy: str = "Always"
+    termination_grace_period_seconds: int = 30
+
+
+@dataclass
+class PodStatus:
+    phase: str = "Pending"        # Pending | Running | Succeeded | Failed
+    conditions: List[Dict[str, Any]] = field(default_factory=list)
+    nominated_node_name: str = ""
+
+
+@dataclass
+class Pod:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    KIND = "Pod"
+
+    # -- derived ---------------------------------------------------------
+
+    def resource_requests(self) -> Dict[str, int]:
+        """Effective pod request: sum of containers, elementwise max with the
+        largest init container, plus overhead
+        (reference: pkg/api/v1/resource/helpers.go PodRequests)."""
+        total: Dict[str, int] = {}
+        for c in self.spec.containers:
+            for k, v in c.requests.items():
+                total[k] = total.get(k, 0) + v
+        for ic in self.spec.init_containers:
+            for k, v in ic.requests.items():
+                if v > total.get(k, 0):
+                    total[k] = v
+        for k, v in self.spec.overhead.items():
+            total[k] = total.get(k, 0) + v
+        return total
+
+    def nonzero_requests(self) -> Tuple[int, int]:
+        """(milli_cpu, memory) with scoring defaults applied
+        (reference: pkg/scheduler/util/pod_resources.go GetNonzeroRequests)."""
+        req = self.resource_requests()
+        return (
+            req.get(CPU, DEFAULT_MILLI_CPU_REQUEST),
+            req.get(MEMORY, DEFAULT_MEMORY_REQUEST),
+        )
+
+    def host_ports(self) -> List[Tuple[str, str, int]]:
+        """(protocol, host_ip, port) triples claimed by this pod."""
+        out = []
+        for c in self.spec.containers:
+            for p in c.ports:
+                if p.host_port > 0:
+                    out.append((p.protocol, p.host_ip or "0.0.0.0", p.host_port))
+        return out
+
+    def required_node_selector(self) -> Optional[NodeSelector]:
+        """Merge .spec.node_selector and required node affinity into one
+        NodeSelector in CNF-ish form.  node_selector entries are ANDed into
+        every term (reference semantics: both must match —
+        component-helpers/scheduling/corev1/nodeaffinity.GetRequiredNodeAffinity)."""
+        ns_reqs = [Requirement(k, OP_IN, [v]) for k, v in sorted(self.spec.node_selector.items())]
+        aff = self.spec.affinity.node_affinity if self.spec.affinity else None
+        req_sel = aff.required if aff else None
+        if req_sel is None or not req_sel.terms:
+            if not ns_reqs:
+                return None
+            return NodeSelector(terms=[NodeSelectorTerm(match_expressions=ns_reqs)])
+        terms = [
+            NodeSelectorTerm(match_expressions=ns_reqs + list(t.match_expressions))
+            for t in req_sel.terms
+        ]
+        return NodeSelector(terms=terms)
+
+    def preferred_node_affinity(self) -> List[PreferredSchedulingTerm]:
+        aff = self.spec.affinity.node_affinity if self.spec.affinity else None
+        return list(aff.preferred) if aff else []
+
+
+# ---------------------------------------------------------------------------
+# Nodes
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+    taints: List[Taint] = field(default_factory=list)
+    provider_id: str = ""
+
+
+@dataclass
+class ContainerImage:
+    names: List[str] = field(default_factory=list)
+    size_bytes: int = 0
+
+
+@dataclass
+class NodeStatus:
+    allocatable: Dict[str, int] = field(default_factory=dict)
+    capacity: Dict[str, int] = field(default_factory=dict)
+    images: List[ContainerImage] = field(default_factory=list)
+    conditions: List[Dict[str, Any]] = field(default_factory=list)
+
+
+@dataclass
+class Node:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    KIND = "Node"
+
+    def effective_taints(self) -> List[Taint]:
+        """Spec taints plus the synthetic unschedulable taint for cordoned
+        nodes (the reference's NodeUnschedulable plugin consults the spec
+        flag but honours tolerations of node.kubernetes.io/unschedulable —
+        pkg/scheduler/framework/plugins/nodeunschedulable/node_unschedulable.go:60-76;
+        modelling it as a taint gives identical semantics in one code path)."""
+        taints = list(self.spec.taints)
+        if self.spec.unschedulable:
+            t = Taint(TAINT_NODE_UNSCHEDULABLE, "", NO_SCHEDULE)
+            if t not in taints:
+                taints.append(t)
+        return taints
+
+
+def clone(obj):
+    """Deep copy an API object (the reference's generated DeepCopy)."""
+    return dataclasses.replace(
+        obj,
+        **{
+            f.name: _deep(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        },
+    )
+
+
+def _deep(v):
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return clone(v)
+    if isinstance(v, dict):
+        return {k: _deep(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_deep(x) for x in v]
+    return v

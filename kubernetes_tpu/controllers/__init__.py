@@ -1,0 +1,1 @@
+"""controllers layer (being built out; see package docstring for the layout map)."""

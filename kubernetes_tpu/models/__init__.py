@@ -1,0 +1,1 @@
+"""Flagship end-to-end models built from the ops kernels."""

@@ -1,0 +1,67 @@
+"""TPUBatchScheduler — the flagship model: snapshot in, assignments out.
+
+Wraps the ops kernels into the one-dispatch scheduling step the rest of
+the framework (host scheduler, extender endpoint, benchmarks) calls.  The
+north-star replacement for the reference's per-pod scheduling cycle
+(pkg/scheduler/schedule_one.go:66): one compiled program filters, scores,
+and greedily assigns an entire pending batch with assume-bookkeeping
+carried on device.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..api import types as api
+from ..ops import assign as assign_ops
+from ..ops import schema
+from ..ops.scores import DEFAULT_SCORE_CONFIG, ScoreConfig
+
+
+class TPUBatchScheduler:
+    """Owns a SnapshotBuilder (persistent vocabularies) and a jitted solver.
+
+    Usage:
+        sched = TPUBatchScheduler()
+        placements = sched.schedule(nodes, pending_pods, bound_pods)
+        # placements: list[node-name or None], one per pending pod
+    """
+
+    def __init__(
+        self,
+        score_config: ScoreConfig = DEFAULT_SCORE_CONFIG,
+        limits: Optional[schema.SnapshotLimits] = None,
+    ):
+        self.builder = schema.SnapshotBuilder(limits)
+        self.score_config = score_config
+        self._solver = assign_ops.greedy_assign_jit(score_config)
+        self.last_result: Optional[assign_ops.SolveResult] = None
+
+    def snapshot(
+        self,
+        nodes: Sequence[api.Node],
+        pending: Sequence[api.Pod],
+        bound: Sequence[api.Pod] = (),
+    ) -> Tuple[schema.Snapshot, schema.SnapshotMeta]:
+        return self.builder.build(nodes, pending, bound_pods=bound)
+
+    def schedule(
+        self,
+        nodes: Sequence[api.Node],
+        pending: Sequence[api.Pod],
+        bound: Sequence[api.Pod] = (),
+    ) -> List[Optional[str]]:
+        if not pending:
+            return []
+        snap, meta = self.snapshot(nodes, pending, bound)
+        result = self._solver(snap)
+        self.last_result = result
+        idx = np.asarray(result.assignment)[: meta.num_pods]
+        return [meta.node_name(int(i)) for i in idx]
+
+    def solve(self, snap: schema.Snapshot) -> assign_ops.SolveResult:
+        """Raw device-side solve on a prebuilt snapshot."""
+        return self._solver(snap)

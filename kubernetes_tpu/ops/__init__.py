@@ -1,0 +1,1 @@
+"""JAX kernels: tensor schema, filter masks, score kernels, assignment solves."""

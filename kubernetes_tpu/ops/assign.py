@@ -1,0 +1,117 @@
+"""Batched assignment solves.
+
+The reference schedules one pod at a time: pop, filter, score, pick, then
+`assume` the pod into the cache so the next pod sees its resources
+(schedule_one.go:66-133, :940-957).  `greedy_assign` reproduces exactly
+those semantics inside a single compiled program: a lax.scan over the pod
+axis whose carry *is* the assume bookkeeping (requested / ports updated
+tensor-side between picks), so a 10k-pod batch needs one device dispatch
+instead of 10k scheduling cycles.
+
+Host round-trips per batch: one.  Selector/preferred match masks are
+hoisted out of the scan — they depend only on labels, which placements
+don't change.
+
+Tie-breaking: first-max-index (deterministic).  The reference picks
+uniformly at random among max-score nodes via reservoir sampling
+(schedule_one.go:867-905); pass `tie_seed` to sample the same distribution
+with a counter-based PRNG instead.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .filters import feasible_for_pod, pod_view, preferred_match, selector_match
+from .schema import ClusterTensors, Snapshot
+from .scores import DEFAULT_SCORE_CONFIG, ScoreConfig, score_for_pod
+
+NEG_INF = jnp.float32(-jnp.inf)
+
+
+class SolveResult(NamedTuple):
+    assignment: jnp.ndarray   # i32[P]: node index, or -1 unschedulable
+    scores: jnp.ndarray       # f32[P]: winning node's score (-inf if none)
+    feasible_counts: jnp.ndarray  # i32[P]: feasible nodes seen by each pod
+    cluster: ClusterTensors   # post-solve cluster (assumed placements applied)
+
+
+def _pick(
+    masked_scores: jnp.ndarray,
+    feasible: jnp.ndarray,
+    key: Optional[jax.Array],
+) -> jnp.ndarray:
+    """argmax with first-index ties, or uniform-among-ties when keyed
+    (the reference's selectHost reservoir sampling)."""
+    if key is None:
+        return jnp.argmax(masked_scores)
+    best = jnp.max(masked_scores)
+    tie = feasible & (masked_scores == best)
+    # Gumbel-max over the tie set = uniform choice among ties.
+    g = jax.random.gumbel(key, masked_scores.shape)
+    return jnp.argmax(jnp.where(tie, g, NEG_INF))
+
+
+def greedy_assign(
+    snapshot: Snapshot,
+    cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,
+    tie_seed: Optional[int] = None,
+) -> SolveResult:
+    """Sequential-greedy solve of the whole pending batch on device.
+
+    Semantically equivalent to running the reference's scheduling cycle
+    once per pod in batch order with cache assume between cycles.
+    """
+    cluster, pods, sel, pref = jax.tree.map(jnp.asarray, tuple(snapshot))
+    n = cluster.allocatable.shape[0]
+    p = pods.req.shape[0]
+
+    sel_mask = selector_match(cluster, sel)
+    pref_mask = preferred_match(cluster, pref)
+    keys = (
+        jax.random.split(jax.random.PRNGKey(tie_seed), p)
+        if tie_seed is not None
+        else None
+    )
+
+    def step(carry, i):
+        requested, nonzero, ports = carry
+        cl = cluster._replace(
+            requested=requested, nonzero_requested=nonzero, port_bits=ports
+        )
+        pod = pod_view(pods, i)
+        feas = feasible_for_pod(cl, pod, sel_mask)
+        found = feas.any()
+        scores = score_for_pod(cl, pod, feas, pref_mask, cfg)
+        masked = jnp.where(feas, scores, NEG_INF)
+        choice = _pick(masked, feas, keys[i] if keys is not None else None)
+        idx = jnp.where(found, choice, -1).astype(jnp.int32)
+
+        onehot = (jnp.arange(n) == choice) & found
+        requested = requested + onehot[:, None] * pod.req[None, :]
+        nonzero = nonzero + onehot[:, None] * pod.nonzero_req[None, :]
+        ports = jnp.where(onehot[:, None], ports | pod.port_bits[None, :], ports)
+        out = (idx, jnp.where(found, masked[choice], NEG_INF), feas.sum().astype(jnp.int32))
+        return (requested, nonzero, ports), out
+
+    init = (cluster.requested, cluster.nonzero_requested, cluster.port_bits)
+    (requested, nonzero, ports), (assignment, win_scores, feas_counts) = jax.lax.scan(
+        step, init, jnp.arange(p)
+    )
+    final = cluster._replace(
+        requested=requested, nonzero_requested=nonzero, port_bits=ports
+    )
+    return SolveResult(assignment, win_scores, feas_counts, final)
+
+
+def greedy_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
+    """A jitted closure over the (static, hashable) score config."""
+
+    @jax.jit
+    def run(snapshot: Snapshot) -> SolveResult:
+        return greedy_assign(snapshot, cfg)
+
+    return run

@@ -1,0 +1,220 @@
+"""Fused feasibility kernels — the Filter extension point as boolean masks.
+
+Replaces the reference's chunked 16-goroutine per-node Filter loop
+(pkg/scheduler/schedule_one.go:574-660, framework/parallelize) with one
+vectorized pass over the node axis.  Covered plugins and their reference
+counterparts:
+
+  NodeResourcesFit     fitsRequest, noderesources/fit.go:421-480
+  NodeName             nodename/node_name.go:52-72
+  NodeUnschedulable    nodeunschedulable/node_unschedulable.go (as the
+                       synthetic unschedulable taint, see api.types.Node)
+  TaintToleration      tainttoleration/taint_toleration.go Filter
+  NodeAffinity         nodeaffinity/node_affinity.go Filter (required terms)
+  NodePorts            nodeports/node_ports.go Filter
+
+All functions are pure and jit/vmap/shard_map-friendly: no data-dependent
+shapes, node axis last so it shards cleanly over a device mesh.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .schema import (
+    OP_NEG,
+    OP_POS,
+    TOPO_ANY_VALUE,
+    ClusterTensors,
+    PodBatch,
+    PreferredTable,
+    SelectorTable,
+)
+
+_PAD_ID = -1  # empty id slot in expr_ids
+
+# Taint effect rows (schema.EFFECT_INDEX)
+_NO_SCHEDULE = 0
+_PREFER_NO_SCHEDULE = 1
+_NO_EXECUTE = 2
+
+
+class PodView(NamedTuple):
+    """One pod's slices out of a PodBatch (works under tracing)."""
+
+    valid: jnp.ndarray        # bool[]
+    req: jnp.ndarray          # f32[R]
+    nonzero_req: jnp.ndarray  # f32[R]
+    name_id: jnp.ndarray      # i32[]
+    sel_idx: jnp.ndarray      # i32[]
+    tol_bits: jnp.ndarray     # u32[3, TW]
+    tol_all: jnp.ndarray      # bool[3]
+    port_bits: jnp.ndarray    # u32[PW]
+    pref_idx: jnp.ndarray     # i32[MT]
+    pref_weight: jnp.ndarray  # f32[MT]
+
+
+def pod_view(pods: PodBatch, i) -> PodView:
+    return PodView(
+        valid=pods.valid[i],
+        req=pods.req[i],
+        nonzero_req=pods.nonzero_req[i],
+        name_id=pods.name_id[i],
+        sel_idx=pods.sel_idx[i],
+        tol_bits=pods.tol_bits[:, i, :],
+        tol_all=pods.tol_all[:, i],
+        port_bits=pods.port_bits[i],
+        pref_idx=pods.pref_idx[i],
+        pref_weight=pods.pref_weight[i],
+    )
+
+
+def _test_bits(label_bits: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+    """Presence of each id in each node's bitset.
+
+    label_bits: u32[N, W]; ids: i32[...]; returns bool[N, ...].
+    """
+    w = label_bits.shape[-1]
+    word = jnp.clip(ids >> 5, 0, w - 1)
+    bit = (ids & 31).astype(jnp.uint32)
+    words = label_bits[:, word]                       # u32[N, ...]
+    present = (words >> bit) & jnp.uint32(1)
+    return (present != 0) & (ids >= 0)
+
+
+def match_terms(
+    cluster: ClusterTensors,
+    expr_ids: jnp.ndarray,
+    expr_op: jnp.ndarray,
+    expr_slot: jnp.ndarray,
+) -> jnp.ndarray:
+    """AND-of-expressions term matching.
+
+    expr_ids: i32[..., E, K], expr_op/expr_slot: i32[..., E] ->
+    bool[..., N] with the node axis appended last.  Implements label-set
+    requirement semantics (apimachinery/pkg/labels/selector.go
+    Requirement.Matches): OP_POS is satisfied when any expanded id is
+    present, OP_NEG when none is — which makes NotIn/DoesNotExist match
+    key-absent nodes for free.
+
+    Two id domains per expression (schema.DOMAIN_LABELS): the shared label
+    bitset, or one topology slot of topo_ids (hostname/zone/region), where
+    presence is value-id equality and TOPO_ANY_VALUE means 'key present'.
+    """
+    n = cluster.label_bits.shape[0]
+    tk = cluster.topo_ids.shape[1]
+
+    in_labels = _test_bits(cluster.label_bits, expr_ids)     # bool[N, ..., E, K]
+
+    if tk > 0:
+        slot = jnp.clip(expr_slot, 0, tk - 1)                # i32[..., E]
+        topo_val = cluster.topo_ids[:, slot]                 # i32[N, ..., E]
+        ids = expr_ids                                       # i32[..., E, K]
+        in_topo = (topo_val[..., None] == ids) | (
+            (ids == TOPO_ANY_VALUE) & (topo_val[..., None] >= 0)
+        )
+        in_topo = in_topo & (ids != _PAD_ID)
+        present = jnp.where(
+            (expr_slot >= 0)[..., None], in_topo, in_labels
+        )                                                    # bool[N, ..., E, K]
+    else:
+        present = in_labels
+    any_present = present.any(axis=-1)                       # bool[N, ..., E]
+    op = jnp.broadcast_to(expr_op, any_present.shape)
+    sat = jnp.where(
+        op == OP_POS, any_present, jnp.where(op == OP_NEG, ~any_present, True)
+    )
+    all_sat = sat.all(axis=-1)                               # bool[N, ...]
+    return jnp.moveaxis(all_sat, 0, -1)                      # bool[..., N]
+
+
+def selector_match(cluster: ClusterTensors, sel: SelectorTable) -> jnp.ndarray:
+    """Match mask for every distinct required selector: bool[S, N].
+
+    Terms are ORed (v1.NodeSelector semantics).  Computed once per batch —
+    the payoff of deduplicating selectors in the SnapshotBuilder.
+    """
+    term_ok = match_terms(cluster, sel.expr_ids, sel.expr_op, sel.expr_slot)  # [S, T, N]
+    return (term_ok & sel.term_valid[:, :, None]).any(axis=1)                 # [S, N]
+
+
+def preferred_match(cluster: ClusterTensors, pref: PreferredTable) -> jnp.ndarray:
+    """Match mask for every distinct preferred term: bool[F, N]."""
+    ok = match_terms(cluster, pref.expr_ids, pref.expr_op, pref.expr_slot)    # [F, N]
+    return ok & pref.valid[:, None]
+
+
+def feasible_for_pod(
+    cluster: ClusterTensors, pod: PodView, sel_match: jnp.ndarray
+) -> jnp.ndarray:
+    """The fused Filter chain for one pod against every node: bool[N].
+
+    sel_match is the precomputed [S, N] selector mask from selector_match().
+    """
+    n = cluster.allocatable.shape[0]
+
+    # NodeResourcesFit: requested + pod <= allocatable, but only for
+    # resources the pod actually requests (fit.go:430-470 skips
+    # podRequest == 0; the pods-count row is always 1 so the per-pod
+    # capacity check rides the same comparison).
+    fits = (
+        (pod.req[None, :] <= 0)
+        | (cluster.requested + pod.req[None, :] <= cluster.allocatable)
+    ).all(axis=-1)
+
+    # NodeName
+    name_ok = (pod.name_id == -1) | (cluster.name_id == pod.name_id)
+
+    # TaintToleration over NoSchedule / NoExecute (PreferNoSchedule only
+    # affects scoring).  Untolerated taint present => infeasible.
+    def effect_ok(e: int) -> jnp.ndarray:
+        untolerated = (
+            cluster.taint_bits[e] & ~pod.tol_bits[e][None, :]
+        ).any(axis=-1)
+        return pod.tol_all[e] | ~untolerated
+
+    taints_ok = effect_ok(_NO_SCHEDULE) & effect_ok(_NO_EXECUTE)
+
+    # NodePorts: claimed host ports must be free.
+    ports_ok = ~((cluster.port_bits & pod.port_bits[None, :]).any(axis=-1))
+
+    # NodeAffinity / nodeSelector
+    sel_ok = jnp.where(
+        pod.sel_idx < 0,
+        jnp.ones(n, dtype=bool),
+        sel_match[jnp.clip(pod.sel_idx, 0, sel_match.shape[0] - 1)],
+    )
+
+    return (
+        cluster.node_valid
+        & pod.valid
+        & fits
+        & name_ok
+        & taints_ok
+        & ports_ok
+        & sel_ok
+    )
+
+
+def feasible_batch(
+    cluster: ClusterTensors,
+    pods: PodBatch,
+    sel: SelectorTable,
+) -> jnp.ndarray:
+    """Filter the whole batch at once: bool[P, N].
+
+    This is the embarrassingly-parallel variant (no inter-pod interaction);
+    the greedy solve in ops.assign re-evaluates per step instead, because
+    placements change `requested`.
+    """
+    cluster, pods, sel = jax.tree.map(jnp.asarray, (cluster, pods, sel))
+    sm = selector_match(cluster, sel)
+    p = pods.req.shape[0]
+
+    def one(i):
+        return feasible_for_pod(cluster, pod_view(pods, i), sm)
+
+    return jax.vmap(one)(jnp.arange(p))

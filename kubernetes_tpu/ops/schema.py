@@ -1,0 +1,592 @@
+"""Dense tensor schema for cluster state + the snapshot builder.
+
+This is the tensorization of the reference scheduler's per-node bookkeeping
+(`framework.NodeInfo`, pkg/scheduler/framework/types.go:542-602) and of the
+per-pod scheduling spec.  Everything the Filter/Score kernels consume lives
+in statically-shaped arrays:
+
+  ClusterTensors   one row per node: resource vectors + packed bitsets
+  PodBatch         one row per pending pod
+  SelectorTable    deduplicated required-node-affinity selectors (pods in a
+                   real batch overwhelmingly share selectors — a Deployment's
+                   pods are identical — so match masks are computed once per
+                   distinct selector, [S, N], then gathered per pod)
+  PreferredTable   deduplicated preferred scheduling terms for scoring
+
+String state (labels, taints, ports, names, topology values) is interned
+exactly via vocabularies (kubernetes_tpu.utils.vocab) and represented as
+uint32 bitsets; selector expressions are expanded host-side into explicit
+id sets, turning all matching on device into bit tests.  `Exists`/`NotIn`
+operators expand against the *current* vocabulary, which is why pod-side
+tables are rebuilt per batch while node-side bitsets persist.
+
+Shapes are padded to power-of-two buckets (utils.vocab.pad_dim) so repeated
+solves at similar scale hit the XLA compile cache.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import types as api
+from ..utils import vocab as vb
+
+# Resource axis layout: fixed head + discovered scalar resources.
+RESOURCE_CPU = 0          # milli-cores
+RESOURCE_MEMORY = 1       # bytes
+RESOURCE_EPH = 2          # bytes
+RESOURCE_PODS = 3         # pod-count capacity (AllowedPodNumber in the
+                          # reference's Resource struct, types.go:593-602)
+FIXED_RESOURCES = (api.CPU, api.MEMORY, api.EPHEMERAL_STORAGE, api.PODS)
+
+# Taint-effect axis
+EFFECT_INDEX = {api.NO_SCHEDULE: 0, api.PREFER_NO_SCHEDULE: 1, api.NO_EXECUTE: 2}
+
+# Device resource units.  Byte-denominated resources are carried in MiB so
+# every realistic quantity (and the products `quantity * 100` the scorers
+# form) stays inside float32's exact-integer range (2^24): 64 GiB -> 65536.
+# cpu stays in milli-cores, counts stay counts.  This keeps the f32 score
+# kernels bit-faithful to the reference's int64 math for MiB-aligned
+# requests, which is what real specs use.
+DEVICE_UNIT_DIVISOR = {api.MEMORY: 1 << 20, api.EPHEMERAL_STORAGE: 1 << 20}
+
+# Selector expression ops on device
+OP_PAD = 0   # slot unused: contributes True
+OP_POS = 1   # satisfied iff any listed id present on the node
+OP_NEG = 2   # satisfied iff no listed id present on the node
+
+# Expression domains.  Labels that are unique-per-node (hostname) or
+# enumerable-per-key (zone, region) live in topo_ids[N, TK] as dense value
+# ids rather than in the shared label bitset — a 50k-node cluster would
+# otherwise need 50k bits of hostname vocabulary on every node.  Selector
+# expressions over those keys evaluate against the topo slot; everything
+# else evaluates against the label bitset.
+DOMAIN_LABELS = -1          # expr_slot value meaning "label bitset domain"
+TOPO_ANY_VALUE = -2         # id meaning "key present with any value" (Exists)
+
+
+class ClusterTensors(NamedTuple):
+    """Per-node state. N = padded node count, R = resource axis,
+    LW/TW/PW = label/taint/port bitset words, TK = tracked topology keys."""
+
+    allocatable: np.ndarray        # f32[N, R]
+    requested: np.ndarray          # f32[N, R]   actual requests (BalancedAllocation)
+    nonzero_requested: np.ndarray  # f32[N, R]   with scoring defaults (LeastAllocated)
+    node_valid: np.ndarray         # bool[N]
+    name_id: np.ndarray            # i32[N]
+    label_bits: np.ndarray         # u32[N, LW]
+    taint_bits: np.ndarray         # u32[3, N, TW]  effect-major
+    port_bits: np.ndarray          # u32[N, PW]
+    topo_ids: np.ndarray           # i32[N, TK]  per-key value id, -1 absent
+
+
+class SelectorTable(NamedTuple):
+    """S distinct required-node selectors in OR-of-AND form."""
+
+    expr_ids: np.ndarray   # i32[S, T, E, K]  expanded ids, -1 pad
+    expr_op: np.ndarray    # i32[S, T, E]     OP_PAD/OP_POS/OP_NEG
+    expr_slot: np.ndarray  # i32[S, T, E]     DOMAIN_LABELS or topo slot
+    term_valid: np.ndarray  # bool[S, T]
+
+
+class PreferredTable(NamedTuple):
+    """F distinct preferred NodeSelectorTerms (AND of expressions)."""
+
+    expr_ids: np.ndarray   # i32[F, E, K]
+    expr_op: np.ndarray    # i32[F, E]
+    expr_slot: np.ndarray  # i32[F, E]
+    valid: np.ndarray      # bool[F]
+
+
+class PodBatch(NamedTuple):
+    """Per-pending-pod state. P = padded batch size, MT = preferred slots."""
+
+    valid: np.ndarray        # bool[P]
+    req: np.ndarray          # f32[P, R]
+    nonzero_req: np.ndarray  # f32[P, R]
+    name_id: np.ndarray      # i32[P]  -1 none, -2 names an unknown node
+    sel_idx: np.ndarray      # i32[P]  -1 no required selector
+    tol_bits: np.ndarray     # u32[3, P, TW]
+    tol_all: np.ndarray      # bool[3, P]
+    port_bits: np.ndarray    # u32[P, PW]
+    pref_idx: np.ndarray     # i32[P, MT]  rows of PreferredTable, -1 pad
+    pref_weight: np.ndarray  # f32[P, MT]
+
+
+class Snapshot(NamedTuple):
+    cluster: ClusterTensors
+    pods: PodBatch
+    selectors: SelectorTable
+    preferred: PreferredTable
+
+
+@dataclass
+class SnapshotLimits:
+    """Static capacities.  All are *caps*, checked at encode time with a
+    clear OverflowError; raise them (new executable) when a workload
+    exceeds them."""
+
+    max_terms: int = 4          # T: NodeSelectorTerms per selector
+    max_exprs: int = 8          # E: expressions per term (incl. node_selector)
+    max_ids_per_expr: int = 16  # K: expanded ids per expression
+    max_preferred: int = 4      # MT: preferred terms per pod
+    label_capacity: int = 4096
+    taint_capacity: int = 256
+    port_capacity: int = 2048
+    topology_keys: Tuple[str, ...] = (api.LABEL_HOSTNAME, api.LABEL_ZONE, api.LABEL_REGION)
+    min_nodes: int = 8
+    min_pods: int = 8
+
+    @property
+    def label_words(self) -> int:
+        return vb.words_for(self.label_capacity)
+
+    @property
+    def taint_words(self) -> int:
+        return vb.words_for(self.taint_capacity)
+
+    @property
+    def port_words(self) -> int:
+        return vb.words_for(self.port_capacity)
+
+
+@dataclass
+class SnapshotMeta:
+    """Host-side sidecar of a Snapshot: real counts and decode tables."""
+
+    num_nodes: int
+    num_pods: int
+    node_names: List[str]
+    resource_names: List[str]
+    limits: SnapshotLimits
+
+    def node_name(self, idx: int) -> Optional[str]:
+        if 0 <= idx < self.num_nodes:
+            return self.node_names[idx]
+        return None
+
+
+class SnapshotBuilder:
+    """Encodes api.Node / api.Pod objects into Snapshot tensors.
+
+    Vocabularies are append-only and owned by the builder, so successive
+    snapshots from the same builder keep node bitsets comparable (the
+    incremental analogue of the reference cache's generation-tracked
+    UpdateSnapshot, pkg/scheduler/internal/cache/cache.go:185).
+    """
+
+    def __init__(self, limits: Optional[SnapshotLimits] = None):
+        self.limits = limits or SnapshotLimits()
+        self.label_vocab = vb.PairVocab()
+        self.taint_vocab = vb.PairVocab()
+        self.port_vocab = vb.Vocab()
+        self.name_vocab = vb.Vocab()
+        self.topo_vocabs: Dict[str, vb.Vocab] = {
+            k: vb.Vocab() for k in self.limits.topology_keys
+        }
+        self.scalar_resources: List[str] = []
+        self._scalar_index: Dict[str, int] = {}
+
+    # -- resource axis ----------------------------------------------------
+
+    @property
+    def resource_names(self) -> List[str]:
+        return list(FIXED_RESOURCES) + self.scalar_resources
+
+    def _resource_index(self, name: str, grow: bool) -> Optional[int]:
+        try:
+            return FIXED_RESOURCES.index(name)
+        except ValueError:
+            pass
+        idx = self._scalar_index.get(name)
+        if idx is None and grow:
+            idx = len(FIXED_RESOURCES) + len(self.scalar_resources)
+            self._scalar_index[name] = idx
+            self.scalar_resources.append(name)
+        return idx
+
+    def _resource_vector(self, requests: Dict[str, int], r: int, grow: bool = True) -> np.ndarray:
+        out = np.zeros(r, dtype=np.float32)
+        for name, val in requests.items():
+            idx = self._resource_index(name, grow)
+            if idx is not None and idx < r:
+                out[idx] = float(val) / DEVICE_UNIT_DIVISOR.get(name, 1)
+        return out
+
+    # -- vocab interning ---------------------------------------------------
+
+    def _intern_node_strings(self, nodes: Sequence[api.Node]) -> None:
+        topo = self.topo_vocabs
+        for node in nodes:
+            self.name_vocab.intern(node.meta.name)
+            for k, v in node.meta.labels.items():
+                if k in topo:
+                    topo[k].intern(v)
+                else:
+                    self.label_vocab.intern((k, v))
+            for t in node.effective_taints():
+                self.taint_vocab.intern((t.key, t.value))
+
+    # -- selector expansion ------------------------------------------------
+
+    def _expand_requirement(self, r: api.Requirement) -> Tuple[int, int, List[int]]:
+        """Return (op, domain slot, expanded ids).  Expansion is exact
+        against the current vocabulary: a value no node carries simply
+        yields no id, which under OP_POS means 'matches nowhere' — precisely
+        the reference semantics of an In clause naming an absent value.
+
+        Expressions over topology keys evaluate against topo_ids[:, slot]
+        (see DOMAIN_LABELS); everything else against the label bitset."""
+        try:
+            slot = self.limits.topology_keys.index(r.key)
+            voc = self.topo_vocabs[r.key]
+
+            def lookup(v: str) -> int:
+                return voc.get(v)
+
+            def all_ids() -> List[int]:
+                return [TOPO_ANY_VALUE]
+
+            def value_of(i: int) -> str:
+                return voc.item(i)
+
+            id_range = range(len(voc))
+        except ValueError:
+            slot = DOMAIN_LABELS
+            voc = None
+
+            def lookup(v: str) -> int:
+                return self.label_vocab.get((r.key, v))
+
+            def all_ids() -> List[int]:
+                return self.label_vocab.ids_for_key(r.key)
+
+            def value_of(i: int) -> str:
+                return self.label_vocab.item(i)[1]
+
+            id_range = self.label_vocab.ids_for_key(r.key)
+
+        if r.op == api.OP_IN:
+            ids = [lookup(v) for v in r.values]
+            return OP_POS, slot, [i for i in ids if i >= 0]
+        if r.op == api.OP_NOT_IN:
+            ids = [lookup(v) for v in r.values]
+            return OP_NEG, slot, [i for i in ids if i >= 0]
+        if r.op == api.OP_EXISTS:
+            return OP_POS, slot, all_ids()
+        if r.op == api.OP_DOES_NOT_EXIST:
+            return OP_NEG, slot, all_ids()
+        if r.op in (api.OP_GT, api.OP_LT):
+            # Gt/Lt compare integer label values; expand exactly against the
+            # known value set for the key (the vocab holds every value
+            # present in the cluster, so this stays exact).  An unparseable
+            # bound means the requirement matches nothing (not an encode
+            # failure — one malformed spec must not sink the whole batch).
+            ids: List[int] = []
+            try:
+                bound = int(r.values[0]) if r.values else None
+            except ValueError:
+                bound = None
+            if bound is None:
+                return OP_POS, slot, ids
+            for i in id_range:
+                try:
+                    num = int(value_of(i))
+                except ValueError:
+                    continue
+                if (r.op == api.OP_GT and num > bound) or (r.op == api.OP_LT and num < bound):
+                    ids.append(i)
+            return OP_POS, slot, ids
+        raise ValueError(f"unsupported selector operator {r.op}")
+
+    def _encode_term(
+        self, exprs: Sequence[api.Requirement], e_cap: int, k_cap: int
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        if len(exprs) > e_cap:
+            raise OverflowError(
+                f"{len(exprs)} expressions in one term exceed max_exprs={e_cap}"
+            )
+        ids = np.full((e_cap, k_cap), -1, dtype=np.int32)
+        ops = np.zeros(e_cap, dtype=np.int32)
+        slots = np.full(e_cap, DOMAIN_LABELS, dtype=np.int32)
+        for j, r in enumerate(exprs):
+            op, slot, expanded = self._expand_requirement(r)
+            ops[j] = op
+            slots[j] = slot
+            ids[j] = vb.pad_ids(expanded, k_cap)
+        return ids, ops, slots
+
+    # -- pod pieces --------------------------------------------------------
+
+    def _encode_tolerations(
+        self, tols: Sequence[api.Toleration]
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Expand tolerations into per-effect tolerated-taint bitsets.
+        Matching semantics follow v1.Toleration.ToleratesTaint
+        (api/core/v1/toleration.go): empty effect spans all effects, empty
+        key + Exists tolerates everything, Exists-with-key tolerates every
+        value of the key."""
+        lim = self.limits
+        bits = np.zeros((3, lim.taint_words), dtype=np.uint32)
+        tol_all = np.zeros(3, dtype=bool)
+        for t in tols:
+            effects = range(3) if not t.effect else [EFFECT_INDEX[t.effect]]
+            if not t.key:
+                if t.op == api.OP_EXISTS:
+                    for e in effects:
+                        tol_all[e] = True
+                continue
+            if t.op == api.OP_EXISTS:
+                ids = self.taint_vocab.ids_for_key(t.key)
+            else:
+                i = self.taint_vocab.get((t.key, t.value))
+                ids = [i] if i >= 0 else []
+            for e in effects:
+                for i in ids:
+                    vb.set_bit(bits[e], i)
+        return bits, tol_all
+
+    def _encode_ports(self, ports: Sequence[Tuple[str, str, int]]) -> np.ndarray:
+        """Intern (protocol, port) claims.  Host-IP specificity is folded to
+        the wildcard (conservative: two pods claiming the same port on
+        *different* specific IPs are treated as conflicting; the reference's
+        exact rule is nodeports/node_ports.go:130-150).  Exact-IP support
+        rides the host-side fallback once needed."""
+        bits = np.zeros(self.limits.port_words, dtype=np.uint32)
+        for proto, _ip, port in ports:
+            vb.set_bit(bits, self.port_vocab.intern((proto, port)))
+        return bits
+
+    # -- build -------------------------------------------------------------
+
+    def build(
+        self,
+        nodes: Sequence[api.Node],
+        pending_pods: Sequence[api.Pod],
+        bound_pods: Sequence[api.Pod] = (),
+        num_nodes_hint: int = 0,
+        num_pods_hint: int = 0,
+    ) -> Tuple[Snapshot, SnapshotMeta]:
+        lim = self.limits
+
+        # Interning order matters: node strings first, so pod-side
+        # Exists/NotIn expansions and toleration expansions see every pair
+        # present in the cluster.
+        self._intern_node_strings(nodes)
+        for p in bound_pods:
+            self._resource_vector(p.resource_requests(), 0, grow=True)
+        for p in pending_pods:
+            self._resource_vector(p.resource_requests(), 0, grow=True)
+
+        r = len(self.resource_names)
+        n = vb.pad_dim(max(len(nodes), num_nodes_hint), lim.min_nodes)
+        p_dim = vb.pad_dim(max(len(pending_pods), num_pods_hint), lim.min_pods)
+
+        cluster = self._build_cluster(nodes, bound_pods, n, r)
+        pods, sel, pref = self._build_pods(pending_pods, p_dim, r)
+        meta = SnapshotMeta(
+            num_nodes=len(nodes),
+            num_pods=len(pending_pods),
+            node_names=[nd.meta.name for nd in nodes],
+            resource_names=self.resource_names,
+            limits=lim,
+        )
+        return Snapshot(cluster, pods, sel, pref), meta
+
+    def _build_cluster(
+        self, nodes: Sequence[api.Node], bound_pods: Sequence[api.Pod], n: int, r: int
+    ) -> ClusterTensors:
+        lim = self.limits
+        alloc = np.zeros((n, r), dtype=np.float32)
+        requested = np.zeros((n, r), dtype=np.float32)
+        nonzero = np.zeros((n, r), dtype=np.float32)
+        valid = np.zeros(n, dtype=bool)
+        name_id = np.full(n, -1, dtype=np.int32)
+        label_bits = np.zeros((n, lim.label_words), dtype=np.uint32)
+        taint_bits = np.zeros((3, n, lim.taint_words), dtype=np.uint32)
+        port_bits = np.zeros((n, lim.port_words), dtype=np.uint32)
+        topo_ids = np.full((n, len(lim.topology_keys)), -1, dtype=np.int32)
+
+        index_by_name: Dict[str, int] = {}
+        for i, node in enumerate(nodes):
+            valid[i] = True
+            index_by_name[node.meta.name] = i
+            name_id[i] = self.name_vocab.get(node.meta.name)
+            alloc[i] = self._resource_vector(node.status.allocatable, r, grow=False)
+            for k, v in node.meta.labels.items():
+                if k in self.topo_vocabs:
+                    continue
+                vb.set_bit(label_bits[i], self.label_vocab.get((k, v)))
+            for t in node.effective_taints():
+                vb.set_bit(taint_bits[EFFECT_INDEX[t.effect], i], self.taint_vocab.get((t.key, t.value)))
+            for j, key in enumerate(lim.topology_keys):
+                val = node.meta.labels.get(key)
+                if val is not None:
+                    topo_ids[i, j] = self.topo_vocabs[key].get(val)
+
+        for pod in bound_pods:
+            i = index_by_name.get(pod.spec.node_name)
+            if i is None:
+                continue
+            req = self._resource_vector(pod.resource_requests(), r, grow=False)
+            req[RESOURCE_PODS] = 1.0
+            requested[i] += req
+            nz = req.copy()
+            nz_cpu, nz_mem = pod.nonzero_requests()
+            nz[RESOURCE_CPU] = nz_cpu
+            nz[RESOURCE_MEMORY] = nz_mem / DEVICE_UNIT_DIVISOR[api.MEMORY]
+            nonzero[i] += nz
+            port_bits[i] |= self._encode_ports(pod.host_ports())
+
+        return ClusterTensors(
+            allocatable=alloc,
+            requested=requested,
+            nonzero_requested=nonzero,
+            node_valid=valid,
+            name_id=name_id,
+            label_bits=label_bits,
+            taint_bits=taint_bits,
+            port_bits=port_bits,
+            topo_ids=topo_ids,
+        )
+
+    def _build_pods(
+        self, pods: Sequence[api.Pod], p_dim: int, r: int
+    ) -> Tuple[PodBatch, SelectorTable, PreferredTable]:
+        lim = self.limits
+        t_cap, e_cap, k_cap, mt = (
+            lim.max_terms, lim.max_exprs, lim.max_ids_per_expr, lim.max_preferred,
+        )
+
+        req = np.zeros((p_dim, r), dtype=np.float32)
+        nonzero = np.zeros((p_dim, r), dtype=np.float32)
+        valid = np.zeros(p_dim, dtype=bool)
+        name_id = np.full(p_dim, -1, dtype=np.int32)
+        sel_idx = np.full(p_dim, -1, dtype=np.int32)
+        tol_bits = np.zeros((3, p_dim, lim.taint_words), dtype=np.uint32)
+        tol_all = np.zeros((3, p_dim), dtype=bool)
+        port_bits = np.zeros((p_dim, lim.port_words), dtype=np.uint32)
+        pref_idx = np.full((p_dim, mt), -1, dtype=np.int32)
+        pref_weight = np.zeros((p_dim, mt), dtype=np.float32)
+
+        # Dedup tables keyed by canonical signatures.
+        sel_rows: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        sel_index: Dict[tuple, int] = {}
+        pref_rows: List[Tuple[np.ndarray, np.ndarray]] = []
+        pref_index: Dict[tuple, int] = {}
+
+        for i, pod in enumerate(pods):
+            valid[i] = True
+            rv = self._resource_vector(pod.resource_requests(), r, grow=False)
+            rv[RESOURCE_PODS] = 1.0
+            req[i] = rv
+            nz = rv.copy()
+            nz_cpu, nz_mem = pod.nonzero_requests()
+            nz[RESOURCE_CPU] = nz_cpu
+            nz[RESOURCE_MEMORY] = nz_mem / DEVICE_UNIT_DIVISOR[api.MEMORY]
+            nonzero[i] = nz
+
+            if pod.spec.node_name:
+                nid = self.name_vocab.get(pod.spec.node_name)
+                name_id[i] = nid if nid >= 0 else -2
+
+            selector = pod.required_node_selector()
+            if selector is not None:
+                sig = _selector_signature(selector)
+                idx = sel_index.get(sig)
+                if idx is None:
+                    idx = len(sel_rows)
+                    sel_index[sig] = idx
+                    sel_rows.append(self._encode_selector(selector, t_cap, e_cap, k_cap))
+                sel_idx[i] = idx
+
+            bits, tall = self._encode_tolerations(pod.spec.tolerations)
+            tol_bits[:, i, :] = bits
+            tol_all[:, i] = tall
+            port_bits[i] = self._encode_ports(pod.host_ports())
+
+            preferred = pod.preferred_node_affinity()
+            if len(preferred) > mt:
+                raise OverflowError(
+                    f"{len(preferred)} preferred terms exceed max_preferred={mt}"
+                )
+            for j, pt in enumerate(preferred):
+                sig = _term_signature(pt.preference)
+                idx = pref_index.get(sig)
+                if idx is None:
+                    idx = len(pref_rows)
+                    pref_index[sig] = idx
+                    pref_rows.append(
+                        self._encode_term(pt.preference.match_expressions, e_cap, k_cap)
+                    )
+                pref_idx[i, j] = idx
+                pref_weight[i, j] = float(pt.weight)
+
+        s_dim = vb.pad_dim(len(sel_rows), 1)
+        sel = SelectorTable(
+            expr_ids=np.full((s_dim, t_cap, e_cap, k_cap), -1, dtype=np.int32),
+            expr_op=np.zeros((s_dim, t_cap, e_cap), dtype=np.int32),
+            expr_slot=np.full((s_dim, t_cap, e_cap), DOMAIN_LABELS, dtype=np.int32),
+            term_valid=np.zeros((s_dim, t_cap), dtype=bool),
+        )
+        for s, (ids, ops, slots, tv) in enumerate(sel_rows):
+            sel.expr_ids[s] = ids
+            sel.expr_op[s] = ops
+            sel.expr_slot[s] = slots
+            sel.term_valid[s] = tv
+
+        f_dim = vb.pad_dim(len(pref_rows), 1)
+        pref = PreferredTable(
+            expr_ids=np.full((f_dim, e_cap, k_cap), -1, dtype=np.int32),
+            expr_op=np.zeros((f_dim, e_cap), dtype=np.int32),
+            expr_slot=np.full((f_dim, e_cap), DOMAIN_LABELS, dtype=np.int32),
+            valid=np.zeros(f_dim, dtype=bool),
+        )
+        for f, (ids, ops, slots) in enumerate(pref_rows):
+            pref.expr_ids[f] = ids
+            pref.expr_op[f] = ops
+            pref.expr_slot[f] = slots
+            pref.valid[f] = True
+
+        batch = PodBatch(
+            valid=valid,
+            req=req,
+            nonzero_req=nonzero,
+            name_id=name_id,
+            sel_idx=sel_idx,
+            tol_bits=tol_bits,
+            tol_all=tol_all,
+            port_bits=port_bits,
+            pref_idx=pref_idx,
+            pref_weight=pref_weight,
+        )
+        return batch, sel, pref
+
+    def _encode_selector(
+        self, selector: api.NodeSelector, t_cap: int, e_cap: int, k_cap: int
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        if len(selector.terms) > t_cap:
+            raise OverflowError(
+                f"{len(selector.terms)} selector terms exceed max_terms={t_cap}"
+            )
+        ids = np.full((t_cap, e_cap, k_cap), -1, dtype=np.int32)
+        ops = np.zeros((t_cap, e_cap), dtype=np.int32)
+        slots = np.full((t_cap, e_cap), DOMAIN_LABELS, dtype=np.int32)
+        term_valid = np.zeros(t_cap, dtype=bool)
+        for t, term in enumerate(selector.terms):
+            term_valid[t] = True
+            ids[t], ops[t], slots[t] = self._encode_term(term.match_expressions, e_cap, k_cap)
+        return ids, ops, slots, term_valid
+
+
+def _term_signature(term: api.NodeSelectorTerm) -> tuple:
+    return tuple(
+        (r.key, r.op, tuple(sorted(r.values))) for r in term.match_expressions
+    )
+
+
+def _selector_signature(sel: api.NodeSelector) -> tuple:
+    return tuple(_term_signature(t) for t in sel.terms)

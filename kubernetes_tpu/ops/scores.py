@@ -1,0 +1,196 @@
+"""Score kernels — the Score extension point as one weighted-sum pass.
+
+Replaces the reference's parallel per-node score + NormalizeScore + weight
+application (pkg/scheduler/framework/runtime/framework.go:1090-1180) with
+closed-form vector math over the node axis.  Implemented scorers:
+
+  NodeResourcesFit/LeastAllocated   least_allocated.go:30-61
+  NodeResourcesBalancedAllocation   balanced_allocation.go:138-176
+  NodeResourcesMostAllocated        most_allocated.go:30-53 (opt-in strategy)
+  NodeAffinity (preferred terms)    nodeaffinity/node_affinity.go Score
+  TaintToleration (PreferNoSchedule) tainttoleration/taint_toleration.go Score
+
+Go-side scorers run in int64 with truncating division; these kernels mimic
+that with float32 + floor, which is exact for the quantities the schema
+carries (see schema.DEVICE_UNIT_DIVISOR).  Normalization follows
+helper.DefaultNormalizeScore (plugins/helper/normalize_score.go): scale to
+[0,100] by the max over *feasible* nodes, optionally reversed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .filters import PodView, preferred_match
+from .schema import RESOURCE_CPU, RESOURCE_MEMORY, ClusterTensors, PreferredTable
+
+MAX_NODE_SCORE = 100.0
+_PREFER_NO_SCHEDULE = 1  # taint-effect row
+
+
+@dataclass(frozen=True)
+class ScoreConfig:
+    """Plugin weights (reference defaults:
+    apis/config/v1/default_plugins.go:38-50) and the resource sets the
+    allocation scorers consider (default cpu+memory, weight 1 each —
+    apis/config/v1/defaults.go defaultResourceSpec)."""
+
+    fit_weight: float = 1.0              # NodeResourcesFit
+    balanced_weight: float = 1.0         # NodeResourcesBalancedAllocation
+    node_affinity_weight: float = 2.0    # NodeAffinity
+    taint_weight: float = 3.0            # TaintToleration
+    spread_weight: float = 2.0           # PodTopologySpread (ops.topology)
+    interpod_weight: float = 2.0         # InterPodAffinity (ops.interpod)
+    # (resource_index, weight) pairs for Least/MostAllocated
+    fit_resources: Tuple[Tuple[int, float], ...] = (
+        (RESOURCE_CPU, 1.0),
+        (RESOURCE_MEMORY, 1.0),
+    )
+    # resource indices for BalancedAllocation
+    balanced_resources: Tuple[int, ...] = (RESOURCE_CPU, RESOURCE_MEMORY)
+    fit_strategy: str = "LeastAllocated"  # or "MostAllocated"
+
+
+DEFAULT_SCORE_CONFIG = ScoreConfig()
+
+
+def _floor(x: jnp.ndarray) -> jnp.ndarray:
+    """Go int64 division truncates; operands here are non-negative."""
+    return jnp.floor(x)
+
+
+def least_allocated(
+    cluster: ClusterTensors, pod: PodView, cfg: ScoreConfig
+) -> jnp.ndarray:
+    """score = sum_r w_r * floor((cap - req) * 100 / cap) / sum w, skipping
+    resources a node doesn't expose (allocable==0 skips the weight too —
+    least_allocated.go:34-37).  Uses NonZeroRequested."""
+    req = cluster.nonzero_requested + pod.nonzero_req[None, :]
+    cap = cluster.allocatable
+    total = jnp.zeros(cap.shape[0], dtype=jnp.float32)
+    wsum = jnp.zeros(cap.shape[0], dtype=jnp.float32)
+    for idx, weight in cfg.fit_resources:
+        c = cap[:, idx]
+        q = req[:, idx]
+        ok = c > 0
+        s = jnp.where(ok & (q <= c), _floor((c - q) * MAX_NODE_SCORE / jnp.maximum(c, 1.0)), 0.0)
+        total = total + weight * s * ok
+        wsum = wsum + weight * ok
+    return jnp.where(wsum > 0, _floor(total / jnp.maximum(wsum, 1.0)), 0.0)
+
+
+def most_allocated(
+    cluster: ClusterTensors, pod: PodView, cfg: ScoreConfig
+) -> jnp.ndarray:
+    """score = sum_r w_r * floor(req * 100 / cap) / sum w (most_allocated.go:30-53)."""
+    req = cluster.nonzero_requested + pod.nonzero_req[None, :]
+    cap = cluster.allocatable
+    total = jnp.zeros(cap.shape[0], dtype=jnp.float32)
+    wsum = jnp.zeros(cap.shape[0], dtype=jnp.float32)
+    for idx, weight in cfg.fit_resources:
+        c = cap[:, idx]
+        q = req[:, idx]
+        ok = c > 0
+        s = jnp.where(ok & (q <= c), _floor(q * MAX_NODE_SCORE / jnp.maximum(c, 1.0)), 0.0)
+        total = total + weight * s * ok
+        wsum = wsum + weight * ok
+    return jnp.where(wsum > 0, _floor(total / jnp.maximum(wsum, 1.0)), 0.0)
+
+
+def balanced_allocation(
+    cluster: ClusterTensors, pod: PodView, cfg: ScoreConfig
+) -> jnp.ndarray:
+    """score = floor((1 - std(fractions)) * 100) with fractions clamped to 1,
+    over resources with allocable > 0.  The reference's two-resource
+    |f1-f2|/2 shortcut equals the general population-std formula, so one
+    formula serves all arities (balanced_allocation.go:138-176).  Uses
+    actual Requested (useRequested=true, balanced_allocation.go:130)."""
+    req = cluster.requested + pod.req[None, :]
+    cap = cluster.allocatable
+    fracs = []
+    valids = []
+    for idx in cfg.balanced_resources:
+        c = cap[:, idx]
+        ok = c > 0
+        f = jnp.minimum(req[:, idx] / jnp.maximum(c, 1.0), 1.0)
+        fracs.append(jnp.where(ok, f, 0.0))
+        valids.append(ok)
+    f = jnp.stack(fracs, axis=-1)          # [N, B]
+    v = jnp.stack(valids, axis=-1)         # [N, B]
+    count = v.sum(axis=-1)
+    mean = f.sum(axis=-1) / jnp.maximum(count, 1)
+    var = (jnp.where(v, (f - mean[:, None]) ** 2, 0.0)).sum(axis=-1) / jnp.maximum(count, 1)
+    std = jnp.sqrt(var)
+    return _floor((1.0 - std) * MAX_NODE_SCORE)
+
+
+def node_affinity_raw(pod: PodView, pref_mask: jnp.ndarray) -> jnp.ndarray:
+    """Sum of weights of matching preferred terms (nodeaffinity Score).
+    pref_mask: bool[F, N] from filters.preferred_match."""
+    f = pref_mask.shape[0]
+    idx = jnp.clip(pod.pref_idx, 0, f - 1)               # [MT]
+    hit = pref_mask[idx]                                 # [MT, N]
+    w = jnp.where(pod.pref_idx >= 0, pod.pref_weight, 0.0)
+    return (w[:, None] * hit).sum(axis=0)                # [N]
+
+
+def taint_toleration_raw(cluster: ClusterTensors, pod: PodView) -> jnp.ndarray:
+    """Count of untolerated PreferNoSchedule taints per node
+    (tainttoleration countIntolerableTaintsPreferNoSchedule)."""
+    untol = cluster.taint_bits[_PREFER_NO_SCHEDULE] & ~pod.tol_bits[_PREFER_NO_SCHEDULE][None, :]
+    counts = jax.lax.population_count(untol).sum(axis=-1).astype(jnp.float32)
+    return jnp.where(pod.tol_all[_PREFER_NO_SCHEDULE], 0.0, counts)
+
+
+def normalize(
+    raw: jnp.ndarray,
+    feasible: jnp.ndarray,
+    reverse: bool = False,
+    axis_name: str | None = None,
+) -> jnp.ndarray:
+    """helper.DefaultNormalizeScore: scale by the max over feasible nodes to
+    [0,100] with truncating division; if the max is 0, scores become 0
+    (or 100 when reversed).  Under shard_map the max must span every node
+    shard — pass the mesh axis_name and it is pmax-reduced."""
+    m = jnp.max(jnp.where(feasible, raw, 0.0))
+    if axis_name is not None:
+        m = jax.lax.pmax(m, axis_name)
+    scaled = _floor(MAX_NODE_SCORE * raw / jnp.maximum(m, 1e-30))
+    out = jnp.where(m > 0, scaled, 0.0)
+    if reverse:
+        out = jnp.where(m > 0, MAX_NODE_SCORE - out, MAX_NODE_SCORE)
+    return out
+
+
+def score_for_pod(
+    cluster: ClusterTensors,
+    pod: PodView,
+    feasible: jnp.ndarray,
+    pref_mask: jnp.ndarray,
+    cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,
+    axis_name: str | None = None,
+) -> jnp.ndarray:
+    """Weighted plugin-score sum for one pod over all nodes: f32[N].
+    Infeasible nodes score -1 (callers mask again before argmax anyway).
+    axis_name: mesh axis to reduce normalization maxima over when the node
+    axis is sharded."""
+    if cfg.fit_strategy == "MostAllocated":
+        fit = most_allocated(cluster, pod, cfg)
+    else:
+        fit = least_allocated(cluster, pod, cfg)
+    bal = balanced_allocation(cluster, pod, cfg)
+    aff = normalize(node_affinity_raw(pod, pref_mask), feasible, axis_name=axis_name)
+    taint = normalize(
+        taint_toleration_raw(cluster, pod), feasible, reverse=True, axis_name=axis_name
+    )
+    total = (
+        cfg.fit_weight * fit
+        + cfg.balanced_weight * bal
+        + cfg.node_affinity_weight * aff
+        + cfg.taint_weight * taint
+    )
+    return jnp.where(feasible, total, -1.0)

@@ -1,0 +1,1 @@
+"""Multi-chip sharding of the batched solve over a jax.sharding.Mesh."""

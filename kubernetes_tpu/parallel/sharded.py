@@ -1,0 +1,145 @@
+"""Node-axis-sharded greedy solve: the multi-chip scheduling step.
+
+The reference scales its hot loop with 16 goroutines and adaptive node
+sampling (parallelize/parallelism.go, schedule_one.go:662); the TPU-native
+scale-out shards the *node axis* of every cluster tensor across a device
+mesh with shard_map.  Each chip filters and scores its node shard, reduces
+its local champion, and a pmax/pmin pair elects the global winner — the
+ring-reduction analogue sketched in SURVEY.md section 5.7.  The winning
+shard applies the assume-update locally; per-pod state (requested, ports)
+never leaves its shard, so per-step communication is O(1) scalars on ICI,
+independent of cluster size.
+
+Tie-break parity with the single-chip path: lowest node index among
+max-score nodes (argmax-first-index locally, pmin on the winner index
+globally).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.assign import NEG_INF, SolveResult
+from ..ops.filters import (
+    feasible_for_pod,
+    pod_view,
+    preferred_match,
+    selector_match,
+)
+from ..ops.schema import ClusterTensors, Snapshot
+from ..ops.scores import DEFAULT_SCORE_CONFIG, ScoreConfig, score_for_pod
+
+AXIS = "nodes"
+
+# PartitionSpec for each ClusterTensors field: node axis sharded, the rest
+# replicated.  taint_bits is effect-major so its node axis is dim 1.
+CLUSTER_SPECS = ClusterTensors(
+    allocatable=P(AXIS, None),
+    requested=P(AXIS, None),
+    nonzero_requested=P(AXIS, None),
+    node_valid=P(AXIS),
+    name_id=P(AXIS),
+    label_bits=P(AXIS, None),
+    taint_bits=P(None, AXIS, None),
+    port_bits=P(AXIS, None),
+    topo_ids=P(AXIS, None),
+)
+
+
+def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()[: n_devices or len(jax.devices())]
+    return Mesh(devices, (AXIS,))
+
+
+def sharded_greedy_assign(
+    snapshot: Snapshot,
+    mesh: Mesh,
+    cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,
+) -> SolveResult:
+    """greedy_assign with the node axis sharded over `mesh`.
+
+    Placement semantics are identical to ops.assign.greedy_assign; only the
+    data layout differs.  Requires the padded node count to be divisible by
+    the mesh size (SnapshotBuilder pads to powers of two, mesh sizes are
+    powers of two, so this holds by construction).
+    """
+    cluster, pods, sel, pref = jax.tree.map(jnp.asarray, tuple(snapshot))
+    n = cluster.allocatable.shape[0]
+    n_dev = mesh.devices.size
+    if n % n_dev:
+        raise ValueError(f"padded node count {n} not divisible by mesh size {n_dev}")
+    p = pods.req.shape[0]
+
+    rep = P()
+    in_specs = (
+        CLUSTER_SPECS,
+        jax.tree.map(lambda _: rep, pods),
+        jax.tree.map(lambda _: rep, sel),
+        jax.tree.map(lambda _: rep, pref),
+    )
+    out_specs = SolveResult(
+        assignment=rep, scores=rep, feasible_counts=rep, cluster=CLUSTER_SPECS
+    )
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    def run(cl: ClusterTensors, pods, sel, pref) -> SolveResult:
+        n_local = cl.allocatable.shape[0]
+        offset = jax.lax.axis_index(AXIS) * n_local
+        sel_mask = selector_match(cl, sel)
+        pref_mask = preferred_match(cl, pref)
+
+        def step(carry, i):
+            requested, nonzero, ports = carry
+            cur = cl._replace(
+                requested=requested, nonzero_requested=nonzero, port_bits=ports
+            )
+            pod = pod_view(pods, i)
+            feas = feasible_for_pod(cur, pod, sel_mask)
+            scores = score_for_pod(cur, pod, feas, pref_mask, cfg, axis_name=AXIS)
+            masked = jnp.where(feas, scores, NEG_INF)
+
+            # Local champion, then a 2-collective global election.
+            li = jnp.argmax(masked)
+            lv = masked[li]
+            gi = (offset + li).astype(jnp.int32)
+            best = jax.lax.pmax(lv, AXIS)
+            cand = jnp.where(lv == best, gi, jnp.int32(2**31 - 1))
+            winner = jax.lax.pmin(cand, AXIS)
+            found = best > NEG_INF
+            idx = jnp.where(found, winner, -1).astype(jnp.int32)
+
+            onehot = ((jnp.arange(n_local) + offset) == winner) & found
+            requested = requested + onehot[:, None] * pod.req[None, :]
+            nonzero = nonzero + onehot[:, None] * pod.nonzero_req[None, :]
+            ports = jnp.where(onehot[:, None], ports | pod.port_bits[None, :], ports)
+            n_feas = jax.lax.psum(feas.sum().astype(jnp.int32), AXIS)
+            return (requested, nonzero, ports), (idx, jnp.where(found, best, NEG_INF), n_feas)
+
+        init = (cl.requested, cl.nonzero_requested, cl.port_bits)
+        (requested, nonzero, ports), (assignment, win, nf) = jax.lax.scan(
+            step, init, jnp.arange(p)
+        )
+        final = cl._replace(requested=requested, nonzero_requested=nonzero, port_bits=ports)
+        return SolveResult(assignment, win, nf, final)
+
+    return run(cluster, pods, sel, pref)
+
+
+def sharded_greedy_jit(mesh: Mesh, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
+    @jax.jit
+    def solve(snapshot: Snapshot) -> SolveResult:
+        return sharded_greedy_assign(snapshot, mesh, cfg)
+
+    return solve

@@ -1,0 +1,1 @@
+"""Test kit: object builders (wrappers) and the pure-Python scheduling oracle."""

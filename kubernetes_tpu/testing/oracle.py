@@ -1,0 +1,189 @@
+"""Pure-Python scheduling oracle — an independent re-implementation of the
+reference's per-pod Filter/Score cycle used to validate the TPU kernels.
+
+Deliberately written the slow, obvious way (per-node Python loops over the
+api object model, no tensors, no shared code with ops/) so that a bug in
+the snapshot encoder or a kernel cannot cancel itself out in tests.
+Semantics follow the same reference code paths the kernels cite:
+
+  filter: noderesources/fit.go:421, nodename, tainttoleration,
+          nodeports (wildcard-IP simplification, same as the kernel),
+          nodeaffinity required terms
+  score:  least_allocated.go:30, balanced_allocation.go:138,
+          nodeaffinity preferred + DefaultNormalizeScore,
+          tainttoleration PreferNoSchedule count + reversed normalize
+  loop:   one pod at a time with assume between picks
+          (schedule_one.go:66-133), first-index tie-break.
+
+Resource quantities are converted to the same device units the schema uses
+(schema.DEVICE_UNIT_DIVISOR) so score floors land on identical integers.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..api import types as api
+from ..ops.schema import DEVICE_UNIT_DIVISOR
+
+MAX_SCORE = 100
+
+
+def _units(requests: Dict[str, int]) -> Dict[str, float]:
+    return {k: v / DEVICE_UNIT_DIVISOR.get(k, 1) for k, v in requests.items()}
+
+
+@dataclass
+class _NodeState:
+    node: api.Node
+    allocatable: Dict[str, float]
+    requested: Dict[str, float] = field(default_factory=dict)
+    nonzero_requested: Dict[str, float] = field(default_factory=dict)
+    used_ports: Set[Tuple[str, int]] = field(default_factory=set)
+
+    def add_pod(self, pod: api.Pod) -> None:
+        req = _units(pod.resource_requests())
+        req[api.PODS] = req.get(api.PODS, 0) + 1
+        for k, v in req.items():
+            self.requested[k] = self.requested.get(k, 0) + v
+        nz = dict(req)
+        nz_cpu, nz_mem = pod.nonzero_requests()
+        nz[api.CPU] = nz_cpu
+        nz[api.MEMORY] = nz_mem / DEVICE_UNIT_DIVISOR[api.MEMORY]
+        for k, v in nz.items():
+            self.nonzero_requested[k] = self.nonzero_requested.get(k, 0) + v
+        for proto, _ip, port in pod.host_ports():
+            self.used_ports.add((proto, port))
+
+
+class Oracle:
+    """Schedules pods one at a time with reference semantics."""
+
+    def __init__(
+        self,
+        nodes: Sequence[api.Node],
+        bound_pods: Sequence[api.Pod] = (),
+        fit_strategy: str = "LeastAllocated",
+    ):
+        self.states: List[_NodeState] = [
+            _NodeState(node=n, allocatable=_units(n.status.allocatable)) for n in nodes
+        ]
+        self.fit_strategy = fit_strategy
+        by_name = {s.node.meta.name: s for s in self.states}
+        for p in bound_pods:
+            st = by_name.get(p.spec.node_name)
+            if st is not None:
+                st.add_pod(p)
+
+    # -- filter ----------------------------------------------------------
+
+    def _feasible(self, pod: api.Pod, st: _NodeState) -> bool:
+        req = _units(pod.resource_requests())
+        req[api.PODS] = req.get(api.PODS, 0) + 1
+        for k, v in req.items():
+            if v == 0:
+                continue
+            if st.requested.get(k, 0) + v > st.allocatable.get(k, 0):
+                return False
+        if pod.spec.node_name and pod.spec.node_name != st.node.meta.name:
+            return False
+        for taint in st.node.effective_taints():
+            if taint.effect in (api.NO_SCHEDULE, api.NO_EXECUTE):
+                if not api.tolerations_tolerate_taint(pod.spec.tolerations, taint):
+                    return False
+        for proto, _ip, port in pod.host_ports():
+            if (proto, port) in st.used_ports:
+                return False
+        sel = pod.required_node_selector()
+        if sel is not None and not sel.matches(st.node.meta.labels):
+            return False
+        return True
+
+    # -- score -----------------------------------------------------------
+
+    def _fit_score(self, pod: api.Pod, st: _NodeState) -> int:
+        nz_cpu, nz_mem = pod.nonzero_requests()
+        pod_nz = {api.CPU: nz_cpu, api.MEMORY: nz_mem / DEVICE_UNIT_DIVISOR[api.MEMORY]}
+        total = wsum = 0
+        for res in (api.CPU, api.MEMORY):
+            cap = st.allocatable.get(res, 0)
+            if cap <= 0:
+                continue
+            q = st.nonzero_requested.get(res, 0) + pod_nz[res]
+            if self.fit_strategy == "MostAllocated":
+                s = math.floor(q * MAX_SCORE / cap) if q <= cap else 0
+            else:
+                s = math.floor((cap - q) * MAX_SCORE / cap) if q <= cap else 0
+            total += s
+            wsum += 1
+        return math.floor(total / wsum) if wsum else 0
+
+    def _balanced_score(self, pod: api.Pod, st: _NodeState) -> int:
+        req = _units(pod.resource_requests())
+        fracs = []
+        for res in (api.CPU, api.MEMORY):
+            cap = st.allocatable.get(res, 0)
+            if cap <= 0:
+                continue
+            f = (st.requested.get(res, 0) + req.get(res, 0)) / cap
+            fracs.append(min(f, 1.0))
+        if len(fracs) < 2:
+            std = 0.0
+        else:
+            mean = sum(fracs) / len(fracs)
+            std = math.sqrt(sum((f - mean) ** 2 for f in fracs) / len(fracs))
+        return math.floor((1 - std) * MAX_SCORE)
+
+    @staticmethod
+    def _affinity_raw(pod: api.Pod, st: _NodeState) -> int:
+        return sum(
+            t.weight
+            for t in pod.preferred_node_affinity()
+            if t.preference.matches(st.node.meta.labels)
+        )
+
+    @staticmethod
+    def _taint_raw(pod: api.Pod, st: _NodeState) -> int:
+        return sum(
+            1
+            for t in st.node.effective_taints()
+            if t.effect == api.PREFER_NO_SCHEDULE
+            and not api.tolerations_tolerate_taint(pod.spec.tolerations, t)
+        )
+
+    @staticmethod
+    def _normalize(raws: List[int], reverse: bool = False) -> List[int]:
+        m = max(raws) if raws else 0
+        if m == 0:
+            return [MAX_SCORE if reverse else 0 for _ in raws]
+        out = [math.floor(MAX_SCORE * r / m) for r in raws]
+        if reverse:
+            out = [MAX_SCORE - s for s in out]
+        return out
+
+    # -- cycle -----------------------------------------------------------
+
+    def schedule_one(self, pod: api.Pod) -> Optional[str]:
+        feasible = [(i, st) for i, st in enumerate(self.states) if self._feasible(pod, st)]
+        if not feasible:
+            return None
+        aff = self._normalize([self._affinity_raw(pod, st) for _, st in feasible])
+        taint = self._normalize([self._taint_raw(pod, st) for _, st in feasible], reverse=True)
+        best_i, best_score = None, None
+        for j, (i, st) in enumerate(feasible):
+            score = (
+                1 * self._fit_score(pod, st)
+                + 1 * self._balanced_score(pod, st)
+                + 2 * aff[j]
+                + 3 * taint[j]
+            )
+            if best_score is None or score > best_score:
+                best_i, best_score = i, score
+        st = self.states[best_i]
+        st.add_pod(pod)
+        return st.node.meta.name
+
+    def schedule(self, pods: Sequence[api.Pod]) -> List[Optional[str]]:
+        return [self.schedule_one(p) for p in pods]

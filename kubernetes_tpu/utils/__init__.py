@@ -1,0 +1,1 @@
+"""Cross-cutting utilities (vocab encoding, clocks, backoff)."""

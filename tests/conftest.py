@@ -1,0 +1,31 @@
+"""Test configuration.
+
+Forces JAX onto the host CPU platform with 8 virtual devices so
+sharding/collective tests exercise a multi-chip mesh without TPU hardware
+(the reference's analogue: integration tests create Nodes as API objects
+only — test/integration/util/util.go:86).
+
+Note: this image's sitecustomize imports jax at interpreter startup (for
+the axon TPU tunnel), so env vars alone are too late; the backend isn't
+initialized yet though, so jax.config still wins.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,129 @@
+"""Placement parity: greedy_assign (TPU kernels) vs the pure-Python oracle.
+
+This is the round-1 "minimum end-to-end slice" acceptance test from
+SURVEY.md section 7: identical placements to a reference-semantics oracle
+across randomized and structured workloads.
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.ops import assign, schema
+from kubernetes_tpu.testing.oracle import Oracle
+from kubernetes_tpu.testing.wrappers import GI, MI, make_node, make_pod
+
+
+def run_both(nodes, pods, bound=()):
+    snap, meta = schema.SnapshotBuilder().build(nodes, pods, bound_pods=bound)
+    result = assign.greedy_assign_jit()(snap)
+    got = [meta.node_name(int(i)) for i in np.asarray(result.assignment)[: len(pods)]]
+    want = Oracle(nodes, bound_pods=bound).schedule(pods)
+    return got, want
+
+
+def test_basic_binpack_parity():
+    nodes = [
+        make_node(f"n{i}").capacity(cpu_milli=4000, mem=8 * GI, pods=110).obj()
+        for i in range(8)
+    ]
+    pods = [make_pod(f"p{i}").req(cpu_milli=1000, mem=1 * GI).obj() for i in range(20)]
+    got, want = run_both(nodes, pods)
+    assert got == want
+    assert None not in got
+
+
+def test_unschedulable_overflow():
+    nodes = [make_node("n0").capacity(cpu_milli=1000, mem=2 * GI, pods=110).obj()]
+    pods = [make_pod(f"p{i}").req(cpu_milli=600, mem=256 * MI).obj() for i in range(3)]
+    got, want = run_both(nodes, pods)
+    assert got == want == ["n0", None, None]
+
+
+def test_spread_via_least_allocated():
+    """LeastAllocated drives pods onto the emptiest node each pick."""
+    nodes = [
+        make_node("a").capacity(cpu_milli=10000, mem=16 * GI, pods=110).obj(),
+        make_node("b").capacity(cpu_milli=10000, mem=16 * GI, pods=110).obj(),
+    ]
+    pods = [make_pod(f"p{i}").req(cpu_milli=2000, mem=2 * GI).obj() for i in range(4)]
+    got, want = run_both(nodes, pods)
+    assert got == want
+    assert got.count("a") == got.count("b") == 2
+
+
+def test_parity_with_affinity_taints_ports():
+    nodes = [
+        make_node("gpu0").capacity(cpu_milli=16000, mem=32 * GI, pods=110)
+        .zone("z1").taint("dedicated", "ml", api.NO_SCHEDULE).obj(),
+        make_node("gen0").capacity(cpu_milli=8000, mem=16 * GI, pods=110).zone("z1").obj(),
+        make_node("gen1").capacity(cpu_milli=8000, mem=16 * GI, pods=110).zone("z2").obj(),
+    ]
+    pods = [
+        make_pod("web0").req(cpu_milli=1000, mem=1 * GI).host_port(80).obj(),
+        make_pod("web1").req(cpu_milli=1000, mem=1 * GI).host_port(80).obj(),
+        make_pod("web2").req(cpu_milli=1000, mem=1 * GI).host_port(80).obj(),
+        make_pod("ml0").req(cpu_milli=4000, mem=8 * GI)
+        .toleration("dedicated", api.OP_EQUAL, "ml", api.NO_SCHEDULE)
+        .preferred_affinity(10, api.LABEL_ZONE, api.OP_IN, ["z1"]).obj(),
+        make_pod("zonal").req(cpu_milli=500, mem=512 * MI)
+        .node_selector_kv(api.LABEL_ZONE, "z2").obj(),
+    ]
+    got, want = run_both(nodes, pods)
+    assert got == want
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_randomized_parity(seed):
+    rng = np.random.default_rng(seed)
+    zones = ["z1", "z2", "z3"]
+    nodes = []
+    for i in range(24):
+        nw = (
+            make_node(f"n{i}")
+            .capacity(
+                cpu_milli=int(rng.choice([2000, 4000, 8000, 16000])),
+                mem=int(rng.choice([4, 8, 16, 32])) * GI,
+                pods=int(rng.choice([5, 10, 110])),
+            )
+            .zone(str(rng.choice(zones)))
+        )
+        if rng.random() < 0.2:
+            nw.taint("dedicated", "batch", api.NO_SCHEDULE)
+        if rng.random() < 0.15:
+            nw.taint("flaky", "true", api.PREFER_NO_SCHEDULE)
+        if rng.random() < 0.1:
+            nw.unschedulable()
+        nodes.append(nw.obj())
+
+    pods = []
+    for i in range(60):
+        pw = make_pod(f"p{i}").req(
+            cpu_milli=int(rng.choice([0, 100, 500, 1000, 2000])),
+            mem=int(rng.choice([0, 128, 512, 1024, 4096])) * MI,
+        )
+        if rng.random() < 0.3:
+            pw.node_selector_kv(api.LABEL_ZONE, str(rng.choice(zones)))
+        if rng.random() < 0.2:
+            pw.toleration("dedicated", api.OP_EQUAL, "batch", api.NO_SCHEDULE)
+        if rng.random() < 0.2:
+            pw.preferred_affinity(
+                int(rng.integers(1, 100)), api.LABEL_ZONE, api.OP_IN, [str(rng.choice(zones))]
+            )
+        if rng.random() < 0.15:
+            pw.host_port(int(rng.choice([80, 443, 8080])))
+        pods.append(pw.obj())
+
+    got, want = run_both(nodes, pods)
+    assert got == want
+
+
+def test_bound_pods_respected():
+    nodes = [
+        make_node("a").capacity(cpu_milli=4000, mem=8 * GI, pods=110).obj(),
+        make_node("b").capacity(cpu_milli=4000, mem=8 * GI, pods=110).obj(),
+    ]
+    bound = [make_pod("old").req(cpu_milli=3000, mem=6 * GI).node_name("a").obj()]
+    pods = [make_pod("new").req(cpu_milli=2000, mem=2 * GI).obj()]
+    got, want = run_both(nodes, pods, bound=bound)
+    assert got == want == ["b"]

@@ -1,0 +1,182 @@
+"""Fused filter kernel tests (each case pins one plugin's semantics)."""
+
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.ops import filters, schema
+from kubernetes_tpu.testing.wrappers import GI, MI, make_node, make_pod
+
+
+def _feasible(nodes, pods, bound=()):
+    snap, meta = schema.SnapshotBuilder().build(nodes, pods, bound_pods=bound)
+    mask = filters.feasible_batch(snap.cluster, snap.pods, snap.selectors)
+    return np.asarray(mask)[: len(pods), : len(nodes)], meta
+
+
+def test_resources_fit():
+    nodes = [
+        make_node("small").capacity(cpu_milli=1000, mem=1 * GI, pods=10).obj(),
+        make_node("big").capacity(cpu_milli=8000, mem=16 * GI, pods=10).obj(),
+    ]
+    pods = [make_pod("p").req(cpu_milli=2000, mem=2 * GI).obj()]
+    m, _ = _feasible(nodes, pods)
+    assert m.tolist() == [[False, True]]
+
+
+def test_fit_boundary_exact():
+    """requested + pod == allocatable fits (<=, fit.go:446)."""
+    nodes = [make_node("n").capacity(cpu_milli=1000, mem=1 * GI, pods=10).obj()]
+    pods = [make_pod("p").req(cpu_milli=1000, mem=1 * GI).obj()]
+    m, _ = _feasible(nodes, pods)
+    assert m.tolist() == [[True]]
+
+
+def test_pod_count_capacity():
+    nodes = [make_node("n").capacity(cpu_milli=64000, mem=64 * GI, pods=2).obj()]
+    bound = [
+        make_pod("b0").node_name("n").obj(),
+        make_pod("b1").node_name("n").obj(),
+    ]
+    pods = [make_pod("p").obj()]
+    m, _ = _feasible(nodes, pods, bound=bound)
+    assert m.tolist() == [[False]]
+
+
+def test_node_name():
+    nodes = [make_node("a").obj(), make_node("b").obj()]
+    pods = [make_pod("p").node_name("b").obj(), make_pod("q").node_name("ghost").obj()]
+    m, _ = _feasible(nodes, pods)
+    assert m.tolist() == [[False, True], [False, False]]
+
+
+def test_taints_and_tolerations():
+    nodes = [
+        make_node("tainted").taint("dedicated", "gpu", api.NO_SCHEDULE).obj(),
+        make_node("clean").obj(),
+        make_node("prefer").taint("x", "y", api.PREFER_NO_SCHEDULE).obj(),
+    ]
+    pods = [
+        make_pod("plain").obj(),
+        make_pod("tolerant").toleration("dedicated", api.OP_EQUAL, "gpu", api.NO_SCHEDULE).obj(),
+        make_pod("tolerate-all").toleration().obj(),
+    ]
+    m, _ = _feasible(nodes, pods)
+    # PreferNoSchedule never blocks (scoring only)
+    assert m.tolist() == [
+        [False, True, True],
+        [True, True, True],
+        [True, True, True],
+    ]
+
+
+def test_unschedulable_node_and_toleration():
+    nodes = [make_node("cordoned").unschedulable().obj(), make_node("ok").obj()]
+    pods = [
+        make_pod("plain").obj(),
+        make_pod("tol").toleration(api.TAINT_NODE_UNSCHEDULABLE, api.OP_EXISTS).obj(),
+    ]
+    m, _ = _feasible(nodes, pods)
+    assert m.tolist() == [[False, True], [True, True]]
+
+
+def test_node_selector_and_affinity():
+    nodes = [
+        make_node("a").zone("us-a").obj(),
+        make_node("b").zone("us-b").obj(),
+        make_node("c").obj(),  # no zone label
+    ]
+    pods = [
+        make_pod("sel").node_selector_kv(api.LABEL_ZONE, "us-a").obj(),
+        make_pod("in").required_affinity(api.LABEL_ZONE, api.OP_IN, ["us-b"]).obj(),
+        # NotIn matches nodes without the key at all (selector.go semantics)
+        make_pod("notin").required_affinity(api.LABEL_ZONE, api.OP_NOT_IN, ["us-a"]).obj(),
+        make_pod("exists").required_affinity(api.LABEL_ZONE, api.OP_EXISTS).obj(),
+        make_pod("absent").required_affinity(api.LABEL_ZONE, api.OP_DOES_NOT_EXIST).obj(),
+        # In naming a value no node carries matches nowhere
+        make_pod("ghost").required_affinity(api.LABEL_ZONE, api.OP_IN, ["mars"]).obj(),
+    ]
+    m, _ = _feasible(nodes, pods)
+    assert m.tolist() == [
+        [True, False, False],
+        [False, True, False],
+        [False, True, True],
+        [True, True, False],
+        [False, False, True],
+        [False, False, False],
+    ]
+
+
+def test_or_of_terms():
+    nodes = [make_node("a").zone("z1").obj(), make_node("b").zone("z2").obj(),
+             make_node("c").zone("z3").obj()]
+    pods = [
+        make_pod("p")
+        .required_affinity(api.LABEL_ZONE, api.OP_IN, ["z1"])
+        .required_affinity(api.LABEL_ZONE, api.OP_IN, ["z3"])
+        .obj()
+    ]
+    m, _ = _feasible(nodes, pods)
+    assert m.tolist() == [[True, False, True]]
+
+
+def test_node_selector_ands_with_affinity_terms():
+    """spec.nodeSelector must hold in addition to every affinity term."""
+    nodes = [
+        make_node("a").zone("z1").label("disk", "ssd").obj(),
+        make_node("b").zone("z1").obj(),
+    ]
+    pods = [
+        make_pod("p")
+        .node_selector_kv("disk", "ssd")
+        .required_affinity(api.LABEL_ZONE, api.OP_IN, ["z1"])
+        .obj()
+    ]
+    m, _ = _feasible(nodes, pods)
+    assert m.tolist() == [[True, False]]
+
+
+def test_host_ports():
+    nodes = [make_node("n0").obj(), make_node("n1").obj()]
+    bound = [make_pod("b").host_port(8080).node_name("n0").obj()]
+    pods = [
+        make_pod("p").host_port(8080).obj(),
+        make_pod("q").host_port(8080, protocol="UDP").obj(),
+    ]
+    m, _ = _feasible(nodes, pods, bound=bound)
+    assert m.tolist() == [[False, True], [True, True]]
+
+
+def test_gt_lt_operators():
+    nodes = [
+        make_node("n0").label("cores", "8").obj(),
+        make_node("n1").label("cores", "32").obj(),
+    ]
+    pods = [make_pod("p").required_affinity("cores", api.OP_GT, ["16"]).obj()]
+    m, _ = _feasible(nodes, pods)
+    assert m.tolist() == [[False, True]]
+
+
+def test_fit_ignores_resources_pod_does_not_request():
+    """A node over-committed on a scalar resource stays feasible for pods
+    that don't request it (fit.go checks only podRequest > 0)."""
+    nodes = [make_node("n").capacity(cpu_milli=4000, mem=8 * GI, pods=10).obj()]
+    # bound pod requests a gpu the node doesn't expose (requested 1 > alloc 0)
+    bound = [make_pod("b").req(**{"example.com/gpu": 1}).node_name("n").obj()]
+    pods = [make_pod("p").req(cpu_milli=100).obj()]
+    m, _ = _feasible(nodes, pods, bound=bound)
+    assert m.tolist() == [[True]]
+
+
+def test_gt_with_unparseable_values():
+    """Non-numeric label values / bounds never match Gt/Lt — and never
+    crash the batch encode."""
+    nodes = [
+        make_node("num").label("cores", "32").obj(),
+        make_node("alpha").label("cores", "lots").obj(),
+    ]
+    pods = [
+        make_pod("p").required_affinity("cores", api.OP_GT, ["16"]).obj(),
+        make_pod("bad").required_affinity("cores", api.OP_GT, ["much"]).obj(),
+    ]
+    m, _ = _feasible(nodes, pods)
+    assert m.tolist() == [[True, False], [False, False]]

@@ -1,0 +1,94 @@
+"""Snapshot encoder unit tests."""
+
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.ops import schema
+from kubernetes_tpu.testing.wrappers import GI, MI, make_node, make_pod
+
+
+def test_basic_shapes_and_units():
+    nodes = [
+        make_node("n0").capacity(cpu_milli=4000, mem=8 * GI, pods=10).obj(),
+        make_node("n1").capacity(cpu_milli=8000, mem=16 * GI, pods=20).obj(),
+    ]
+    pods = [make_pod("p0").req(cpu_milli=500, mem=512 * MI).obj()]
+    b = schema.SnapshotBuilder()
+    snap, meta = b.build(nodes, pods)
+
+    n = snap.cluster.allocatable.shape[0]
+    assert n >= 2 and (n & (n - 1)) == 0  # power-of-two padded
+    assert meta.num_nodes == 2 and meta.num_pods == 1
+    # device units: cpu milli, memory MiB
+    assert snap.cluster.allocatable[0, schema.RESOURCE_CPU] == 4000
+    assert snap.cluster.allocatable[0, schema.RESOURCE_MEMORY] == 8 * 1024
+    assert snap.cluster.allocatable[1, schema.RESOURCE_PODS] == 20
+    assert snap.pods.req[0, schema.RESOURCE_CPU] == 500
+    assert snap.pods.req[0, schema.RESOURCE_MEMORY] == 512
+    assert snap.pods.req[0, schema.RESOURCE_PODS] == 1
+    assert snap.cluster.node_valid[:2].all() and not snap.cluster.node_valid[2:].any()
+
+
+def test_nonzero_defaults():
+    """Pods with no requests get 100m / 200Mi for scoring only
+    (reference: pkg/scheduler/util/pod_resources.go:33-36)."""
+    nodes = [make_node("n0").obj()]
+    pods = [make_pod("p0").obj()]
+    snap, _ = schema.SnapshotBuilder().build(nodes, pods)
+    assert snap.pods.req[0, schema.RESOURCE_CPU] == 0
+    assert snap.pods.nonzero_req[0, schema.RESOURCE_CPU] == 100
+    assert snap.pods.nonzero_req[0, schema.RESOURCE_MEMORY] == 200
+
+
+def test_bound_pods_accumulate_requested():
+    nodes = [make_node("n0").obj()]
+    bound = [
+        make_pod("b0").req(cpu_milli=1000, mem=1 * GI).node_name("n0").obj(),
+        make_pod("b1").req(cpu_milli=500).node_name("n0").obj(),
+    ]
+    snap, _ = schema.SnapshotBuilder().build(nodes, [], bound_pods=bound)
+    assert snap.cluster.requested[0, schema.RESOURCE_CPU] == 1500
+    assert snap.cluster.requested[0, schema.RESOURCE_MEMORY] == 1024
+    assert snap.cluster.requested[0, schema.RESOURCE_PODS] == 2
+    # b1 declares no memory -> nonzero default 200Mi applies
+    assert snap.cluster.nonzero_requested[0, schema.RESOURCE_MEMORY] == 1024 + 200
+
+
+def test_taint_and_toleration_encoding():
+    nodes = [
+        make_node("n0").taint("gpu", "true", api.NO_SCHEDULE).obj(),
+        make_node("n1").unschedulable().obj(),
+    ]
+    pods = [
+        make_pod("p0").toleration("gpu", api.OP_EQUAL, "true", api.NO_SCHEDULE).obj(),
+    ]
+    b = schema.SnapshotBuilder()
+    snap, _ = b.build(nodes, pods)
+    e = schema.EFFECT_INDEX[api.NO_SCHEDULE]
+    assert snap.cluster.taint_bits[e, 0].any()
+    # cordoned node got the synthetic unschedulable taint
+    assert snap.cluster.taint_bits[e, 1].any()
+    assert snap.pods.tol_bits[e, 0].any()
+
+
+def test_selector_dedup():
+    nodes = [make_node("n0").zone("a").obj()]
+    pods = [
+        make_pod(f"p{i}").node_selector_kv(api.LABEL_ZONE, "a").obj() for i in range(5)
+    ]
+    snap, _ = schema.SnapshotBuilder().build(nodes, pods)
+    # five identical selectors -> one table row, all pods point at it
+    assert (snap.pods.sel_idx[:5] == 0).all()
+    assert snap.selectors.term_valid[0, 0]
+    assert not snap.selectors.term_valid[1:].any()
+
+
+def test_scalar_resource_discovery():
+    nodes = [make_node("n0").capacity(**{"example.com/gpu": 4}).obj()]
+    pods = [make_pod("p0").req(**{"example.com/gpu": 2}).obj()]
+    b = schema.SnapshotBuilder()
+    snap, meta = b.build(nodes, pods)
+    assert "example.com/gpu" in meta.resource_names
+    idx = meta.resource_names.index("example.com/gpu")
+    assert snap.cluster.allocatable[0, idx] == 4
+    assert snap.pods.req[0, idx] == 2

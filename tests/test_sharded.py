@@ -1,0 +1,85 @@
+"""Sharded solve must place pods identically to the single-chip solve.
+
+Runs on the 8-virtual-device CPU mesh from conftest.py.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.ops import assign, schema
+from kubernetes_tpu.parallel import sharded
+from kubernetes_tpu.testing.wrappers import GI, MI, make_node, make_pod
+
+
+def _workload(seed, n_nodes=32, n_pods=40):
+    rng = np.random.default_rng(seed)
+    zones = ["z1", "z2", "z3"]
+    nodes = []
+    for i in range(n_nodes):
+        nw = (
+            make_node(f"n{i}")
+            .capacity(
+                cpu_milli=int(rng.choice([4000, 8000, 16000])),
+                mem=int(rng.choice([8, 16, 32])) * GI,
+                pods=110,
+            )
+            .zone(str(rng.choice(zones)))
+        )
+        if rng.random() < 0.2:
+            nw.taint("dedicated", "batch", api.NO_SCHEDULE)
+        if rng.random() < 0.2:
+            nw.taint("flaky", "true", api.PREFER_NO_SCHEDULE)
+        nodes.append(nw.obj())
+    pods = []
+    for i in range(n_pods):
+        pw = make_pod(f"p{i}").req(
+            cpu_milli=int(rng.choice([100, 500, 1000, 2000])),
+            mem=int(rng.choice([128, 512, 1024])) * MI,
+        )
+        if rng.random() < 0.3:
+            pw.node_selector_kv(api.LABEL_ZONE, str(rng.choice(zones)))
+        if rng.random() < 0.2:
+            pw.toleration("dedicated", api.OP_EQUAL, "batch", api.NO_SCHEDULE)
+        if rng.random() < 0.25:
+            pw.preferred_affinity(
+                int(rng.integers(1, 50)), api.LABEL_ZONE, api.OP_IN, [str(rng.choice(zones))]
+            )
+        pods.append(pw.obj())
+    return nodes, pods
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_sharded_matches_single_chip(seed):
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    nodes, pods = _workload(seed)
+    snap, meta = schema.SnapshotBuilder().build(nodes, pods)
+
+    single = assign.greedy_assign(snap)
+    mesh = sharded.make_mesh(8)
+    multi = sharded.sharded_greedy_assign(snap, mesh)
+
+    np.testing.assert_array_equal(
+        np.asarray(single.assignment), np.asarray(multi.assignment)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(single.feasible_counts), np.asarray(multi.feasible_counts)
+    )
+    # post-solve cluster state matches too (gather the sharded one)
+    np.testing.assert_allclose(
+        np.asarray(single.cluster.requested),
+        np.asarray(multi.cluster.requested),
+        rtol=0,
+        atol=0,
+    )
+
+
+def test_mesh_sizes():
+    nodes, pods = _workload(7, n_nodes=16, n_pods=12)
+    snap, _ = schema.SnapshotBuilder().build(nodes, pods)
+    want = np.asarray(assign.greedy_assign(snap).assignment)
+    for n_dev in (2, 4):
+        mesh = sharded.make_mesh(n_dev)
+        got = np.asarray(sharded.sharded_greedy_assign(snap, mesh).assignment)
+        np.testing.assert_array_equal(want, got)
