@@ -57,11 +57,11 @@ class TPUBatchScheduler:
         if not pending:
             return []
         snap, meta = self.snapshot(nodes, pending, bound)
-        result = self._solver(snap)
+        result = self._solver(snap, meta.topo_z)
         self.last_result = result
         idx = np.asarray(result.assignment)[: meta.num_pods]
         return [meta.node_name(int(i)) for i in idx]
 
-    def solve(self, snap: schema.Snapshot) -> assign_ops.SolveResult:
+    def solve(self, snap: schema.Snapshot, topo_z: int = 1) -> assign_ops.SolveResult:
         """Raw device-side solve on a prebuilt snapshot."""
-        return self._solver(snap)
+        return self._solver(snap, topo_z)

@@ -20,16 +20,54 @@ with a counter-based PRNG instead.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .filters import feasible_for_pod, pod_view, preferred_match, selector_match
+from .interpod import interpod_filter, interpod_update, prep_terms
 from .schema import ClusterTensors, Snapshot
 from .scores import DEFAULT_SCORE_CONFIG, ScoreConfig, score_for_pod
+from .topology import prep_spread, spread_filter, spread_score, spread_update
 
 NEG_INF = jnp.float32(-jnp.inf)
+
+
+class FeatureFlags(NamedTuple):
+    """Static gates: a workload only pays scan-step cost for the constraint
+    families it actually uses (the analogue of the reference's PreFilter
+    returning Skip to elide a plugin for a pod — framework.go:687)."""
+
+    spread: bool = False       # any topology-spread constraints
+    soft_spread: bool = False  # any ScheduleAnyway constraints (scoring)
+    interpod: bool = False     # any inter-pod (anti-)affinity terms
+    term_slots: Tuple[int, ...] = ()  # topology-key slots those terms use
+
+
+def required_topo_z(snapshot: Snapshot) -> int:
+    """Smallest valid topo-value capacity for this snapshot.  Using a
+    smaller z would alias topology values together in the prep-time count
+    scatter and silently corrupt spread/inter-pod state."""
+    from ..utils.vocab import pad_dim
+
+    return pad_dim(int(np.asarray(snapshot.cluster.topo_ids).max()) + 1, 1)
+
+
+def features_of(snapshot: Snapshot) -> FeatureFlags:
+    """Derive the static gates host-side (cheap numpy reductions)."""
+    spread_valid = np.asarray(snapshot.spread.valid)
+    hard = np.asarray(snapshot.spread.hard)
+    term_valid = np.asarray(snapshot.terms.valid)
+    slots = np.asarray(snapshot.terms.slot)
+    return FeatureFlags(
+        spread=bool(spread_valid.any()),
+        soft_spread=bool((spread_valid & ~hard).any()),
+        interpod=bool(term_valid.any()),
+        term_slots=tuple(sorted(set(slots[term_valid].tolist()))),
+    )
 
 
 class SolveResult(NamedTuple):
@@ -59,18 +97,39 @@ def greedy_assign(
     snapshot: Snapshot,
     cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,
     tie_seed: Optional[int] = None,
+    topo_z: Optional[int] = None,
+    features: Optional[FeatureFlags] = None,
 ) -> SolveResult:
     """Sequential-greedy solve of the whole pending batch on device.
 
     Semantically equivalent to running the reference's scheduling cycle
-    once per pod in batch order with cache assume between cycles.
+    once per pod in batch order with cache assume between cycles — the
+    scan carry holds everything a placement changes: resource usage,
+    ports, topology-spread counts, and inter-pod affinity term state.
+
+    topo_z: padded topology-value vocab size (SnapshotMeta.topo_z or
+    required_topo_z); auto-derived when None.  Both topo_z and features
+    can only be auto-derived outside jit — jitted callers must pass them
+    (greedy_assign_jit's wrapper does).
     """
-    cluster, pods, sel, pref = jax.tree.map(jnp.asarray, tuple(snapshot))
+    if features is None:
+        features = features_of(snapshot)
+    if topo_z is None:
+        topo_z = required_topo_z(snapshot)
+    cluster, pods, sel, pref, spread, terms = jax.tree.map(
+        jnp.asarray, tuple(snapshot)
+    )
     n = cluster.allocatable.shape[0]
     p = pods.req.shape[0]
 
     sel_mask = selector_match(cluster, sel)
     pref_mask = preferred_match(cluster, pref)
+    sp0 = prep_spread(cluster, sel_mask, spread, topo_z) if features.spread else None
+    tm0 = (
+        prep_terms(cluster, terms, topo_z, slots=features.term_slots)
+        if features.interpod
+        else None
+    )
     keys = (
         jax.random.split(jax.random.PRNGKey(tie_seed), p)
         if tie_seed is not None
@@ -78,14 +137,26 @@ def greedy_assign(
     )
 
     def step(carry, i):
-        requested, nonzero, ports = carry
+        requested, nonzero, ports, sp_counts, tm_present, tm_blocked, tm_global = carry
         cl = cluster._replace(
             requested=requested, nonzero_requested=nonzero, port_bits=ports
         )
         pod = pod_view(pods, i)
         feas = feasible_for_pod(cl, pod, sel_mask)
+        sp = tm = None
+        if features.spread:
+            sp = sp0._replace(counts_node=sp_counts)
+            feas = feas & spread_filter(sp, spread, i)
+        if features.interpod:
+            tm = tm0._replace(
+                present_bits=tm_present, blocked_bits=tm_blocked, global_any=tm_global
+            )
+            feas = feas & interpod_filter(tm, terms, i)
         found = feas.any()
-        scores = score_for_pod(cl, pod, feas, pref_mask, cfg)
+        sp_score = (
+            spread_score(sp, spread, i, feas) if features.soft_spread else None
+        )
+        scores = score_for_pod(cl, pod, feas, pref_mask, cfg, spread_score=sp_score)
         masked = jnp.where(feas, scores, NEG_INF)
         choice = _pick(masked, feas, keys[i] if keys is not None else None)
         idx = jnp.where(found, choice, -1).astype(jnp.int32)
@@ -94,12 +165,35 @@ def greedy_assign(
         requested = requested + onehot[:, None] * pod.req[None, :]
         nonzero = nonzero + onehot[:, None] * pod.nonzero_req[None, :]
         ports = jnp.where(onehot[:, None], ports | pod.port_bits[None, :], ports)
+        if features.spread:
+            sp = spread_update(
+                sp, spread, i, sp.v[:, choice], sp.eligible[:, choice], found
+            )
+            sp_counts = sp.counts_node
+        if features.interpod:
+            tm = interpod_update(
+                tm, terms, i, cluster.topo_ids[choice], found,
+                slots=features.term_slots,
+            )
+            tm_present, tm_blocked, tm_global = (
+                tm.present_bits, tm.blocked_bits, tm.global_any
+            )
         out = (idx, jnp.where(found, masked[choice], NEG_INF), feas.sum().astype(jnp.int32))
-        return (requested, nonzero, ports), out
+        carry = (requested, nonzero, ports, sp_counts, tm_present, tm_blocked, tm_global)
+        return carry, out
 
-    init = (cluster.requested, cluster.nonzero_requested, cluster.port_bits)
-    (requested, nonzero, ports), (assignment, win_scores, feas_counts) = jax.lax.scan(
-        step, init, jnp.arange(p)
+    zero = jnp.zeros(())
+    init = (
+        cluster.requested,
+        cluster.nonzero_requested,
+        cluster.port_bits,
+        sp0.counts_node if features.spread else zero,
+        tm0.present_bits if features.interpod else zero,
+        tm0.blocked_bits if features.interpod else zero,
+        tm0.global_any if features.interpod else zero,
+    )
+    (requested, nonzero, ports, *_rest), (assignment, win_scores, feas_counts) = (
+        jax.lax.scan(step, init, jnp.arange(p))
     )
     final = cluster._replace(
         requested=requested, nonzero_requested=nonzero, port_bits=ports
@@ -108,10 +202,24 @@ def greedy_assign(
 
 
 def greedy_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
-    """A jitted closure over the (static, hashable) score config."""
+    """A jitted closure over the (static, hashable) score config.
+    topo_z and the feature gates are static: one executable per
+    (shape-bucket, topo_z, features).  Features are auto-detected
+    host-side when not supplied."""
 
-    @jax.jit
-    def run(snapshot: Snapshot) -> SolveResult:
-        return greedy_assign(snapshot, cfg)
+    @partial(jax.jit, static_argnums=(1, 2))
+    def run(snapshot: Snapshot, topo_z: int, features: FeatureFlags) -> SolveResult:
+        return greedy_assign(snapshot, cfg, topo_z=topo_z, features=features)
 
-    return run
+    def call(
+        snapshot: Snapshot,
+        topo_z: Optional[int] = None,
+        features: Optional[FeatureFlags] = None,
+    ) -> SolveResult:
+        if features is None:
+            features = features_of(snapshot)
+        if topo_z is None:
+            topo_z = required_topo_z(snapshot)
+        return run(snapshot, topo_z, features)
+
+    return call

@@ -1,0 +1,186 @@
+"""InterPodAffinity as per-node bitsets.
+
+The reference's PreFilter builds topology-pair count maps and Filter does
+three boolean checks per node (interpodaffinity/filtering.go:306-366):
+
+  1. no existing pod's required anti-affinity term matches the incoming
+     pod in the node's topology
+  2. none of the incoming pod's anti-affinity terms match an existing pod
+     in the node's topology
+  3. every affinity term has a matching existing pod in the node's
+     topology — with the first-pod-of-a-group escape: all terms globally
+     unmatched + the pod matches its own terms + node has the keys.
+
+Every check consumes only count *presence* (> 0), and presence is
+monotone during a batch solve (placements never remove pods), so the
+state is three bitsets over the term axis instead of [T, Z] count tensors:
+
+  present_bits[N, W] : term t has a matching pod in node n's topology
+  blocked_bits[N, W] : a pod carrying anti-term t sits in n's topology
+  global_any[W]      : term t has a matching pod anywhere
+
+and the per-step work is O(N * W) word ops — no gathers or scatters in
+the scan.  Updates exploit that terms share at most TK topology keys:
+one node-mask per key, OR-ed with per-(slot, pod) precomputed bit rows.
+
+All selector/namespace string matching was precomputed host-side into
+schema.TermTable matrices — the O(pods x nodes) pairwise term the north
+star turns into bit algebra.
+
+Not yet modelled: namespaceSelector on terms, matchLabelKeys, and the
+preferred (scoring) terms — required terms only.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .schema import ClusterTensors, TermTable
+
+
+class TermState(NamedTuple):
+    present_bits: jnp.ndarray  # u32[N, W]
+    blocked_bits: jnp.ndarray  # u32[N, W]
+    global_any: jnp.ndarray    # u32[W]
+    # static within a solve:
+    key_bits: jnp.ndarray      # u32[N, W] node has term t's topology key
+    slot_v: jnp.ndarray        # i32[TK, N] node topo values by slot
+    mi_slot_bits: jnp.ndarray  # u32[TK, P, W] matches_incoming split by term slot
+    anti_slot_bits: jnp.ndarray  # u32[TK, P, W] own anti terms split by slot
+    aff_bits: jnp.ndarray      # u32[P, W] own required affinity terms
+    anti_bits: jnp.ndarray     # u32[P, W] own required anti-affinity terms
+
+
+def _pack_bits_t(mat: jnp.ndarray) -> jnp.ndarray:
+    """Pack bool[..., T] -> u32[..., ceil(T/32)] (little-endian bits)."""
+    t = mat.shape[-1]
+    w = (t + 31) // 32
+    pad = w * 32 - t
+    if pad:
+        mat = jnp.concatenate(
+            [mat, jnp.zeros(mat.shape[:-1] + (pad,), dtype=bool)], axis=-1
+        )
+    grouped = mat.reshape(mat.shape[:-1] + (w, 32)).astype(jnp.uint32)
+    weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    return (grouped * weights).sum(axis=-1, dtype=jnp.uint32)
+
+
+def _idx_to_bits(idx: jnp.ndarray, t_dim: int) -> jnp.ndarray:
+    """int32[P, MA] term indices (-1 pad) -> bool[P, T] membership."""
+    return (jnp.arange(t_dim)[None, None, :] == idx[:, :, None]).any(axis=1)
+
+
+def prep_terms(
+    cluster: ClusterTensors,
+    terms: TermTable,
+    z: int,
+    axis_name: str | None = None,
+    slots: tuple = (),
+) -> TermState:
+    """One-time assembly (the PreFilter analogue).  z is the topo-value
+    vocab bound, used only for the prep-time count scatter.  Under
+    shard_map pass axis_name: global_any must OR across node shards
+    (pre-pack — psum on packed bitsets would carry between bits), and
+    counts must be psum-reduced so a topology domain spanning shards is
+    seen whole."""
+    t_dim = terms.valid.shape[0]
+    v = jnp.take_along_axis(cluster.topo_ids, terms.slot[None, :], axis=1).T  # [T, N]
+    vc = jnp.clip(v, 0, z - 1)
+    ok = (v >= 0) & cluster.node_valid[None, :] & terms.valid[:, None]
+
+    def per_t(vc_row, ok_row, m_row, o_row):
+        cm = jnp.zeros(z, jnp.float32).at[vc_row].add(m_row * ok_row)
+        co = jnp.zeros(z, jnp.float32).at[vc_row].add(o_row * ok_row)
+        return cm, co
+
+    cm, co = jax.vmap(per_t)(vc, ok, terms.node_matches, terms.node_owners)
+    if axis_name is not None:
+        cm = jax.lax.psum(cm, axis_name)
+        co = jax.lax.psum(co, axis_name)
+    present = ok & (jnp.take_along_axis(cm, vc, axis=-1) > 0)   # [T, N]
+    blocked = ok & (jnp.take_along_axis(co, vc, axis=-1) > 0)   # [T, N]
+    global_any = _pack_bits_t((cm.sum(axis=-1) > 0) & terms.valid)
+
+    mi = terms.matches_incoming & terms.valid[None, :]           # [P, T]
+    # Only the topology-key slots some term actually uses get a row in the
+    # per-slot bit tables (static from FeatureFlags.term_slots) — real
+    # workloads use one or two keys, so the per-step slot loop shrinks
+    # from TK to that count.
+    used = jnp.asarray(slots or tuple(range(cluster.topo_ids.shape[1])), dtype=jnp.int32)
+    slot_onehot = terms.slot[None, :] == used[:, None]           # [U, T]
+    anti_membership = _idx_to_bits(terms.anti_idx, t_dim) & terms.valid[None, :]
+    aff_membership = _idx_to_bits(terms.aff_idx, t_dim) & terms.valid[None, :]
+
+    return TermState(
+        present_bits=_pack_bits_t(present.T),
+        blocked_bits=_pack_bits_t(blocked.T),
+        global_any=global_any,
+        key_bits=_pack_bits_t(ok.T),
+        slot_v=cluster.topo_ids.T[used],
+        mi_slot_bits=_pack_bits_t(mi[None, :, :] & slot_onehot[:, None, :]),
+        anti_slot_bits=_pack_bits_t(
+            anti_membership[None, :, :] & slot_onehot[:, None, :]
+        ),
+        aff_bits=_pack_bits_t(aff_membership),
+        anti_bits=_pack_bits_t(anti_membership),
+    )
+
+
+def interpod_filter(
+    state: TermState, terms: TermTable, p: jnp.ndarray
+) -> jnp.ndarray:
+    """The three checks for pod p over all nodes: bool[N], as bit algebra."""
+    mi_all = jnp.zeros_like(state.global_any)
+    for s in range(state.mi_slot_bits.shape[0]):
+        mi_all = mi_all | state.mi_slot_bits[s, p]
+
+    # 1. existing pods' anti-affinity against the incoming pod
+    viol_existing = (state.blocked_bits & mi_all[None, :]).any(axis=-1)
+
+    # 2. incoming pod's anti-affinity against existing pods
+    viol_own = (state.present_bits & state.anti_bits[p][None, :]).any(axis=-1)
+
+    # 3. incoming pod's affinity (with the first-pod escape)
+    aff = state.aff_bits[p]                                       # [W]
+    any_active = (aff != 0).any()
+    all_here = ((aff[None, :] & ~state.present_bits) == 0).all(axis=-1)
+    keys_ok = ((aff[None, :] & ~state.key_bits) == 0).all(axis=-1)
+    none_anywhere = ((aff & state.global_any) == 0).all()
+    fallback = none_anywhere & terms.self_match_all[p] & keys_ok
+    aff_ok = ~any_active | (all_here & keys_ok) | fallback
+
+    return aff_ok & ~viol_existing & ~viol_own
+
+
+def interpod_update(
+    state: TermState,
+    terms: TermTable,
+    p: jnp.ndarray,
+    topo_at: jnp.ndarray,
+    found: jnp.ndarray,
+    slots: tuple = (),
+) -> TermState:
+    """Account a placement: terms the placed pod matches turn present (and
+    globally-any) in the placement's topology; its own anti-affinity terms
+    turn blocked there.  topo_at = the chosen node's topo_ids row ([TK]);
+    the sharded solve psum-broadcasts it from the owning shard.  slots
+    must match the tuple prep_terms was built with
+    (FeatureFlags.term_slots)."""
+    idxs = slots or tuple(range(state.slot_v.shape[0]))
+    present = state.present_bits
+    blocked = state.blocked_bits
+    global_any = state.global_any
+    for j, s in enumerate(idxs):
+        ta = topo_at[s]
+        node_mask = (state.slot_v[j] == ta) & (ta >= 0) & found
+        mi_bits = state.mi_slot_bits[j, p]
+        anti_bits = state.anti_slot_bits[j, p]
+        present = present | jnp.where(node_mask[:, None], mi_bits[None, :], 0)
+        blocked = blocked | jnp.where(node_mask[:, None], anti_bits[None, :], 0)
+        global_any = global_any | jnp.where((ta >= 0) & found, mi_bits, 0)
+    return state._replace(
+        present_bits=present, blocked_bits=blocked, global_any=global_any
+    )

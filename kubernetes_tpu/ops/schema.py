@@ -101,6 +101,48 @@ class PreferredTable(NamedTuple):
     valid: np.ndarray      # bool[F]
 
 
+class SpreadTable(NamedTuple):
+    """C distinct topology-spread constraint instances (constraint spec +
+    owner namespace/selector/key-set, since eligibility is owner-scoped).
+    Z = padded max topology-value vocabulary size.
+
+    Counting state lives as per-node match vectors ([C, N]); the solver
+    scatter-adds them into per-topology-value counts on device (the
+    tensorization of preFilterState.TpPairToMatchNum,
+    podtopologyspread/filtering.go + scoring.go)."""
+
+    valid: np.ndarray         # bool[C]
+    slot: np.ndarray          # i32[C]   topology-key slot in topo_ids
+    max_skew: np.ndarray      # f32[C]
+    hard: np.ndarray          # bool[C]  DoNotSchedule (filter) vs ScheduleAnyway (score)
+    owner_sel_idx: np.ndarray  # i32[C]  owner pod's SelectorTable row, -1 none
+    owner_keys: np.ndarray    # bool[C, TK] topology keys the owner's constraints use
+    node_matches: np.ndarray  # f32[C, N] bound pods on node n matching constraint c
+    pod_matches: np.ndarray   # bool[P, C] pending pod p matches c's selector+namespace
+    pod_idx: np.ndarray       # i32[P, MC] constraint rows per pod, -1 pad
+
+
+class TermTable(NamedTuple):
+    """T distinct inter-pod (anti-)affinity terms: batch pods' required
+    affinity + anti-affinity terms, plus bound pods' anti-affinity terms
+    (needed for the existing-pods-anti-affinity direction,
+    interpodaffinity/filtering.go:306-366).
+
+    counts_match[t, v] (# pods whose labels+ns match term t in topology v)
+    and counts_owner[t, v] (# pods *carrying* t as an anti-affinity term)
+    are assembled on device from the per-node vectors below and updated
+    in-scan as the solver places pods."""
+
+    valid: np.ndarray            # bool[T]
+    slot: np.ndarray             # i32[T]   topology-key slot
+    node_matches: np.ndarray     # f32[T, N] bound pods on n matching term t
+    node_owners: np.ndarray      # f32[T, N] bound pods on n owning anti-term t
+    matches_incoming: np.ndarray  # bool[P, T] batch pod p matches term t
+    aff_idx: np.ndarray          # i32[P, MA] pod's required affinity terms
+    anti_idx: np.ndarray         # i32[P, MA] pod's required anti-affinity terms
+    self_match_all: np.ndarray   # bool[P] pod matches all its own affinity terms
+
+
 class PodBatch(NamedTuple):
     """Per-pending-pod state. P = padded batch size, MT = preferred slots."""
 
@@ -121,6 +163,8 @@ class Snapshot(NamedTuple):
     pods: PodBatch
     selectors: SelectorTable
     preferred: PreferredTable
+    spread: SpreadTable
+    terms: TermTable
 
 
 @dataclass
@@ -133,6 +177,8 @@ class SnapshotLimits:
     max_exprs: int = 8          # E: expressions per term (incl. node_selector)
     max_ids_per_expr: int = 16  # K: expanded ids per expression
     max_preferred: int = 4      # MT: preferred terms per pod
+    max_spread_per_pod: int = 4  # MC: topology spread constraints per pod
+    max_pod_terms: int = 4      # MA: required (anti-)affinity terms per pod
     label_capacity: int = 4096
     taint_capacity: int = 256
     port_capacity: int = 2048
@@ -162,6 +208,7 @@ class SnapshotMeta:
     node_names: List[str]
     resource_names: List[str]
     limits: SnapshotLimits
+    topo_z: int = 1  # padded max topology-value vocab size (the Z axis)
 
     def node_name(self, idx: int) -> Optional[str]:
         if 0 <= idx < self.num_nodes:
@@ -385,19 +432,31 @@ class SnapshotBuilder:
         n = vb.pad_dim(max(len(nodes), num_nodes_hint), lim.min_nodes)
         p_dim = vb.pad_dim(max(len(pending_pods), num_pods_hint), lim.min_pods)
 
-        cluster = self._build_cluster(nodes, bound_pods, n, r)
-        pods, sel, pref = self._build_pods(pending_pods, p_dim, r)
+        index_by_name = {nd.meta.name: i for i, nd in enumerate(nodes)}
+        cluster = self._build_cluster(nodes, bound_pods, n, r, index_by_name)
+        pods, sel, pref, sel_index = self._build_pods(pending_pods, p_dim, r)
+        spread, terms = self._build_constraints(
+            pending_pods, bound_pods, index_by_name, sel_index, n, p_dim
+        )
         meta = SnapshotMeta(
             num_nodes=len(nodes),
             num_pods=len(pending_pods),
             node_names=[nd.meta.name for nd in nodes],
             resource_names=self.resource_names,
             limits=lim,
+            topo_z=vb.pad_dim(
+                max([len(v) for v in self.topo_vocabs.values()] or [1]), 1
+            ),
         )
-        return Snapshot(cluster, pods, sel, pref), meta
+        return Snapshot(cluster, pods, sel, pref, spread, terms), meta
 
     def _build_cluster(
-        self, nodes: Sequence[api.Node], bound_pods: Sequence[api.Pod], n: int, r: int
+        self,
+        nodes: Sequence[api.Node],
+        bound_pods: Sequence[api.Pod],
+        n: int,
+        r: int,
+        index_by_name: Dict[str, int],
     ) -> ClusterTensors:
         lim = self.limits
         alloc = np.zeros((n, r), dtype=np.float32)
@@ -410,10 +469,8 @@ class SnapshotBuilder:
         port_bits = np.zeros((n, lim.port_words), dtype=np.uint32)
         topo_ids = np.full((n, len(lim.topology_keys)), -1, dtype=np.int32)
 
-        index_by_name: Dict[str, int] = {}
         for i, node in enumerate(nodes):
             valid[i] = True
-            index_by_name[node.meta.name] = i
             name_id[i] = self.name_vocab.get(node.meta.name)
             alloc[i] = self._resource_vector(node.status.allocatable, r, grow=False)
             for k, v in node.meta.labels.items():
@@ -455,7 +512,7 @@ class SnapshotBuilder:
 
     def _build_pods(
         self, pods: Sequence[api.Pod], p_dim: int, r: int
-    ) -> Tuple[PodBatch, SelectorTable, PreferredTable]:
+    ) -> Tuple[PodBatch, SelectorTable, PreferredTable, Dict[tuple, int]]:
         lim = self.limits
         t_cap, e_cap, k_cap, mt = (
             lim.max_terms, lim.max_exprs, lim.max_ids_per_expr, lim.max_preferred,
@@ -563,7 +620,183 @@ class SnapshotBuilder:
             pref_idx=pref_idx,
             pref_weight=pref_weight,
         )
-        return batch, sel, pref
+        return batch, sel, pref, sel_index
+
+    def _topo_slot(self, key: str) -> int:
+        try:
+            return self.limits.topology_keys.index(key)
+        except ValueError:
+            raise OverflowError(
+                f"topology key {key!r} is not tracked; add it to "
+                "SnapshotLimits.topology_keys"
+            ) from None
+
+    def _build_constraints(
+        self,
+        pods: Sequence[api.Pod],
+        bound_pods: Sequence[api.Pod],
+        index_by_name: Dict[str, int],
+        sel_index: Dict[tuple, int],
+        n: int,
+        p_dim: int,
+    ) -> Tuple[SpreadTable, TermTable]:
+        lim = self.limits
+        tk = len(lim.topology_keys)
+        mc, ma = lim.max_spread_per_pod, lim.max_pod_terms
+        bound_by_node = [
+            (p, index_by_name[p.spec.node_name])
+            for p in bound_pods
+            if p.spec.node_name in index_by_name
+        ]
+
+        # ---- topology spread constraints --------------------------------
+        # A constraint instance is owner-scoped: eligibility honours the
+        # owner's node selector/affinity and requires every topology key of
+        # *all* the owner's constraints (filtering.go PreFilter).
+        spread_rows: List[tuple] = []  # (api constraint, owner_ns, owner_sel, keys)
+        spread_index: Dict[tuple, int] = {}
+        pod_spread_idx = np.full((p_dim, mc), -1, dtype=np.int32)
+        for i, pod in enumerate(pods):
+            cons = pod.spec.topology_spread_constraints
+            if not cons:
+                continue
+            if len(cons) > mc:
+                raise OverflowError(
+                    f"{len(cons)} spread constraints exceed max_spread_per_pod={mc}"
+                )
+            owner_sel = pod.required_node_selector()
+            owner_sel_row = (
+                sel_index[_selector_signature(owner_sel)] if owner_sel else -1
+            )
+            keys = tuple(sorted({c.topology_key for c in cons}))
+            for j, c in enumerate(cons):
+                sig = (
+                    c.topology_key,
+                    c.max_skew,
+                    c.when_unsatisfiable,
+                    _label_selector_signature(c.label_selector),
+                    pod.meta.namespace,
+                    owner_sel_row,
+                    keys,
+                )
+                idx = spread_index.get(sig)
+                if idx is None:
+                    idx = len(spread_rows)
+                    spread_index[sig] = idx
+                    spread_rows.append((c, pod.meta.namespace, owner_sel_row, keys))
+                pod_spread_idx[i, j] = idx
+
+        c_dim = vb.pad_dim(len(spread_rows), 1)
+        spread = SpreadTable(
+            valid=np.zeros(c_dim, dtype=bool),
+            slot=np.zeros(c_dim, dtype=np.int32),
+            max_skew=np.ones(c_dim, dtype=np.float32),
+            hard=np.zeros(c_dim, dtype=bool),
+            owner_sel_idx=np.full(c_dim, -1, dtype=np.int32),
+            owner_keys=np.zeros((c_dim, tk), dtype=bool),
+            node_matches=np.zeros((c_dim, n), dtype=np.float32),
+            pod_matches=np.zeros((p_dim, c_dim), dtype=bool),
+            pod_idx=pod_spread_idx,
+        )
+        for ci, (c, owner_ns, owner_sel_row, keys) in enumerate(spread_rows):
+            spread.valid[ci] = True
+            spread.slot[ci] = self._topo_slot(c.topology_key)
+            spread.max_skew[ci] = float(c.max_skew)
+            spread.hard[ci] = c.when_unsatisfiable == "DoNotSchedule"
+            spread.owner_sel_idx[ci] = owner_sel_row
+            for k in keys:
+                spread.owner_keys[ci, self._topo_slot(k)] = True
+            sel = c.label_selector or api.LabelSelector()
+            for q, ni in bound_by_node:
+                if q.meta.namespace == owner_ns and sel.matches(q.meta.labels):
+                    spread.node_matches[ci, ni] += 1.0
+            for i, pod in enumerate(pods):
+                spread.pod_matches[i, ci] = (
+                    pod.meta.namespace == owner_ns and sel.matches(pod.meta.labels)
+                )
+
+        # ---- inter-pod (anti-)affinity terms ----------------------------
+        term_rows: List[Tuple[api.PodAffinityTerm, Tuple[str, ...]]] = []
+        term_index: Dict[tuple, int] = {}
+
+        def intern_term(term: api.PodAffinityTerm, owner_ns: str) -> int:
+            namespaces = tuple(sorted(term.namespaces or [owner_ns]))
+            sig = (
+                term.topology_key,
+                _label_selector_signature(term.label_selector),
+                namespaces,
+            )
+            idx = term_index.get(sig)
+            if idx is None:
+                idx = len(term_rows)
+                term_index[sig] = idx
+                term_rows.append((term, namespaces))
+            return idx
+
+        def pod_terms(pod: api.Pod) -> Tuple[List[api.PodAffinityTerm], List[api.PodAffinityTerm]]:
+            aff = pod.spec.affinity
+            a = aff.pod_affinity.required if aff and aff.pod_affinity else []
+            b = aff.pod_anti_affinity.required if aff and aff.pod_anti_affinity else []
+            return list(a), list(b)
+
+        aff_idx = np.full((p_dim, ma), -1, dtype=np.int32)
+        anti_idx = np.full((p_dim, ma), -1, dtype=np.int32)
+        for i, pod in enumerate(pods):
+            aff_terms, anti_terms = pod_terms(pod)
+            if len(aff_terms) > ma or len(anti_terms) > ma:
+                raise OverflowError(
+                    f"pod has {len(aff_terms)}/{len(anti_terms)} (anti-)affinity "
+                    f"terms, exceeding max_pod_terms={ma}"
+                )
+            for j, t in enumerate(aff_terms):
+                aff_idx[i, j] = intern_term(t, pod.meta.namespace)
+            for j, t in enumerate(anti_terms):
+                anti_idx[i, j] = intern_term(t, pod.meta.namespace)
+        # Bound pods' anti-affinity terms participate in the
+        # existing-pods-anti-affinity direction even if no pending pod
+        # carries them.
+        bound_anti: List[Tuple[int, int]] = []  # (term row, node index)
+        for q, ni in bound_by_node:
+            _, anti_terms = pod_terms(q)
+            for t in anti_terms:
+                bound_anti.append((intern_term(t, q.meta.namespace), ni))
+
+        t_dim = vb.pad_dim(len(term_rows), 1)
+        terms = TermTable(
+            valid=np.zeros(t_dim, dtype=bool),
+            slot=np.zeros(t_dim, dtype=np.int32),
+            node_matches=np.zeros((t_dim, n), dtype=np.float32),
+            node_owners=np.zeros((t_dim, n), dtype=np.float32),
+            matches_incoming=np.zeros((p_dim, t_dim), dtype=bool),
+            aff_idx=aff_idx,
+            anti_idx=anti_idx,
+            self_match_all=np.zeros(p_dim, dtype=bool),
+        )
+
+        def term_matches(term: api.PodAffinityTerm, namespaces, pod: api.Pod) -> bool:
+            if pod.meta.namespace not in namespaces:
+                return False
+            sel = term.label_selector or api.LabelSelector()
+            return sel.matches(pod.meta.labels)
+
+        for ti, (term, namespaces) in enumerate(term_rows):
+            terms.valid[ti] = True
+            terms.slot[ti] = self._topo_slot(term.topology_key)
+            for q, ni in bound_by_node:
+                if term_matches(term, namespaces, q):
+                    terms.node_matches[ti, ni] += 1.0
+            for i, pod in enumerate(pods):
+                terms.matches_incoming[i, ti] = term_matches(term, namespaces, pod)
+        for ti, ni in bound_anti:
+            terms.node_owners[ti, ni] += 1.0
+        for i, pod in enumerate(pods):
+            aff_terms, _ = pod_terms(pod)
+            terms.self_match_all[i] = bool(aff_terms) and all(
+                term_matches(t, tuple(t.namespaces or [pod.meta.namespace]), pod)
+                for t in aff_terms
+            )
+
+        return spread, terms
 
     def _encode_selector(
         self, selector: api.NodeSelector, t_cap: int, e_cap: int, k_cap: int
@@ -580,6 +813,14 @@ class SnapshotBuilder:
             term_valid[t] = True
             ids[t], ops[t], slots[t] = self._encode_term(term.match_expressions, e_cap, k_cap)
         return ids, ops, slots, term_valid
+
+
+def _label_selector_signature(sel: Optional[api.LabelSelector]) -> tuple:
+    if sel is None:
+        return ()
+    return tuple(
+        (r.key, r.op, tuple(sorted(r.values))) for r in sel.requirements()
+    )
 
 
 def _term_signature(term: api.NodeSelectorTerm) -> tuple:

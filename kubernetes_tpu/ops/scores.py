@@ -44,7 +44,6 @@ class ScoreConfig:
     node_affinity_weight: float = 2.0    # NodeAffinity
     taint_weight: float = 3.0            # TaintToleration
     spread_weight: float = 2.0           # PodTopologySpread (ops.topology)
-    interpod_weight: float = 2.0         # InterPodAffinity (ops.interpod)
     # (resource_index, weight) pairs for Least/MostAllocated
     fit_resources: Tuple[Tuple[int, float], ...] = (
         (RESOURCE_CPU, 1.0),
@@ -173,11 +172,13 @@ def score_for_pod(
     pref_mask: jnp.ndarray,
     cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,
     axis_name: str | None = None,
+    spread_score: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Weighted plugin-score sum for one pod over all nodes: f32[N].
     Infeasible nodes score -1 (callers mask again before argmax anyway).
     axis_name: mesh axis to reduce normalization maxima over when the node
-    axis is sharded."""
+    axis is sharded.  spread_score: pre-normalized PodTopologySpread score
+    (ops.topology.spread_score), weighted in here."""
     if cfg.fit_strategy == "MostAllocated":
         fit = most_allocated(cluster, pod, cfg)
     else:
@@ -193,4 +194,6 @@ def score_for_pod(
         + cfg.node_affinity_weight * aff
         + cfg.taint_weight * taint
     )
+    if spread_score is not None:
+        total = total + cfg.spread_weight * spread_score
     return jnp.where(feasible, total, -1.0)

@@ -24,15 +24,23 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.assign import NEG_INF, SolveResult
+from ..ops.assign import (
+    NEG_INF,
+    FeatureFlags,
+    SolveResult,
+    features_of,
+    required_topo_z,
+)
 from ..ops.filters import (
     feasible_for_pod,
     pod_view,
     preferred_match,
     selector_match,
 )
-from ..ops.schema import ClusterTensors, Snapshot
+from ..ops.interpod import interpod_filter, interpod_update, prep_terms
+from ..ops.schema import ClusterTensors, Snapshot, SpreadTable, TermTable
 from ..ops.scores import DEFAULT_SCORE_CONFIG, ScoreConfig, score_for_pod
+from ..ops.topology import prep_spread, spread_filter, spread_score, spread_update
 
 AXIS = "nodes"
 
@@ -57,10 +65,19 @@ def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     return Mesh(devices, (AXIS,))
 
 
+def _broadcast_column(matrix: jnp.ndarray, local_idx: jnp.ndarray, own: jnp.ndarray):
+    """Give every shard the owning shard's matrix[:, local_idx] column
+    (psum of a single masked contribution)."""
+    col = jnp.where(own, matrix[:, local_idx], 0)
+    return jax.lax.psum(col, AXIS)
+
+
 def sharded_greedy_assign(
     snapshot: Snapshot,
     mesh: Mesh,
     cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,
+    topo_z: Optional[int] = None,
+    features: Optional[FeatureFlags] = None,
 ) -> SolveResult:
     """greedy_assign with the node axis sharded over `mesh`.
 
@@ -68,8 +85,18 @@ def sharded_greedy_assign(
     data layout differs.  Requires the padded node count to be divisible by
     the mesh size (SnapshotBuilder pads to powers of two, mesh sizes are
     powers of two, so this holds by construction).
+
+    Constraint count state ([C/T, Z]) is small and kept replicated: each
+    shard scatter-builds counts from its node shard, a psum replicates
+    them, and per-placement updates are broadcast from the winning shard.
     """
-    cluster, pods, sel, pref = jax.tree.map(jnp.asarray, tuple(snapshot))
+    if features is None:
+        features = features_of(snapshot)
+    if topo_z is None:
+        topo_z = required_topo_z(snapshot)
+    cluster, pods, sel, pref, spread, terms = jax.tree.map(
+        jnp.asarray, tuple(snapshot)
+    )
     n = cluster.allocatable.shape[0]
     n_dev = mesh.devices.size
     if n % n_dev:
@@ -77,11 +104,21 @@ def sharded_greedy_assign(
     p = pods.req.shape[0]
 
     rep = P()
+    spread_specs = SpreadTable(
+        valid=rep, slot=rep, max_skew=rep, hard=rep, owner_sel_idx=rep,
+        owner_keys=rep, node_matches=P(None, AXIS), pod_matches=rep, pod_idx=rep,
+    )
+    term_specs = TermTable(
+        valid=rep, slot=rep, node_matches=P(None, AXIS), node_owners=P(None, AXIS),
+        matches_incoming=rep, aff_idx=rep, anti_idx=rep, self_match_all=rep,
+    )
     in_specs = (
         CLUSTER_SPECS,
         jax.tree.map(lambda _: rep, pods),
         jax.tree.map(lambda _: rep, sel),
         jax.tree.map(lambda _: rep, pref),
+        spread_specs,
+        term_specs,
     )
     out_specs = SolveResult(
         assignment=rep, scores=rep, feasible_counts=rep, cluster=CLUSTER_SPECS
@@ -94,20 +131,47 @@ def sharded_greedy_assign(
         out_specs=out_specs,
         check_vma=False,
     )
-    def run(cl: ClusterTensors, pods, sel, pref) -> SolveResult:
+    def run(cl: ClusterTensors, pods, sel, pref, spread, terms) -> SolveResult:
         n_local = cl.allocatable.shape[0]
         offset = jax.lax.axis_index(AXIS) * n_local
         sel_mask = selector_match(cl, sel)
         pref_mask = preferred_match(cl, pref)
 
+        # Local scatter + psum => replicated counts over all shards;
+        # v/eligible/blocked stay node-sharded.
+        sp0 = tm0 = None
+        if features.spread:
+            sp0 = prep_spread(cl, sel_mask, spread, topo_z, axis_name=AXIS)
+        if features.interpod:
+            tm0 = prep_terms(
+                cl, terms, topo_z, axis_name=AXIS, slots=features.term_slots
+            )
+
         def step(carry, i):
-            requested, nonzero, ports = carry
+            requested, nonzero, ports, sp_counts, tm_present, tm_blocked, tm_global = carry
             cur = cl._replace(
                 requested=requested, nonzero_requested=nonzero, port_bits=ports
             )
             pod = pod_view(pods, i)
             feas = feasible_for_pod(cur, pod, sel_mask)
-            scores = score_for_pod(cur, pod, feas, pref_mask, cfg, axis_name=AXIS)
+            sp = tm = None
+            if features.spread:
+                sp = sp0._replace(counts_node=sp_counts)
+                feas = feas & spread_filter(sp, spread, i, axis_name=AXIS)
+            if features.interpod:
+                tm = tm0._replace(
+                    present_bits=tm_present, blocked_bits=tm_blocked,
+                    global_any=tm_global,
+                )
+                feas = feas & interpod_filter(tm, terms, i)
+            sp_score = (
+                spread_score(sp, spread, i, feas, axis_name=AXIS)
+                if features.soft_spread
+                else None
+            )
+            scores = score_for_pod(
+                cur, pod, feas, pref_mask, cfg, axis_name=AXIS, spread_score=sp_score
+            )
             masked = jnp.where(feas, scores, NEG_INF)
 
             # Local champion, then a 2-collective global election.
@@ -124,22 +188,60 @@ def sharded_greedy_assign(
             requested = requested + onehot[:, None] * pod.req[None, :]
             nonzero = nonzero + onehot[:, None] * pod.nonzero_req[None, :]
             ports = jnp.where(onehot[:, None], ports | pod.port_bits[None, :], ports)
-            n_feas = jax.lax.psum(feas.sum().astype(jnp.int32), AXIS)
-            return (requested, nonzero, ports), (idx, jnp.where(found, best, NEG_INF), n_feas)
 
-        init = (cl.requested, cl.nonzero_requested, cl.port_bits)
-        (requested, nonzero, ports), (assignment, win, nf) = jax.lax.scan(
+            own = found & (winner >= offset) & (winner < offset + n_local)
+            wli = jnp.clip(winner - offset, 0, n_local - 1)
+            if features.spread:
+                sp_v = _broadcast_column(sp.v, wli, own)
+                sp_elig = _broadcast_column(sp.eligible.astype(jnp.int32), wli, own) > 0
+                sp = spread_update(sp, spread, i, sp_v, sp_elig, found)
+                sp_counts = sp.counts_node
+            if features.interpod:
+                topo_at = _broadcast_column(cl.topo_ids.T, wli, own)
+                tm = interpod_update(
+                    tm, terms, i, topo_at, found, slots=features.term_slots
+                )
+                tm_present, tm_blocked, tm_global = (
+                    tm.present_bits, tm.blocked_bits, tm.global_any
+                )
+
+            n_feas = jax.lax.psum(feas.sum().astype(jnp.int32), AXIS)
+            carry = (requested, nonzero, ports, sp_counts, tm_present, tm_blocked, tm_global)
+            return carry, (idx, jnp.where(found, best, NEG_INF), n_feas)
+
+        zero = jnp.zeros(())
+        init = (
+            cl.requested, cl.nonzero_requested, cl.port_bits,
+            sp0.counts_node if features.spread else zero,
+            tm0.present_bits if features.interpod else zero,
+            tm0.blocked_bits if features.interpod else zero,
+            tm0.global_any if features.interpod else zero,
+        )
+        (requested, nonzero, ports, *_rest), (assignment, win, nf) = jax.lax.scan(
             step, init, jnp.arange(p)
         )
         final = cl._replace(requested=requested, nonzero_requested=nonzero, port_bits=ports)
         return SolveResult(assignment, win, nf, final)
 
-    return run(cluster, pods, sel, pref)
+    return run(cluster, pods, sel, pref, spread, terms)
 
 
 def sharded_greedy_jit(mesh: Mesh, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
-    @jax.jit
-    def solve(snapshot: Snapshot) -> SolveResult:
-        return sharded_greedy_assign(snapshot, mesh, cfg)
+    @partial(jax.jit, static_argnums=(1, 2))
+    def run(snapshot: Snapshot, topo_z: int, features: FeatureFlags) -> SolveResult:
+        return sharded_greedy_assign(
+            snapshot, mesh, cfg, topo_z=topo_z, features=features
+        )
 
-    return solve
+    def call(
+        snapshot: Snapshot,
+        topo_z: Optional[int] = None,
+        features: Optional[FeatureFlags] = None,
+    ) -> SolveResult:
+        if features is None:
+            features = features_of(snapshot)
+        if topo_z is None:
+            topo_z = required_topo_z(snapshot)
+        return run(snapshot, topo_z, features)
+
+    return call

@@ -42,8 +42,10 @@ class _NodeState:
     requested: Dict[str, float] = field(default_factory=dict)
     nonzero_requested: Dict[str, float] = field(default_factory=dict)
     used_ports: Set[Tuple[str, int]] = field(default_factory=set)
+    pods: List[api.Pod] = field(default_factory=list)
 
     def add_pod(self, pod: api.Pod) -> None:
+        self.pods.append(pod)
         req = _units(pod.resource_requests())
         req[api.PODS] = req.get(api.PODS, 0) + 1
         for k, v in req.items():
@@ -77,9 +79,145 @@ class Oracle:
             if st is not None:
                 st.add_pod(p)
 
+    # -- topology spread (filtering.go) ----------------------------------
+
+    def _spread_eligible(self, pod: api.Pod, st: _NodeState) -> bool:
+        """Node counted for the pod's spread constraints: passes the pod's
+        node selector/affinity and has every constraint's topology key."""
+        sel = pod.required_node_selector()
+        if sel is not None and not sel.matches(st.node.meta.labels):
+            return False
+        return all(
+            c.topology_key in st.node.meta.labels
+            for c in pod.spec.topology_spread_constraints
+        )
+
+    def _spread_counts(self, pod: api.Pod, c: api.TopologySpreadConstraint):
+        """(counts per topology value over eligible nodes, min count)."""
+        sel = c.label_selector or api.LabelSelector()
+        counts: Dict[str, int] = {}
+        for st in self.states:
+            if not self._spread_eligible(pod, st):
+                continue
+            val = st.node.meta.labels.get(c.topology_key)
+            if val is None:
+                continue
+            counts.setdefault(val, 0)
+            counts[val] += sum(
+                1
+                for q in st.pods
+                if q.meta.namespace == pod.meta.namespace
+                and sel.matches(q.meta.labels)
+            )
+        return counts, (min(counts.values()) if counts else 0)
+
+    # -- inter-pod affinity (interpodaffinity/filtering.go) --------------
+
+    @staticmethod
+    def _term_matches(term: api.PodAffinityTerm, owner_ns: str, q: api.Pod) -> bool:
+        namespaces = term.namespaces or [owner_ns]
+        if q.meta.namespace not in namespaces:
+            return False
+        sel = term.label_selector or api.LabelSelector()
+        return sel.matches(q.meta.labels)
+
+    def _pod_context(self, pod: api.Pod) -> dict:
+        """Node-independent per-cycle state, computed once per pod — the
+        oracle's PreFilter.  Keeps _feasible O(1)-ish per node so parity
+        tests stay O(N * pods) instead of O(N^2 * pods)."""
+        ctx: dict = {}
+
+        # spread: counts + min per hard constraint, self-match flags
+        hard = [
+            c
+            for c in pod.spec.topology_spread_constraints
+            if c.when_unsatisfiable == "DoNotSchedule"
+        ]
+        ctx["spread"] = []
+        for c in hard:
+            counts, min_match = self._spread_counts(pod, c)
+            sel = c.label_selector or api.LabelSelector()
+            self_match = 1 if sel.matches(pod.meta.labels) else 0
+            ctx["spread"].append((c, counts, min_match, self_match))
+
+        # existing pods' anti-affinity terms that match this pod:
+        # (topologyKey, value) pairs that block it
+        blockers = set()
+        for other in self.states:
+            for q in other.pods:
+                qaff = q.spec.affinity
+                for t in (
+                    qaff.pod_anti_affinity.required
+                    if qaff and qaff.pod_anti_affinity
+                    else []
+                ):
+                    if not self._term_matches(t, q.meta.namespace, pod):
+                        continue
+                    qv = other.node.meta.labels.get(t.topology_key)
+                    if qv is not None:
+                        blockers.add((t.topology_key, qv))
+        ctx["blockers"] = blockers
+
+        # per own-term: topology values with a matching existing pod
+        aff = pod.spec.affinity
+        aff_terms = aff.pod_affinity.required if aff and aff.pod_affinity else []
+        anti_terms = aff.pod_anti_affinity.required if aff and aff.pod_anti_affinity else []
+
+        def values_with_match(t: api.PodAffinityTerm) -> Set[str]:
+            vals = set()
+            for other in self.states:
+                ov = other.node.meta.labels.get(t.topology_key)
+                if ov is None:
+                    continue
+                if any(
+                    self._term_matches(t, pod.meta.namespace, q) for q in other.pods
+                ):
+                    vals.add(ov)
+            return vals
+
+        ctx["aff_terms"] = [(t, values_with_match(t)) for t in aff_terms]
+        ctx["anti_terms"] = [(t, values_with_match(t)) for t in anti_terms]
+        ctx["self_match"] = bool(aff_terms) and all(
+            self._term_matches(t, pod.meta.namespace, pod) for t in aff_terms
+        )
+        return ctx
+
+    def _spread_ok(self, pod: api.Pod, st: _NodeState, ctx: dict) -> bool:
+        for c, counts, min_match, self_match in ctx["spread"]:
+            val = st.node.meta.labels.get(c.topology_key)
+            if val is None:
+                return False
+            if counts.get(val, 0) + self_match - min_match > c.max_skew:
+                return False
+        return True
+
+    def _interpod_ok(self, pod: api.Pod, st: _NodeState, ctx: dict) -> bool:
+        labels = st.node.meta.labels
+        # 1. existing pods' anti-affinity vs the incoming pod
+        for key, val in ctx["blockers"]:
+            if labels.get(key) == val:
+                return False
+        # 2. incoming pod's anti-affinity
+        for t, vals in ctx["anti_terms"]:
+            v = labels.get(t.topology_key)
+            if v is not None and v in vals:
+                return False
+        # 3. incoming pod's affinity (with first-pod escape)
+        if ctx["aff_terms"]:
+            if any(t.topology_key not in labels for t, _ in ctx["aff_terms"]):
+                return False
+            all_here = all(
+                labels[t.topology_key] in vals for t, vals in ctx["aff_terms"]
+            )
+            if not all_here:
+                none_anywhere = all(not vals for _, vals in ctx["aff_terms"])
+                if not (none_anywhere and ctx["self_match"]):
+                    return False
+        return True
+
     # -- filter ----------------------------------------------------------
 
-    def _feasible(self, pod: api.Pod, st: _NodeState) -> bool:
+    def _feasible(self, pod: api.Pod, st: _NodeState, ctx: dict) -> bool:
         req = _units(pod.resource_requests())
         req[api.PODS] = req.get(api.PODS, 0) + 1
         for k, v in req.items():
@@ -98,6 +236,10 @@ class Oracle:
                 return False
         sel = pod.required_node_selector()
         if sel is not None and not sel.matches(st.node.meta.labels):
+            return False
+        if not self._spread_ok(pod, st, ctx):
+            return False
+        if not self._interpod_ok(pod, st, ctx):
             return False
         return True
 
@@ -163,14 +305,73 @@ class Oracle:
             out = [MAX_SCORE - s for s in out]
         return out
 
+    def _spread_scores(self, pod: api.Pod, feasible: List[Tuple[int, _NodeState]]) -> List[int]:
+        """PodTopologySpread soft-constraint scores, normalized
+        (scoring.go Score + NormalizeScore)."""
+        soft = [
+            c
+            for c in pod.spec.topology_spread_constraints
+            if c.when_unsatisfiable == "ScheduleAnyway"
+        ]
+        if not soft:
+            return [0] * len(feasible)
+        ignored = [
+            any(c.topology_key not in st.node.meta.labels for c in soft)
+            for _, st in feasible
+        ]
+        raws: List[Optional[int]] = []
+        counts = {id(c): self._spread_counts(pod, c)[0] for c in soft}
+        # Distinct values over *eligible* nodes, matching the kernel's
+        # prep-time sizes (the reference uses the per-cycle feasible set;
+        # see ops/topology.py spread_score for why this is equivalent in
+        # the single-constraint case).
+        sizes = {
+            id(c): len(
+                {
+                    st.node.meta.labels[c.topology_key]
+                    for st in self.states
+                    if self._spread_eligible(pod, st)
+                    and c.topology_key in st.node.meta.labels
+                }
+            )
+            for c in soft
+        }
+        for (_, st), ign in zip(feasible, ignored):
+            if ign:
+                raws.append(None)
+                continue
+            s = 0.0
+            for c in soft:
+                val = st.node.meta.labels[c.topology_key]
+                cnt = counts[id(c)].get(val, 0)
+                s += cnt * math.log(sizes[id(c)] + 2) + (c.max_skew - 1)
+            raws.append(round(s))
+        valid = [r for r in raws if r is not None]
+        mx, mn = (max(valid), min(valid)) if valid else (0, 0)
+        out = []
+        for r in raws:
+            if r is None:
+                out.append(0)
+            elif mx <= 0:
+                out.append(MAX_SCORE)
+            else:
+                out.append(math.floor(MAX_SCORE * (mx + mn - r) / mx))
+        return out
+
     # -- cycle -----------------------------------------------------------
 
     def schedule_one(self, pod: api.Pod) -> Optional[str]:
-        feasible = [(i, st) for i, st in enumerate(self.states) if self._feasible(pod, st)]
+        ctx = self._pod_context(pod)
+        feasible = [
+            (i, st)
+            for i, st in enumerate(self.states)
+            if self._feasible(pod, st, ctx)
+        ]
         if not feasible:
             return None
         aff = self._normalize([self._affinity_raw(pod, st) for _, st in feasible])
         taint = self._normalize([self._taint_raw(pod, st) for _, st in feasible], reverse=True)
+        spread = self._spread_scores(pod, feasible)
         best_i, best_score = None, None
         for j, (i, st) in enumerate(feasible):
             score = (
@@ -178,6 +379,7 @@ class Oracle:
                 + 1 * self._balanced_score(pod, st)
                 + 2 * aff[j]
                 + 3 * taint[j]
+                + 2 * spread[j]
             )
             if best_score is None or score > best_score:
                 best_i, best_score = i, score

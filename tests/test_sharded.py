@@ -83,3 +83,38 @@ def test_mesh_sizes():
         mesh = sharded.make_mesh(n_dev)
         got = np.asarray(sharded.sharded_greedy_assign(snap, mesh).assignment)
         np.testing.assert_array_equal(want, got)
+
+
+def test_sharded_with_spread_and_interpod():
+    """Constraint count-state must stay consistent across shards (the
+    psum-broadcast of the winning node's topology values)."""
+    from kubernetes_tpu.testing.oracle import Oracle
+
+    nodes = [
+        make_node(f"n{i}").capacity(cpu_milli=8000, mem=16 * GI, pods=20)
+        .zone(f"z{i % 3}").obj()
+        for i in range(16)
+    ]
+    pods = []
+    for i in range(24):
+        pw = make_pod(f"p{i}").labels(app=f"a{i % 2}").req(cpu_milli=500)
+        if i % 3 == 0:
+            pw.spread(max_skew=1, topology_key=api.LABEL_ZONE,
+                      selector={"app": f"a{i % 2}"})
+        elif i % 3 == 1:
+            pw.pod_anti_affinity({"app": f"a{i % 2}"}, topology_key=api.LABEL_HOSTNAME)
+        else:
+            pw.pod_affinity({"app": f"a{i % 2}"}, topology_key=api.LABEL_ZONE)
+        pods.append(pw.obj())
+
+    snap, meta = schema.SnapshotBuilder().build(nodes, pods)
+    single = assign.greedy_assign(snap, topo_z=meta.topo_z)
+    mesh = sharded.make_mesh(8)
+    multi = sharded.sharded_greedy_assign(snap, mesh, topo_z=meta.topo_z)
+    np.testing.assert_array_equal(
+        np.asarray(single.assignment), np.asarray(multi.assignment)
+    )
+    # and both match the oracle
+    got = [meta.node_name(int(i)) for i in np.asarray(single.assignment)[:24]]
+    want = Oracle(nodes).schedule(pods)
+    assert got == want
