@@ -62,6 +62,20 @@ class TPUBatchScheduler:
         idx = np.asarray(result.assignment)[: meta.num_pods]
         return [meta.node_name(int(i)) for i in idx]
 
-    def solve(self, snap: schema.Snapshot, topo_z: int = 1) -> assign_ops.SolveResult:
-        """Raw device-side solve on a prebuilt snapshot."""
+    def solve(
+        self, snap: schema.Snapshot, topo_z: Optional[int] = None
+    ) -> assign_ops.SolveResult:
+        """Raw device-side solve on a prebuilt snapshot.
+
+        topo_z is auto-derived (required_topo_z) when not given; passing a
+        value smaller than required aliases topology domains together and
+        silently corrupts spread/inter-pod state, so it is validated."""
+        required = assign_ops.required_topo_z(snap)
+        if topo_z is None:
+            topo_z = required
+        elif topo_z < required:
+            raise ValueError(
+                f"topo_z={topo_z} < required_topo_z={required}: would alias "
+                "topology values together (see ops.assign.required_topo_z)"
+            )
         return self._solver(snap, topo_z)
