@@ -1,1 +1,23 @@
-"""API layer: object model, versioned in-memory store, watch streams."""
+"""API layer: object model (types), versioned in-memory store with watch
+streams (store) — the single-process collapse of etcd + apiserver +
+apimachinery (SURVEY.md layers 1-6)."""
+
+from . import types
+from .store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExists,
+    Conflict,
+    Event,
+    Expired,
+    NotFound,
+    Store,
+    Watch,
+)
+
+__all__ = [
+    "types", "Store", "Watch", "Event",
+    "ADDED", "MODIFIED", "DELETED",
+    "NotFound", "AlreadyExists", "Conflict", "Expired",
+]

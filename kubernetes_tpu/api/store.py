@@ -1,0 +1,267 @@
+"""In-memory versioned object store with watch streams.
+
+The control-plane data path of the reference collapses into one process:
+etcd revisions + the apiserver's generic registry + the watch cache
+(storage/etcd3/store.go:106, registry/generic/registry/store.go:414,
+storage/cacher/cacher.go:337-514) become a single store with a monotonic
+resourceVersion, per-kind keyspaces, and fan-out watch channels serving
+events from a bounded ring buffer.
+
+Semantics kept from the reference:
+  * every successful write bumps one global resourceVersion (etcd
+    revision semantics: one counter across kinds);
+  * optimistic concurrency: update with a stale resource_version fails
+    with Conflict (GuaranteedUpdate's retry trigger);
+  * list returns (items, rv) so a watch can resume from that rv
+    (reflector's ListAndWatch contract, reflector.go:340);
+  * watch(from_rv) replays buffered events after from_rv, then streams;
+    a from_rv older than the buffer raises Expired — the client relists
+    (the 410 Gone path).
+
+Threading: writes and watch dispatch hold one lock; delivery is
+per-watcher bounded queues.  A slow watcher that overflows its queue is
+stopped (the cacher's terminate-blocked-watcher behaviour,
+cacher.go dispatchEvent) and must relist.
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from . import types as api
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+BOOKMARK = "BOOKMARK"
+
+
+class NotFound(KeyError):
+    pass
+
+
+class AlreadyExists(ValueError):
+    pass
+
+
+class Conflict(ValueError):
+    """Stale resourceVersion on update/delete."""
+
+
+class Expired(ValueError):
+    """Watch start revision fell out of the event buffer (410 Gone)."""
+
+
+@dataclass
+class Event:
+    type: str          # ADDED | MODIFIED | DELETED
+    kind: str
+    obj: Any           # deep copy at dispatch time
+    rv: int
+
+
+def _key(namespace: str, name: str) -> str:
+    return f"{namespace}/{name}" if namespace else name
+
+
+class Watch:
+    """One watch stream: iterate to receive events; stop() to cancel.
+    Iteration ends when the store stops the watch (overflow/close)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, store: "Store", capacity: int):
+        self._store = store
+        self._q: "queue.Queue" = queue.Queue(maxsize=capacity)
+        self.stopped = False
+
+    def stop(self) -> None:
+        self._store._drop_watch(self)
+        self._close()
+
+    def _close(self) -> None:
+        if not self.stopped:
+            self.stopped = True
+            try:
+                self._q.put_nowait(self._SENTINEL)
+            except queue.Full:
+                pass
+
+    def _offer(self, ev: Event) -> bool:
+        try:
+            self._q.put_nowait(ev)
+            return True
+        except queue.Full:
+            return False
+
+    def __iter__(self) -> Iterator[Event]:
+        return self
+
+    def __next__(self) -> Event:
+        ev = self._q.get()
+        if ev is self._SENTINEL:
+            raise StopIteration
+        return ev
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Event]:
+        """One event, or None on timeout / stream end."""
+        try:
+            ev = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        return None if ev is self._SENTINEL else ev
+
+
+class Store:
+    """The single-process control-plane store (see module docstring)."""
+
+    def __init__(self, buffer_size: int = 4096, watch_capacity: int = 1024):
+        self._lock = threading.RLock()
+        self._rv = 0
+        self._objects: Dict[str, Dict[str, Any]] = {}   # kind -> key -> obj
+        self._versions: Dict[str, Dict[str, int]] = {}  # kind -> key -> rv
+        self._buffer: List[Event] = []                  # ring of recent events
+        self._buffer_size = buffer_size
+        self._watch_capacity = watch_capacity
+        self._watchers: Dict[str, List[Watch]] = {}     # kind -> watches
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def _meta(obj: Any) -> api.ObjectMeta:
+        return obj.meta
+
+    def _kind_of(self, obj: Any) -> str:
+        kind = getattr(obj, "KIND", None)
+        if not kind:
+            raise TypeError(f"object {obj!r} has no KIND")
+        return kind
+
+    def _dispatch(self, ev: Event) -> None:
+        # caller holds the lock
+        self._buffer.append(ev)
+        if len(self._buffer) > self._buffer_size:
+            del self._buffer[: self._buffer_size // 4]
+        dead: List[Watch] = []
+        for w in self._watchers.get(ev.kind, ()):  # fan-out (cacher.go:514)
+            if not w._offer(ev):
+                dead.append(w)
+        for w in dead:
+            self._watchers[ev.kind].remove(w)
+            w._close()
+
+    # -- CRUD --------------------------------------------------------------
+
+    def create(self, obj: Any) -> Any:
+        kind = self._kind_of(obj)
+        meta = self._meta(obj)
+        key = _key(meta.namespace, meta.name)
+        with self._lock:
+            objs = self._objects.setdefault(kind, {})
+            if key in objs:
+                raise AlreadyExists(f"{kind} {key} exists")
+            self._rv += 1
+            obj = copy.deepcopy(obj)
+            obj.meta.resource_version = self._rv
+            objs[key] = obj
+            self._versions.setdefault(kind, {})[key] = self._rv
+            self._dispatch(Event(ADDED, kind, copy.deepcopy(obj), self._rv))
+            return copy.deepcopy(obj)
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> Any:
+        key = _key(namespace, name)
+        with self._lock:
+            try:
+                return copy.deepcopy(self._objects[kind][key])
+            except KeyError:
+                raise NotFound(f"{kind} {key}") from None
+
+    def update(self, obj: Any, *, force: bool = False) -> Any:
+        """Optimistic-concurrency update: obj.meta.resource_version must
+        match the stored version unless force (the GuaranteedUpdate retry
+        loop's compare step)."""
+        kind = self._kind_of(obj)
+        meta = self._meta(obj)
+        key = _key(meta.namespace, meta.name)
+        with self._lock:
+            objs = self._objects.get(kind, {})
+            if key not in objs:
+                raise NotFound(f"{kind} {key}")
+            current_rv = self._versions[kind][key]
+            if not force and meta.resource_version != current_rv:
+                raise Conflict(
+                    f"{kind} {key}: rv {meta.resource_version} != {current_rv}"
+                )
+            self._rv += 1
+            obj = copy.deepcopy(obj)
+            obj.meta.resource_version = self._rv
+            objs[key] = obj
+            self._versions[kind][key] = self._rv
+            self._dispatch(Event(MODIFIED, kind, copy.deepcopy(obj), self._rv))
+            return copy.deepcopy(obj)
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> Any:
+        key = _key(namespace, name)
+        with self._lock:
+            objs = self._objects.get(kind, {})
+            if key not in objs:
+                raise NotFound(f"{kind} {key}")
+            obj = objs.pop(key)
+            self._versions[kind].pop(key)
+            self._rv += 1
+            self._dispatch(Event(DELETED, kind, copy.deepcopy(obj), self._rv))
+            return obj
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        selector: Optional[Callable[[Any], bool]] = None,
+    ) -> Tuple[List[Any], int]:
+        """(items, resource_version) — the ListAndWatch handoff point."""
+        with self._lock:
+            items = [
+                copy.deepcopy(o)
+                for o in self._objects.get(kind, {}).values()
+                if (namespace is None or o.meta.namespace == namespace)
+                and (selector is None or selector(o))
+            ]
+            return items, self._rv
+
+    # -- watch -------------------------------------------------------------
+
+    def watch(self, kind: str, from_rv: Optional[int] = None) -> Watch:
+        """Stream events for `kind` after `from_rv` (exclusive).  None
+        means 'from now'.  Raises Expired when from_rv predates the event
+        buffer — relist and retry (reflector.go 410 handling)."""
+        with self._lock:
+            w = Watch(self, self._watch_capacity)
+            if from_rv is not None:
+                oldest_known = self._buffer[0].rv if self._buffer else self._rv + 1
+                if from_rv + 1 < oldest_known and from_rv < self._rv:
+                    raise Expired(
+                        f"rv {from_rv} too old (buffer starts at {oldest_known})"
+                    )
+                for ev in self._buffer:
+                    if ev.kind == kind and ev.rv > from_rv:
+                        w._offer(ev)
+            self._watchers.setdefault(kind, []).append(w)
+            return w
+
+    def _drop_watch(self, w: Watch) -> None:
+        with self._lock:
+            for ws in self._watchers.values():
+                if w in ws:
+                    ws.remove(w)
+                    return
+
+    # -- convenience -------------------------------------------------------
+
+    @property
+    def resource_version(self) -> int:
+        with self._lock:
+            return self._rv

@@ -314,6 +314,10 @@ class PodSpec:
     priority: int = 0
     preemption_policy: str = "PreemptLowerPriority"  # or "Never"
     scheduler_name: str = "default-scheduler"
+    # Gang/coscheduling group: pods sharing a group name schedule
+    # all-or-nothing in the joint batched solve (the out-of-tree
+    # coscheduling PodGroup pattern; no in-tree reference counterpart).
+    scheduling_group: Optional[str] = None
     scheduling_gates: List[str] = field(default_factory=list)
     restart_policy: str = "Always"
     termination_grace_period_seconds: int = 30

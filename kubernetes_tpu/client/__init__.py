@@ -1,1 +1,8 @@
-"""client layer (being built out; see package docstring for the layout map)."""
+"""Client layer: shared informers + listers over the API store's watch
+streams, and rate-limited work queues — the client-go tools/cache +
+util/workqueue analogue (SURVEY.md layer 7)."""
+
+from .informers import InformerFactory, SharedInformer
+from .workqueue import WorkQueue
+
+__all__ = ["InformerFactory", "SharedInformer", "WorkQueue"]

@@ -1,0 +1,181 @@
+"""Informer machinery: reflector + shared informer + listers.
+
+The client-go cache stack (tools/cache) reduced to its load-bearing
+parts:
+
+  Reflector      list+watch against the Store, relisting on Expired /
+                 stream termination (reflector.go:340 ListAndWatch, the
+                 410-Gone relist path)
+  SharedInformer local thread-safe object cache + handler fan-out
+                 (shared_informer.go:459 Run; handlers get add/update/
+                 delete callbacks after an initial synthetic-ADDED sync,
+                 DeltaFIFO's replace semantics)
+  Lister         snapshot reads of the informer cache (listers)
+
+Transport is the in-process api.store.Store — the deployment analogue of
+client-go speaking to the apiserver's watch cache.  Delivery runs on one
+informer thread per kind (client-go's single event goroutine per
+informer); handlers must not block it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ..api import store as st
+
+Handler = Callable[[str, Any, Optional[Any]], None]
+# Handler(event_type, obj, old_obj): old_obj set for MODIFIED only.
+
+
+class SharedInformer:
+    """One kind's local cache, kept in sync by a reflector thread."""
+
+    def __init__(self, store: st.Store, kind: str):
+        self._store = store
+        self.kind = kind
+        self._lock = threading.RLock()
+        self._cache: Dict[str, Any] = {}
+        self._handlers: List[Handler] = []
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._watch: Optional[st.Watch] = None
+
+    # -- wiring ------------------------------------------------------------
+
+    def add_handler(self, handler: Handler, replay: bool = True) -> None:
+        """Register a handler; when replay (the shared-informer contract),
+        it first receives synthetic ADDED events for the current cache."""
+        with self._lock:
+            if replay:
+                for obj in self._cache.values():
+                    handler(st.ADDED, obj, None)
+            self._handlers.append(handler)
+
+    def start(self) -> None:
+        if self._thread:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name=f"informer-{self.kind}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        w = self._watch
+        if w:
+            w.stop()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def wait_for_sync(self, timeout: Optional[float] = 10) -> bool:
+        """WaitForCacheSync: true once the initial list landed."""
+        return self._synced.wait(timeout)
+
+    # -- reads (listers) ---------------------------------------------------
+
+    def get(self, name: str, namespace: str = "default") -> Optional[Any]:
+        with self._lock:
+            return self._cache.get(self._key(namespace, name))
+
+    def list(self) -> List[Any]:
+        with self._lock:
+            return list(self._cache.values())
+
+    @staticmethod
+    def _key(namespace: str, name: str) -> str:
+        return f"{namespace}/{name}" if namespace else name
+
+    def _obj_key(self, obj: Any) -> str:
+        return self._key(obj.meta.namespace, obj.meta.name)
+
+    # -- reflector loop ----------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                rv = self._relist()
+                self._synced.set()
+                self._stream(rv)
+            except st.Expired:
+                continue  # relist (the 410 path)
+            except Exception:
+                if self._stop.is_set():
+                    return
+                self._stop.wait(0.05)  # backoff then relist
+
+    def _relist(self) -> int:
+        items, rv = self._store.list(self.kind)
+        with self._lock:
+            fresh = {self._obj_key(o): o for o in items}
+            stale = set(self._cache) - set(fresh)
+            for key in stale:
+                old = self._cache.pop(key)
+                self._emit(st.DELETED, old, None)
+            for key, obj in fresh.items():
+                old = self._cache.get(key)
+                self._cache[key] = obj
+                if old is None:
+                    self._emit(st.ADDED, obj, None)
+                elif old.meta.resource_version != obj.meta.resource_version:
+                    self._emit(st.MODIFIED, obj, old)
+        return rv
+
+    def _stream(self, rv: int) -> None:
+        self._watch = self._store.watch(self.kind, from_rv=rv)
+        try:
+            for ev in self._watch:
+                if self._stop.is_set():
+                    return
+                with self._lock:
+                    key = self._obj_key(ev.obj)
+                    if ev.type == st.DELETED:
+                        old = self._cache.pop(key, None)
+                        self._emit(st.DELETED, ev.obj, old)
+                    else:
+                        old = self._cache.get(key)
+                        self._cache[key] = ev.obj
+                        self._emit(
+                            st.ADDED if old is None else st.MODIFIED, ev.obj, old
+                        )
+        finally:
+            self._watch = None
+        # stream ended (overflow / store closed it): loop relists
+
+    def _emit(self, typ: str, obj: Any, old: Optional[Any]) -> None:
+        for h in self._handlers:
+            h(typ, obj, old)
+
+
+class InformerFactory:
+    """SharedInformerFactory: one informer per kind, shared by consumers."""
+
+    def __init__(self, store: st.Store):
+        self.store = store
+        self._informers: Dict[str, SharedInformer] = {}
+        self._lock = threading.Lock()
+
+    def informer(self, kind: str) -> SharedInformer:
+        with self._lock:
+            inf = self._informers.get(kind)
+            if inf is None:
+                inf = SharedInformer(self.store, kind)
+                self._informers[kind] = inf
+            return inf
+
+    def start(self) -> None:
+        with self._lock:
+            for inf in self._informers.values():
+                inf.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            for inf in self._informers.values():
+                inf.stop()
+
+    def wait_for_sync(self, timeout: Optional[float] = 10) -> bool:
+        with self._lock:
+            infs = list(self._informers.values())
+        return all(inf.wait_for_sync(timeout) for inf in infs)

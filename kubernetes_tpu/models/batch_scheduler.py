@@ -4,41 +4,144 @@ Wraps the ops kernels into the one-dispatch scheduling step the rest of
 the framework (host scheduler, extender endpoint, benchmarks) calls.  The
 north-star replacement for the reference's per-pod scheduling cycle
 (pkg/scheduler/schedule_one.go:66): one compiled program filters, scores,
-and greedily assigns an entire pending batch with assume-bookkeeping
-carried on device.
+and assigns an entire pending batch with assume-bookkeeping carried on
+device.
+
+Two solver paths, routed automatically:
+  * greedy scan (ops.assign) — exact one-pod-at-a-time reference
+    semantics; handles every constraint family.
+  * auction (ops.auction) — joint parallel solve for large bursts and
+    gang groups; static+resource families only.
+
+Cluster state is incremental (ops.schema.ClusterState): node and pod
+changes touch one tensor row, and per-batch encode cost is O(pending),
+the cache.go:185-260 UpdateSnapshot property.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple, Union
 
-import jax
 import numpy as np
 
 from ..api import types as api
 from ..ops import assign as assign_ops
+from ..ops import auction as auction_ops
 from ..ops import schema
 from ..ops.scores import DEFAULT_SCORE_CONFIG, ScoreConfig
 
+Result = Union[assign_ops.SolveResult, auction_ops.AuctionResult]
+
 
 class TPUBatchScheduler:
-    """Owns a SnapshotBuilder (persistent vocabularies) and a jitted solver.
+    """Owns the incremental cluster state (persistent vocabularies) and
+    the jitted solvers.
 
-    Usage:
+    Stateless usage (one-shot):
         sched = TPUBatchScheduler()
         placements = sched.schedule(nodes, pending_pods, bound_pods)
-        # placements: list[node-name or None], one per pending pod
+
+    Incremental usage (the host scheduler's path):
+        sched.add_node(n) / sched.remove_node(name)
+        sched.assume(pod, node_name) / sched.forget(pod)
+        placements = sched.schedule_pending(pending_pods)
     """
 
     def __init__(
         self,
         score_config: ScoreConfig = DEFAULT_SCORE_CONFIG,
         limits: Optional[schema.SnapshotLimits] = None,
+        mode: str = "auto",  # auto | greedy | auction
     ):
         self.builder = schema.SnapshotBuilder(limits)
+        self.state = schema.ClusterState(self.builder)
         self.score_config = score_config
-        self._solver = assign_ops.greedy_assign_jit(score_config)
-        self.last_result: Optional[assign_ops.SolveResult] = None
+        self.mode = mode
+        self._greedy = assign_ops.greedy_assign_jit(score_config)
+        self._auction = auction_ops.auction_assign_jit(score_config)
+        self.last_result: Optional[Result] = None
+
+    # -- incremental cluster state ---------------------------------------
+
+    def add_node(self, node: api.Node) -> None:
+        self.state.add_node(node)
+
+    def update_node(self, node: api.Node) -> None:
+        self.state.update_node(node)
+
+    def remove_node(self, name: str) -> None:
+        self.state.remove_node(name)
+
+    def assume(self, pod: api.Pod, node_name: str) -> None:
+        """Account a placement immediately (cache.go AssumePod)."""
+        self.state.add_pod(pod, node_name)
+
+    def forget(self, pod: api.Pod) -> None:
+        """Undo an assume / remove a bound pod (ForgetPod/RemovePod)."""
+        self.state.remove_pod(pod)
+
+    # -- scheduling -------------------------------------------------------
+
+    def _route(
+        self, snap: schema.Snapshot, features: assign_ops.FeatureFlags
+    ) -> str:
+        if self.mode != "auto":
+            return self.mode
+        has_gangs = auction_ops.num_groups(snap) > 0
+        if has_gangs and auction_ops.auction_features_ok(features):
+            return "auction"
+        return "greedy"
+
+    def solve(
+        self, snap: schema.Snapshot, topo_z: Optional[int] = None
+    ) -> assign_ops.SolveResult:
+        """Raw greedy device solve on a prebuilt snapshot.
+
+        topo_z is auto-derived when not given; passing a value smaller
+        than required aliases topology domains together and silently
+        corrupts spread/inter-pod state, so it is validated (when those
+        families are active — it is unused otherwise)."""
+        features = assign_ops.features_of(snap)
+        if features.spread or features.interpod:
+            required = assign_ops.required_topo_z(snap)
+            if topo_z is None:
+                topo_z = required
+            elif topo_z < required:
+                raise ValueError(
+                    f"topo_z={topo_z} < required_topo_z={required}: would "
+                    "alias topology values (see ops.assign.required_topo_z)"
+                )
+        return self._greedy(snap, topo_z, features)
+
+    def _dispatch(self, snap: schema.Snapshot) -> Result:
+        features = assign_ops.features_of(snap)
+        route = self._route(snap, features)
+        if route == "auction":
+            return self._auction(snap, features=features)
+        topo_z = (
+            assign_ops.required_topo_z(snap)
+            if (features.spread or features.interpod)
+            else 1
+        )
+        return self._greedy(snap, topo_z, features)
+
+    def schedule_pending(
+        self, pending: Sequence[api.Pod], num_pods_hint: int = 0
+    ) -> List[Optional[str]]:
+        """One batched scheduling step against the incremental state.
+        Returns one node name (or None) per pending pod.  Placements are
+        NOT auto-assumed — the host scheduler assumes/binds explicitly."""
+        if not pending:
+            return []
+        snap, meta = self.builder.build_from_state(
+            self.state, pending, num_pods_hint=num_pods_hint
+        )
+        result = self._dispatch(snap)
+        self.last_result = result
+        idx = np.asarray(result.assignment)[: meta.num_pods]
+        return [meta.node_name(int(i)) for i in idx]
+
+    # -- stateless (one-shot) ---------------------------------------------
 
     def snapshot(
         self,
@@ -57,25 +160,7 @@ class TPUBatchScheduler:
         if not pending:
             return []
         snap, meta = self.snapshot(nodes, pending, bound)
-        result = self._solver(snap, meta.topo_z)
+        result = self._dispatch(snap)
         self.last_result = result
         idx = np.asarray(result.assignment)[: meta.num_pods]
         return [meta.node_name(int(i)) for i in idx]
-
-    def solve(
-        self, snap: schema.Snapshot, topo_z: Optional[int] = None
-    ) -> assign_ops.SolveResult:
-        """Raw device-side solve on a prebuilt snapshot.
-
-        topo_z is auto-derived (required_topo_z) when not given; passing a
-        value smaller than required aliases topology domains together and
-        silently corrupts spread/inter-pod state, so it is validated."""
-        required = assign_ops.required_topo_z(snap)
-        if topo_z is None:
-            topo_z = required
-        elif topo_z < required:
-            raise ValueError(
-                f"topo_z={topo_z} < required_topo_z={required}: would alias "
-                "topology values together (see ops.assign.required_topo_z)"
-            )
-        return self._solver(snap, topo_z)

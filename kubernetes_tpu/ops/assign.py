@@ -8,9 +8,18 @@ axis whose carry *is* the assume bookkeeping (requested / ports updated
 tensor-side between picks), so a 10k-pod batch needs one device dispatch
 instead of 10k scheduling cycles.
 
-Host round-trips per batch: one.  Selector/preferred match masks are
-hoisted out of the scan — they depend only on labels, which placements
-don't change.
+Pods are solved in priority-then-batch-index order (the reference's
+queuesort/priority_sort.go:52 pop order); results are scattered back to
+input positions.
+
+The scan step is kept minimal: everything placement-independent — the
+NodeName/TaintToleration/NodeAffinity filter slice and the raw
+affinity/taint score rows — is hoisted out per *pod class*
+(schema.PodBatch.class_id groups pods with byte-identical static state),
+so a step only re-evaluates resource fit, the carried constraint state,
+and the closed-form allocation scores.
+
+Host round-trips per batch: one.
 
 Tie-breaking: first-max-index (deterministic).  The reference picks
 uniformly at random among max-score nodes via reservoir sampling
@@ -27,10 +36,22 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .filters import feasible_for_pod, pod_view, preferred_match, selector_match
+from .filters import (
+    fits_resources,
+    pod_view,
+    preferred_match,
+    selector_match,
+    static_feasible_for_pod,
+)
 from .interpod import interpod_filter, interpod_update, prep_terms
-from .schema import ClusterTensors, Snapshot
-from .scores import DEFAULT_SCORE_CONFIG, ScoreConfig, score_for_pod
+from .schema import ClusterTensors, PodBatch, Snapshot
+from .scores import (
+    DEFAULT_SCORE_CONFIG,
+    ScoreConfig,
+    node_affinity_raw,
+    score_from_raw,
+    taint_toleration_raw,
+)
 from .topology import prep_spread, spread_filter, spread_score, spread_update
 
 NEG_INF = jnp.float32(-jnp.inf)
@@ -45,6 +66,9 @@ class FeatureFlags(NamedTuple):
     soft_spread: bool = False  # any ScheduleAnyway constraints (scoring)
     interpod: bool = False     # any inter-pod (anti-)affinity terms
     term_slots: Tuple[int, ...] = ()  # topology-key slots those terms use
+    ports: bool = False        # any pending pod claims host ports (the
+                               # dynamic port-conflict carry; the static
+                               # check against bound pods is always on)
 
 
 def required_topo_z(snapshot: Snapshot) -> int:
@@ -67,6 +91,7 @@ def features_of(snapshot: Snapshot) -> FeatureFlags:
         soft_spread=bool((spread_valid & ~hard).any()),
         interpod=bool(term_valid.any()),
         term_slots=tuple(sorted(set(slots[term_valid].tolist()))),
+        ports=bool(np.asarray(snapshot.pods.port_bits).any()),
     )
 
 
@@ -75,6 +100,41 @@ class SolveResult(NamedTuple):
     scores: jnp.ndarray       # f32[P]: winning node's score (-inf if none)
     feasible_counts: jnp.ndarray  # i32[P]: feasible nodes seen by each pod
     cluster: ClusterTensors   # post-solve cluster (assumed placements applied)
+
+
+def class_statics(
+    cluster: ClusterTensors,
+    pods: PodBatch,
+    sel_mask: jnp.ndarray,
+    pref_mask: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Per-class hoisted tables: (static_feas[C, N], aff_raw[C, N],
+    taint_raw[C, N]).  One row per static-equivalence class, computed from
+    its representative pod; the scan gathers rows by class_id.  The static
+    feasibility folds in the port check against *initial* (bound-pod)
+    port claims; in-batch port conflicts ride the dynamic carry."""
+    p = pods.req.shape[0]
+    reps = jnp.clip(pods.class_rep, 0, p - 1)
+
+    def one(rep):
+        pod = pod_view(pods, rep)
+        sfeas = static_feasible_for_pod(cluster, pod, sel_mask) & ~(
+            (cluster.port_bits & pod.port_bits[None, :]).any(axis=-1)
+        )
+        return (
+            sfeas,
+            node_affinity_raw(pod, pref_mask),
+            taint_toleration_raw(cluster, pod),
+        )
+
+    return jax.vmap(one)(reps)
+
+
+def solve_order(pods: PodBatch) -> jnp.ndarray:
+    """Priority-then-batch-index pop order (queuesort/priority_sort.go:52:
+    higher priority first, earlier arrival breaking ties).  Stable argsort
+    on negated priority ≡ lexicographic (-priority, index)."""
+    return jnp.argsort(-pods.priority, stable=True).astype(jnp.int32)
 
 
 def _pick(
@@ -103,9 +163,10 @@ def greedy_assign(
     """Sequential-greedy solve of the whole pending batch on device.
 
     Semantically equivalent to running the reference's scheduling cycle
-    once per pod in batch order with cache assume between cycles — the
+    once per pod in priority order with cache assume between cycles — the
     scan carry holds everything a placement changes: resource usage,
-    ports, topology-spread counts, and inter-pod affinity term state.
+    in-batch port claims, topology-spread counts, and inter-pod affinity
+    term state.
 
     topo_z: padded topology-value vocab size (SnapshotMeta.topo_z or
     required_topo_z); auto-derived when None.  Both topo_z and features
@@ -124,25 +185,30 @@ def greedy_assign(
 
     sel_mask = selector_match(cluster, sel)
     pref_mask = preferred_match(cluster, pref)
+    sfeas_c, aff_c, taint_c = class_statics(cluster, pods, sel_mask, pref_mask)
+    c_dim = sfeas_c.shape[0]
     sp0 = prep_spread(cluster, sel_mask, spread, topo_z) if features.spread else None
     tm0 = (
         prep_terms(cluster, terms, topo_z, slots=features.term_slots)
         if features.interpod
         else None
     )
+    order = solve_order(pods)
     keys = (
         jax.random.split(jax.random.PRNGKey(tie_seed), p)
         if tie_seed is not None
         else None
     )
 
-    def step(carry, i):
-        requested, nonzero, ports, sp_counts, tm_present, tm_blocked, tm_global = carry
-        cl = cluster._replace(
-            requested=requested, nonzero_requested=nonzero, port_bits=ports
-        )
+    def step(carry, k):
+        requested, nonzero, new_ports, sp_counts, tm_present, tm_blocked, tm_global = carry
+        i = order[k]
+        cl = cluster._replace(requested=requested, nonzero_requested=nonzero)
         pod = pod_view(pods, i)
-        feas = feasible_for_pod(cl, pod, sel_mask)
+        cls = jnp.clip(pods.class_id[i], 0, c_dim - 1)
+        feas = sfeas_c[cls] & fits_resources(cl, pod)
+        if features.ports:
+            feas = feas & ~((new_ports & pod.port_bits[None, :]).any(axis=-1))
         sp = tm = None
         if features.spread:
             sp = sp0._replace(counts_node=sp_counts)
@@ -156,15 +222,20 @@ def greedy_assign(
         sp_score = (
             spread_score(sp, spread, i, feas) if features.soft_spread else None
         )
-        scores = score_for_pod(cl, pod, feas, pref_mask, cfg, spread_score=sp_score)
+        scores = score_from_raw(
+            cl, pod, feas, aff_c[cls], taint_c[cls], cfg, spread_score=sp_score
+        )
         masked = jnp.where(feas, scores, NEG_INF)
-        choice = _pick(masked, feas, keys[i] if keys is not None else None)
+        choice = _pick(masked, feas, keys[k] if keys is not None else None)
         idx = jnp.where(found, choice, -1).astype(jnp.int32)
 
         onehot = (jnp.arange(n) == choice) & found
         requested = requested + onehot[:, None] * pod.req[None, :]
         nonzero = nonzero + onehot[:, None] * pod.nonzero_req[None, :]
-        ports = jnp.where(onehot[:, None], ports | pod.port_bits[None, :], ports)
+        if features.ports:
+            new_ports = jnp.where(
+                onehot[:, None], new_ports | pod.port_bits[None, :], new_ports
+            )
         if features.spread:
             sp = spread_update(
                 sp, spread, i, sp.v[:, choice], sp.eligible[:, choice], found
@@ -178,25 +249,33 @@ def greedy_assign(
             tm_present, tm_blocked, tm_global = (
                 tm.present_bits, tm.blocked_bits, tm.global_any
             )
-        out = (idx, jnp.where(found, masked[choice], NEG_INF), feas.sum().astype(jnp.int32))
-        carry = (requested, nonzero, ports, sp_counts, tm_present, tm_blocked, tm_global)
+        out = (i, idx, jnp.where(found, masked[choice], NEG_INF),
+               feas.sum().astype(jnp.int32))
+        carry = (requested, nonzero, new_ports, sp_counts, tm_present, tm_blocked, tm_global)
         return carry, out
 
     zero = jnp.zeros(())
     init = (
         cluster.requested,
         cluster.nonzero_requested,
-        cluster.port_bits,
+        jnp.zeros_like(cluster.port_bits) if features.ports else zero,
         sp0.counts_node if features.spread else zero,
         tm0.present_bits if features.interpod else zero,
         tm0.blocked_bits if features.interpod else zero,
         tm0.global_any if features.interpod else zero,
     )
-    (requested, nonzero, ports, *_rest), (assignment, win_scores, feas_counts) = (
+    (requested, nonzero, new_ports, *_rest), (pod_is, assign_o, win_o, feas_o) = (
         jax.lax.scan(step, init, jnp.arange(p))
     )
+    # Scatter scan outputs (priority order) back to batch positions.
+    assignment = jnp.full(p, -1, jnp.int32).at[pod_is].set(assign_o)
+    win_scores = jnp.full(p, NEG_INF).at[pod_is].set(win_o)
+    feas_counts = jnp.zeros(p, jnp.int32).at[pod_is].set(feas_o)
     final = cluster._replace(
-        requested=requested, nonzero_requested=nonzero, port_bits=ports
+        requested=requested,
+        nonzero_requested=nonzero,
+        port_bits=(cluster.port_bits | new_ports) if features.ports
+        else cluster.port_bits,
     )
     return SolveResult(assignment, win_scores, feas_counts, final)
 
@@ -219,7 +298,14 @@ def greedy_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
         if features is None:
             features = features_of(snapshot)
         if topo_z is None:
-            topo_z = required_topo_z(snapshot)
+            # topo_z only shapes spread/inter-pod prep state; pinning it
+            # to 1 when neither family is active keeps the jit cache key
+            # stable as topology vocabularies grow.
+            topo_z = (
+                required_topo_z(snapshot)
+                if (features.spread or features.interpod)
+                else 1
+            )
         return run(snapshot, topo_z, features)
 
     return call

@@ -147,23 +147,32 @@ def preferred_match(cluster: ClusterTensors, pref: PreferredTable) -> jnp.ndarra
     return ok & pref.valid[:, None]
 
 
-def feasible_for_pod(
-    cluster: ClusterTensors, pod: PodView, sel_match: jnp.ndarray
-) -> jnp.ndarray:
-    """The fused Filter chain for one pod against every node: bool[N].
-
-    sel_match is the precomputed [S, N] selector mask from selector_match().
-    """
-    n = cluster.allocatable.shape[0]
-
-    # NodeResourcesFit: requested + pod <= allocatable, but only for
-    # resources the pod actually requests (fit.go:430-470 skips
-    # podRequest == 0; the pods-count row is always 1 so the per-pod
-    # capacity check rides the same comparison).
-    fits = (
+def fits_resources(cluster: ClusterTensors, pod: PodView) -> jnp.ndarray:
+    """NodeResourcesFit: requested + pod <= allocatable, but only for
+    resources the pod actually requests (fit.go:430-470 skips
+    podRequest == 0; the pods-count row is always 1 so the per-pod
+    capacity check rides the same comparison)."""
+    return (
         (pod.req[None, :] <= 0)
         | (cluster.requested + pod.req[None, :] <= cluster.allocatable)
     ).all(axis=-1)
+
+
+def ports_free(cluster: ClusterTensors, pod: PodView) -> jnp.ndarray:
+    """NodePorts: claimed host ports must be free on the node."""
+    return ~((cluster.port_bits & pod.port_bits[None, :]).any(axis=-1))
+
+
+def static_feasible_for_pod(
+    cluster: ClusterTensors, pod: PodView, sel_match: jnp.ndarray
+) -> jnp.ndarray:
+    """The placement-independent Filter slice for one pod: bool[N].
+    NodeName + TaintToleration + NodeAffinity + node validity — everything
+    that depends only on labels/taints/names, which placements never
+    change.  The solver hoists this out of its scan per pod *class*
+    (schema.PodBatch.class_id); resources (fits_resources) and ports
+    (ports_free, when pods claim ports) stay dynamic."""
+    n = cluster.allocatable.shape[0]
 
     # NodeName
     name_ok = (pod.name_id == -1) | (cluster.name_id == pod.name_id)
@@ -178,9 +187,6 @@ def feasible_for_pod(
 
     taints_ok = effect_ok(_NO_SCHEDULE) & effect_ok(_NO_EXECUTE)
 
-    # NodePorts: claimed host ports must be free.
-    ports_ok = ~((cluster.port_bits & pod.port_bits[None, :]).any(axis=-1))
-
     # NodeAffinity / nodeSelector
     sel_ok = jnp.where(
         pod.sel_idx < 0,
@@ -188,14 +194,20 @@ def feasible_for_pod(
         sel_match[jnp.clip(pod.sel_idx, 0, sel_match.shape[0] - 1)],
     )
 
+    return cluster.node_valid & pod.valid & name_ok & taints_ok & sel_ok
+
+
+def feasible_for_pod(
+    cluster: ClusterTensors, pod: PodView, sel_match: jnp.ndarray
+) -> jnp.ndarray:
+    """The fused Filter chain for one pod against every node: bool[N].
+
+    sel_match is the precomputed [S, N] selector mask from selector_match().
+    """
     return (
-        cluster.node_valid
-        & pod.valid
-        & fits
-        & name_ok
-        & taints_ok
-        & ports_ok
-        & sel_ok
+        static_feasible_for_pod(cluster, pod, sel_match)
+        & fits_resources(cluster, pod)
+        & ports_free(cluster, pod)
     )
 
 

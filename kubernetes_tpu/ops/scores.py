@@ -165,6 +165,41 @@ def normalize(
     return out
 
 
+def score_from_raw(
+    cluster: ClusterTensors,
+    pod: PodView,
+    feasible: jnp.ndarray,
+    aff_raw: jnp.ndarray,
+    taint_raw: jnp.ndarray,
+    cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,
+    axis_name: str | None = None,
+    spread_score: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Weighted plugin-score sum with precomputed *raw* static scores.
+
+    aff_raw/taint_raw are the placement-independent per-node raw scores
+    (node_affinity_raw / taint_toleration_raw), hoisted out of the
+    solver's scan per pod class; normalization stays per-step because its
+    maxima range over the pod's current feasible set.  fit/balanced are
+    computed here from the carried requested state."""
+    if cfg.fit_strategy == "MostAllocated":
+        fit = most_allocated(cluster, pod, cfg)
+    else:
+        fit = least_allocated(cluster, pod, cfg)
+    bal = balanced_allocation(cluster, pod, cfg)
+    aff = normalize(aff_raw, feasible, axis_name=axis_name)
+    taint = normalize(taint_raw, feasible, reverse=True, axis_name=axis_name)
+    total = (
+        cfg.fit_weight * fit
+        + cfg.balanced_weight * bal
+        + cfg.node_affinity_weight * aff
+        + cfg.taint_weight * taint
+    )
+    if spread_score is not None:
+        total = total + cfg.spread_weight * spread_score
+    return jnp.where(feasible, total, -1.0)
+
+
 def score_for_pod(
     cluster: ClusterTensors,
     pod: PodView,
@@ -179,21 +214,13 @@ def score_for_pod(
     axis_name: mesh axis to reduce normalization maxima over when the node
     axis is sharded.  spread_score: pre-normalized PodTopologySpread score
     (ops.topology.spread_score), weighted in here."""
-    if cfg.fit_strategy == "MostAllocated":
-        fit = most_allocated(cluster, pod, cfg)
-    else:
-        fit = least_allocated(cluster, pod, cfg)
-    bal = balanced_allocation(cluster, pod, cfg)
-    aff = normalize(node_affinity_raw(pod, pref_mask), feasible, axis_name=axis_name)
-    taint = normalize(
-        taint_toleration_raw(cluster, pod), feasible, reverse=True, axis_name=axis_name
+    return score_from_raw(
+        cluster,
+        pod,
+        feasible,
+        node_affinity_raw(pod, pref_mask),
+        taint_toleration_raw(cluster, pod),
+        cfg,
+        axis_name=axis_name,
+        spread_score=spread_score,
     )
-    total = (
-        cfg.fit_weight * fit
-        + cfg.balanced_weight * bal
-        + cfg.node_affinity_weight * aff
-        + cfg.taint_weight * taint
-    )
-    if spread_score is not None:
-        total = total + cfg.spread_weight * spread_score
-    return jnp.where(feasible, total, -1.0)

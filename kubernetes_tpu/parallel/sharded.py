@@ -28,18 +28,20 @@ from ..ops.assign import (
     NEG_INF,
     FeatureFlags,
     SolveResult,
+    class_statics,
     features_of,
     required_topo_z,
+    solve_order,
 )
 from ..ops.filters import (
-    feasible_for_pod,
+    fits_resources,
     pod_view,
     preferred_match,
     selector_match,
 )
 from ..ops.interpod import interpod_filter, interpod_update, prep_terms
 from ..ops.schema import ClusterTensors, Snapshot, SpreadTable, TermTable
-from ..ops.scores import DEFAULT_SCORE_CONFIG, ScoreConfig, score_for_pod
+from ..ops.scores import DEFAULT_SCORE_CONFIG, ScoreConfig, score_from_raw
 from ..ops.topology import prep_spread, spread_filter, spread_score, spread_update
 
 AXIS = "nodes"
@@ -136,6 +138,11 @@ def sharded_greedy_assign(
         offset = jax.lax.axis_index(AXIS) * n_local
         sel_mask = selector_match(cl, sel)
         pref_mask = preferred_match(cl, pref)
+        # Hoisted per-class statics over the local node shard ([C, N/k]);
+        # normalization maxima stay per-step (they span shards via pmax).
+        sfeas_c, aff_c, taint_c = class_statics(cl, pods, sel_mask, pref_mask)
+        c_dim = sfeas_c.shape[0]
+        order = solve_order(pods)
 
         # Local scatter + psum => replicated counts over all shards;
         # v/eligible/blocked stay node-sharded.
@@ -147,13 +154,15 @@ def sharded_greedy_assign(
                 cl, terms, topo_z, axis_name=AXIS, slots=features.term_slots
             )
 
-        def step(carry, i):
-            requested, nonzero, ports, sp_counts, tm_present, tm_blocked, tm_global = carry
-            cur = cl._replace(
-                requested=requested, nonzero_requested=nonzero, port_bits=ports
-            )
+        def step(carry, k):
+            requested, nonzero, new_ports, sp_counts, tm_present, tm_blocked, tm_global = carry
+            i = order[k]
+            cur = cl._replace(requested=requested, nonzero_requested=nonzero)
             pod = pod_view(pods, i)
-            feas = feasible_for_pod(cur, pod, sel_mask)
+            cls = jnp.clip(pods.class_id[i], 0, c_dim - 1)
+            feas = sfeas_c[cls] & fits_resources(cur, pod)
+            if features.ports:
+                feas = feas & ~((new_ports & pod.port_bits[None, :]).any(axis=-1))
             sp = tm = None
             if features.spread:
                 sp = sp0._replace(counts_node=sp_counts)
@@ -169,8 +178,9 @@ def sharded_greedy_assign(
                 if features.soft_spread
                 else None
             )
-            scores = score_for_pod(
-                cur, pod, feas, pref_mask, cfg, axis_name=AXIS, spread_score=sp_score
+            scores = score_from_raw(
+                cur, pod, feas, aff_c[cls], taint_c[cls], cfg,
+                axis_name=AXIS, spread_score=sp_score,
             )
             masked = jnp.where(feas, scores, NEG_INF)
 
@@ -187,7 +197,10 @@ def sharded_greedy_assign(
             onehot = ((jnp.arange(n_local) + offset) == winner) & found
             requested = requested + onehot[:, None] * pod.req[None, :]
             nonzero = nonzero + onehot[:, None] * pod.nonzero_req[None, :]
-            ports = jnp.where(onehot[:, None], ports | pod.port_bits[None, :], ports)
+            if features.ports:
+                new_ports = jnp.where(
+                    onehot[:, None], new_ports | pod.port_bits[None, :], new_ports
+                )
 
             own = found & (winner >= offset) & (winner < offset + n_local)
             wli = jnp.clip(winner - offset, 0, n_local - 1)
@@ -206,21 +219,29 @@ def sharded_greedy_assign(
                 )
 
             n_feas = jax.lax.psum(feas.sum().astype(jnp.int32), AXIS)
-            carry = (requested, nonzero, ports, sp_counts, tm_present, tm_blocked, tm_global)
-            return carry, (idx, jnp.where(found, best, NEG_INF), n_feas)
+            carry = (requested, nonzero, new_ports, sp_counts, tm_present, tm_blocked, tm_global)
+            return carry, (i, idx, jnp.where(found, best, NEG_INF), n_feas)
 
         zero = jnp.zeros(())
         init = (
-            cl.requested, cl.nonzero_requested, cl.port_bits,
+            cl.requested, cl.nonzero_requested,
+            jnp.zeros_like(cl.port_bits) if features.ports else zero,
             sp0.counts_node if features.spread else zero,
             tm0.present_bits if features.interpod else zero,
             tm0.blocked_bits if features.interpod else zero,
             tm0.global_any if features.interpod else zero,
         )
-        (requested, nonzero, ports, *_rest), (assignment, win, nf) = jax.lax.scan(
-            step, init, jnp.arange(p)
+        (requested, nonzero, new_ports, *_rest), (pod_is, assign_o, win_o, nf_o) = (
+            jax.lax.scan(step, init, jnp.arange(p))
         )
-        final = cl._replace(requested=requested, nonzero_requested=nonzero, port_bits=ports)
+        assignment = jnp.full(p, -1, jnp.int32).at[pod_is].set(assign_o)
+        win = jnp.full(p, NEG_INF).at[pod_is].set(win_o)
+        nf = jnp.zeros(p, jnp.int32).at[pod_is].set(nf_o)
+        final = cl._replace(
+            requested=requested,
+            nonzero_requested=nonzero,
+            port_bits=(cl.port_bits | new_ports) if features.ports else cl.port_bits,
+        )
         return SolveResult(assignment, win, nf, final)
 
     return run(cluster, pods, sel, pref, spread, terms)
@@ -241,7 +262,11 @@ def sharded_greedy_jit(mesh: Mesh, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
         if features is None:
             features = features_of(snapshot)
         if topo_z is None:
-            topo_z = required_topo_z(snapshot)
+            topo_z = (
+                required_topo_z(snapshot)
+                if (features.spread or features.interpod)
+                else 1
+            )
         return run(snapshot, topo_z, features)
 
     return call

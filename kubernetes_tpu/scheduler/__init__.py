@@ -1,1 +1,14 @@
-"""scheduler layer (being built out; see package docstring for the layout map)."""
+"""Host-side scheduler framework (SURVEY.md layer 8, pkg/scheduler):
+3-tier scheduling queue, assume-TTL cache over the incremental tensor
+state, metrics registry, and the informer-fed run loop that drains the
+queue into batched TPU solves."""
+
+from .cache import SchedulerCache
+from .metrics import Registry
+from .queue import QueuedPodInfo, SchedulingQueue, pod_key
+from .scheduler import Scheduler
+
+__all__ = [
+    "Scheduler", "SchedulerCache", "SchedulingQueue", "QueuedPodInfo",
+    "Registry", "pod_key",
+]

@@ -1,0 +1,152 @@
+"""Scheduler cache: assume/confirm/expire over the incremental tensor
+state.
+
+Reference: pkg/scheduler/internal/cache/cache.go:57-260.  The reference
+cache keeps per-node NodeInfo structs plus an assumed-pods set with TTL;
+ours keeps the same bookkeeping over ops.schema.ClusterState, whose rows
+ARE the snapshot (no separate UpdateSnapshot walk — updating a row is
+updating the snapshot, the end state the generation protocol exists to
+approximate).
+
+Lifecycle (cache.go's state machine):
+
+  assume(pod, node)    solver picked a node; resources land immediately
+                       so the next batch sees them (AssumePod)
+  finish_binding(pod)  bind API call returned; TTL countdown starts
+                       (FinishBinding)
+  confirm via add_pod  informer delivered the bound pod: assumed ->
+                       confirmed (AddPod on an assumed pod)
+  forget(pod)          bind failed; undo the assume (ForgetPod)
+  cleanup_expired()    assumed-with-finished-binding pods whose TTL
+                       passed are dropped — the informer never confirmed
+                       them (cleanupAssumedPods, run periodically)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ..api import types as api
+from ..ops import schema
+from .queue import pod_key
+
+
+@dataclass
+class _Assumed:
+    pod: api.Pod
+    node: str
+    binding_finished: bool = False
+    deadline: Optional[float] = None
+
+
+class SchedulerCache:
+    def __init__(
+        self,
+        state: schema.ClusterState,
+        ttl: float = 30.0,
+        clock=time.monotonic,
+    ):
+        self.state = state
+        self.ttl = ttl
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._assumed: Dict[str, _Assumed] = {}
+
+    # -- nodes (informer-fed) ---------------------------------------------
+
+    def add_node(self, node: api.Node) -> None:
+        with self._lock:
+            self.state.add_node(node)
+
+    def update_node(self, node: api.Node) -> None:
+        with self._lock:
+            self.state.update_node(node)
+
+    def remove_node(self, name: str) -> None:
+        with self._lock:
+            # drop assumed entries for pods that lived on the node
+            for key, a in list(self._assumed.items()):
+                if a.node == name:
+                    self._assumed.pop(key)
+            self.state.remove_node(name)
+
+    # -- assume protocol ---------------------------------------------------
+
+    def assume(self, pod: api.Pod, node: str) -> None:
+        key = pod_key(pod)
+        with self._lock:
+            if key in self._assumed:
+                raise ValueError(f"pod {key} already assumed")
+            self.state.add_pod(pod, node)
+            self._assumed[key] = _Assumed(pod=pod, node=node)
+
+    def finish_binding(self, pod: api.Pod) -> None:
+        with self._lock:
+            a = self._assumed.get(pod_key(pod))
+            if a is not None and not a.binding_finished:
+                a.binding_finished = True
+                a.deadline = self._clock() + self.ttl
+
+    def forget(self, pod: api.Pod) -> None:
+        key = pod_key(pod)
+        with self._lock:
+            a = self._assumed.pop(key, None)
+            if a is not None:
+                self.state.remove_pod(a.pod)
+
+    def is_assumed(self, pod: api.Pod) -> bool:
+        with self._lock:
+            return pod_key(pod) in self._assumed
+
+    # -- bound pods (informer-fed) ----------------------------------------
+
+    def add_pod(self, pod: api.Pod) -> None:
+        """Informer ADDED/MODIFIED with an assigned node.  Confirms an
+        assumed pod (dropping its TTL) or accounts a newly seen one."""
+        key = pod_key(pod)
+        with self._lock:
+            a = self._assumed.pop(key, None)
+            if a is not None:
+                if a.node == pod.spec.node_name:
+                    return  # confirmed; resources already accounted
+                # scheduled elsewhere than assumed: re-account
+                self.state.remove_pod(a.pod)
+            if not self.state.has_pod(pod):
+                self.state.add_pod(pod)
+
+    def update_pod(self, old: api.Pod, new: api.Pod) -> None:
+        with self._lock:
+            if self.state.has_pod(old):
+                self.state.remove_pod(old)
+            if new.spec.node_name:
+                self.state.add_pod(new)
+
+    def remove_pod(self, pod: api.Pod) -> None:
+        key = pod_key(pod)
+        with self._lock:
+            self._assumed.pop(key, None)
+            if self.state.has_pod(pod):
+                self.state.remove_pod(pod)
+
+    # -- expiry ------------------------------------------------------------
+
+    def cleanup_expired(self) -> List[api.Pod]:
+        """Drop assumed pods whose binding finished but the informer never
+        confirmed within TTL.  Returns the expired pods (callers requeue
+        them)."""
+        now = self._clock()
+        expired: List[api.Pod] = []
+        with self._lock:
+            for key, a in list(self._assumed.items()):
+                if a.binding_finished and a.deadline is not None and now > a.deadline:
+                    self._assumed.pop(key)
+                    self.state.remove_pod(a.pod)
+                    expired.append(a.pod)
+        return expired
+
+    def assumed_count(self) -> int:
+        with self._lock:
+            return len(self._assumed)

@@ -1,0 +1,124 @@
+"""Scheduler metrics — the reference's Prometheus surface reduced to an
+in-process registry (pkg/scheduler/metrics/metrics.go:89-150,
+component-base/metrics wrappers).  Metric *names* are kept identical so
+the scheduler_perf collectors scrape the same series the reference's do.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Tuple
+
+# the reference's scheduling-latency bucket layout (metrics.go:92:
+# ExponentialBuckets(0.001, 2, 15))
+_DEF_BUCKETS = tuple(0.001 * 2 ** i for i in range(15))
+
+
+class Histogram:
+    def __init__(self, name: str, buckets: Tuple[float, ...] = _DEF_BUCKETS):
+        self.name = name
+        self.buckets = sorted(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.total = 0.0
+        self.n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self.counts[bisect.bisect_left(self.buckets, value)] += 1
+            self.total += value
+            self.n += 1
+
+    def percentile(self, q: float) -> float:
+        """Linear-interpolated quantile from bucket counts (what the
+        perf-harness metricsCollector computes from histograms)."""
+        with self._lock:
+            if self.n == 0:
+                return 0.0
+            target = q * self.n
+            seen = 0
+            lo = 0.0
+            for i, c in enumerate(self.counts):
+                hi = self.buckets[i] if i < len(self.buckets) else lo * 2 or 1.0
+                if seen + c >= target and c > 0:
+                    frac = (target - seen) / c
+                    return lo + (hi - lo) * frac
+                seen += c
+                lo = hi
+            return lo
+
+    @property
+    def average(self) -> float:
+        with self._lock:
+            return self.total / self.n if self.n else 0.0
+
+
+class Counter:
+    def __init__(self, name: str):
+        self.name = name
+        self._v: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, *labels: str, by: float = 1.0) -> None:
+        with self._lock:
+            self._v[labels] = self._v.get(labels, 0.0) + by
+
+    def get(self, *labels: str) -> float:
+        with self._lock:
+            return self._v.get(labels, 0.0)
+
+    @property
+    def total(self) -> float:
+        with self._lock:
+            return sum(self._v.values())
+
+
+class Gauge:
+    def __init__(self, name: str):
+        self.name = name
+        self._v: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, *labels: str) -> None:
+        with self._lock:
+            self._v[labels] = value
+
+    def get(self, *labels: str) -> float:
+        with self._lock:
+            return self._v.get(labels, 0.0)
+
+
+class Registry:
+    """One scheduler's metric set, by reference name."""
+
+    def __init__(self):
+        # metrics.go:89 scheduling_attempt_duration_seconds
+        self.scheduling_attempt_duration = Histogram(
+            "scheduler_scheduling_attempt_duration_seconds"
+        )
+        # metrics.go SchedulingAlgorithmLatency
+        self.scheduling_algorithm_duration = Histogram(
+            "scheduler_scheduling_algorithm_duration_seconds"
+        )
+        # pod_scheduling_sli_duration_seconds (end-to-end incl. requeues)
+        self.pod_scheduling_sli_duration = Histogram(
+            "scheduler_pod_scheduling_sli_duration_seconds"
+        )
+        self.framework_extension_point_duration = Histogram(
+            "scheduler_framework_extension_point_duration_seconds"
+        )
+        # schedule_attempts_total{result="scheduled|unschedulable|error"}
+        self.schedule_attempts = Counter("scheduler_schedule_attempts_total")
+        # pending_pods{queue="active|backoff|unschedulable|gated"}
+        self.pending_pods = Gauge("scheduler_pending_pods")
+        self.preemption_victims = Histogram("scheduler_preemption_victims")
+        self.preemption_attempts = Counter("scheduler_preemption_attempts_total")
+
+    def snapshot(self) -> Dict[str, object]:
+        """Name → metric, for collectors."""
+        return {
+            m.name: m
+            for m in vars(self).values()
+            if isinstance(m, (Histogram, Counter, Gauge))
+        }

@@ -1,0 +1,267 @@
+"""The 3-tier scheduling queue, adapted to batch draining.
+
+Reference: pkg/scheduler/internal/queue/scheduling_queue.go:90-206.
+Tiers and transitions are preserved:
+
+  activeQ        heap in queuesort order (priority desc, then arrival —
+                 plugins/queuesort/priority_sort.go:52)
+  backoffQ       heap by backoff expiry; exponential per-pod backoff
+                 (DefaultPodInitialBackoff 1s .. DefaultPodMaxBackoff 10s,
+                 apis/config/types.go:72-77)
+  unschedulable  map of pods a cycle failed; they leave on cluster events
+                 (move_all_to_active_or_backoff — the pre-QueueingHints
+                 moveAllToActiveOrBackoffQueue behaviour) or after the
+                 flush interval (flushUnschedulablePodsLeftover,
+                 scheduling_queue.go DefaultPodMaxInUnschedulablePodsDuration)
+
+The one TPU-shaped change: the hot consumer is `pop_batch`, which drains
+up to max_n pods in queuesort order for one batched device solve, instead
+of the reference's one-pod Pop (schedule_one.go:66).  Gated pods
+(non-empty spec.scheduling_gates) are held outside all three tiers until
+their gates clear — the SchedulingGates PreEnqueue plugin
+(plugins/schedulinggates/scheduling_gates.go:62).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api import types as api
+
+
+def pod_key(pod: api.Pod) -> str:
+    return f"{pod.meta.namespace}/{pod.meta.name}"
+
+
+@dataclass
+class QueuedPodInfo:
+    """scheduling_queue.go QueuedPodInfo."""
+
+    pod: api.Pod
+    timestamp: float = 0.0            # arrival (queuesort tiebreak)
+    attempts: int = 0
+    initial_attempt_timestamp: float = 0.0
+    unschedulable_since: float = 0.0
+    gated: bool = False
+
+
+class SchedulingQueue:
+    def __init__(
+        self,
+        backoff_base: float = 1.0,
+        backoff_max: float = 10.0,
+        unschedulable_flush_after: float = 300.0,
+        clock=time.monotonic,
+    ):
+        self._clock = clock
+        self._base = backoff_base
+        self._max_backoff = backoff_max
+        self._flush_after = unschedulable_flush_after
+        self._cond = threading.Condition()
+        self._seq = itertools.count()
+        self._active: List[tuple] = []           # (-prio, ts, seq, key)
+        self._backoff: List[tuple] = []          # (ready, seq, key)
+        self._unschedulable: Dict[str, QueuedPodInfo] = {}
+        self._gated: Dict[str, QueuedPodInfo] = {}
+        self._infos: Dict[str, QueuedPodInfo] = {}   # all known pending pods
+        self._tier: Dict[str, str] = {}          # key -> active|backoff|unsched|gated|inflight
+        self._closed = False
+
+    # -- helpers -----------------------------------------------------------
+
+    def _push_active(self, info: QueuedPodInfo) -> None:
+        key = pod_key(info.pod)
+        heapq.heappush(
+            self._active,
+            (-info.pod.spec.priority, info.timestamp, next(self._seq), key),
+        )
+        self._tier[key] = "active"
+        self._cond.notify_all()
+
+    def _backoff_duration(self, info: QueuedPodInfo) -> float:
+        # calculateBackoffDuration: base * 2^(attempts-1), capped
+        d = self._base * (2 ** max(info.attempts - 1, 0))
+        return min(d, self._max_backoff)
+
+    def _push_backoff(self, info: QueuedPodInfo) -> None:
+        key = pod_key(info.pod)
+        ready = self._clock() + self._backoff_duration(info)
+        heapq.heappush(self._backoff, (ready, next(self._seq), key))
+        self._tier[key] = "backoff"
+        self._cond.notify_all()
+
+    def _flush_due_locked(self) -> None:
+        now = self._clock()
+        while self._backoff and self._backoff[0][0] <= now:
+            _, _, key = heapq.heappop(self._backoff)
+            info = self._infos.get(key)
+            if info is not None and self._tier.get(key) == "backoff":
+                self._push_active(info)
+        # unschedulable flush interval
+        stale = [
+            k for k, inf in self._unschedulable.items()
+            if now - inf.unschedulable_since >= self._flush_after
+        ]
+        for k in stale:
+            info = self._unschedulable.pop(k)
+            self._push_backoff(info)
+
+    # -- producer side (event handlers) -----------------------------------
+
+    def add(self, pod: api.Pod) -> None:
+        """A new pending pod (eventhandlers addPodToSchedulingQueue)."""
+        with self._cond:
+            if self._closed:
+                return
+            key = pod_key(pod)
+            now = self._clock()
+            info = self._infos.get(key)
+            if info is None:
+                info = QueuedPodInfo(
+                    pod=pod, timestamp=now, initial_attempt_timestamp=now
+                )
+                self._infos[key] = info
+            info.pod = pod
+            if pod.spec.scheduling_gates:
+                info.gated = True
+                self._gated[key] = info
+                self._tier[key] = "gated"
+                return
+            info.gated = False
+            if self._tier.get(key) in ("active", "backoff", "inflight"):
+                return
+            self._unschedulable.pop(key, None)
+            self._gated.pop(key, None)
+            self._push_active(info)
+
+    def update(self, pod: api.Pod) -> None:
+        """Spec/labels changed: gated pods re-check gates; unschedulable
+        pods get another chance (updatePodInSchedulingQueue)."""
+        with self._cond:
+            key = pod_key(pod)
+            info = self._infos.get(key)
+            if info is None:
+                self.add(pod)
+                return
+            info.pod = pod
+            tier = self._tier.get(key)
+            if tier == "gated" and not pod.spec.scheduling_gates:
+                self._gated.pop(key, None)
+                info.gated = False
+                self._push_active(info)
+            elif tier == "unsched":
+                self._unschedulable.pop(key, None)
+                self._push_active(info)
+
+    def delete(self, pod: api.Pod) -> None:
+        with self._cond:
+            key = pod_key(pod)
+            self._infos.pop(key, None)
+            self._unschedulable.pop(key, None)
+            self._gated.pop(key, None)
+            self._tier.pop(key, None)
+            # lazy heap deletion: stale keys skipped on pop
+
+    # -- consumer side -----------------------------------------------------
+
+    def pop_batch(
+        self, max_n: int, timeout: Optional[float] = None
+    ) -> List[QueuedPodInfo]:
+        """Drain up to max_n pods in queuesort order; blocks until at
+        least one is available (or timeout).  Popped pods are 'inflight'
+        until done()/requeue."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cond:
+            while True:
+                self._flush_due_locked()
+                batch: List[QueuedPodInfo] = []
+                while self._active and len(batch) < max_n:
+                    _, _, _, key = heapq.heappop(self._active)
+                    info = self._infos.get(key)
+                    if info is None or self._tier.get(key) != "active":
+                        continue  # stale entry
+                    self._tier[key] = "inflight"
+                    info.attempts += 1
+                    batch.append(info)
+                if batch:
+                    return batch
+                if self._closed:
+                    return []
+                wait = None
+                if self._backoff:
+                    wait = max(self._backoff[0][0] - self._clock(), 0.01)
+                if deadline is not None:
+                    remaining = deadline - self._clock()
+                    if remaining <= 0:
+                        return []
+                    wait = min(wait, remaining) if wait else remaining
+                self._cond.wait(wait)
+
+    def done(self, pod: api.Pod) -> None:
+        """Pod scheduled (assumed+bound): drop from the pending set."""
+        with self._cond:
+            key = pod_key(pod)
+            self._infos.pop(key, None)
+            self._tier.pop(key, None)
+
+    def add_unschedulable(self, info: QueuedPodInfo) -> None:
+        """A cycle failed to place the pod: park it until an event or the
+        flush interval (AddUnschedulableIfNotPresent)."""
+        with self._cond:
+            key = pod_key(info.pod)
+            if key not in self._infos:
+                return  # deleted meanwhile
+            info.unschedulable_since = self._clock()
+            self._unschedulable[key] = info
+            self._tier[key] = "unsched"
+
+    def requeue_backoff(self, info: QueuedPodInfo) -> None:
+        """Transient failure (e.g. bind error): retry after backoff."""
+        with self._cond:
+            key = pod_key(info.pod)
+            if key not in self._infos:
+                return
+            self._push_backoff(info)
+
+    def move_all_to_active_or_backoff(self, event: str = "") -> None:
+        """A cluster event may have made unschedulable pods schedulable:
+        move them to backoff (still inside their backoff window) or
+        active (MoveAllToActiveOrBackoffQueue, scheduling_queue.go:117)."""
+        with self._cond:
+            now = self._clock()
+            for key, info in list(self._unschedulable.items()):
+                self._unschedulable.pop(key)
+                if now < info.unschedulable_since + self._backoff_duration(info):
+                    self._push_backoff(info)
+                else:
+                    self._push_active(info)
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> Dict[str, int]:
+        with self._cond:
+            active = sum(1 for t in self._tier.values() if t == "active")
+            backoff = sum(1 for t in self._tier.values() if t == "backoff")
+            return {
+                "active": active,
+                "backoff": backoff,
+                "unschedulable": len(self._unschedulable),
+                "gated": len(self._gated),
+                "inflight": sum(
+                    1 for t in self._tier.values() if t == "inflight"
+                ),
+            }
+
+    def pending_count(self) -> int:
+        with self._cond:
+            return len(self._infos)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()

@@ -1,0 +1,195 @@
+"""The host scheduler: informer-fed cache + queue draining into batched
+device solves, with assume/bind/fail-requeue.
+
+Reference mapping (pkg/scheduler/scheduler.go, schedule_one.go):
+
+  Scheduler.run            scheduler.go:438 Run (queue flush + hot loop)
+  schedule_batch           the batched schedule_one.go:66 ScheduleOne:
+                           NextPod -> schedulePod -> assume -> bind; one
+                           device dispatch schedules the whole batch
+  _bind                    bindingCycle's DefaultBinder POST
+                           (schedule_one.go:962, defaultbinder)
+  failure handling         handleSchedulingFailure :1017 ->
+                           AddUnschedulableIfNotPresent; bind errors
+                           forget the assume and requeue with backoff
+  event wiring             eventhandlers.go:287 addAllEventHandlers:
+                           informers feed cache (assigned pods, nodes)
+                           and queue (pending pods, requeue-on-event)
+
+The scheduling algorithm itself — filters, scores, selectHost, the
+assume bookkeeping between pods of one batch — runs on the TPU inside
+TPUBatchScheduler (models/batch_scheduler.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..api import store as st
+from ..api import types as api
+from ..client.informers import InformerFactory
+from ..models.batch_scheduler import TPUBatchScheduler
+from .cache import SchedulerCache
+from .metrics import Registry
+from .queue import QueuedPodInfo, SchedulingQueue, pod_key
+
+
+class Scheduler:
+    def __init__(
+        self,
+        store: st.Store,
+        batch_size: int = 4096,
+        tpu: Optional[TPUBatchScheduler] = None,
+        assume_ttl: float = 30.0,
+        clock=time.monotonic,
+    ):
+        self.store = store
+        self.batch_size = batch_size
+        self.tpu = tpu or TPUBatchScheduler()
+        self.cache = SchedulerCache(self.tpu.state, ttl=assume_ttl, clock=clock)
+        self.queue = SchedulingQueue(clock=clock)
+        self.metrics = Registry()
+        self.informers = InformerFactory(store)
+        self._clock = clock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._wire_handlers()
+
+    # -- event wiring (eventhandlers.go:287) ------------------------------
+
+    def _wire_handlers(self) -> None:
+        self.informers.informer("Node").add_handler(self._on_node)
+        self.informers.informer("Pod").add_handler(self._on_pod)
+
+    def _on_node(self, typ: str, node: api.Node, old) -> None:
+        if typ == st.ADDED:
+            self.cache.add_node(node)
+            self.queue.move_all_to_active_or_backoff("NodeAdd")
+        elif typ == st.MODIFIED:
+            self.cache.update_node(node)
+            self.queue.move_all_to_active_or_backoff("NodeUpdate")
+        elif typ == st.DELETED:
+            self.cache.remove_node(node.meta.name)
+
+    def _on_pod(self, typ: str, pod: api.Pod, old) -> None:
+        assigned = bool(pod.spec.node_name)
+        if typ == st.DELETED:
+            if assigned:
+                self.cache.remove_pod(pod)
+                # a terminated pod frees resources: unschedulable pods
+                # may fit now (AssignedPodDelete cluster event)
+                self.queue.move_all_to_active_or_backoff("AssignedPodDelete")
+            else:
+                self.queue.delete(pod)
+            return
+        if assigned:
+            # bound (or our own bind echoing back): confirm in cache
+            if old is not None and not old.spec.node_name:
+                self.queue.done(pod)
+            self.cache.add_pod(pod)
+            return
+        if typ == st.ADDED:
+            self.queue.add(pod)
+        else:
+            self.queue.update(pod)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Start informers + the scheduling loop thread."""
+        self.informers.informer("Node").start()
+        self.informers.informer("Pod").start()
+        self.informers.wait_for_sync()
+        self._thread = threading.Thread(
+            target=self._run, name="scheduler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.close()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self.informers.stop()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.schedule_batch(timeout=0.2)
+            for pod in self.cache.cleanup_expired():
+                # binding never confirmed: give the pod another chance
+                self.queue.add(pod)
+
+    # -- the batched scheduling cycle -------------------------------------
+
+    def schedule_batch(self, timeout: Optional[float] = None) -> Dict[str, int]:
+        """One batched cycle: drain -> device solve -> assume+bind each
+        placement -> park failures.  Returns counters for tests/metrics."""
+        batch = self.queue.pop_batch(self.batch_size, timeout=timeout)
+        stats = {"popped": len(batch), "scheduled": 0, "unschedulable": 0,
+                 "bind_errors": 0}
+        if not batch:
+            return stats
+        t0 = self._clock()
+        names = self.tpu.schedule_pending([info.pod for info in batch])
+        self.metrics.scheduling_algorithm_duration.observe(self._clock() - t0)
+
+        for info, node_name in zip(batch, names):
+            t_attempt = self._clock()
+            if node_name is None:
+                stats["unschedulable"] += 1
+                self.metrics.schedule_attempts.inc("unschedulable")
+                self.queue.add_unschedulable(info)
+                continue
+            try:
+                self.cache.assume(info.pod, node_name)
+            except (KeyError, ValueError):
+                stats["bind_errors"] += 1
+                self.metrics.schedule_attempts.inc("error")
+                self.queue.requeue_backoff(info)
+                continue
+            try:
+                self._bind(info.pod, node_name)
+            except Exception:
+                self.cache.forget(info.pod)
+                stats["bind_errors"] += 1
+                self.metrics.schedule_attempts.inc("error")
+                self.queue.requeue_backoff(info)
+                continue
+            self.cache.finish_binding(info.pod)
+            self.queue.done(info.pod)
+            stats["scheduled"] += 1
+            self.metrics.schedule_attempts.inc("scheduled")
+            self.metrics.scheduling_attempt_duration.observe(
+                self._clock() - t_attempt
+            )
+            self.metrics.pod_scheduling_sli_duration.observe(
+                self._clock() - info.initial_attempt_timestamp
+            )
+
+        qs = self.queue.stats()
+        for tier, v in qs.items():
+            self.metrics.pending_pods.set(v, tier)
+        return stats
+
+    def _bind(self, pod: api.Pod, node_name: str) -> None:
+        """The DefaultBinder POST pods/{name}/binding analogue: write
+        nodeName through the API with optimistic concurrency."""
+        current = self.store.get("Pod", pod.meta.name, pod.meta.namespace)
+        current.spec.node_name = node_name
+        current.status.phase = "Running"
+        self.store.update(current)
+
+    # -- test/bench convenience -------------------------------------------
+
+    def wait_for_idle(self, timeout: float = 30.0) -> bool:
+        """True once no pending pods remain in active/backoff/inflight
+        (unschedulable pods may remain parked)."""
+        deadline = self._clock() + timeout
+        while self._clock() < deadline:
+            s = self.queue.stats()
+            if s["active"] == 0 and s["inflight"] == 0 and s["backoff"] == 0:
+                return True
+            time.sleep(0.02)
+        return False

@@ -56,6 +56,11 @@ class PodWrapper:
         self.pod.spec.priority = p
         return self
 
+    def group(self, name: str) -> "PodWrapper":
+        """Gang/coscheduling group (PodSpec.scheduling_group)."""
+        self.pod.spec.scheduling_group = name
+        return self
+
     def toleration(
         self, key: str = "", op: str = api.OP_EXISTS, value: str = "", effect: str = ""
     ) -> "PodWrapper":

@@ -1,0 +1,136 @@
+"""Incremental ClusterState: parity with bulk builds, node/pod lifecycle,
+and the assume/forget protocol (cache.go:57-260 analogue)."""
+
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.models.batch_scheduler import TPUBatchScheduler
+from kubernetes_tpu.ops import assign, schema
+from kubernetes_tpu.testing.wrappers import GI, MI, make_node, make_pod
+
+
+def _nodes(n=8):
+    return [
+        make_node(f"n{i}")
+        .capacity(cpu_milli=8000, mem=16 * GI, pods=10)
+        .zone(f"z{i % 3}")
+        .obj()
+        for i in range(n)
+    ]
+
+
+def _pods(p=12):
+    return [
+        make_pod(f"p{i}").req(cpu_milli=1000, mem=GI).obj() for i in range(p)
+    ]
+
+
+def test_state_matches_bulk_build():
+    nodes, pods = _nodes(), _pods()
+    bound = [make_pod("b0").req(cpu_milli=2000).node_name("n3").obj()]
+
+    b1 = schema.SnapshotBuilder()
+    snap1, meta1 = b1.build(nodes, pods, bound_pods=bound)
+
+    b2 = schema.SnapshotBuilder()
+    st = schema.ClusterState(b2)
+    for nd in nodes:
+        st.add_node(nd)
+    st.add_pod(bound[0])
+    snap2, meta2 = b2.build_from_state(st, pods)
+
+    for a1, a2 in zip(snap1.cluster, snap2.cluster):
+        np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    r1 = np.asarray(assign.greedy_assign(snap1).assignment)
+    r2 = np.asarray(assign.greedy_assign(snap2).assignment)
+    np.testing.assert_array_equal(r1, r2)
+    assert meta2.node_name(0) == "n0"
+
+
+def test_assume_forget_roundtrip():
+    st = schema.ClusterState(schema.SnapshotBuilder())
+    for nd in _nodes():
+        st.add_node(nd)
+    before = [a.copy() for a in st.tensors()]
+    pod = make_pod("x").req(cpu_milli=1500, mem=2 * GI).host_port(8080).obj()
+    st.add_pod(pod, "n2")
+    assert st.has_pod(pod)
+    changed = st.tensors()
+    assert changed.requested[2, schema.RESOURCE_CPU] == 1500
+    assert changed.port_bits[2].any()
+    st.remove_pod(pod)
+    after = st.tensors()
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(np.asarray(b), np.asarray(a))
+
+
+def test_update_node_preserves_usage():
+    st = schema.ClusterState(schema.SnapshotBuilder())
+    nodes = _nodes()
+    for nd in nodes:
+        st.add_node(nd)
+    st.add_pod(make_pod("x").req(cpu_milli=1000).obj(), "n1")
+    updated = (
+        make_node("n1")
+        .capacity(cpu_milli=16000, mem=32 * GI, pods=20)
+        .zone("z9")
+        .label("disk", "ssd")
+        .obj()
+    )
+    st.update_node(updated)
+    t = st.tensors()
+    assert t.allocatable[1, schema.RESOURCE_CPU] == 16000
+    assert t.requested[1, schema.RESOURCE_CPU] == 1000  # preserved
+    assert t.label_bits[1].any()
+
+
+def test_remove_node_frees_row_for_reuse():
+    st = schema.ClusterState(schema.SnapshotBuilder())
+    for nd in _nodes(4):
+        st.add_node(nd)
+    st.remove_node("n1")
+    t = st.tensors()
+    assert not t.node_valid[1]
+    assert st.num_nodes == 3
+    st.add_node(make_node("n9").capacity(cpu_milli=4000, mem=GI).obj())
+    t = st.tensors()
+    assert t.node_valid[1]  # freed row reused
+    assert st.node_names[1] == "n9"
+
+
+def test_scheduler_incremental_flow():
+    """schedule_pending + assume: the second batch sees the first batch's
+    placements; forget releases them."""
+    sched = TPUBatchScheduler()
+    for nd in _nodes(2):
+        sched.add_node(nd)
+    # Each node fits 8 such pods on cpu (8000/1000).
+    first = [make_pod(f"a{i}").req(cpu_milli=1000).obj() for i in range(16)]
+    names = sched.schedule_pending(first)
+    assert all(n is not None for n in names)
+    for p, n in zip(first, names):
+        sched.assume(p, n)
+    # cluster is now cpu-full: nothing fits
+    second = [make_pod("b0").req(cpu_milli=1000).obj()]
+    assert sched.schedule_pending(second) == [None]
+    # forget one, retry: fits again
+    sched.forget(first[0])
+    assert sched.schedule_pending(second)[0] is not None
+
+
+def test_growth_past_initial_capacity():
+    st = schema.ClusterState(schema.SnapshotBuilder())
+    nodes = _nodes(70)  # > min_nodes default, forces several grows
+    for nd in nodes:
+        st.add_node(nd)
+    t = st.tensors()
+    assert st.num_nodes == 70
+    assert t.node_valid[:70].all()
+    assert t.allocatable.shape[0] >= 70
+    # scalar resource widening
+    st.add_pod(
+        make_pod("gpu").req(cpu_milli=100, **{"example.com/gpu": 2}).obj(), "n0"
+    )
+    t = st.tensors()
+    gi = st.builder.resource_names.index("example.com/gpu")
+    assert t.requested[0, gi] == 2

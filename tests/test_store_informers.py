@@ -1,0 +1,110 @@
+"""API store + watch + informer layer (the integration-test tier's
+foundation: nodes/pods as API objects only, test/integration/util/util.go:86)."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import store as st
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client import InformerFactory, WorkQueue
+from kubernetes_tpu.testing.wrappers import GI, make_node, make_pod
+
+
+def test_crud_and_versions():
+    s = st.Store()
+    pod = make_pod("a").obj()
+    created = s.create(pod)
+    assert created.meta.resource_version == 1
+    got = s.get("Pod", "a")
+    assert got.meta.name == "a"
+    got.spec.node_name = "n1"
+    updated = s.update(got)
+    assert updated.meta.resource_version == 2
+    # stale rv conflicts
+    got2 = s.get("Pod", "a")
+    got2.meta.resource_version = 1
+    with pytest.raises(st.Conflict):
+        s.update(got2)
+    with pytest.raises(st.AlreadyExists):
+        s.create(pod)
+    s.delete("Pod", "a")
+    with pytest.raises(st.NotFound):
+        s.get("Pod", "a")
+
+
+def test_list_returns_rv_for_watch_resume():
+    s = st.Store()
+    s.create(make_pod("a").obj())
+    items, rv = s.list("Pod")
+    assert len(items) == 1
+    w = s.watch("Pod", from_rv=rv)
+    s.create(make_pod("b").obj())
+    ev = w.get(timeout=2)
+    assert ev.type == st.ADDED and ev.obj.meta.name == "b"
+    w.stop()
+
+
+def test_watch_replays_buffered_events():
+    s = st.Store()
+    s.create(make_pod("a").obj())   # rv 1
+    s.create(make_pod("b").obj())   # rv 2
+    w = s.watch("Pod", from_rv=1)   # should replay b's ADDED
+    ev = w.get(timeout=2)
+    assert ev.obj.meta.name == "b" and ev.rv == 2
+    w.stop()
+
+
+def test_watch_expired_when_too_old():
+    s = st.Store(buffer_size=8)
+    for i in range(40):  # trims buffer
+        s.create(make_pod(f"p{i}").obj())
+    with pytest.raises(st.Expired):
+        s.watch("Pod", from_rv=1)
+
+
+def test_informer_sync_and_stream():
+    s = st.Store()
+    s.create(make_node("n0").capacity(cpu_milli=1000, mem=GI).obj())
+    factory = InformerFactory(s)
+    inf = factory.informer("Node")
+    events = []
+    inf.add_handler(lambda t, o, old: events.append((t, o.meta.name)))
+    inf.start()
+    assert inf.wait_for_sync(5)
+    assert inf.get("n0", namespace="") is not None
+    s.create(make_node("n1").capacity(cpu_milli=1000, mem=GI).obj())
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and len(inf.list()) < 2:
+        time.sleep(0.01)
+    assert {n for _, n in events} >= {"n0", "n1"}
+    # delete propagates
+    s.delete("Node", "n0", namespace="")
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and inf.get("n0", namespace="") is not None:
+        time.sleep(0.01)
+    assert inf.get("n0", namespace="") is None
+    factory.stop()
+
+
+def test_workqueue_dedup_and_backoff():
+    q = WorkQueue(base_delay=0.01, max_delay=1.0)
+    q.add("x"); q.add("x")
+    assert q.get(timeout=1) == "x"
+    assert len(q) == 0
+    # re-add while processing: comes back after done
+    q.add("x")
+    q.done("x")
+    assert q.get(timeout=1) == "x"
+    q.done("x")
+    # rate-limited: backoff grows, forget resets
+    q.add_rate_limited("y")
+    assert q.num_requeues("y") == 1
+    item = q.get(timeout=2)
+    assert item == "y"
+    q.done("y")
+    q.forget("y")
+    assert q.num_requeues("y") == 0
+    q.shutdown()
+    assert q.get(timeout=0.1) is None
