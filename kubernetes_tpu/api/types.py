@@ -197,6 +197,13 @@ class PodAffinityTerm:
     label_selector: Optional[LabelSelector] = None
     topology_key: str = LABEL_HOSTNAME
     namespaces: List[str] = field(default_factory=list)  # empty => pod's own ns
+    # namespace_selector needs Namespace objects (not modelled); encode
+    # raises when set rather than silently ignoring it.
+    namespace_selector: Optional[LabelSelector] = None
+    # match_label_keys fold the *incoming pod's* label values into the
+    # selector at schedule time (interpodaffinity PreFilter since 1.29);
+    # the encoder implements this merge.
+    match_label_keys: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -238,7 +245,16 @@ class TopologySpreadConstraint:
     topology_key: str = LABEL_ZONE
     when_unsatisfiable: str = "DoNotSchedule"  # or "ScheduleAnyway"
     label_selector: Optional[LabelSelector] = None
+    # When fewer eligible domains than min_domains exist, global minimum
+    # is treated as 0 (filtering.go minMatchNum); DoNotSchedule only.
     min_domains: Optional[int] = None
+    # Pod label values at these keys merge into the selector at schedule
+    # time (PreFilter); the encoder implements this merge.
+    match_label_keys: List[str] = field(default_factory=list)
+    # NodeInclusionPolicies: only the reference defaults are implemented
+    # (Honor affinity, Ignore taints); encode raises on other values.
+    node_affinity_policy: str = "Honor"   # Honor | Ignore
+    node_taints_policy: str = "Ignore"    # Honor | Ignore
 
 
 # ---------------------------------------------------------------------------

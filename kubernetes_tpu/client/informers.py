@@ -20,10 +20,13 @@ informer); handlers must not block it.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
 from ..api import store as st
+
+logger = logging.getLogger(__name__)
 
 Handler = Callable[[str, Any, Optional[Any]], None]
 # Handler(event_type, obj, old_obj): old_obj set for MODIFIED only.
@@ -51,7 +54,13 @@ class SharedInformer:
         with self._lock:
             if replay:
                 for obj in self._cache.values():
-                    handler(st.ADDED, obj, None)
+                    try:
+                        handler(st.ADDED, obj, None)
+                    except Exception:
+                        logger.exception(
+                            "informer %s: handler %r failed on replay",
+                            self.kind, handler,
+                        )
             self._handlers.append(handler)
 
     def start(self) -> None:
@@ -145,8 +154,17 @@ class SharedInformer:
         # stream ended (overflow / store closed it): loop relists
 
     def _emit(self, typ: str, obj: Any, old: Optional[Any]) -> None:
+        # Handler faults must not kill the stream or starve later handlers
+        # (client-go's processorListener delivery is panic-isolated per
+        # listener); the local cache was already updated, so a dead stream
+        # would never re-deliver this event after relist.
         for h in self._handlers:
-            h(typ, obj, old)
+            try:
+                h(typ, obj, old)
+            except Exception:
+                logger.exception(
+                    "informer %s: handler %r failed on %s", self.kind, h, typ
+                )
 
 
 class InformerFactory:

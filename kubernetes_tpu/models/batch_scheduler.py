@@ -20,8 +20,10 @@ the cache.go:185-260 UpdateSnapshot property.
 
 from __future__ import annotations
 
+import contextlib
 from typing import List, Optional, Sequence, Tuple, Union
 
+import jax
 import numpy as np
 
 from ..api import types as api
@@ -125,21 +127,44 @@ class TPUBatchScheduler:
         )
         return self._greedy(snap, topo_z, features)
 
+    def encode_pending(
+        self, pending: Sequence[api.Pod], num_pods_hint: int = 0, lock=None
+    ) -> Tuple[schema.Snapshot, schema.SnapshotMeta]:
+        """Encode pending pods + live cluster state into a device-resident
+        snapshot.  `lock` (the scheduler cache's mutex) is held across the
+        encode AND the device transfer: build_from_state returns views
+        aliasing live arrays that informer threads mutate, and both sides
+        intern into the shared vocabularies — the reference holds the cache
+        mutex for UpdateSnapshot (cache.go:185) for the same reason.
+        device_put copies the host buffers, so once it returns the snapshot
+        is immune to further cache mutation."""
+        with lock if lock is not None else contextlib.nullcontext():
+            snap, meta = self.builder.build_from_state(
+                self.state, pending, num_pods_hint=num_pods_hint
+            )
+            return jax.device_put(snap), meta
+
+    def solve_encoded(
+        self, snap: schema.Snapshot, meta: schema.SnapshotMeta
+    ) -> List[Optional[str]]:
+        """Dispatch a prebuilt snapshot and decode node names."""
+        result = self._dispatch(snap)
+        self.last_result = result
+        idx = np.asarray(result.assignment)[: meta.num_pods]
+        return [meta.node_name(int(i)) for i in idx]
+
     def schedule_pending(
-        self, pending: Sequence[api.Pod], num_pods_hint: int = 0
+        self, pending: Sequence[api.Pod], num_pods_hint: int = 0, lock=None
     ) -> List[Optional[str]]:
         """One batched scheduling step against the incremental state.
         Returns one node name (or None) per pending pod.  Placements are
         NOT auto-assumed — the host scheduler assumes/binds explicitly."""
         if not pending:
             return []
-        snap, meta = self.builder.build_from_state(
-            self.state, pending, num_pods_hint=num_pods_hint
+        snap, meta = self.encode_pending(
+            pending, num_pods_hint=num_pods_hint, lock=lock
         )
-        result = self._dispatch(snap)
-        self.last_result = result
-        idx = np.asarray(result.assignment)[: meta.num_pods]
-        return [meta.node_name(int(i)) for i in idx]
+        return self.solve_encoded(snap, meta)
 
     # -- stateless (one-shot) ---------------------------------------------
 

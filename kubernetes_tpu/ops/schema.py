@@ -26,10 +26,17 @@ solves at similar scale hit the XLA compile cache.
 
 from __future__ import annotations
 
+import warnings
 from dataclasses import dataclass, field
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
+
+# f32 represents integers exactly up to 2^24.  The score kernels form
+# `quantity * 100` products (ops/scores.py), so any allocatable value
+# above this threshold can drift Least/MostAllocated floors by ±1 vs the
+# reference's int64 math.  Validated at node encode; see _check_f32_exact.
+F32_EXACT_LIMIT = float(1 << 24) / 100.0
 
 from ..api import types as api
 from ..utils import vocab as vb
@@ -114,6 +121,7 @@ class SpreadTable(NamedTuple):
     valid: np.ndarray         # bool[C]
     slot: np.ndarray          # i32[C]   topology-key slot in topo_ids
     max_skew: np.ndarray      # f32[C]
+    min_domains: np.ndarray   # f32[C]   0 = unset (filtering.go minMatchNum)
     hard: np.ndarray          # bool[C]  DoNotSchedule (filter) vs ScheduleAnyway (score)
     owner_sel_idx: np.ndarray  # i32[C]  owner pod's SelectorTable row, -1 none
     owner_keys: np.ndarray    # bool[C, TK] topology keys the owner's constraints use
@@ -571,6 +579,7 @@ class SnapshotBuilder:
         valid[i] = True
         name_id[i] = self.name_vocab.get(node.meta.name)
         alloc[i] = self._resource_vector(node.status.allocatable, r, grow=False)
+        self._check_f32_exact(node.meta.name, alloc[i])
         label_bits[i] = 0
         for k, v in node.meta.labels.items():
             if k in self.topo_vocabs:
@@ -587,6 +596,24 @@ class SnapshotBuilder:
             val = node.meta.labels.get(key)
             if val is not None:
                 topo_ids[i, j] = self.topo_vocabs[key].get(val)
+
+    def _check_f32_exact(self, node_name: str, alloc_row: np.ndarray) -> None:
+        """Warn (once per builder) when a node's allocatable exceeds the
+        f32 exact-integer envelope: score floors may drift ±1 vs the
+        reference's int64 math (the `* 100 < 2^24` claim in ops/scores.py
+        is only guaranteed inside this range)."""
+        if getattr(self, "_f32_warned", False):
+            return
+        over = alloc_row[alloc_row > F32_EXACT_LIMIT]
+        if over.size:
+            self._f32_warned = True
+            warnings.warn(
+                f"node {node_name!r}: allocatable value {over.max():.0f} "
+                f"(device units) exceeds {F32_EXACT_LIMIT:.0f}; "
+                "Least/MostAllocated scores may differ from the reference "
+                "by ±1 on this node (f32 exactness envelope)",
+                stacklevel=3,
+            )
 
     def pod_usage(
         self, pod: api.Pod, r: int
@@ -757,7 +784,7 @@ class SnapshotBuilder:
         # A constraint instance is owner-scoped: eligibility honours the
         # owner's node selector/affinity and requires every topology key of
         # *all* the owner's constraints (filtering.go PreFilter).
-        spread_rows: List[tuple] = []  # (api constraint, owner_ns, owner_sel, keys)
+        spread_rows: List[tuple] = []  # (api constraint, sel, owner_ns, owner_sel, keys)
         spread_index: Dict[tuple, int] = {}
         pod_spread_idx = np.full((p_dim, mc), -1, dtype=np.int32)
         for i, pod in enumerate(pods):
@@ -774,11 +801,22 @@ class SnapshotBuilder:
             )
             keys = tuple(sorted({c.topology_key for c in cons}))
             for j, c in enumerate(cons):
+                if c.node_affinity_policy != "Honor" or c.node_taints_policy != "Ignore":
+                    raise OverflowError(
+                        "nodeInclusionPolicies other than the defaults "
+                        "(Honor affinity / Ignore taints) are not implemented; "
+                        f"got affinity={c.node_affinity_policy!r} "
+                        f"taints={c.node_taints_policy!r}"
+                    )
+                sel = _merge_match_label_keys(
+                    c.label_selector, c.match_label_keys, pod.meta.labels
+                )
                 sig = (
                     c.topology_key,
                     c.max_skew,
+                    c.min_domains,
                     c.when_unsatisfiable,
-                    _label_selector_signature(c.label_selector),
+                    _label_selector_signature(sel),
                     pod.meta.namespace,
                     owner_sel_row,
                     keys,
@@ -787,7 +825,7 @@ class SnapshotBuilder:
                 if idx is None:
                     idx = len(spread_rows)
                     spread_index[sig] = idx
-                    spread_rows.append((c, pod.meta.namespace, owner_sel_row, keys))
+                    spread_rows.append((c, sel, pod.meta.namespace, owner_sel_row, keys))
                 pod_spread_idx[i, j] = idx
 
         c_dim = vb.pad_dim(len(spread_rows), 1)
@@ -795,6 +833,7 @@ class SnapshotBuilder:
             valid=np.zeros(c_dim, dtype=bool),
             slot=np.zeros(c_dim, dtype=np.int32),
             max_skew=np.ones(c_dim, dtype=np.float32),
+            min_domains=np.zeros(c_dim, dtype=np.float32),
             hard=np.zeros(c_dim, dtype=bool),
             owner_sel_idx=np.full(c_dim, -1, dtype=np.int32),
             owner_keys=np.zeros((c_dim, tk), dtype=bool),
@@ -802,15 +841,15 @@ class SnapshotBuilder:
             pod_matches=np.zeros((p_dim, c_dim), dtype=bool),
             pod_idx=pod_spread_idx,
         )
-        for ci, (c, owner_ns, owner_sel_row, keys) in enumerate(spread_rows):
+        for ci, (c, sel, owner_ns, owner_sel_row, keys) in enumerate(spread_rows):
             spread.valid[ci] = True
             spread.slot[ci] = self._topo_slot(c.topology_key)
             spread.max_skew[ci] = float(c.max_skew)
+            spread.min_domains[ci] = float(c.min_domains or 0)
             spread.hard[ci] = c.when_unsatisfiable == "DoNotSchedule"
             spread.owner_sel_idx[ci] = owner_sel_row
             for k in keys:
                 spread.owner_keys[ci, self._topo_slot(k)] = True
-            sel = c.label_selector or api.LabelSelector()
             for q, ni in bound_by_node:
                 if q.meta.namespace == owner_ns and sel.matches(q.meta.labels):
                     spread.node_matches[ci, ni] += 1.0
@@ -820,21 +859,33 @@ class SnapshotBuilder:
                 )
 
         # ---- inter-pod (anti-)affinity terms ----------------------------
-        term_rows: List[Tuple[api.PodAffinityTerm, Tuple[str, ...]]] = []
+        # A row is (topology_key slot, effective selector, namespaces);
+        # match_label_keys are merged into the selector per owning pod
+        # (interpodaffinity PreFilter's mergeAffinityTermsPerPod).
+        term_rows: List[Tuple[str, api.LabelSelector, Tuple[str, ...]]] = []
         term_index: Dict[tuple, int] = {}
 
-        def intern_term(term: api.PodAffinityTerm, owner_ns: str) -> int:
-            namespaces = tuple(sorted(term.namespaces or [owner_ns]))
+        def intern_term(term: api.PodAffinityTerm, owner: api.Pod) -> int:
+            if term.namespace_selector is not None:
+                raise OverflowError(
+                    "PodAffinityTerm.namespace_selector requires Namespace "
+                    "objects, which are not modelled; list namespaces "
+                    "explicitly instead"
+                )
+            namespaces = tuple(sorted(term.namespaces or [owner.meta.namespace]))
+            sel = _merge_match_label_keys(
+                term.label_selector, term.match_label_keys, owner.meta.labels
+            )
             sig = (
                 term.topology_key,
-                _label_selector_signature(term.label_selector),
+                _label_selector_signature(sel),
                 namespaces,
             )
             idx = term_index.get(sig)
             if idx is None:
                 idx = len(term_rows)
                 term_index[sig] = idx
-                term_rows.append((term, namespaces))
+                term_rows.append((term.topology_key, sel, namespaces))
             return idx
 
         def pod_terms(pod: api.Pod) -> Tuple[List[api.PodAffinityTerm], List[api.PodAffinityTerm]]:
@@ -853,9 +904,9 @@ class SnapshotBuilder:
                     f"terms, exceeding max_pod_terms={ma}"
                 )
             for j, t in enumerate(aff_terms):
-                aff_idx[i, j] = intern_term(t, pod.meta.namespace)
+                aff_idx[i, j] = intern_term(t, pod)
             for j, t in enumerate(anti_terms):
-                anti_idx[i, j] = intern_term(t, pod.meta.namespace)
+                anti_idx[i, j] = intern_term(t, pod)
         # Bound pods' anti-affinity terms participate in the
         # existing-pods-anti-affinity direction even if no pending pod
         # carries them.
@@ -863,7 +914,7 @@ class SnapshotBuilder:
         for q, ni in bound_by_node:
             _, anti_terms = pod_terms(q)
             for t in anti_terms:
-                bound_anti.append((intern_term(t, q.meta.namespace), ni))
+                bound_anti.append((intern_term(t, q), ni))
 
         t_dim = vb.pad_dim(len(term_rows), 1)
         terms = TermTable(
@@ -877,26 +928,29 @@ class SnapshotBuilder:
             self_match_all=np.zeros(p_dim, dtype=bool),
         )
 
-        def term_matches(term: api.PodAffinityTerm, namespaces, pod: api.Pod) -> bool:
-            if pod.meta.namespace not in namespaces:
-                return False
-            sel = term.label_selector or api.LabelSelector()
-            return sel.matches(pod.meta.labels)
+        def row_matches(sel: api.LabelSelector, namespaces, pod: api.Pod) -> bool:
+            return pod.meta.namespace in namespaces and sel.matches(pod.meta.labels)
 
-        for ti, (term, namespaces) in enumerate(term_rows):
+        for ti, (topo_key, sel, namespaces) in enumerate(term_rows):
             terms.valid[ti] = True
-            terms.slot[ti] = self._topo_slot(term.topology_key)
+            terms.slot[ti] = self._topo_slot(topo_key)
             for q, ni in bound_by_node:
-                if term_matches(term, namespaces, q):
+                if row_matches(sel, namespaces, q):
                     terms.node_matches[ti, ni] += 1.0
             for i, pod in enumerate(pods):
-                terms.matches_incoming[i, ti] = term_matches(term, namespaces, pod)
+                terms.matches_incoming[i, ti] = row_matches(sel, namespaces, pod)
         for ti, ni in bound_anti:
             terms.node_owners[ti, ni] += 1.0
         for i, pod in enumerate(pods):
             aff_terms, _ = pod_terms(pod)
             terms.self_match_all[i] = bool(aff_terms) and all(
-                term_matches(t, tuple(t.namespaces or [pod.meta.namespace]), pod)
+                row_matches(
+                    _merge_match_label_keys(
+                        t.label_selector, t.match_label_keys, pod.meta.labels
+                    ),
+                    tuple(t.namespaces or [pod.meta.namespace]),
+                    pod,
+                )
                 for t in aff_terms
             )
 
@@ -1032,6 +1086,11 @@ class ClusterState:
         for pk in self._pods_by_node.pop(name, []):
             self._pods.pop(pk, None)
             self._pod_node.pop(pk, None)
+        self._clear_row(i)
+        self._free.append(i)
+        self._maybe_compact()
+
+    def _clear_row(self, i: int) -> None:
         self.node_valid[i] = False
         self.name_id[i] = -1
         self.allocatable[i] = 0
@@ -1042,7 +1101,38 @@ class ClusterState:
         self.port_bits[i] = 0
         self.topo_ids[i] = -1
         self.node_names[i] = None
-        self._free.append(i)
+
+    def _move_row(self, src: int, dst: int) -> None:
+        self.node_valid[dst] = self.node_valid[src]
+        self.name_id[dst] = self.name_id[src]
+        self.allocatable[dst] = self.allocatable[src]
+        self.requested[dst] = self.requested[src]
+        self.nonzero_requested[dst] = self.nonzero_requested[src]
+        self.label_bits[dst] = self.label_bits[src]
+        self.taint_bits[:, dst] = self.taint_bits[:, src]
+        self.port_bits[dst] = self.port_bits[src]
+        self.topo_ids[dst] = self.topo_ids[src]
+        name = self.node_names[src]
+        self.node_names[dst] = name
+        self._rows[name] = dst
+        self._clear_row(src)
+
+    def _maybe_compact(self) -> None:
+        """Shrink the high watermark once occupancy drops below half of it:
+        move tail rows into free slots so snapshots return to a smaller
+        shape bucket instead of staying padded at the historical peak."""
+        live = len(self._rows)
+        if self._high <= max(2 * live, self.builder.limits.min_nodes):
+            return
+        occupied_tail = sorted(
+            (i for i in self._rows.values() if i >= live), reverse=True
+        )
+        holes = sorted(i for i in self._free if i < live)
+        for src, dst in zip(occupied_tail, holes):
+            self._move_row(src, dst)
+        self._high = live
+        self._free = []
+        del self.node_names[live:]
 
     # -- pod (bound/assumed) lifecycle ------------------------------------
 
@@ -1181,6 +1271,28 @@ def _pod_classes(
     class_rep = np.full(c_dim, -1, dtype=np.int32)
     class_rep[: len(reps)] = np.asarray(reps, dtype=np.int32)
     return class_id, class_rep
+
+
+def _merge_match_label_keys(
+    sel: Optional[api.LabelSelector],
+    keys: Sequence[str],
+    owner_labels: Dict[str, str],
+) -> api.LabelSelector:
+    """Fold the owning pod's values at match_label_keys into the selector
+    (podtopologyspread/plugin.go + interpodaffinity since 1.29: an In
+    requirement per present key; absent keys are skipped)."""
+    sel = sel or api.LabelSelector()
+    extra = [
+        api.Requirement(k, api.OP_IN, [owner_labels[k]])
+        for k in keys
+        if k in owner_labels
+    ]
+    if not extra:
+        return sel
+    return api.LabelSelector(
+        match_labels=dict(sel.match_labels),
+        match_expressions=list(sel.match_expressions) + extra,
+    )
 
 
 def _label_selector_signature(sel: Optional[api.LabelSelector]) -> tuple:

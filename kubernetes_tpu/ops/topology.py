@@ -17,9 +17,13 @@ minimum equals the masked min over eligible nodes because every eligible
 value has at least one eligible node.
 
 Omitted vs reference (documented divergences):
-  * minDomains (beta) is ignored.
-  * NodeInclusionPolicies default to Honor(affinity)/Ignore(taints), the
-    reference's defaults; the policy fields themselves are not modelled.
+  * NodeInclusionPolicies support only the reference defaults
+    Honor(affinity)/Ignore(taints); the encoder raises on other values.
+  * minDomains uses the prep-time eligible-domain count (sizes), not a
+    per-cycle recount over filtered nodes — identical whenever eligible
+    nodes are schedulable.
+  * matchLabelKeys are merged into the selector at encode
+    (schema._merge_match_label_keys).
 """
 
 from __future__ import annotations
@@ -111,9 +115,15 @@ def spread_filter(
     elig = state.eligible[c]
     v = state.v[c]
     min_match = jnp.min(jnp.where(elig, counts, _BIG), axis=-1)  # [MC]
+    sizes = state.sizes[c]                                       # [MC]
     if axis_name is not None:
         min_match = jax.lax.pmin(min_match, axis_name)
+        # sizes already span shards (prep psums the value mask)
     min_match = jnp.where(min_match >= _BIG, 0.0, min_match)
+    # minDomains: fewer eligible domains than required => global min is 0
+    # (filtering.go minMatchNum; 0 in the table means unset)
+    md = spread.min_domains[c]
+    min_match = jnp.where((md > 0) & (sizes < md), 0.0, min_match)
     self_match = spread.pod_matches[p][c]           # [MC]
     skew = counts + self_match[:, None] - min_match[:, None]
     ok = (skew <= spread.max_skew[c][:, None]) & (v >= 0)

@@ -107,8 +107,9 @@ def sharded_greedy_assign(
 
     rep = P()
     spread_specs = SpreadTable(
-        valid=rep, slot=rep, max_skew=rep, hard=rep, owner_sel_idx=rep,
-        owner_keys=rep, node_matches=P(None, AXIS), pod_matches=rep, pod_idx=rep,
+        valid=rep, slot=rep, max_skew=rep, min_domains=rep, hard=rep,
+        owner_sel_idx=rep, owner_keys=rep, node_matches=P(None, AXIS),
+        pod_matches=rep, pod_idx=rep,
     )
     term_specs = TermTable(
         valid=rep, slot=rep, node_matches=P(None, AXIS), node_owners=P(None, AXIS),

@@ -54,12 +54,27 @@ class SchedulerCache:
         self._clock = clock
         self._lock = threading.RLock()
         self._assumed: Dict[str, _Assumed] = {}
+        # Pods delivered before their node (informers are per-kind threads
+        # with no cross-kind ordering).  The reference cache tolerates this
+        # by creating a stub NodeInfo (cache.go AddPod on unknown node);
+        # we buffer and apply when the node arrives.
+        self._waiting_on_node: Dict[str, Dict[str, api.Pod]] = {}
+
+    @property
+    def lock(self) -> threading.RLock:
+        """The cache mutex.  The solve path holds it while encoding a
+        snapshot from live state (the UpdateSnapshot-under-mutex property,
+        cache.go:185) so informer threads can't mutate mid-encode."""
+        return self._lock
 
     # -- nodes (informer-fed) ---------------------------------------------
 
     def add_node(self, node: api.Node) -> None:
         with self._lock:
             self.state.add_node(node)
+            for pod in self._waiting_on_node.pop(node.meta.name, {}).values():
+                if not self.state.has_pod(pod):
+                    self.state.add_pod(pod)
 
     def update_node(self, node: api.Node) -> None:
         with self._lock:
@@ -71,6 +86,7 @@ class SchedulerCache:
             for key, a in list(self._assumed.items()):
                 if a.node == name:
                     self._assumed.pop(key)
+            self._waiting_on_node.pop(name, None)
             self.state.remove_node(name)
 
     # -- assume protocol ---------------------------------------------------
@@ -103,6 +119,15 @@ class SchedulerCache:
 
     # -- bound pods (informer-fed) ----------------------------------------
 
+    def _account(self, pod: api.Pod) -> None:
+        """Add the pod to state, buffering when its node is unknown."""
+        try:
+            self.state.add_pod(pod)
+        except KeyError:
+            self._waiting_on_node.setdefault(pod.spec.node_name, {})[
+                pod_key(pod)
+            ] = pod
+
     def add_pod(self, pod: api.Pod) -> None:
         """Informer ADDED/MODIFIED with an assigned node.  Confirms an
         assumed pod (dropping its TTL) or accounts a newly seen one."""
@@ -115,19 +140,36 @@ class SchedulerCache:
                 # scheduled elsewhere than assumed: re-account
                 self.state.remove_pod(a.pod)
             if not self.state.has_pod(pod):
-                self.state.add_pod(pod)
+                self._account(pod)
 
     def update_pod(self, old: api.Pod, new: api.Pod) -> None:
+        """Bound-pod spec change (in-place resize, label edits): swap the
+        accounted object so requested rows and constraint tables track the
+        new spec (cache.go UpdatePod)."""
+        key = pod_key(new)
+        if old.spec == new.spec and old.meta.labels == new.meta.labels:
+            # status-only update (phase/conditions churn): nothing the
+            # accounting or constraint tables read changed — skip the
+            # O(pods-on-node) re-account entirely
+            return
         with self._lock:
+            if self._assumed.get(key) is not None:
+                # still assumed: add_pod's confirm path owns the transition
+                self.add_pod(new)
+                return
+            for waiting in self._waiting_on_node.values():
+                waiting.pop(key, None)
             if self.state.has_pod(old):
                 self.state.remove_pod(old)
             if new.spec.node_name:
-                self.state.add_pod(new)
+                self._account(new)
 
     def remove_pod(self, pod: api.Pod) -> None:
         key = pod_key(pod)
         with self._lock:
             self._assumed.pop(key, None)
+            for waiting in self._waiting_on_node.values():
+                waiting.pop(key, None)
             if self.state.has_pod(pod):
                 self.state.remove_pod(pod)
 

@@ -22,6 +22,7 @@ class Histogram:
         self.counts = [0] * (len(self.buckets) + 1)
         self.total = 0.0
         self.n = 0
+        self.max = 0.0  # true upper bound for the +Inf bucket
         self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
@@ -29,6 +30,8 @@ class Histogram:
             self.counts[bisect.bisect_left(self.buckets, value)] += 1
             self.total += value
             self.n += 1
+            if value > self.max:
+                self.max = value
 
     def percentile(self, q: float) -> float:
         """Linear-interpolated quantile from bucket counts (what the
@@ -40,7 +43,14 @@ class Histogram:
             seen = 0
             lo = 0.0
             for i, c in enumerate(self.counts):
-                hi = self.buckets[i] if i < len(self.buckets) else lo * 2 or 1.0
+                # the +Inf bucket's bound is the true max observed value
+                # (Prometheus would report the last finite bound; fabricating
+                # lo*2 would misreport p99s the perf harness quotes)
+                hi = (
+                    self.buckets[i]
+                    if i < len(self.buckets)
+                    else max(self.max, lo)
+                )
                 if seen + c >= target and c > 0:
                     frac = (target - seen) / c
                     return lo + (hi - lo) * frac

@@ -88,7 +88,16 @@ class Scheduler:
             # bound (or our own bind echoing back): confirm in cache
             if old is not None and not old.spec.node_name:
                 self.queue.done(pod)
-            self.cache.add_pod(pod)
+            if (
+                typ == st.MODIFIED
+                and old is not None
+                and old.spec.node_name == pod.spec.node_name
+            ):
+                # already-bound pod changed (in-place resize, label edit):
+                # re-account so requested rows track the new spec
+                self.cache.update_pod(old, pod)
+            else:
+                self.cache.add_pod(pod)
             return
         if typ == st.ADDED:
             self.queue.add(pod)
@@ -132,7 +141,22 @@ class Scheduler:
         if not batch:
             return stats
         t0 = self._clock()
-        names = self.tpu.schedule_pending([info.pod for info in batch])
+        # Encode under the cache lock (informer threads mutate the same
+        # ClusterState/vocabularies); solve outside it.  A pod whose spec
+        # can't be encoded (cap overflow, unsupported field) must only
+        # reject that pod, not kill the loop (the reference marks the one
+        # pod unschedulable, handleSchedulingFailure).
+        try:
+            names = self.tpu.schedule_pending(
+                [info.pod for info in batch], lock=self.cache.lock
+            )
+        except (OverflowError, ValueError):
+            batch = self._reject_unencodable(batch)
+            if not batch:
+                return stats
+            names = self.tpu.schedule_pending(
+                [info.pod for info in batch], lock=self.cache.lock
+            )
         self.metrics.scheduling_algorithm_duration.observe(self._clock() - t0)
 
         for info, node_name in zip(batch, names):
@@ -172,6 +196,21 @@ class Scheduler:
         for tier, v in qs.items():
             self.metrics.pending_pods.set(v, tier)
         return stats
+
+    def _reject_unencodable(self, batch: List[QueuedPodInfo]) -> List[QueuedPodInfo]:
+        """Batch encode failed: find the offending pods by encoding each
+        alone (rare path; the per-pod encode is the authoritative
+        validation, so checks are never duplicated here) and park them
+        unschedulable.  Returns the encodable remainder."""
+        good: List[QueuedPodInfo] = []
+        for info in batch:
+            try:
+                self.tpu.encode_pending([info.pod], lock=self.cache.lock)
+                good.append(info)
+            except (OverflowError, ValueError):
+                self.metrics.schedule_attempts.inc("error")
+                self.queue.add_unschedulable(info)
+        return good
 
     def _bind(self, pod: api.Pod, node_name: str) -> None:
         """The DefaultBinder POST pods/{name}/binding analogue: write

@@ -1,0 +1,295 @@
+"""Round-3 robustness fixes: pod-before-node buffering, update_pod
+re-accounting, min_domains / match_label_keys semantics, encode-time
+strictness, watermark compaction, histogram bounds, handler isolation."""
+
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import store as st
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client.informers import SharedInformer
+from kubernetes_tpu.ops import assign, schema
+from kubernetes_tpu.scheduler.cache import SchedulerCache
+from kubernetes_tpu.scheduler.metrics import Histogram
+from kubernetes_tpu.testing.wrappers import GI, MI, make_node, make_pod
+
+
+def _node(name, zone="z1", cpu=8000):
+    return make_node(name).capacity(cpu_milli=cpu, mem=16 * GI, pods=20).zone(zone).obj()
+
+
+# -- pod-before-node buffering (ADVICE: add_pod KeyError) -----------------
+
+
+def test_pod_delivered_before_node_is_buffered_then_accounted():
+    state = schema.ClusterState(schema.SnapshotBuilder())
+    cache = SchedulerCache(state)
+    pod = make_pod("early").req(cpu_milli=1000).node_name("n-late").obj()
+    cache.add_pod(pod)  # must not raise
+    assert not state.has_pod(pod)
+    cache.add_node(_node("n-late"))
+    assert state.has_pod(pod)
+    row = state._rows["n-late"]
+    assert state.requested[row, schema.RESOURCE_CPU] == 1000
+
+
+def test_buffered_pod_dropped_on_delete():
+    state = schema.ClusterState(schema.SnapshotBuilder())
+    cache = SchedulerCache(state)
+    pod = make_pod("early").req(cpu_milli=1000).node_name("n-late").obj()
+    cache.add_pod(pod)
+    cache.remove_pod(pod)
+    cache.add_node(_node("n-late"))
+    assert not state.has_pod(pod)
+
+
+# -- update_pod re-accounting (ADVICE: bound-pod resize drift) ------------
+
+
+def test_update_pod_reaccounts_requests():
+    state = schema.ClusterState(schema.SnapshotBuilder())
+    cache = SchedulerCache(state)
+    cache.add_node(_node("n0"))
+    old = make_pod("p").req(cpu_milli=1000).node_name("n0").obj()
+    cache.add_pod(old)
+    row = state._rows["n0"]
+    assert state.requested[row, schema.RESOURCE_CPU] == 1000
+    new = make_pod("p").req(cpu_milli=3000).node_name("n0").obj()
+    cache.update_pod(old, new)
+    assert state.requested[row, schema.RESOURCE_CPU] == 3000
+
+
+# -- minDomains (filtering.go minMatchNum) --------------------------------
+
+
+def _spread_cluster():
+    nodes = [_node("a1", "z1"), _node("a2", "z2")]
+    bound = []
+    for z, n in (("z1", "a1"), ("z2", "a2")):
+        for j in range(2):
+            bound.append(
+                make_pod(f"b-{z}-{j}").labels(app="web").node_name(n).obj()
+            )
+    return nodes, bound
+
+
+def _spread_pod(min_domains=None):
+    p = make_pod("incoming").labels(app="web").req(cpu_milli=100)
+    p.pod.spec.topology_spread_constraints.append(
+        api.TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=api.LABEL_ZONE,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=api.LabelSelector(match_labels={"app": "web"}),
+            min_domains=min_domains,
+        )
+    )
+    return p.obj()
+
+
+def test_min_domains_unset_allows_placement():
+    nodes, bound = _spread_cluster()
+    snap, meta = schema.SnapshotBuilder().build(nodes, [_spread_pod()], bound)
+    res = assign.greedy_assign(snap)
+    assert int(np.asarray(res.assignment)[0]) >= 0
+
+
+def test_min_domains_unmet_zeroes_global_min():
+    # 2 eligible domains < min_domains=3 => global min treated as 0 =>
+    # skew = 2 + 1 - 0 = 3 > maxSkew=1 on every node => unschedulable.
+    nodes, bound = _spread_cluster()
+    snap, meta = schema.SnapshotBuilder().build(
+        nodes, [_spread_pod(min_domains=3)], bound
+    )
+    res = assign.greedy_assign(snap)
+    assert int(np.asarray(res.assignment)[0]) == -1
+
+
+# -- matchLabelKeys merge -------------------------------------------------
+
+
+def test_spread_match_label_keys_scopes_counts_to_own_version():
+    nodes = [_node("a1", "z1"), _node("a2", "z2")]
+    bound = [
+        make_pod("b1").labels(app="web", version="v1").node_name("a1").obj(),
+        make_pod("b2").labels(app="web", version="v2").node_name("a1").obj(),
+    ]
+    p = make_pod("inc").labels(app="web", version="v1").req(cpu_milli=100)
+    p.pod.spec.topology_spread_constraints.append(
+        api.TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=api.LABEL_ZONE,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=api.LabelSelector(match_labels={"app": "web"}),
+            match_label_keys=["version"],
+        )
+    )
+    snap, meta = schema.SnapshotBuilder().build(nodes, [p.obj()], bound)
+    # only the v1 bound pod counts for the merged selector
+    row = np.asarray(snap.spread.node_matches)[0]
+    assert row[0] == 1.0 and row[1] == 0.0
+
+
+def test_anti_affinity_match_label_keys():
+    nodes = [_node("a1", "z1"), _node("a2", "z2")]
+    bound = [
+        make_pod("b1").labels(app="web", version="v1").node_name("a1").obj(),
+        make_pod("b2").labels(app="web", version="v2").node_name("a2").obj(),
+    ]
+    p = make_pod("inc").labels(app="web", version="v1").req(cpu_milli=100)
+    p.pod.spec.affinity = api.Affinity(
+        pod_anti_affinity=api.PodAntiAffinity(
+            required=[
+                api.PodAffinityTerm(
+                    label_selector=api.LabelSelector(match_labels={"app": "web"}),
+                    topology_key=api.LABEL_HOSTNAME,
+                    match_label_keys=["version"],
+                )
+            ]
+        )
+    )
+    snap, meta = schema.SnapshotBuilder().build(nodes, [p.obj()], bound)
+    res = assign.greedy_assign(snap)
+    # v1 conflict lives on a1 only; the pod must land on a2
+    assert meta.node_name(int(np.asarray(res.assignment)[0])) == "a2"
+
+
+# -- encode-time strictness ----------------------------------------------
+
+
+def test_namespace_selector_raises():
+    p = make_pod("x").req(cpu_milli=100)
+    p.pod.spec.affinity = api.Affinity(
+        pod_anti_affinity=api.PodAntiAffinity(
+            required=[
+                api.PodAffinityTerm(
+                    label_selector=api.LabelSelector(match_labels={"a": "b"}),
+                    namespace_selector=api.LabelSelector(match_labels={"team": "x"}),
+                )
+            ]
+        )
+    )
+    with pytest.raises(OverflowError, match="namespace_selector"):
+        schema.SnapshotBuilder().build([_node("n0")], [p.obj()])
+
+
+def test_node_inclusion_policy_raises():
+    p = make_pod("x").req(cpu_milli=100)
+    p.pod.spec.topology_spread_constraints.append(
+        api.TopologySpreadConstraint(node_taints_policy="Honor")
+    )
+    with pytest.raises(OverflowError, match="nodeInclusionPolicies"):
+        schema.SnapshotBuilder().build([_node("n0")], [p.obj()])
+
+
+def test_f32_envelope_warns_on_huge_node():
+    b = schema.SnapshotBuilder()
+    state = schema.ClusterState(b)
+    huge = make_node("big").capacity(cpu_milli=4000, mem=512 * GI, pods=10).obj()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        state.add_node(huge)
+    assert any("f32" in str(x.message) for x in w)
+
+
+# -- watermark compaction (ADVICE low) ------------------------------------
+
+
+def test_high_watermark_shrinks_after_mass_removal():
+    state = schema.ClusterState(schema.SnapshotBuilder())
+    for i in range(64):
+        state.add_node(_node(f"n{i}", zone=f"z{i % 3}"))
+    assert state._high == 64
+    for i in range(60):
+        state.remove_node(f"n{i}")
+    assert state.num_nodes == 4
+    assert state._high < 16
+    # surviving rows keep their identity and a solve still places pods
+    survivors = {state.node_names[i] for i in state._rows.values()}
+    assert survivors == {f"n{i}" for i in range(60, 64)}
+    b = state.builder
+    snap, meta = b.build_from_state(state, [make_pod("p").req(cpu_milli=500).obj()])
+    res = assign.greedy_assign(snap)
+    assert meta.node_name(int(np.asarray(res.assignment)[0])) in survivors
+
+
+# -- histogram +Inf bucket (VERDICT weak #7) ------------------------------
+
+
+def test_histogram_percentile_bounded_by_max():
+    h = Histogram("t", buckets=(0.1, 1.0))
+    for v in (5.0, 6.0, 7.0):
+        h.observe(v)
+    assert h.percentile(0.99) <= 7.0
+    assert h.max == 7.0
+
+
+# -- unencodable pod must not kill the scheduling loop --------------------
+
+
+def test_unencodable_pod_parks_without_killing_batch():
+    from kubernetes_tpu.scheduler import Scheduler
+
+    store = st.Store()
+    for i in range(2):
+        store.create(_node(f"n{i}"))
+    bad = make_pod("bad").req(cpu_milli=100)
+    bad.pod.spec.affinity = api.Affinity(
+        pod_anti_affinity=api.PodAntiAffinity(
+            required=[
+                api.PodAffinityTerm(
+                    label_selector=api.LabelSelector(match_labels={"a": "b"}),
+                    namespace_selector=api.LabelSelector(match_labels={"t": "x"}),
+                )
+            ]
+        )
+    )
+    store.create(bad.obj())
+    for i in range(3):
+        store.create(make_pod(f"ok{i}").req(cpu_milli=100).obj())
+    sched = Scheduler(store)
+    sched.informers.informer("Node").start()
+    sched.informers.informer("Pod").start()
+    assert sched.informers.wait_for_sync(10)
+    try:
+        total = 0
+        for _ in range(10):
+            total += sched.schedule_batch(timeout=0.2)["scheduled"]
+            if total == 3:
+                break
+        assert total == 3
+        assert sched.queue.stats()["unschedulable"] == 1
+    finally:
+        sched.stop()
+
+
+# -- informer handler isolation (ADVICE medium) ---------------------------
+
+
+def test_handler_exception_does_not_kill_stream_or_other_handlers():
+    store = st.Store()
+    inf = SharedInformer(store, "Node")
+    seen = []
+
+    def bad(typ, obj, old):
+        raise RuntimeError("boom")
+
+    inf.add_handler(bad)
+    inf.add_handler(lambda typ, obj, old: seen.append((typ, obj.meta.name)))
+    inf.start()
+    try:
+        assert inf.wait_for_sync(5)
+        store.create(_node("n1"))
+        store.create(_node("n2"))
+        deadline = threading.Event()
+        for _ in range(100):
+            if len(seen) >= 2:
+                break
+            deadline.wait(0.05)
+        names = {n for _, n in seen}
+        assert names == {"n1", "n2"}
+    finally:
+        inf.stop()
