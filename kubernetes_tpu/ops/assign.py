@@ -159,6 +159,7 @@ def greedy_assign(
     tie_seed: Optional[int] = None,
     topo_z: Optional[int] = None,
     features: Optional[FeatureFlags] = None,
+    n_groups: int = 0,
 ) -> SolveResult:
     """Sequential-greedy solve of the whole pending batch on device.
 
@@ -172,7 +173,15 @@ def greedy_assign(
     required_topo_z); auto-derived when None.  Both topo_z and features
     can only be auto-derived outside jit — jitted callers must pass them
     (greedy_assign_jit's wrapper does).
-    """
+
+    n_groups (static): gang-group count.  When > 0, groups with any
+    unplaced member release every placement after the scan (all-or-nothing,
+    the coscheduling-PodGroup contract) — this is what lets gangs carrying
+    spread/interpod/port constraints keep gang semantics instead of
+    routing-away to a solver that drops them.  Later in-scan pods saw the
+    released placements' resource/count impact (conservative: they may
+    park and retry next batch); the released members return as
+    unschedulable (-1)."""
     if features is None:
         features = features_of(snapshot)
     if topo_z is None:
@@ -271,6 +280,26 @@ def greedy_assign(
     assignment = jnp.full(p, -1, jnp.int32).at[pod_is].set(assign_o)
     win_scores = jnp.full(p, NEG_INF).at[pod_is].set(win_o)
     feas_counts = jnp.zeros(p, jnp.int32).at[pod_is].set(feas_o)
+
+    # Gang post-pass: release every placement of a group with an unplaced
+    # member (all-or-nothing), mirroring ops.auction's post-pass.  Only
+    # requested/nonzero need subtracting: ports and spread/interpod counts
+    # are rebuilt from *actually bound* pods at the next batch's prep, and
+    # the host never assumes released members.
+    if n_groups > 0:
+        g = pods.group_id
+        gc = jnp.clip(g, 0, n_groups - 1)
+        incomplete = jnp.zeros(n_groups, bool).at[gc].max(
+            (assignment < 0) & pods.valid & (g >= 0)
+        )
+        dropped = (g >= 0) & incomplete[gc] & (assignment >= 0)
+        nodes = jnp.clip(assignment, 0, n - 1)
+        w = dropped[:, None].astype(jnp.float32)
+        requested = requested.at[nodes].add(-pods.req * w)
+        nonzero = nonzero.at[nodes].add(-pods.nonzero_req * w)
+        assignment = jnp.where(dropped, -1, assignment)
+        win_scores = jnp.where(dropped, NEG_INF, win_scores)
+
     final = cluster._replace(
         requested=requested,
         nonzero_requested=nonzero,
