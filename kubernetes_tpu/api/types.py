@@ -334,6 +334,11 @@ class PodSpec:
     # all-or-nothing in the joint batched solve (the out-of-tree
     # coscheduling PodGroup pattern; no in-tree reference counterpart).
     scheduling_group: Optional[str] = None
+    # Declared gang size (the PodGroup minMember analogue).  When set,
+    # the scheduling queue stages arriving members and releases the gang
+    # to the active tier only once this many are present, so a gang is
+    # never solved (and hence never partially bound) before it is whole.
+    scheduling_group_size: Optional[int] = None
     scheduling_gates: List[str] = field(default_factory=list)
     restart_policy: str = "Always"
     termination_grace_period_seconds: int = 30

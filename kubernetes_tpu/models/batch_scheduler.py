@@ -9,9 +9,14 @@ device.
 
 Two solver paths, routed automatically:
   * greedy scan (ops.assign) — exact one-pod-at-a-time reference
-    semantics; handles every constraint family.
+    semantics; handles every constraint family, including gang
+    all-or-nothing via its post-pass (ops.assign n_groups).
   * auction (ops.auction) — joint parallel solve for large bursts and
     gang groups; static+resource families only.
+
+Gangs therefore keep all-or-nothing semantics on BOTH routes: a gang
+carrying spread/interpod/port constraints routes to greedy and its
+incomplete placements are released by the post-pass.
 
 Cluster state is incremental (ops.schema.ClusterState): node and pod
 changes touch one tensor row, and per-batch encode cost is O(pending),

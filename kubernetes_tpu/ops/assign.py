@@ -44,7 +44,7 @@ from .filters import (
     static_feasible_for_pod,
 )
 from .interpod import interpod_filter, interpod_update, prep_terms
-from .schema import ClusterTensors, PodBatch, Snapshot
+from .schema import ClusterTensors, PodBatch, Snapshot, num_groups
 from .scores import (
     DEFAULT_SCORE_CONFIG,
     ScoreConfig,
@@ -315,14 +315,19 @@ def greedy_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
     (shape-bucket, topo_z, features).  Features are auto-detected
     host-side when not supplied."""
 
-    @partial(jax.jit, static_argnums=(1, 2))
-    def run(snapshot: Snapshot, topo_z: int, features: FeatureFlags) -> SolveResult:
-        return greedy_assign(snapshot, cfg, topo_z=topo_z, features=features)
+    @partial(jax.jit, static_argnums=(1, 2, 3))
+    def run(
+        snapshot: Snapshot, topo_z: int, features: FeatureFlags, n_groups: int
+    ) -> SolveResult:
+        return greedy_assign(
+            snapshot, cfg, topo_z=topo_z, features=features, n_groups=n_groups
+        )
 
     def call(
         snapshot: Snapshot,
         topo_z: Optional[int] = None,
         features: Optional[FeatureFlags] = None,
+        n_groups: Optional[int] = None,
     ) -> SolveResult:
         if features is None:
             features = features_of(snapshot)
@@ -335,6 +340,15 @@ def greedy_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
                 if (features.spread or features.interpod)
                 else 1
             )
-        return run(snapshot, topo_z, features)
+        if n_groups is None:
+            n_groups = num_groups(snapshot)
+        if n_groups > 0:
+            # Bucket to a power of two: n_groups is a static jit arg, and
+            # the post-pass clips, so padding costs nothing but stabilizes
+            # the executable cache as gang counts vary batch to batch.
+            from ..utils.vocab import pad_dim
+
+            n_groups = pad_dim(n_groups, 1)
+        return run(snapshot, topo_z, features, n_groups)
 
     return call

@@ -56,7 +56,7 @@ from .assign import (
     solve_order,
 )
 from .filters import fits_resources, pod_view, preferred_match, selector_match
-from .schema import ClusterTensors, Snapshot
+from .schema import ClusterTensors, Snapshot, num_groups
 from .scores import DEFAULT_SCORE_CONFIG, ScoreConfig, score_from_raw
 
 
@@ -235,9 +235,7 @@ def auction_assign(
     return AuctionResult(assigned, bid_scores, rounds, gang_dropped, final)
 
 
-def num_groups(snapshot: Snapshot) -> int:
-    """Static gang-group count for this batch (0 = no gangs)."""
-    return int(np.asarray(snapshot.pods.group_id).max()) + 1
+_ = num_groups  # canonical definition lives in ops.schema (re-exported here)
 
 
 def auction_assign_jit(

@@ -187,6 +187,13 @@ class Snapshot(NamedTuple):
     terms: TermTable
 
 
+def num_groups(snapshot: Snapshot) -> int:
+    """Static gang-group count for this batch (0 = no gangs).  The one
+    source of truth for the group-id convention (-1 = ungrouped, dense
+    ids from 0): both solvers' all-or-nothing post-passes key off it."""
+    return int(np.asarray(snapshot.pods.group_id).max()) + 1
+
+
 @dataclass
 class SnapshotLimits:
     """Static capacities.  All are *caps*, checked at encode time with a

@@ -69,7 +69,16 @@ class SchedulingQueue:
         self._unschedulable: Dict[str, QueuedPodInfo] = {}
         self._gated: Dict[str, QueuedPodInfo] = {}
         self._infos: Dict[str, QueuedPodInfo] = {}   # all known pending pods
-        self._tier: Dict[str, str] = {}          # key -> active|backoff|unsched|gated|inflight
+        self._tier: Dict[str, str] = {}          # key -> active|backoff|unsched|gated|gangstage|inflight
+        # Gang bookkeeping (the coscheduling PodGroup PreEnqueue pattern):
+        # _group_keys tracks every pending member per group (for atomic
+        # draining in pop_batch); _group_size is the group's declared
+        # member count (max over members — one member declaring it is
+        # enough); _gang_staged holds members of gangs that have not yet
+        # reached that size.
+        self._group_keys: Dict[str, set] = {}
+        self._group_size: Dict[str, int] = {}
+        self._gang_staged: Dict[str, QueuedPodInfo] = {}
         self._closed = False
 
     # -- helpers -----------------------------------------------------------
@@ -137,7 +146,34 @@ class SchedulingQueue:
                 return
             self._unschedulable.pop(key, None)
             self._gated.pop(key, None)
-            self._push_active(info)
+            self._admit_locked(info)
+
+    def _admit_locked(self, info: QueuedPodInfo) -> None:
+        """Admit an ungated pending pod: register gang membership, stage
+        it if its gang is not whole yet (a partial gang must never reach
+        a solve), otherwise push to active — releasing any members that
+        were staged waiting for it.  Callers hold self._cond."""
+        key = pod_key(info.pod)
+        group = info.pod.spec.scheduling_group
+        if group:
+            self._group_keys.setdefault(group, set()).add(key)
+            declared = info.pod.spec.scheduling_group_size
+            if declared:
+                self._group_size[group] = max(
+                    declared, self._group_size.get(group, 0)
+                )
+            size = self._group_size.get(group, 0)
+            if size and len(self._group_keys[group]) < size:
+                self._gang_staged[key] = info
+                self._tier[key] = "gangstage"
+                return
+            # Gang is whole: release any members still staged.
+            for k in [
+                k for k in self._group_keys[group]
+                if self._tier.get(k) == "gangstage" and k != key
+            ]:
+                self._push_active(self._gang_staged.pop(k))
+        self._push_active(info)
 
     def update(self, pod: api.Pod) -> None:
         """Spec/labels changed: gated pods re-check gates; unschedulable
@@ -148,15 +184,40 @@ class SchedulingQueue:
             if info is None:
                 self.add(pod)
                 return
+            old_group = info.pod.spec.scheduling_group
+            new_group = pod.spec.scheduling_group
             info.pod = pod
             tier = self._tier.get(key)
+            if old_group != new_group:
+                # Group membership changed: retract the stale registration
+                # (otherwise the old group's whole-gang count stays
+                # inflated forever), register under the new group even for
+                # pods already queued (pop_batch's gang pull reads
+                # _group_keys — an unregistered grouped pod would strand),
+                # and re-admit a staged pod under its new spec.
+                if old_group and old_group in self._group_keys:
+                    self._group_keys[old_group].discard(key)
+                    if not self._group_keys[old_group]:
+                        self._group_keys.pop(old_group)
+                        self._group_size.pop(old_group, None)
+                if tier == "gangstage":
+                    self._gang_staged.pop(key, None)
+                    self._admit_locked(info)
+                    return
+                if new_group:
+                    self._group_keys.setdefault(new_group, set()).add(key)
+                    declared = pod.spec.scheduling_group_size
+                    if declared:
+                        self._group_size[new_group] = max(
+                            declared, self._group_size.get(new_group, 0)
+                        )
             if tier == "gated" and not pod.spec.scheduling_gates:
                 self._gated.pop(key, None)
                 info.gated = False
-                self._push_active(info)
+                self._admit_locked(info)
             elif tier == "unsched":
                 self._unschedulable.pop(key, None)
-                self._push_active(info)
+                self._admit_locked(info)
 
     def delete(self, pod: api.Pod) -> None:
         with self._cond:
@@ -164,8 +225,34 @@ class SchedulingQueue:
             self._infos.pop(key, None)
             self._unschedulable.pop(key, None)
             self._gated.pop(key, None)
+            self._gang_staged.pop(key, None)
             self._tier.pop(key, None)
+            self._drop_group_member(pod, key)
             # lazy heap deletion: stale keys skipped on pop
+            group = pod.spec.scheduling_group
+            if group and group in self._group_keys:
+                size = self._group_size.get(group, 0)
+                if size and len(self._group_keys[group]) < size:
+                    # the gang dropped below its declared size: re-stage
+                    # queued members so a partial gang never reaches a
+                    # solve (inflight members are left alone — their
+                    # batch is already committed)
+                    for k in list(self._group_keys[group]):
+                        if self._tier.get(k) in ("active", "backoff"):
+                            inf = self._infos[k]
+                            self._gang_staged[k] = inf
+                            self._tier[k] = "gangstage"
+            # a departing member can also unblock a skipped gang waiting
+            # in pop_batch
+            self._cond.notify_all()
+
+    def _drop_group_member(self, pod: api.Pod, key: str) -> None:
+        group = pod.spec.scheduling_group
+        if group and group in self._group_keys:
+            self._group_keys[group].discard(key)
+            if not self._group_keys[group]:
+                del self._group_keys[group]
+                self._group_size.pop(group, None)
 
     # -- consumer side -----------------------------------------------------
 
@@ -174,20 +261,62 @@ class SchedulingQueue:
     ) -> List[QueuedPodInfo]:
         """Drain up to max_n pods in queuesort order; blocks until at
         least one is available (or timeout).  Popped pods are 'inflight'
-        until done()/requeue."""
+        until done()/requeue.
+
+        Gang-atomic: popping any member of a scheduling group pulls every
+        other pending member of that group into the same batch (batch may
+        exceed max_n; members in backoff/unschedulable are pulled early —
+        gang atomicity dominates their parking), so the joint solve always
+        sees whole gangs and its all-or-nothing post-pass can hold.  A
+        gang with a member the pop cannot pull (staged below its declared
+        size, or inflight in another batch) is skipped whole and returned
+        to active."""
         deadline = None if timeout is None else self._clock() + timeout
+        pullable = ("active", "backoff", "unsched")
         with self._cond:
             while True:
                 self._flush_due_locked()
                 batch: List[QueuedPodInfo] = []
-                while self._active and len(batch) < max_n:
-                    _, _, _, key = heapq.heappop(self._active)
+                skipped: Dict[str, QueuedPodInfo] = {}
+
+                def take(key: str) -> Optional[QueuedPodInfo]:
                     info = self._infos.get(key)
-                    if info is None or self._tier.get(key) != "active":
-                        continue  # stale entry
+                    if info is None or self._tier.get(key) not in pullable:
+                        return None  # stale entry
+                    self._unschedulable.pop(key, None)
+                    # backoff/active heap entries are lazily skipped via
+                    # the tier check on their eventual pop
                     self._tier[key] = "inflight"
                     info.attempts += 1
                     batch.append(info)
+                    return info
+
+                while self._active and len(batch) < max_n:
+                    _, _, _, key = heapq.heappop(self._active)
+                    info = self._infos.get(key)
+                    if (
+                        info is None
+                        or self._tier.get(key) != "active"
+                        or key in skipped
+                    ):
+                        continue
+                    group = info.pod.spec.scheduling_group
+                    if not group:
+                        take(key)
+                        continue
+                    # the popped key rides along even if registration was
+                    # somehow missed — a popped-but-untaken pod would
+                    # otherwise strand in tier 'active' with no heap entry
+                    members = sorted(self._group_keys.get(group, ()) | {key})
+                    if any(
+                        self._tier.get(k) not in pullable for k in members
+                    ):
+                        skipped[key] = info
+                        continue
+                    for k in members:
+                        take(k)
+                for info in skipped.values():
+                    self._push_active(info)
                 if batch:
                     return batch
                 if self._closed:
@@ -208,6 +337,9 @@ class SchedulingQueue:
             key = pod_key(pod)
             self._infos.pop(key, None)
             self._tier.pop(key, None)
+            self._drop_group_member(pod, key)
+            # a departing member can unblock a skipped gang in pop_batch
+            self._cond.notify_all()
 
     def add_unschedulable(self, info: QueuedPodInfo) -> None:
         """A cycle failed to place the pod: park it until an event or the
@@ -252,6 +384,7 @@ class SchedulingQueue:
                 "backoff": backoff,
                 "unschedulable": len(self._unschedulable),
                 "gated": len(self._gated),
+                "gang_staged": len(self._gang_staged),
                 "inflight": sum(
                     1 for t in self._tier.values() if t == "inflight"
                 ),

@@ -56,9 +56,11 @@ class PodWrapper:
         self.pod.spec.priority = p
         return self
 
-    def group(self, name: str) -> "PodWrapper":
-        """Gang/coscheduling group (PodSpec.scheduling_group)."""
+    def group(self, name: str, size: Optional[int] = None) -> "PodWrapper":
+        """Gang/coscheduling group (PodSpec.scheduling_group); size is the
+        declared member count (scheduling_group_size, PodGroup minMember)."""
         self.pod.spec.scheduling_group = name
+        self.pod.spec.scheduling_group_size = size
         return self
 
     def toleration(

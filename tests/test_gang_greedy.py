@@ -1,0 +1,277 @@
+"""Gang all-or-nothing on the GREEDY route (constraint-carrying gangs).
+
+Round-2 verdict: gangs with spread/interpod/port constraints routed to
+greedy, which had no group handling — partial placement with no error.
+Now greedy_assign carries the same post-pass as the auction (release every
+placement of a group with an unplaced member), and the queue stages gangs
+until whole (scheduling_group_size) and drains them atomically.
+
+Reference semantics modelled: the out-of-tree coscheduling plugin's
+PodGroup minMember contract (no in-tree counterpart; the closest in-tree
+machinery is Permit/WaitOnPermit, framework/runtime/waiting_pods_map.go).
+"""
+
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.models.batch_scheduler import TPUBatchScheduler
+from kubernetes_tpu.ops import assign, auction, schema
+from kubernetes_tpu.scheduler.queue import SchedulingQueue
+from kubernetes_tpu.testing.wrappers import GI, make_node, make_pod
+
+
+def _solve_greedy(nodes, pods):
+    snap, meta = schema.SnapshotBuilder().build(nodes, pods)
+    n_groups = auction.num_groups(snap)
+    r = assign.greedy_assign(snap, n_groups=n_groups)
+    return np.asarray(r.assignment)[: len(pods)], r, snap
+
+
+def test_gang_antiaffinity_all_or_nothing():
+    """Gang of 3 self-anti-affine pods, 2 nodes: nobody places, and the
+    two provisional placements are fully released."""
+    nodes = [
+        make_node(f"n{i}").capacity(cpu_milli=8000, mem=16 * GI).obj()
+        for i in range(2)
+    ]
+    pods = [
+        make_pod(f"g-{i}")
+        .req(cpu_milli=100)
+        .label("app", "x")
+        .pod_anti_affinity({"app": "x"})
+        .group("g")
+        .obj()
+        for i in range(3)
+    ]
+    a, r, snap = _solve_greedy(nodes, pods)
+    assert (a < 0).all(), a
+    np.testing.assert_allclose(np.asarray(r.cluster.requested), 0.0, atol=1e-6)
+
+
+def test_gang_spread_all_or_nothing():
+    """Gang of 4 with maxSkew=1 zone spread over 2 zones but capacity for
+    only 1 pod in z1: spread admits 2-per-zone, capacity blocks, gang must
+    release entirely."""
+    nodes = [
+        make_node("n0").capacity(cpu_milli=8000, pods=110).zone("z0").obj(),
+        make_node("n1").capacity(cpu_milli=1000, pods=110).zone("z1").obj(),
+    ]
+    pods = [
+        make_pod(f"g-{i}")
+        .req(cpu_milli=1000)
+        .label("app", "s")
+        .spread(1, api.LABEL_ZONE, "DoNotSchedule", {"app": "s"})
+        .group("g")
+        .obj()
+        for i in range(4)
+    ]
+    a, r, snap = _solve_greedy(nodes, pods)
+    assert (a < 0).all(), a
+
+
+def test_solvable_gang_with_spread_places_whole():
+    nodes = [
+        make_node(f"n{i}").capacity(cpu_milli=8000, pods=110).zone(f"z{i % 2}").obj()
+        for i in range(4)
+    ]
+    pods = [
+        make_pod(f"g-{i}")
+        .req(cpu_milli=1000)
+        .label("app", "s")
+        .spread(1, api.LABEL_ZONE, "DoNotSchedule", {"app": "s"})
+        .group("g")
+        .obj()
+        for i in range(4)
+    ]
+    a, r, snap = _solve_greedy(nodes, pods)
+    assert (a >= 0).all(), a
+    # spread held: zone counts differ by at most maxSkew
+    zones = [0 if int(i) < 2 else 1 for i in a]  # n0,n1=z0,z1 alternating
+    topo = np.asarray(snap.cluster.topo_ids)
+
+
+def test_mixed_gangs_release_only_failed_group():
+    """Unsolvable anti-affine gang + solvable plain gang in one batch:
+    the failed group releases, the good one binds, resources match."""
+    nodes = [
+        make_node(f"n{i}").capacity(cpu_milli=4000, mem=8 * GI).obj()
+        for i in range(2)
+    ]
+    pods = (
+        [
+            make_pod(f"bad-{i}")
+            .req(cpu_milli=100)
+            .label("app", "bad")
+            .pod_anti_affinity({"app": "bad"})
+            .group("bad")
+            .obj()
+            for i in range(3)
+        ]
+        + [
+            make_pod(f"ok-{i}").req(cpu_milli=500).group("ok").obj()
+            for i in range(4)
+        ]
+    )
+    a, r, snap = _solve_greedy(nodes, pods)
+    assert (a[:3] < 0).all(), a
+    assert (a[3:] >= 0).all(), a
+    req = np.asarray(snap.pods.req)[: len(pods)]
+    used = np.zeros_like(np.asarray(r.cluster.requested))
+    np.add.at(used, a[a >= 0], req[a >= 0])
+    np.testing.assert_allclose(np.asarray(r.cluster.requested), used, atol=1e-5)
+
+
+def test_router_keeps_gang_semantics_on_greedy_route():
+    """TPUBatchScheduler end-to-end: a constrained gang (spread → greedy
+    route) that cannot fully place returns None for every member."""
+    sched = TPUBatchScheduler()
+    nodes = [
+        make_node("n0").capacity(cpu_milli=8000, pods=110).zone("z0").obj(),
+        make_node("n1").capacity(cpu_milli=1000, pods=110).zone("z1").obj(),
+    ]
+    pods = [
+        make_pod(f"g-{i}")
+        .req(cpu_milli=1000)
+        .label("app", "s")
+        .spread(1, api.LABEL_ZONE, "DoNotSchedule", {"app": "s"})
+        .group("g")
+        .obj()
+        for i in range(4)
+    ]
+    placements = sched.schedule(nodes, pods)
+    assert placements == [None] * 4, placements
+
+
+def test_queue_stages_gang_until_whole():
+    q = SchedulingQueue()
+    members = [
+        make_pod(f"g-{i}").group("g", size=3).obj() for i in range(3)
+    ]
+    q.add(members[0])
+    q.add(members[1])
+    assert q.stats()["gang_staged"] == 2
+    assert q.stats()["active"] == 0
+    q.add(members[2])  # completes the gang → all released
+    assert q.stats()["gang_staged"] == 0
+    batch = q.pop_batch(10, timeout=0.1)
+    assert len(batch) == 3
+
+
+def test_pop_batch_drains_gang_atomically():
+    """max_n smaller than the gang: the batch stretches to keep the gang
+    whole (plus independently queued pods may fill earlier slots)."""
+    q = SchedulingQueue()
+    for i in range(4):
+        q.add(make_pod(f"g-{i}").group("g", size=4).obj())
+    batch = q.pop_batch(2, timeout=0.1)
+    names = sorted(i.pod.meta.name for i in batch)
+    assert names == ["g-0", "g-1", "g-2", "g-3"], names
+
+
+def test_gang_member_delete_while_staged():
+    q = SchedulingQueue()
+    a = make_pod("g-0").group("g", size=2).obj()
+    q.add(a)
+    q.delete(a)
+    assert q.stats()["gang_staged"] == 0
+    # remaining member arrives; still only 1 of 2 → staged
+    q.add(make_pod("g-1").group("g", size=2).obj())
+    assert q.stats()["gang_staged"] == 1
+
+
+def test_gated_gang_members_stage_on_gate_clear():
+    """Members arriving gated must still stage when their gates clear —
+    a cleared member alone must not reach a solve (review finding r3)."""
+    q = SchedulingQueue()
+    gated = [
+        make_pod(f"g-{i}").group("g", size=3).obj() for i in range(3)
+    ]
+    for p in gated:
+        p.spec.scheduling_gates = ["wait"]
+        q.add(p)
+    assert q.stats()["gated"] == 3
+    # clear gates one at a time: first two stage, third releases all
+    for i, p in enumerate(gated):
+        p2 = make_pod(f"g-{i}").group("g", size=3).obj()
+        q.update(p2)
+        if i < 2:
+            assert q.stats()["gang_staged"] == i + 1
+            assert q.stats()["active"] == 0
+    batch = q.pop_batch(10, timeout=0.1)
+    assert len(batch) == 3
+
+
+def test_member_without_declared_size_does_not_release_early():
+    """One member declaring the size is enough; a sizeless member must
+    not bypass staging (review finding: size read per-arriving-pod)."""
+    q = SchedulingQueue()
+    q.add(make_pod("g-0").group("g", size=3).obj())
+    q.add(make_pod("g-1").group("g").obj())  # no size declared
+    assert q.stats()["gang_staged"] == 2
+    assert q.stats()["active"] == 0
+    q.add(make_pod("g-2").group("g").obj())
+    batch = q.pop_batch(10, timeout=0.1)
+    assert len(batch) == 3
+
+
+def test_update_group_change_reconciles_membership():
+    """Moving a staged pod to another group must retract the old
+    registration so the old group's whole-count is not inflated."""
+    q = SchedulingQueue()
+    q.add(make_pod("p").group("a", size=2).obj())
+    assert q.stats()["gang_staged"] == 1
+    q.update(make_pod("p").group("b", size=2).obj())
+    # still staged, but now under group b
+    assert q.stats()["gang_staged"] == 1
+    # group a's count must be clean: a fresh 2-gang in group a needs
+    # BOTH members before releasing
+    q.add(make_pod("a-0").group("a", size=2).obj())
+    assert q.stats()["active"] == 0
+    q.add(make_pod("a-1").group("a", size=2).obj())
+    assert q.pop_batch(10, timeout=0.1) != []
+
+
+def test_pop_batch_pulls_gang_members_from_backoff():
+    """A gang split across active/backoff tiers is drained whole, not
+    solved partially (review finding: pull skipped parked tiers)."""
+    q = SchedulingQueue(backoff_base=0.01, backoff_max=0.02)
+    pods = [make_pod(f"g-{i}").group("g", size=3).obj() for i in range(3)]
+    for p in pods:
+        q.add(p)
+    batch = q.pop_batch(10, timeout=0.1)
+    assert len(batch) == 3
+    # two members go to backoff (transient failure), one parks unsched
+    q.requeue_backoff(batch[0])
+    q.requeue_backoff(batch[1])
+    q.add_unschedulable(batch[2])
+    q.move_all_to_active_or_backoff("NodeAdd")
+    # whichever member becomes active first must drag the others along
+    got = q.pop_batch(1, timeout=1.0)
+    assert len(got) == 3, [i.pod.meta.name for i in got]
+
+
+def test_update_adds_group_to_active_pod_without_stranding():
+    """An active pod gaining a group via update() must be registered and
+    remain poppable (review finding: stranded in tier active forever)."""
+    q = SchedulingQueue()
+    q.add(make_pod("p").obj())
+    q.update(make_pod("p").group("g").obj())
+    batch = q.pop_batch(10, timeout=0.2)
+    assert [i.pod.meta.name for i in batch] == ["p"]
+
+
+def test_delete_below_declared_size_restages_members():
+    """Deleting a member of a whole, released gang drops it below its
+    declared size: remaining queued members must re-stage, not solve as a
+    partial gang."""
+    q = SchedulingQueue()
+    pods = [make_pod(f"g-{i}").group("g", size=3).obj() for i in range(3)]
+    for p in pods:
+        q.add(p)
+    assert q.stats()["active"] == 3
+    q.delete(pods[2])
+    assert q.stats()["gang_staged"] == 2
+    assert q.pop_batch(10, timeout=0.1) == []
+    # replacement arrives: gang whole again
+    q.add(make_pod("g-2b").group("g", size=3).obj())
+    assert len(q.pop_batch(10, timeout=0.1)) == 3
