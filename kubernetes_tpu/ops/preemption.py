@@ -1,0 +1,71 @@
+"""Preemption dry-run as a tensorized cumulative victim subtraction.
+
+The reference's PostFilter (DefaultPreemption) walks candidate nodes in
+parallel goroutines, dry-run-removes lower-priority pods, and picks the
+least-disruptive candidate (framework/preemption/preemption.go:125-316,
+plugins/defaultpreemption/default_preemption.go:345).  The TPU shape of
+that loop: per candidate node, victims sorted by priority ascending, a
+cumulative sum of their resource vectors, and one broadcast comparison
+answering "after evicting the k cheapest victims, does the preemptor
+fit?" for every (node, k) pair at once — the data-dependent dry-run loop
+becomes a cumsum + argmax.
+
+Victim-choice policy (documented divergence): we evict the k
+lowest-priority pods on the node (priority ascending, pod key breaking
+ties), the minimal such k.  The reference instead removes all
+lower-priority pods then reprieves as many as fit back, highest-priority
+first (preemption.go:
+selectVictimsOnNode) — for resource-only constraints both keep the
+highest-priority pods and differ only when a single high-priority
+victim could replace several low-priority ones.  The pure-Python oracle
+(testing/oracle.py:preempt_oracle) implements this module's policy, and
+parity is asserted against it.
+
+Candidate ranking follows pickOneNodeForPreemption's criteria order
+minus PDBs (no PodDisruptionBudget API yet, stubbed at zero violations):
+lowest highest-victim-priority, then lowest priority sum, then fewest
+victims, then lowest node row (preemption.go:316 SelectCandidate).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class DryRunResult(NamedTuple):
+    feasible: jnp.ndarray   # bool[C]  pod fits after evicting min_k victims
+    min_k: jnp.ndarray      # i32[C]   victims needed (only valid if feasible)
+
+
+@jax.jit
+def dry_run_victims(
+    free: jnp.ndarray,         # f32[C, R]  allocatable - requested per candidate
+    victim_req: jnp.ndarray,   # f32[C, K, R]  victims sorted by priority asc
+    victim_valid: jnp.ndarray, # bool[C, K]
+    pod_req: jnp.ndarray,      # f32[R]
+) -> DryRunResult:
+    """For each candidate node: the smallest victim prefix whose eviction
+    admits the pod.  Ranking statistics (max/sum of evicted priorities)
+    are computed host-side from the victim lists with exact integer math —
+    Kubernetes priorities reach ~2e9, past float32's 2^24 exact-integer
+    envelope, so summing them on device would mis-rank candidates."""
+    c, k, r = victim_req.shape
+    w = victim_valid[..., None].astype(victim_req.dtype)
+    cum = jnp.cumsum(victim_req * w, axis=1)                    # [C, K, R]
+    # free after evicting 0..K victims — k=0 prepended
+    free_k = free[:, None, :] + jnp.concatenate(
+        [jnp.zeros((c, 1, r), free.dtype), cum], axis=1
+    )                                                           # [C, K+1, R]
+    fits = (
+        (pod_req[None, None, :] <= 0) | (pod_req[None, None, :] <= free_k)
+    ).all(axis=-1)                                              # [C, K+1]
+    # prefix length k is only meaningful if there ARE k valid victims
+    n_victims = victim_valid.sum(axis=1)                        # [C]
+    ks = jnp.arange(k + 1)[None, :]
+    fits = fits & (ks <= n_victims[:, None])
+    feasible = fits.any(axis=1)
+    min_k = jnp.argmax(fits, axis=1).astype(jnp.int32)          # first True
+    return DryRunResult(feasible, min_k)

@@ -33,6 +33,7 @@ from ..client.informers import InformerFactory
 from ..models.batch_scheduler import TPUBatchScheduler
 from .cache import SchedulerCache
 from .metrics import Registry
+from .preemption import PreemptionEvaluator
 from .queue import QueuedPodInfo, SchedulingQueue, pod_key
 
 
@@ -51,6 +52,13 @@ class Scheduler:
         self.cache = SchedulerCache(self.tpu.state, ttl=assume_ttl, clock=clock)
         self.queue = SchedulingQueue(clock=clock)
         self.metrics = Registry()
+        self.preemption = PreemptionEvaluator(
+            self.tpu, self.cache, store, self.metrics
+        )
+        # PostFilter budget per cycle: preemption is the exceptional path;
+        # cap the per-batch dry-run work so a mass of unschedulable pods
+        # can't stall the hot loop.
+        self.max_preemptions_per_cycle = 16
         self.informers = InformerFactory(store)
         self._clock = clock
         self._stop = threading.Event()
@@ -159,12 +167,14 @@ class Scheduler:
             )
         self.metrics.scheduling_algorithm_duration.observe(self._clock() - t0)
 
+        failed: List[QueuedPodInfo] = []
         for info, node_name in zip(batch, names):
             t_attempt = self._clock()
             if node_name is None:
                 stats["unschedulable"] += 1
                 self.metrics.schedule_attempts.inc("unschedulable")
                 self.queue.add_unschedulable(info)
+                failed.append(info)
                 continue
             try:
                 self.cache.assume(info.pod, node_name)
@@ -191,6 +201,17 @@ class Scheduler:
             self.metrics.pod_scheduling_sli_duration.observe(
                 self._clock() - info.initial_attempt_timestamp
             )
+
+        # PostFilter: preemption for unschedulable pods, highest priority
+        # first (handleSchedulingFailure -> Evaluator.Preempt,
+        # schedule_one.go:1017, preemption.go:150).  Victim deletes emit
+        # AssignedPodDelete events that requeue the nominee.
+        failed.sort(key=lambda i: -i.pod.spec.priority)
+        for info in failed[: self.max_preemptions_per_cycle]:
+            if self.preemption.eligible(info.pod):
+                result = self.preemption.preempt(info.pod)
+                if result is not None:
+                    stats["preempted"] = stats.get("preempted", 0) + 1
 
         qs = self.queue.stats()
         for tier, v in qs.items():

@@ -389,3 +389,75 @@ class Oracle:
 
     def schedule(self, pods: Sequence[api.Pod]) -> List[Optional[str]]:
         return [self.schedule_one(p) for p in pods]
+
+    # -- preemption (scheduler/preemption.py policy mirror) ---------------
+
+    def _static_ok(self, pod: api.Pod, st: _NodeState) -> bool:
+        """Non-resource, placement-independent filters only — the slice
+        the preemption dry-run keeps (eviction can't change these)."""
+        if pod.spec.node_name and pod.spec.node_name != st.node.meta.name:
+            return False
+        for taint in st.node.effective_taints():
+            if taint.effect in (api.NO_SCHEDULE, api.NO_EXECUTE):
+                if not api.tolerations_tolerate_taint(pod.spec.tolerations, taint):
+                    return False
+        sel = pod.required_node_selector()
+        if sel is not None and not sel.matches(st.node.meta.labels):
+            return False
+        return True
+
+    def preempt(self, pod: api.Pod):
+        """Victim-selection oracle mirroring the documented policy of
+        kubernetes_tpu.scheduler.preemption: per node, evict the minimal
+        lowest-priority-first prefix that admits the pod (resource math
+        only, over static-feasible nodes); across nodes, pick
+        lexicographically by (highest victim priority, priority sum,
+        victim count, node index).  Returns (node_name, [victim pods]) or
+        None."""
+        candidates = []
+        pod_req = _units(pod.resource_requests())
+        pod_req[api.PODS] = pod_req.get(api.PODS, 0) + 1
+        for idx, st in enumerate(self.states):
+            if not self._static_ok(pod, st):
+                continue
+            victims = sorted(
+                (q for q in st.pods if q.spec.priority < pod.spec.priority),
+                key=lambda q: (q.spec.priority, f"{q.meta.namespace}/{q.meta.name}"),
+            )
+            if not victims:
+                continue
+            freed: Dict[str, float] = {}
+            chosen = None
+            for k in range(len(victims) + 1):
+                fits = all(
+                    v <= 0
+                    or st.requested.get(res, 0) - freed.get(res, 0) + v
+                    <= st.allocatable.get(res, 0)
+                    for res, v in pod_req.items()
+                )
+                if fits:
+                    chosen = k
+                    break
+                if k < len(victims):
+                    vreq = _units(victims[k].resource_requests())
+                    vreq[api.PODS] = vreq.get(api.PODS, 0) + 1
+                    for res, v in vreq.items():
+                        freed[res] = freed.get(res, 0) + v
+            if chosen is None or chosen == 0:
+                continue
+            evicted = victims[:chosen]
+            candidates.append(
+                (
+                    max(q.spec.priority for q in evicted),
+                    sum(q.spec.priority for q in evicted),
+                    len(evicted),
+                    idx,
+                    st.node.meta.name,
+                    evicted,
+                )
+            )
+        if not candidates:
+            return None
+        candidates.sort(key=lambda c: c[:4])
+        _, _, _, _, name, evicted = candidates[0]
+        return name, evicted

@@ -1,0 +1,272 @@
+"""Preemption: tensorized dry-run kernel, victim-choice oracle parity,
+and the end-to-end PostFilter path (evict through the store, nominate,
+reschedule).
+
+Reference semantics: framework/preemption/preemption.go:150-316,
+plugins/defaultpreemption/default_preemption.go; policy divergences are
+documented in ops/preemption.py and mirrored by testing/oracle.preempt.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import store as st
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.models.batch_scheduler import TPUBatchScheduler
+from kubernetes_tpu.ops import preemption as pre_ops
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.scheduler.cache import SchedulerCache
+from kubernetes_tpu.scheduler.preemption import PreemptionEvaluator
+from kubernetes_tpu.testing.oracle import Oracle
+from kubernetes_tpu.testing.wrappers import GI, MI, make_node, make_pod
+
+
+# -- kernel ---------------------------------------------------------------
+
+
+def test_dry_run_min_k():
+    # one node, 3 victims, free 0; pod needs 2 cpu; victims free 1 cpu
+    # each -> min_k = 2
+    free = np.zeros((1, 2), np.float32)
+    victim_req = np.array([[[1, 0], [1, 0], [1, 0]]], np.float32)
+    valid = np.ones((1, 3), bool)
+    pod_req = np.array([2, 0], np.float32)
+    r = pre_ops.dry_run_victims(free, victim_req, valid, pod_req)
+    assert bool(r.feasible[0])
+    assert int(r.min_k[0]) == 2
+
+
+def test_dry_run_infeasible_even_after_all_evictions():
+    free = np.zeros((1, 1), np.float32)
+    victim_req = np.full((1, 2, 1), 1.0, np.float32)
+    valid = np.ones((1, 2), bool)
+    pod_req = np.array([5.0], np.float32)
+    r = pre_ops.dry_run_victims(free, victim_req, valid, pod_req)
+    assert not bool(r.feasible[0])
+
+
+def test_dry_run_padding_not_counted():
+    # 1 real victim + 1 padding slot: k=2 must not become claimable
+    free = np.zeros((1, 1), np.float32)
+    victim_req = np.array([[[1.0], [99.0]]], np.float32)  # padding junk
+    valid = np.array([[True, False]])
+    pod_req = np.array([2.0], np.float32)
+    r = pre_ops.dry_run_victims(free, victim_req, valid, pod_req)
+    assert not bool(r.feasible[0])
+
+
+# -- evaluator vs oracle ---------------------------------------------------
+
+
+def _build_cluster(rng, n_nodes=6, n_victims=12):
+    """Every node gets >= 2 victims (round-robin), so a 3500m preemptor
+    on 4000m nodes never fits without eviction — preemption's actual
+    precondition (PostFilter only runs after filters rejected all)."""
+    nodes = [
+        make_node(f"n{i}").capacity(cpu_milli=4000, mem=8 * GI, pods=20).obj()
+        for i in range(n_nodes)
+    ]
+    bound = []
+    for i in range(n_victims):
+        node = f"n{i % n_nodes}"
+        p = (
+            make_pod(f"v{i}")
+            .req(cpu_milli=int(rng.choice([500, 1000, 1500])), mem=GI)
+            .priority(int(rng.integers(0, 5)))
+            .node_name(node)
+            .obj()
+        )
+        bound.append(p)
+    return nodes, bound
+
+
+def _evaluator_for(nodes, bound):
+    tpu = TPUBatchScheduler()
+    for n in nodes:
+        tpu.add_node(n)
+    for p in bound:
+        tpu.assume(p, p.spec.node_name)
+    cache = SchedulerCache(tpu.state)
+    store = st.Store()
+    ev = PreemptionEvaluator(tpu, cache, store)
+    return ev
+
+
+def test_victim_choice_oracle_parity(rng):
+    """Randomized clusters: the evaluator's (node, victims) must equal the
+    pure-Python policy mirror whenever the optimum is unique enough for
+    both orderings to coincide (resource-only pods, unique priorities per
+    node make it so)."""
+    mismatches = 0
+    for trial in range(10):
+        nodes, bound = _build_cluster(rng)
+        preemptor = (
+            make_pod("hi")
+            .req(cpu_milli=3500, mem=GI)
+            .priority(100)
+            .obj()
+        )
+        ev = _evaluator_for(nodes, bound)
+        with ev.cache.lock:
+            plan = ev._plan(preemptor)
+        oracle = Oracle(nodes, bound_pods=bound)
+        want = oracle.preempt(preemptor)
+        if plan is None:
+            assert want is None, f"trial {trial}: oracle found {want}"
+            continue
+        assert want is not None, f"trial {trial}: oracle found nothing"
+        node, victims = plan
+        wnode, wvictims = want
+        assert node == wnode, f"trial {trial}: {node} != {wnode}"
+        assert sorted(v.meta.name for v in victims) == sorted(
+            v.meta.name for v in wvictims
+        ), trial
+
+
+def test_never_policy_not_eligible():
+    nodes = [make_node("n0").capacity(cpu_milli=1000).obj()]
+    bound = [make_pod("v").req(cpu_milli=1000).priority(0).node_name("n0").obj()]
+    ev = _evaluator_for(nodes, bound)
+    pod = make_pod("hi").req(cpu_milli=1000).priority(10).obj()
+    pod.spec.preemption_policy = "Never"
+    assert not ev.eligible(pod)
+
+
+def test_no_lower_priority_not_eligible():
+    nodes = [make_node("n0").capacity(cpu_milli=1000).obj()]
+    bound = [make_pod("v").req(cpu_milli=1000).priority(50).node_name("n0").obj()]
+    ev = _evaluator_for(nodes, bound)
+    pod = make_pod("lo").req(cpu_milli=1000).priority(10).obj()
+    assert not ev.eligible(pod)
+
+
+def test_verify_rejects_statically_blocked_candidate():
+    """The pod is anti-affine to a label that survives eviction (carried
+    by a HIGHER-priority pod), so resource-only candidates must be
+    rejected by the re-solve verification."""
+    nodes = [make_node("n0").capacity(cpu_milli=2000, pods=10).obj()]
+    blocker = (
+        make_pod("blocker")
+        .req(cpu_milli=1000)
+        .priority(200)  # not evictable
+        .label("app", "x")
+        .node_name("n0")
+        .obj()
+    )
+    filler = (
+        make_pod("filler").req(cpu_milli=1000).priority(0).node_name("n0").obj()
+    )
+    ev = _evaluator_for(nodes, [blocker, filler])
+    pod = (
+        make_pod("hi")
+        .req(cpu_milli=500)
+        .priority(100)
+        .pod_anti_affinity({"app": "x"})
+        .obj()
+    )
+    with ev.cache.lock:
+        plan = ev._plan(pod)
+    assert plan is None
+
+
+def test_verify_accepts_when_eviction_clears_conflict():
+    """Evicting the low-priority conflicting pod removes BOTH the resource
+    shortage and the anti-affinity conflict."""
+    nodes = [make_node("n0").capacity(cpu_milli=1000, pods=10).obj()]
+    conflicter = (
+        make_pod("conflicter")
+        .req(cpu_milli=1000)
+        .priority(0)
+        .label("app", "x")
+        .node_name("n0")
+        .obj()
+    )
+    ev = _evaluator_for(nodes, [conflicter])
+    pod = (
+        make_pod("hi")
+        .req(cpu_milli=500)
+        .priority(100)
+        .pod_anti_affinity({"app": "x"})
+        .obj()
+    )
+    with ev.cache.lock:
+        plan = ev._plan(pod)
+    assert plan is not None
+    node, victims = plan
+    assert node == "n0"
+    assert [v.meta.name for v in victims] == ["conflicter"]
+
+
+# -- end-to-end through the scheduler --------------------------------------
+
+
+def _mk_scheduler(store, **kw):
+    s = Scheduler(store, **kw)
+    s.informers.informer("Node").start()
+    s.informers.informer("Pod").start()
+    assert s.informers.wait_for_sync(10)
+    return s
+
+
+def test_preemption_end_to_end():
+    """Full cluster; a high-priority pod arrives, evicts the cheapest
+    victim set through the store, is nominated, and lands on the freed
+    node on a later cycle.  preemption_* metrics populate."""
+    store = st.Store()
+    store.create(make_node("n0").capacity(cpu_milli=2000, pods=10).obj())
+    store.create(make_node("n1").capacity(cpu_milli=2000, pods=10).obj())
+    # fill both nodes with low-priority pods (bound directly via the API)
+    for i, node in [(0, "n0"), (1, "n0"), (2, "n1"), (3, "n1")]:
+        p = (
+            make_pod(f"low-{i}")
+            .req(cpu_milli=1000)
+            .priority(i)  # low-0 is the cheapest victim
+            .node_name(node)
+            .obj()
+        )
+        p.status.phase = "Running"
+        store.create(p)
+    sched = _mk_scheduler(store)
+    try:
+        store.create(make_pod("hi").req(cpu_milli=1000).priority(100).obj())
+        deadline = time.monotonic() + 15
+        placed = None
+        while time.monotonic() < deadline and not placed:
+            sched.schedule_batch(timeout=0.2)
+            placed = store.get("Pod", "hi").spec.node_name
+        assert placed == "n0", placed
+        # the cheapest victim (lowest priority, prio=0 on n0) was evicted
+        with pytest.raises(KeyError):
+            store.get("Pod", "low-0")
+        # others survive
+        for name in ("low-1", "low-2", "low-3"):
+            store.get("Pod", name)
+        assert sched.metrics.preemption_attempts.get("nominated") >= 1
+        assert sched.metrics.preemption_victims.n >= 1
+        # nomination was recorded through the API at some point
+        assert placed == "n0"
+    finally:
+        sched.stop()
+
+
+def test_preemption_not_triggered_when_feasible_elsewhere():
+    store = st.Store()
+    store.create(make_node("n0").capacity(cpu_milli=1000, pods=10).obj())
+    store.create(make_node("n1").capacity(cpu_milli=2000, pods=10).obj())
+    low = make_pod("low").req(cpu_milli=1000).priority(0).node_name("n0").obj()
+    store.create(low)
+    sched = _mk_scheduler(store)
+    try:
+        store.create(make_pod("hi").req(cpu_milli=1000).priority(100).obj())
+        deadline = time.monotonic() + 10
+        placed = None
+        while time.monotonic() < deadline and not placed:
+            sched.schedule_batch(timeout=0.2)
+            placed = store.get("Pod", "hi").spec.node_name
+        assert placed == "n1"
+        store.get("Pod", "low")  # still alive
+        assert sched.metrics.preemption_attempts.get("attempted") == 0
+    finally:
+        sched.stop()
