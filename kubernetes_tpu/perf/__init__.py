@@ -1,1 +1,30 @@
-"""perf layer (being built out; see package docstring for the layout map)."""
+"""scheduler_perf port: YAML workloads driving the host scheduler
+through the store, with throughput/metrics collectors emitting DataItems
+(reference: test/integration/scheduler_perf).
+
+  from kubernetes_tpu.perf import load_config, run_workloads, select
+  wls = select(load_config(DEFAULT_CONFIG), label="integration-test")
+  result = run_workloads(wls)
+"""
+
+import os
+
+from .collectors import DataItem, MetricsCollector, ThroughputCollector
+from .runner import WorkloadRunner, run_workloads
+from .workload import Workload, load_config, select
+
+DEFAULT_CONFIG = os.path.join(
+    os.path.dirname(__file__), "config", "performance-config.yaml"
+)
+
+__all__ = [
+    "DataItem",
+    "DEFAULT_CONFIG",
+    "MetricsCollector",
+    "ThroughputCollector",
+    "Workload",
+    "WorkloadRunner",
+    "load_config",
+    "run_workloads",
+    "select",
+]

@@ -1,0 +1,157 @@
+"""Throughput and metrics collectors emitting DataItems.
+
+Reference: test/integration/scheduler_perf/util.go:364-475
+(throughputCollector sampling scheduled-pod deltas on a fixed interval;
+collect() summarizing Average/Perc50/90/95/99) and
+scheduler_perf.go:100-112 (metricsCollector scraping histograms).
+DataItem JSON shape matches the reference's {data, unit, labels} so
+perf-dash-style tooling can ingest either.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..api import store as st
+from ..scheduler.metrics import Histogram, Registry
+
+
+class DataItem(dict):
+    """{"data": {...}, "unit": str, "labels": {...}} — util.go DataItem."""
+
+    def __init__(self, data: Dict[str, float], unit: str, labels: Dict[str, str]):
+        super().__init__(data=data, unit=unit, labels=labels)
+
+
+def _percentiles(sorted_vals: List[float]) -> Dict[str, float]:
+    n = len(sorted_vals)
+    if n == 0:
+        return {}
+    pick = lambda q: sorted_vals[max(0, int(math.ceil(n * q / 100)) - 1)]
+    return {
+        "Average": sum(sorted_vals) / n,
+        "Perc50": pick(50),
+        "Perc90": pick(90),
+        "Perc95": pick(95),
+        "Perc99": pick(99),
+    }
+
+
+class ThroughputCollector:
+    """Samples scheduled-pod count deltas every `interval` seconds in a
+    thread (util.go:364 run()); zero-delta intervals are coalesced into
+    the next non-zero sample, skipped-interval style."""
+
+    def __init__(
+        self,
+        store: st.Store,
+        namespaces: Optional[List[str]] = None,
+        interval: float = 0.1,
+        labels: Optional[Dict[str, str]] = None,
+        pod_names: Optional[set] = None,
+    ):
+        self.store = store
+        self.namespaces = namespaces
+        self.interval = interval
+        self.labels = dict(labels or {})
+        # When set, only these pods count — preemption workloads DELETE
+        # bound victims, so counting every scheduled pod in the namespace
+        # would produce negative deltas.
+        self.pod_names = pod_names
+        self.samples: List[float] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _scheduled_count(self) -> int:
+        pods, _ = self.store.list("Pod")
+        return sum(
+            1
+            for p in pods
+            if p.spec.node_name
+            and (self.namespaces is None or p.meta.namespace in self.namespaces)
+            and (self.pod_names is None or p.meta.name in self.pod_names)
+        )
+
+    def _run(self) -> None:
+        last = self._scheduled_count()
+        last_t = time.monotonic()
+        started = False
+        skipped = 0
+        while not self._stop.wait(self.interval):
+            now = time.monotonic()
+            cur = self._scheduled_count()
+            if cur == 0:
+                continue
+            if not started:
+                started = True
+                last, last_t = cur, now
+                continue
+            delta = cur - last
+            if delta == 0:
+                skipped += 1
+                continue
+            throughput = delta / (now - last_t)
+            for _ in range(skipped + 1):
+                self.samples.append(throughput)
+            last, last_t, skipped = cur, now, 0
+
+    def start(self) -> "ThroughputCollector":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    def collect(self) -> List[DataItem]:
+        vals = sorted(self.samples)
+        if not vals:
+            return []
+        labels = dict(self.labels)
+        labels["Metric"] = "SchedulingThroughput"
+        return [DataItem(_percentiles(vals), "pods/s", labels)]
+
+
+class MetricsCollector:
+    """Extracts percentile summaries from the scheduler's histograms by
+    reference metric name (scheduler_perf.go:100-112)."""
+
+    DEFAULT_METRICS = (
+        "scheduler_scheduling_attempt_duration_seconds",
+        "scheduler_scheduling_algorithm_duration_seconds",
+        "scheduler_pod_scheduling_sli_duration_seconds",
+    )
+
+    def __init__(self, registry: Registry, labels: Optional[Dict[str, str]] = None):
+        self.registry = registry
+        self.labels = dict(labels or {})
+
+    def collect(self) -> List[DataItem]:
+        out: List[DataItem] = []
+        snap = self.registry.snapshot()
+        for name in self.DEFAULT_METRICS:
+            h = snap.get(name)
+            if not isinstance(h, Histogram) or h.n == 0:
+                continue
+            labels = dict(self.labels)
+            labels["Metric"] = name
+            ms = 1000.0  # histograms record seconds; DataItems report ms
+            out.append(
+                DataItem(
+                    {
+                        "Average": h.average * ms,
+                        "Perc50": h.percentile(0.50) * ms,
+                        "Perc90": h.percentile(0.90) * ms,
+                        "Perc95": h.percentile(0.95) * ms,
+                        "Perc99": h.percentile(0.99) * ms,
+                    },
+                    "ms",
+                    labels,
+                )
+            )
+        return out
