@@ -29,6 +29,7 @@ import contextlib
 from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..api import types as api
@@ -133,7 +134,11 @@ class TPUBatchScheduler:
         return self._greedy(snap, topo_z, features)
 
     def encode_pending(
-        self, pending: Sequence[api.Pod], num_pods_hint: int = 0, lock=None
+        self,
+        pending: Sequence[api.Pod],
+        num_pods_hint: int = 0,
+        lock=None,
+        reservations: Sequence[Tuple[str, api.Pod]] = (),
     ) -> Tuple[schema.Snapshot, schema.SnapshotMeta]:
         """Encode pending pods + live cluster state into a device-resident
         snapshot.  `lock` (the scheduler cache's mutex) is held across the
@@ -141,13 +146,45 @@ class TPUBatchScheduler:
         aliasing live arrays that informer threads mutate, and both sides
         intern into the shared vocabularies — the reference holds the cache
         mutex for UpdateSnapshot (cache.go:185) for the same reason.
-        device_put copies the host buffers, so once it returns the snapshot
-        is immune to further cache mutation."""
+        The transfer MUST copy: build_from_state returns views aliasing the
+        live arrays, and on the CPU backend jax.device_put can zero-copy
+        alias a numpy buffer — a later cache mutation would then leak into
+        an already-"materialized" snapshot (observed: preemption's verify
+        restore undoing its own victim removal mid-solve).  jnp.array
+        guarantees a copy on every backend; on accelerators it is the same
+        host→device transfer device_put does.
+
+        reservations: (node_name, pod) pairs whose requests overlay the
+        named node's usage in THIS snapshot only — nominated preemptors
+        waiting to land (the filters-with-nominated-pods analogue,
+        runtime/framework.go:962).  The overlay is applied to the device
+        copy; live state is untouched."""
         with lock if lock is not None else contextlib.nullcontext():
             snap, meta = self.builder.build_from_state(
                 self.state, pending, num_pods_hint=num_pods_hint
             )
-            return jax.device_put(snap), meta
+            rows, reqs, nzs = [], [], []
+            for node_name, pod in reservations:
+                row = self.state._rows.get(node_name)
+                if row is None:
+                    continue  # nominated node left the cluster
+                req, nz, _ = self.builder.pod_usage(pod, self.state._r)
+                rows.append(row)
+                reqs.append(req)
+                nzs.append(nz)
+            snap = jax.tree.map(jnp.array, snap)
+        if rows:
+            idx = jnp.asarray(np.array(rows, dtype=np.int32))
+            cluster = snap.cluster._replace(
+                requested=snap.cluster.requested.at[idx].add(
+                    jnp.asarray(np.stack(reqs))
+                ),
+                nonzero_requested=snap.cluster.nonzero_requested.at[idx].add(
+                    jnp.asarray(np.stack(nzs))
+                ),
+            )
+            snap = snap._replace(cluster=cluster)
+        return snap, meta
 
     def solve_encoded(
         self, snap: schema.Snapshot, meta: schema.SnapshotMeta
@@ -159,7 +196,11 @@ class TPUBatchScheduler:
         return [meta.node_name(int(i)) for i in idx]
 
     def schedule_pending(
-        self, pending: Sequence[api.Pod], num_pods_hint: int = 0, lock=None
+        self,
+        pending: Sequence[api.Pod],
+        num_pods_hint: int = 0,
+        lock=None,
+        reservations: Sequence[Tuple[str, api.Pod]] = (),
     ) -> List[Optional[str]]:
         """One batched scheduling step against the incremental state.
         Returns one node name (or None) per pending pod.  Placements are
@@ -167,7 +208,8 @@ class TPUBatchScheduler:
         if not pending:
             return []
         snap, meta = self.encode_pending(
-            pending, num_pods_hint=num_pods_hint, lock=lock
+            pending, num_pods_hint=num_pods_hint, lock=lock,
+            reservations=reservations,
         )
         return self.solve_encoded(snap, meta)
 

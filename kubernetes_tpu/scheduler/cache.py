@@ -54,6 +54,12 @@ class SchedulerCache:
         self._clock = clock
         self._lock = threading.RLock()
         self._assumed: Dict[str, _Assumed] = {}
+        # Nominated pods (preemption winners waiting to land): their
+        # requests overlay the nominated node's usage in OTHER pods'
+        # snapshots, so nobody steals the space their victims freed — the
+        # PodNominator / RunFilterPluginsWithNominatedPods analogue
+        # (framework/interface.go:778, runtime/framework.go:962).
+        self._nominated: Dict[str, tuple] = {}  # key -> (pod, node_name)
         # Pods delivered before their node (informers are per-kind threads
         # with no cross-kind ordering).  The reference cache tolerates this
         # by creating a stub NodeInfo (cache.go AddPod on unknown node);
@@ -98,6 +104,29 @@ class SchedulerCache:
                 raise ValueError(f"pod {key} already assumed")
             self.state.add_pod(pod, node)
             self._assumed[key] = _Assumed(pod=pod, node=node)
+            # the pod landed — its nomination's reservation is spent
+            self._nominated.pop(key, None)
+
+    # -- nominations (PodNominator) ----------------------------------------
+
+    def nominate(self, pod: api.Pod, node_name: str) -> None:
+        with self._lock:
+            self._nominated[pod_key(pod)] = (pod, node_name)
+
+    def remove_nomination(self, pod: api.Pod) -> None:
+        with self._lock:
+            self._nominated.pop(pod_key(pod), None)
+
+    def nominations_excluding(self, keys) -> List[tuple]:
+        """(node_name, pod) reservations for nominated pods NOT in `keys`
+        (a batch must not see its own members' reservations — a nominee
+        schedules INTO its reserved space)."""
+        with self._lock:
+            return [
+                (node, pod)
+                for k, (pod, node) in self._nominated.items()
+                if k not in keys
+            ]
 
     def finish_binding(self, pod: api.Pod) -> None:
         with self._lock:

@@ -86,10 +86,16 @@ class PreemptionEvaluator:
     def preempt(self, pod: api.Pod) -> Optional[PreemptionResult]:
         """Find victims admitting `pod`, verify by re-solve, evict through
         the store, and nominate.  Returns None when no candidate works."""
+        # The preemptor must still exist — evicting running pods on behalf
+        # of a deleted pod is the worst failure mode (the reference
+        # re-fetches the pod before preparing candidates, getUpdatedPod).
+        try:
+            self.store.get("Pod", pod.meta.name, pod.meta.namespace)
+        except KeyError:
+            return None
         if self.metrics:
             self.metrics.preemption_attempts.inc("attempted")
-        with self.cache.lock:
-            plan = self._plan(pod)
+        plan = self._plan(pod)
         if plan is None:
             if self.metrics:
                 self.metrics.preemption_attempts.inc("no_candidate")
@@ -107,6 +113,9 @@ class PreemptionEvaluator:
                 pass  # already gone — the freed space is still freed
             self.cache.remove_pod(v)
         self._nominate(pod, node_name)
+        # reserve the freed space for the nominee: other batches see the
+        # reservation; the nominee's own batch excludes it
+        self.cache.nominate(pod, node_name)
         if self.metrics:
             self.metrics.preemption_attempts.inc("nominated")
             self.metrics.preemption_victims.observe(len(victims))
@@ -126,37 +135,58 @@ class PreemptionEvaluator:
         self, pod: api.Pod
     ) -> Optional[Tuple[str, List[api.Pod]]]:
         """Choose (node, victims) for the pod, verified by a dry-run
-        re-solve against the state with the victims removed.  Caller holds
-        the cache lock."""
+        re-solve against the state with the victims removed.
+
+        Lock discipline mirrors schedule_batch's: host-side reads of the
+        shared state and snapshot encodes run under the cache lock; the
+        device dispatches (which can hit tens-of-seconds first-time XLA
+        compiles) run OUTSIDE it, so informer event handling never stalls
+        behind a compile."""
         state = self.tpu.state
         prio = pod.spec.priority
-        # assumed pods are mid-bind — not evictable (the reference's
-        # dry-run also works off the snapshot of *confirmed* state)
-        assumed = set(self.cache._assumed.keys())
+        with self.cache.lock:
+            # assumed pods are mid-bind — not evictable (the reference's
+            # dry-run also works off the snapshot of *confirmed* state)
+            assumed = set(self.cache._assumed.keys())
+            static_snap = self._encode_static(pod)
+            # candidate victim data is copied out (free vectors, victim
+            # usage) so ranking can run lock-free on a consistent view
+            cands: List[Tuple[int, str, List[api.Pod]]] = []
+            free_rows: List[np.ndarray] = []
+            usage: Dict[str, np.ndarray] = {}
+            r = state._r
+            for name, keys in state._pods_by_node.items():
+                row = state._rows.get(name)
+                if row is None:
+                    continue
+                victims = [
+                    state._pods[k]
+                    for k in keys
+                    if state._pods[k].spec.priority < prio and k not in assumed
+                ]
+                if not victims:
+                    continue
+                victims.sort(key=lambda p: (p.spec.priority, pod_key(p)))
+                cands.append((row, name, victims))
+                free_rows.append(
+                    (state.allocatable[row] - state.requested[row]).copy()
+                )
+                for v in victims:
+                    usage[pod_key(v)] = state.builder.pod_usage(v, r)[0]
+                if len(cands) >= MAX_CANDIDATES:
+                    break
+            if not cands:
+                return None
+            pod_req = state.builder.pod_usage(pod, r)[0]
 
-        static_ok = self._static_feasible_row(pod)
-
-        # collect candidate nodes: static-feasible with >=1 evictable pod
-        cands: List[Tuple[int, str, List[api.Pod]]] = []
-        for name, keys in state._pods_by_node.items():
-            row = state._rows.get(name)
-            if row is None or not static_ok[row]:
-                continue
-            victims = [
-                state._pods[k]
-                for k in keys
-                if state._pods[k].spec.priority < prio and k not in assumed
-            ]
-            if not victims:
-                continue
-            victims.sort(key=lambda p: (p.spec.priority, pod_key(p)))
-            cands.append((row, name, victims))
-            if len(cands) >= MAX_CANDIDATES:
-                break
+        static_ok = self._static_row_from_snap(static_snap)
+        keep = [i for i, (row, _, _) in enumerate(cands) if static_ok[row]]
+        cands = [cands[i] for i in keep]
+        free_rows = [free_rows[i] for i in keep]
         if not cands:
             return None
 
-        ranked, min_k = self._rank(pod, cands)
+        ranked, min_k = self._rank(cands, free_rows, usage, pod_req)
         for ci in ranked[:MAX_VERIFY]:
             row, name, victims = cands[ci]
             chosen = victims[: int(min_k[ci])]
@@ -165,24 +195,27 @@ class PreemptionEvaluator:
         return None
 
     def _rank(
-        self, pod: api.Pod, cands: Sequence[Tuple[int, str, List[api.Pod]]]
+        self,
+        cands: Sequence[Tuple[int, str, List[api.Pod]]],
+        free_rows: Sequence[np.ndarray],
+        usage: Dict[str, np.ndarray],
+        pod_req: np.ndarray,
     ) -> Tuple[List[int], np.ndarray]:
-        """Run the device dry-run over all candidates; return candidate
-        indices ranked most-preferred first (feasible only) plus the
-        per-candidate victim count."""
-        state = self.tpu.state
-        r = state._r
+        """Run the device dry-run over all candidates (lock-free — inputs
+        were copied out under the lock); return candidate indices ranked
+        most-preferred first (feasible only) plus per-candidate victim
+        counts."""
+        r = pod_req.shape[0]
         c_dim = pad_dim(len(cands), 8)
         k_dim = pad_dim(max(len(v) for _, _, v in cands), 4)
         free = np.zeros((c_dim, r), dtype=np.float32)
         victim_req = np.zeros((c_dim, k_dim, r), dtype=np.float32)
         victim_valid = np.zeros((c_dim, k_dim), dtype=bool)
         for ci, (row, _, victims) in enumerate(cands):
-            free[ci] = state.allocatable[row] - state.requested[row]
+            free[ci] = free_rows[ci]
             for vi, v in enumerate(victims[:k_dim]):
-                victim_req[ci, vi] = state.builder.pod_usage(v, r)[0]
+                victim_req[ci, vi] = usage[pod_key(v)]
                 victim_valid[ci, vi] = True
-        pod_req = state.builder.pod_usage(pod, r)[0]
         result = pre_ops.dry_run_victims(free, victim_req, victim_valid, pod_req)
         feasible = np.asarray(result.feasible)[: len(cands)]
         min_k = np.asarray(result.min_k)[: len(cands)]
@@ -209,36 +242,46 @@ class PreemptionEvaluator:
     def _verify(
         self, pod: api.Pod, node_name: str, victims: List[api.Pod]
     ) -> bool:
-        """Dry-run re-solve: remove the victims from live state, solve the
-        single pod, restore.  True iff the pod lands on the expected node.
+        """Dry-run re-solve: under the lock, remove the victims from live
+        state, encode a snapshot (device_put copies), and restore; solve
+        OUTSIDE the lock.  True iff the pod lands on the expected node.
         This is the all-families check the resource-only kernel can't do
         (the reference re-runs the full filter chain in its dry-run)."""
         state = self.tpu.state
-        for v in victims:
-            state.remove_pod(v)
-        try:
-            placements = self.tpu.schedule_pending([pod])
-            return bool(placements) and placements[0] == node_name
-        finally:
+        with self.cache.lock:
             for v in victims:
-                state.add_pod(v, v.spec.node_name or node_name)
+                state.remove_pod(v)
+            try:
+                snap, meta = self.tpu.encode_pending([pod])
+            finally:
+                for v in victims:
+                    state.add_pod(v, v.spec.node_name or node_name)
+        placements = self.tpu.solve_encoded(snap, meta)
+        return bool(placements) and placements[0] == node_name
 
     # -- static feasibility (non-resource filters) --------------------------
 
-    def _static_feasible_row(self, pod: api.Pod) -> np.ndarray:
+    def _encode_static(self, pod: api.Pod):
+        """Encode (under the caller-held lock) the single-pod snapshot the
+        static-feasibility kernels read; jnp.array forces a real copy
+        (device_put may zero-copy-alias on CPU) so later cache mutation
+        can't leak in."""
+        import jax.numpy as jnp
+
+        snap, _ = self.tpu.builder.build_from_state(self.tpu.state, [pod])
+        return jax.tree.map(jnp.array, snap)
+
+    def _static_row_from_snap(self, snap) -> np.ndarray:
         """bool[rows]: NodeName/taints/affinity/validity feasibility of the
         preemptor on every node (resources deliberately excluded — that is
-        what eviction frees)."""
+        what eviction frees).  Pure device dispatch — no lock needed."""
         from ..ops.filters import (
             pod_view,
             selector_match,
             static_feasible_for_pod,
         )
-        import jax.numpy as jnp
 
-        snap, meta = self.tpu.builder.build_from_state(self.tpu.state, [pod])
-        cluster = jax.tree.map(jnp.asarray, snap.cluster)
-        sel_mask = selector_match(cluster, snap.selectors)
-        pv = pod_view(jax.tree.map(jnp.asarray, snap.pods), 0)
-        feas = static_feasible_for_pod(cluster, pv, sel_mask)
+        sel_mask = selector_match(snap.cluster, snap.selectors)
+        pv = pod_view(snap.pods, 0)
+        feas = static_feasible_for_pod(snap.cluster, pv, sel_mask)
         return np.asarray(feas)

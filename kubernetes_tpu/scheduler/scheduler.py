@@ -91,6 +91,7 @@ class Scheduler:
                 self.queue.move_all_to_active_or_backoff("AssignedPodDelete")
             else:
                 self.queue.delete(pod)
+                self.cache.remove_nomination(pod)
             return
         if assigned:
             # bound (or our own bind echoing back): confirm in cache
@@ -128,7 +129,10 @@ class Scheduler:
         self._stop.set()
         self.queue.close()
         if self._thread:
-            self._thread.join(timeout=5)
+            # a device solve mid-compile can run tens of seconds; tearing
+            # the interpreter down under an XLA compile aborts the process,
+            # so wait the compile out
+            self._thread.join(timeout=120)
         self.informers.stop()
 
     def _run(self) -> None:
@@ -154,16 +158,21 @@ class Scheduler:
         # can't be encoded (cap overflow, unsupported field) must only
         # reject that pod, not kill the loop (the reference marks the one
         # pod unschedulable, handleSchedulingFailure).
+        reservations = self.cache.nominations_excluding(
+            {pod_key(info.pod) for info in batch}
+        )
         try:
             names = self.tpu.schedule_pending(
-                [info.pod for info in batch], lock=self.cache.lock
+                [info.pod for info in batch], lock=self.cache.lock,
+                reservations=reservations,
             )
         except (OverflowError, ValueError):
             batch = self._reject_unencodable(batch)
             if not batch:
                 return stats
             names = self.tpu.schedule_pending(
-                [info.pod for info in batch], lock=self.cache.lock
+                [info.pod for info in batch], lock=self.cache.lock,
+                reservations=reservations,
             )
         self.metrics.scheduling_algorithm_duration.observe(self._clock() - t0)
 

@@ -225,18 +225,11 @@ class Oracle:
                 continue
             if st.requested.get(k, 0) + v > st.allocatable.get(k, 0):
                 return False
-        if pod.spec.node_name and pod.spec.node_name != st.node.meta.name:
+        if not self._static_ok(pod, st):
             return False
-        for taint in st.node.effective_taints():
-            if taint.effect in (api.NO_SCHEDULE, api.NO_EXECUTE):
-                if not api.tolerations_tolerate_taint(pod.spec.tolerations, taint):
-                    return False
         for proto, _ip, port in pod.host_ports():
             if (proto, port) in st.used_ports:
                 return False
-        sel = pod.required_node_selector()
-        if sel is not None and not sel.matches(st.node.meta.labels):
-            return False
         if not self._spread_ok(pod, st, ctx):
             return False
         if not self._interpod_ok(pod, st, ctx):

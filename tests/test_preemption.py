@@ -99,7 +99,6 @@ def test_victim_choice_oracle_parity(rng):
     pure-Python policy mirror whenever the optimum is unique enough for
     both orderings to coincide (resource-only pods, unique priorities per
     node make it so)."""
-    mismatches = 0
     for trial in range(10):
         nodes, bound = _build_cluster(rng)
         preemptor = (
@@ -270,3 +269,36 @@ def test_preemption_not_triggered_when_feasible_elsewhere():
         assert sched.metrics.preemption_attempts.get("attempted") == 0
     finally:
         sched.stop()
+
+
+def test_nominated_reservation_blocks_stealers():
+    """A nominated pod's requests overlay its node for OTHER pods'
+    snapshots (PodNominator analogue): the freed space cannot be stolen
+    while the nominee waits to land."""
+    tpu = TPUBatchScheduler()
+    tpu.add_node(make_node("n0").capacity(cpu_milli=1000, pods=10).obj())
+    nominee = make_pod("hi").req(cpu_milli=1000).priority(100).obj()
+    stealer = make_pod("thief").req(cpu_milli=1000).priority(100).obj()
+    # without the reservation the stealer fits
+    assert tpu.schedule_pending([stealer]) == ["n0"]
+    # with the nominee's reservation it must not
+    assert tpu.schedule_pending(
+        [stealer], reservations=[("n0", nominee)]
+    ) == [None]
+    # the nominee's own batch excludes its reservation and lands
+    assert tpu.schedule_pending([nominee]) == ["n0"]
+
+
+def test_nomination_lifecycle_in_cache():
+    tpu = TPUBatchScheduler()
+    tpu.add_node(make_node("n0").capacity(cpu_milli=2000, pods=10).obj())
+    cache = SchedulerCache(tpu.state)
+    pod = make_pod("p").req(cpu_milli=500).priority(5).obj()
+    cache.nominate(pod, "n0")
+    assert cache.nominations_excluding(set()) == [("n0", pod)]
+    # the nominee's own batch is excluded
+    from kubernetes_tpu.scheduler.queue import pod_key
+    assert cache.nominations_excluding({pod_key(pod)}) == []
+    # assuming the pod (it landed) spends the nomination
+    cache.assume(pod, "n0")
+    assert cache.nominations_excluding(set()) == []
