@@ -84,7 +84,7 @@ def config1():
     from kubernetes_tpu.testing.oracle import Oracle
 
     nodes = _mk_nodes(500)
-    runner = _Runner(nodes, mode="greedy")
+    runner = _Runner(nodes, mode="auto")
     pods_fn = lambda tag: _mk_basic_pods(500, seed=1, prefix=f"c1-{tag}")
     names, placed, dt = runner.run(pods_fn)
     want = Oracle(nodes).schedule(pods_fn("run"))
@@ -97,7 +97,7 @@ def config1():
 
 def config2():
     nodes = _mk_nodes(5_000)
-    runner = _Runner(nodes, mode="greedy")
+    runner = _Runner(nodes, mode="auto")
     names, placed, dt = runner.run(
         lambda tag: _mk_basic_pods(5_000, seed=2, prefix=f"c2-{tag}")
     )
@@ -132,7 +132,7 @@ def config3():
             pods.append(pw.obj())
         return pods
 
-    runner = _Runner(nodes, mode="greedy")
+    runner = _Runner(nodes, mode="auto")
     names, placed, dt = runner.run(mk)
     return {
         "nodes": 10_000, "pods": 10_000, "placed": placed,
@@ -162,7 +162,7 @@ def config4():
             )
         return pods
 
-    runner = _Runner(nodes, mode="greedy")
+    runner = _Runner(nodes, mode="auto")
     names, placed, dt = runner.run(mk)
     return {
         "nodes": 20_000, "pods": 10_000, "placed": placed,
@@ -189,7 +189,7 @@ def config5():
             for i in range(10_000)
         ]
 
-    runner = _Runner(nodes, mode="auction")
+    runner = _Runner(nodes, mode="auto")
     names, placed, dt = runner.run(mk)
     return {
         "nodes": 50_000, "pods": 10_000, "placed": placed,

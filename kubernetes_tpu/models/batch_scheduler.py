@@ -90,15 +90,33 @@ class TPUBatchScheduler:
 
     # -- scheduling -------------------------------------------------------
 
+    # Batches at least this large route to the joint auction solve when
+    # its constraint coverage allows: the greedy scan's P sequential steps
+    # dominate solve latency there, while small batches keep the scan's
+    # exact one-at-a-time reference semantics.
+    AUCTION_MIN_PODS = 1024
+
     def _route(
-        self, snap: schema.Snapshot, features: assign_ops.FeatureFlags
+        self,
+        snap: schema.Snapshot,
+        features: assign_ops.FeatureFlags,
+        topo_split: Tuple[int, int],
+        n_groups: int,
     ) -> str:
         if self.mode != "auto":
             return self.mode
-        has_gangs = auction_ops.num_groups(snap) > 0
-        if has_gangs and auction_ops.auction_features_ok(features):
-            return "auction"
-        return "greedy"
+        if not auction_ops.auction_features_ok(features):
+            return "greedy"
+        if features.interpod:
+            # the repair's [P, T] / [Z, T] tables must stay on-chip —
+            # this guard binds even for gang batches (greedy keeps gang
+            # all-or-nothing via its own post-pass)
+            t_dim = snap.terms.valid.shape[0]
+            if t_dim * max(snap.pods.req.shape[0], topo_split[1]) > 2**25:
+                return "greedy"
+        has_gangs = n_groups > 0
+        big = snap.pods.req.shape[0] >= self.AUCTION_MIN_PODS
+        return "auction" if (has_gangs or big) else "greedy"
 
     def solve(
         self, snap: schema.Snapshot, topo_z: Optional[int] = None
@@ -121,17 +139,29 @@ class TPUBatchScheduler:
                 )
         return self._greedy(snap, topo_z, features)
 
-    def _dispatch(self, snap: schema.Snapshot) -> Result:
-        features = assign_ops.features_of(snap)
-        route = self._route(snap, features)
+    def _dispatch(
+        self, snap: schema.Snapshot, meta: Optional[schema.SnapshotMeta] = None
+    ) -> Result:
+        meta = meta or schema.SnapshotMeta(0, 0, [], [], self.builder.limits)
+        features = meta.features or assign_ops.features_of(snap)
+        topo_split = meta.topo_split or assign_ops.required_topo_z_split(snap)
+        n_groups = (
+            meta.n_groups
+            if meta.n_groups is not None
+            else schema.num_groups(snap)
+        )
+        route = self._route(snap, features, topo_split, n_groups)
         if route == "auction":
-            return self._auction(snap, features=features)
+            return self._auction(
+                snap, features=features, topo_z=topo_split,
+                n_groups=n_groups, tie_k=meta.tie_k,
+            )
         topo_z = (
-            assign_ops.required_topo_z(snap)
+            max(topo_split)
             if (features.spread or features.interpod)
             else 1
         )
-        return self._greedy(snap, topo_z, features)
+        return self._greedy(snap, topo_z, features, n_groups=n_groups)
 
     def encode_pending(
         self,
@@ -146,13 +176,16 @@ class TPUBatchScheduler:
         aliasing live arrays that informer threads mutate, and both sides
         intern into the shared vocabularies — the reference holds the cache
         mutex for UpdateSnapshot (cache.go:185) for the same reason.
-        The transfer MUST copy: build_from_state returns views aliasing the
-        live arrays, and on the CPU backend jax.device_put can zero-copy
-        alias a numpy buffer — a later cache mutation would then leak into
-        an already-"materialized" snapshot (observed: preemption's verify
-        restore undoing its own victim removal mid-solve).  jnp.array
-        guarantees a copy on every backend; on accelerators it is the same
-        host→device transfer device_put does.
+        The transfer MUST NOT alias live state: build_from_state returns
+        cluster tensors as views of the ClusterState arrays, and on the
+        CPU backend jax.device_put can zero-copy a numpy buffer — a later
+        cache mutation would then leak into an already-"materialized"
+        snapshot (observed: preemption's verify restore undoing its own
+        victim removal mid-solve).  The cluster leaves are host-copied
+        first (pod/constraint tables are freshly allocated every build,
+        so only the cluster aliases); device_put then transfers without
+        per-leaf device dispatches (jnp.array's convert path costs ~20ms
+        PER LEAF over the axon tunnel — 49 leaves ≈ 1s per encode).
 
         reservations: (node_name, pod) pairs whose requests overlay the
         named node's usage in THIS snapshot only — nominated preemptors
@@ -172,7 +205,16 @@ class TPUBatchScheduler:
                 rows.append(row)
                 reqs.append(req)
                 nzs.append(nz)
-            snap = jax.tree.map(jnp.array, snap)
+            # derive routing statics while the arrays are host-resident —
+            # probing them post-transfer costs one tunnel round-trip each
+            meta.features = assign_ops.features_of(snap)
+            meta.topo_split = assign_ops.required_topo_z_split(snap)
+            meta.n_groups = schema.num_groups(snap)
+            meta.tie_k = auction_ops.default_tie_k(snap)
+            snap = snap._replace(
+                cluster=jax.tree.map(np.array, snap.cluster)
+            )
+            snap = jax.device_put(snap)
         if rows:
             idx = jnp.asarray(np.array(rows, dtype=np.int32))
             cluster = snap.cluster._replace(
@@ -190,7 +232,7 @@ class TPUBatchScheduler:
         self, snap: schema.Snapshot, meta: schema.SnapshotMeta
     ) -> List[Optional[str]]:
         """Dispatch a prebuilt snapshot and decode node names."""
-        result = self._dispatch(snap)
+        result = self._dispatch(snap, meta)
         self.last_result = result
         idx = np.asarray(result.assignment)[: meta.num_pods]
         return [meta.node_name(int(i)) for i in idx]

@@ -69,6 +69,11 @@ class FeatureFlags(NamedTuple):
     ports: bool = False        # any pending pod claims host ports (the
                                # dynamic port-conflict carry; the static
                                # check against bound pods is always on)
+    interpod_aff: bool = False  # any AFFINITY-direction terms (the
+                               # co-location + first-pod-escape family;
+                               # the joint auction covers anti-affinity
+                               # only, so this gates its routing)
+    spread_slots: Tuple[int, ...] = ()  # topology-key slots spread rows use
 
 
 def required_topo_z(snapshot: Snapshot) -> int:
@@ -78,6 +83,28 @@ def required_topo_z(snapshot: Snapshot) -> int:
     from ..utils.vocab import pad_dim
 
     return pad_dim(int(np.asarray(snapshot.cluster.topo_ids).max()) + 1, 1)
+
+
+def required_topo_z_split(snapshot: Snapshot) -> Tuple[int, int]:
+    """(z_spread, z_terms): value capacities sized to the topology slots
+    each family actually uses.  Hostname ids scale with the cluster (50k
+    nodes → 50k values) while zone/region stay tiny; sizing each family's
+    value-space buffers to ITS slots keeps a zone-spread batch's scatters
+    at z≈64 instead of z≈cluster-size."""
+    from ..utils.vocab import pad_dim
+
+    topo = np.asarray(snapshot.cluster.topo_ids)
+
+    def z_for(slots) -> int:
+        if len(slots) == 0:
+            return 1
+        return pad_dim(int(topo[:, sorted(slots)].max()) + 1, 1)
+
+    spread_valid = np.asarray(snapshot.spread.valid)
+    spread_slots = set(np.asarray(snapshot.spread.slot)[spread_valid].tolist())
+    term_valid = np.asarray(snapshot.terms.valid)
+    term_slots = set(np.asarray(snapshot.terms.slot)[term_valid].tolist())
+    return z_for(spread_slots), z_for(term_slots)
 
 
 def features_of(snapshot: Snapshot) -> FeatureFlags:
@@ -92,6 +119,10 @@ def features_of(snapshot: Snapshot) -> FeatureFlags:
         interpod=bool(term_valid.any()),
         term_slots=tuple(sorted(set(slots[term_valid].tolist()))),
         ports=bool(np.asarray(snapshot.pods.port_bits).any()),
+        interpod_aff=bool((np.asarray(snapshot.terms.aff_idx) >= 0).any()),
+        spread_slots=tuple(
+            sorted(set(np.asarray(snapshot.spread.slot)[spread_valid].tolist()))
+        ),
     )
 
 
@@ -352,3 +383,55 @@ def greedy_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
         return run(snapshot, topo_z, features, n_groups)
 
     return call
+
+
+def evaluate_single(
+    snapshot: Snapshot,
+    cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,
+    topo_z: Optional[int] = None,
+    features: Optional[FeatureFlags] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(feasible[N], scores[N]) for pod 0 of the snapshot — the full
+    Filter + Score chain with no placement (what an extender's
+    filter/prioritize verbs need: the node SET, not one pick).
+
+    Same kernels the solvers use: static filters + resources + spread +
+    inter-pod affinity; scores are the weighted normalized sum
+    (runtime/framework.go RunScorePlugins semantics)."""
+    if features is None:
+        features = features_of(snapshot)
+    if topo_z is None:
+        topo_z = (
+            required_topo_z(snapshot)
+            if (features.spread or features.interpod)
+            else 1
+        )
+    cluster, pods, sel, pref, spread, terms = jax.tree.map(
+        jnp.asarray, tuple(snapshot)
+    )
+    from .interpod import interpod_filter, prep_terms
+    from .topology import prep_spread, spread_filter, spread_score
+
+    sel_mask = selector_match(cluster, sel)
+    pref_mask = preferred_match(cluster, pref)
+    pod = pod_view(pods, 0)
+    feas = static_feasible_for_pod(cluster, pod, sel_mask) & ~(
+        (cluster.port_bits & pod.port_bits[None, :]).any(axis=-1)
+    )
+    feas = feas & fits_resources(cluster, pod)
+    sp_score = None
+    if features.spread:
+        sp = prep_spread(cluster, sel_mask, spread, topo_z)
+        feas = feas & spread_filter(sp, spread, 0)
+        if features.soft_spread:
+            sp_score = spread_score(sp, spread, 0, feas)
+    if features.interpod:
+        tm = prep_terms(cluster, terms, topo_z, slots=features.term_slots)
+        feas = feas & interpod_filter(tm, terms, 0)
+    scores = score_from_raw(
+        cluster, pod, feas,
+        node_affinity_raw(pod, pref_mask),
+        taint_toleration_raw(cluster, pod),
+        cfg, spread_score=sp_score,
+    )
+    return feas, jnp.where(feas, scores, NEG_INF)

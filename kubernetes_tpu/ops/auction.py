@@ -33,16 +33,35 @@ plugin's Permit phase is the analogue).
 
 Constraint coverage: the static families + resources (NodeResourcesFit,
 NodeName, NodeUnschedulable, TaintToleration, NodeAffinity, NodePorts
-against bound pods).  Batches using topology spread, inter-pod affinity,
-or in-batch host-port claims must route to the greedy scan — those
-families couple concurrent placements, which is exactly what the
-reference serializes for; `auction_features_ok` is the routing predicate.
+against bound pods), PLUS the two coupled families the round structure
+can repair:
+
+  * PodTopologySpread (hard + soft): filtering/scoring reads the round's
+    counts; after acceptance a per-(constraint, topology value) prefix
+    cap releases over-admitted pods (rank r kept iff
+    count + r + 1 - globalMin <= maxSkew, the filtering.go:336 criterion
+    applied cumulatively), then counts commit from net accepts.
+  * InterPodAntiAffinity (required, both directions incl. existing-pods
+    anti-affinity): the filter handles bound state; within-round
+    conflicts (a carrier and a matcher of one term accepted into one
+    topology domain) release everything after the first accepted pod of
+    that (term, value) group.
+
+Affinity-direction terms (co-location + the first-pod escape) and
+in-batch host-port claims still route to the greedy scan
+(`auction_features_ok`): concurrent co-location bids can deadlock-split
+groups, which is exactly what the reference serializes for.
+
+Placements released by repair re-bid next round against updated counts;
+pods still unplaced at max_rounds return -1 and the host scheduler parks
+and retries them — system-level behaviour is unchanged, only the batch
+boundary moves.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple, Optional
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -53,11 +72,16 @@ from .assign import (
     FeatureFlags,
     class_statics,
     features_of,
+    required_topo_z_split,
     solve_order,
 )
 from .filters import fits_resources, pod_view, preferred_match, selector_match
+from .interpod import _idx_to_bits, _pack_bits_t, interpod_filter, prep_terms
 from .schema import ClusterTensors, Snapshot, num_groups
 from .scores import DEFAULT_SCORE_CONFIG, ScoreConfig, score_from_raw
+from .topology import prep_spread, spread_filter, spread_score
+
+_BIG_I = jnp.int32(2**30)
 
 
 class AuctionResult(NamedTuple):
@@ -70,7 +94,21 @@ class AuctionResult(NamedTuple):
 
 def auction_features_ok(features: FeatureFlags) -> bool:
     """True when the joint solve covers this batch's constraint families."""
-    return not (features.spread or features.interpod or features.ports)
+    return not (features.ports or features.interpod_aff)
+
+
+def default_tie_k(snapshot: Snapshot) -> int:
+    """Tie nodes enumerated per class per round: enough for the LARGEST
+    class to bid distinct nodes (a burst of identical pods would
+    otherwise cram onto tie_k nodes instead of spreading over the tie
+    set), power-of-two bucketed for jit-cache stability, bounded by the
+    node axis."""
+    from ..utils.vocab import pad_dim
+
+    cid = np.asarray(snapshot.pods.class_id)
+    live = cid[np.asarray(snapshot.pods.valid)]
+    biggest = int(np.bincount(live).max()) if live.size else 1
+    return min(pad_dim(max(biggest, 64), 1), snapshot.cluster.allocatable.shape[0])
 
 
 def auction_assign(
@@ -80,26 +118,38 @@ def auction_assign(
     tie_seed: int = 0,
     max_rounds: int = 64,
     features: Optional[FeatureFlags] = None,
+    topo_z: Optional[Tuple[int, int]] = None,
+    tie_k: int = 128,
 ) -> AuctionResult:
     """Jointly assign the pending batch: rounds of (parallel bid →
-    per-node prefix acceptance).  n_groups: gang-group count (static;
-    0 disables the gang post-pass).
+    per-node prefix acceptance → constraint repair).  n_groups:
+    gang-group count (static; 0 disables the gang post-pass).  topo_z:
+    (z_spread, z_terms) per-family padded value capacities (static;
+    auto-derived outside jit — required_topo_z_split).  tie_k (static):
+    tie nodes enumerated per class per round; classes with more active
+    pods than surviving tie nodes wrap and resolve through repair.
 
     Relative to greedy, concurrent bids don't see each other's score
     impact within a round — acceptance order still respects priority,
-    and capacity is never oversubscribed.  Where no two pods contend,
-    round-1 bids equal the greedy picks (same filter/score kernels).
+    capacity is never oversubscribed, and the spread / anti-affinity
+    repairs keep every committed placement constraint-valid.  Where no
+    two pods contend, round-1 bids equal the greedy picks (same
+    filter/score kernels).
     """
     if features is None:
         features = features_of(snapshot)
     if not auction_features_ok(features):
         raise ValueError(
-            "auction_assign covers static+resource families only; route "
-            f"batches with {features} through greedy_assign"
+            "auction_assign does not cover in-batch host ports or "
+            f"affinity-direction inter-pod terms; route batches with "
+            f"{features} through greedy_assign"
         )
-    cluster, pods, sel, pref = jax.tree.map(
-        jnp.asarray, (snapshot.cluster, snapshot.pods, snapshot.selectors,
-                      snapshot.preferred)
+    if topo_z is None:
+        topo_z = required_topo_z_split(snapshot)
+    z_spread, z_terms = topo_z
+    tie_k = min(tie_k, snapshot.cluster.allocatable.shape[0])
+    cluster, pods, sel, pref, spread, terms = jax.tree.map(
+        jnp.asarray, tuple(snapshot)
     )
     n = cluster.allocatable.shape[0]
     p = pods.req.shape[0]
@@ -109,12 +159,34 @@ def auction_assign(
     c_dim = sfeas_c.shape[0]
 
     order = solve_order(pods)
+    # solve_pos[i] = pod i's rank in solve order (repair keeps prefixes
+    # in this order, matching acceptance's priority discipline)
+    solve_pos = jnp.zeros(p, jnp.int32).at[order].set(
+        jnp.arange(p, dtype=jnp.int32)
+    )
+
+    sp0 = (
+        prep_spread(cluster, sel_mask, spread, z_spread)
+        if features.spread
+        else None
+    )
+    tm0 = (
+        prep_terms(cluster, terms, z_terms, slots=features.term_slots)
+        if features.interpod
+        else None
+    )
+    if features.interpod:
+        t_dim = terms.valid.shape[0]
+        # dense [P, T] involvement tables for the within-round repair
+        mi_dense = terms.matches_incoming & terms.valid[None, :]
+        anti_dense = _idx_to_bits(terms.anti_idx, t_dim) & terms.valid[None, :]
+        slot_of_t = terms.slot                                    # [T]
 
     seed_c = jnp.uint32(tie_seed * 2 + 1)
     reps = jnp.clip(pods.class_rep, 0, p - 1)
     arange_p = jnp.arange(p, dtype=jnp.int32)
 
-    def bids(requested, nonzero, assigned, rnd):
+    def bids(requested, nonzero, assigned, rnd, sp_counts, tm_bits):
         # Pods of one class (byte-identical spec incl. requests) see
         # identical filter masks and score rows against the current pool,
         # so filtering + scoring runs once per *class* — [C, N] with C
@@ -127,33 +199,57 @@ def auction_assign(
         # conflicts than independent sampling — and the whole per-pod
         # step is O(P) gathers.
         cl = cluster._replace(requested=requested, nonzero_requested=nonzero)
+        sp = sp0._replace(counts_node=sp_counts) if features.spread else None
+        tm = (
+            tm0._replace(
+                present_bits=tm_bits[0], blocked_bits=tm_bits[1],
+                global_any=tm_bits[2],
+            )
+            if features.interpod
+            else None
+        )
 
         def per_class(c, rep):
             pod = pod_view(pods, rep)
             feas = sfeas_c[c] & fits_resources(cl, pod)
-            scores = score_from_raw(cl, pod, feas, aff_c[c], taint_c[c], cfg)
+            if features.spread:
+                feas = feas & spread_filter(sp, spread, rep)
+            if features.interpod:
+                feas = feas & interpod_filter(tm, terms, rep)
+            sp_score = (
+                spread_score(sp, spread, rep, feas)
+                if features.soft_spread
+                else None
+            )
+            scores = score_from_raw(
+                cl, pod, feas, aff_c[c], taint_c[c], cfg, spread_score=sp_score
+            )
             masked = jnp.where(feas, scores, NEG_INF)
             best = jnp.max(masked)
             tie = jnp.asarray(feas & (masked == best))
-            # Tie nodes enumerated by cumsum-rank + inverse scatter (a
-            # full [N] sort would dominate the round at 50k nodes); the
-            # per-round hashed rotation randomizes which tie node the
-            # class's first pod lands on.
-            t = tie.astype(jnp.int32)
-            rank = jnp.cumsum(t) - t                       # exclusive rank
-            inv = jnp.full(n, n, jnp.int32).at[
-                jnp.where(tie, rank, n)
-            ].set(jnp.arange(n, dtype=jnp.int32), mode="drop")
+            # Tie nodes enumerated by top_k over a per-(class, round)
+            # hashed node ordering: one fused top_k per class instead of
+            # the earlier full-[N] inverse scatter (TPU scatters
+            # serialize; at hundreds of classes the scatter dominated the
+            # round).  The hash randomizes which tie nodes surface and
+            # rotates every round, so re-bidding classes diversify.
             rot = (
                 (c.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
                 ^ (rnd.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B))
                 ^ seed_c
             ) * jnp.uint32(0x27D4EB2F)
-            return inv, t.sum(), (rot >> 8).astype(jnp.int32), best
+            hkey = (
+                (jnp.arange(n, dtype=jnp.uint32) + jnp.uint32(1))
+                * jnp.uint32(0x9E3779B9)
+            ) ^ rot
+            key = jnp.where(tie, (hkey >> 2).astype(jnp.int32), -1)
+            _vals, topk_idx = jax.lax.top_k(key, tie_k)    # i32[K]
+            cnt = jnp.minimum(tie.sum(), tie_k).astype(jnp.int32)
+            return topk_idx, cnt, best
 
-        inv_c, cnt_c, rot_c, best_c = jax.vmap(per_class)(
+        inv_c, cnt_c, best_c = jax.vmap(per_class)(
             jnp.arange(c_dim, dtype=jnp.int32), reps
-        )  # i32[C, N], i32[C], i32[C], f32[C]
+        )  # i32[C, K], i32[C], f32[C]
 
         # Within-class position j of each active pod, in solve order (so
         # higher-priority pods take earlier tie slots).
@@ -168,14 +264,166 @@ def auction_assign(
         )
         cnt = cnt_c[cls]
         has = active & (best_c[cls] > NEG_INF) & (cnt > 0)
-        slot = (j + rot_c[cls]) % jnp.maximum(cnt, 1)
+        # the per-round rotation lives in the tie hash; j indexes the
+        # class's hash-ordered tie list directly
+        slot = j % jnp.maximum(cnt, 1)
         bid = jnp.where(has, inv_c[cls, slot], n).astype(jnp.int32)
         val = jnp.where(has, best_c[cls], NEG_INF)
         return bid, val
 
+    _BIGF = jnp.float32(1e9)
+
+    # how many admit passes one round's spread repair runs: each pass
+    # commits what fits under the current global minimum, then the next
+    # pass re-evaluates the remainder against the RAISED minimum — the
+    # sequential scan's continuously-rising min, approximated in k steps
+    SPREAD_REPAIR_ITERS = 3
+
+    def _spread_ranks(cand, nodes):
+        """rank[C, P]: among `cand` pods matching row c, this pod's
+        0-based position (solve order) within its (row, value) group.
+        One value-sort per spread SLOT + a segmented [C, P] cumsum
+        (per-row sorts serialize on TPU)."""
+        cmax = sp0.counts_node.shape[0]
+        vj_cp = sp0.v[:, nodes]                                  # [C, P]
+        act_cp = cand[None, :] & spread.pod_matches.T & (vj_cp >= 0)
+        rank_cp = jnp.zeros((cmax, p), jnp.int32)
+        for s in features.spread_slots:
+            v_p = cluster.topo_ids[nodes, s]                     # [P]
+            key = jnp.where(v_p >= 0, v_p, _BIG_I)
+            perm = order[jnp.argsort(key[order], stable=True)]
+            skey = key[perm]
+            firstv = jnp.searchsorted(skey, skey, side="left")   # [P]
+            rows_s = spread.slot == s                            # [C]
+            act_s = act_cp & rows_s[:, None]
+            srt = act_s[:, perm].astype(jnp.int32)               # [C, P]
+            exc = jnp.cumsum(srt, axis=1) - srt                  # exclusive
+            seg = exc - exc[:, firstv]                           # segmented
+            back = jnp.zeros((cmax, p), jnp.int32).at[:, perm].set(seg)
+            rank_cp = jnp.where(rows_s[:, None], back, rank_cp)
+        return rank_cp, vj_cp
+
+    def spread_repair(accept, nodes, sp_counts):
+        """Keep the subset of capacity-accepted pods whose placements
+        satisfy every hard constraint (rank r in its (row, value) group
+        kept iff count + r + 1 - min <= maxSkew — the filtering.go:336
+        criterion applied to the round's concurrent admits).  Runs
+        SPREAD_REPAIR_ITERS admit passes, committing each pass's admits
+        into a working copy of the counts so the global minimum rises
+        WITHIN the round — without this, a round can only advance each
+        constraint by maxSkew per topology value."""
+        cmax = sp0.counts_node.shape[0]
+        md = spread.min_domains
+        kept = jnp.zeros(p, bool)
+        counts_it = sp_counts
+        for _ in range(SPREAD_REPAIR_ITERS):
+            cand = accept & ~kept
+            min_c = jnp.min(
+                jnp.where(sp0.eligible, counts_it, _BIGF), axis=-1
+            )
+            min_c = jnp.where(min_c >= _BIGF, 0.0, min_c)
+            min_c = jnp.where((md > 0) & (sp0.sizes < md), 0.0, min_c)
+            rank_cp, vj_cp = _spread_ranks(cand, nodes)
+            admit = cand
+            for j in range(spread.pod_idx.shape[1]):
+                cidx = spread.pod_idx[:, j]
+                c = jnp.clip(cidx, 0, cmax - 1)
+                vj = vj_cp[c, arange_p]
+                own = cand & (cidx >= 0) & spread.hard[c] & (vj >= 0)
+                cnt = counts_it[c, nodes]
+                # sequential criterion: count + rank + selfMatch - min <=
+                # maxSkew.  A carrier whose own labels don't match its
+                # constraint's selector (selfMatch=0, legal in k8s) gets
+                # one extra admit slot — releasing it at the boundary
+                # would park a pod the filter just passed, forever.
+                self_m = spread.pod_matches[arange_p, c].astype(jnp.float32)
+                allowed = (
+                    spread.max_skew[c] + min_c[c] - cnt + (1.0 - self_m)
+                )
+                rank = rank_cp[c, arange_p].astype(jnp.float32)
+                admit = admit & ~(own & (rank >= allowed))
+            kept = kept | admit
+            counts_it = commit_spread(admit, nodes, counts_it)
+        return kept
+
+    def interpod_repair(accept, nodes):
+        """Release within-round anti-affinity conflicts: in each (term,
+        topology value) group containing an accepted CARRIER of the term,
+        only the first accepted involved pod (solve order) survives."""
+        release = jnp.zeros(p, bool)
+        slots_used = features.term_slots or tuple(
+            range(cluster.topo_ids.shape[1])
+        )
+        for s in slots_used:
+            v_p = cluster.topo_ids[nodes, s]                     # [P]
+            rel_t = slot_of_t == s                               # [T]
+            inv = (mi_dense | anti_dense) & rel_t[None, :]       # [P, T]
+            involved = inv & accept[:, None] & (v_p >= 0)[:, None]
+            flat = (
+                jnp.clip(v_p, 0, z_terms - 1)[:, None] * t_dim
+                + jnp.arange(t_dim)[None, :]
+            )                                                    # [P, T]
+            pos = jnp.where(involved, solve_pos[:, None], _BIG_I)
+            minpos = jnp.full(z_terms * t_dim, _BIG_I, jnp.int32).at[
+                flat.reshape(-1)
+            ].min(pos.reshape(-1))
+            carrier = involved & anti_dense
+            c_any = jnp.zeros(z_terms * t_dim, bool).at[
+                flat.reshape(-1)
+            ].max(carrier.reshape(-1))
+            viol = involved & c_any[flat] & (solve_pos[:, None] > minpos[flat])
+            release = release | viol.any(axis=1)
+        return accept & ~release
+
+    def commit_spread(accept, nodes, sp_counts):
+        """Fold net accepts into the node-space counts (the batched
+        spread_update): every row a placed pod matches gains one on every
+        node sharing the placement's topology value."""
+        cmax = sp0.counts_node.shape[0]
+        vj_cp = sp0.v[:, nodes]                                  # [C, P]
+        elig_cp = sp0.eligible[:, nodes]
+        act = (
+            accept[None, :] & spread.pod_matches.T & elig_cp & (vj_cp >= 0)
+        )
+        adds = jnp.zeros((cmax, z_spread), jnp.float32).at[
+            jnp.arange(cmax)[:, None], jnp.clip(vj_cp, 0, z_spread - 1)
+        ].add(act.astype(jnp.float32))
+        vc = jnp.clip(sp0.v, 0, z_spread - 1)
+        delta = jnp.take_along_axis(adds, vc, axis=-1)
+        return sp_counts + jnp.where(sp0.v >= 0, delta, 0.0)
+
+    def commit_terms(accept, nodes, present, blocked, global_any):
+        """Batched interpod_update: matched terms turn present (and
+        global) in each placement's topology; carried anti terms turn
+        blocked there.  Scatter in value space as bools, then map back to
+        nodes and pack."""
+        slots_used = features.term_slots or tuple(
+            range(cluster.topo_ids.shape[1])
+        )
+        for s in slots_used:
+            v_p = cluster.topo_ids[nodes, s]                     # [P]
+            rel_t = slot_of_t == s
+            ok_p = accept & (v_p >= 0)
+            vcp = jnp.clip(v_p, 0, z_terms - 1)
+            mi_s = mi_dense & rel_t[None, :] & ok_p[:, None]     # [P, T]
+            an_s = anti_dense & rel_t[None, :] & ok_p[:, None]
+            z_mi = jnp.zeros((z_terms, t_dim), bool).at[vcp].max(mi_s)
+            z_an = jnp.zeros((z_terms, t_dim), bool).at[vcp].max(an_s)
+            v_n = cluster.topo_ids[:, s]                         # [N]
+            vn = jnp.clip(v_n, 0, z_terms - 1)
+            has = (v_n >= 0)[:, None]
+            present = present | _pack_bits_t(z_mi[vn] & has)
+            blocked = blocked | _pack_bits_t(z_an[vn] & has)
+            global_any = global_any | _pack_bits_t(z_mi.any(axis=0))
+        return present, blocked, global_any
+
     def body(state):
-        assigned, bid_scores, requested, nonzero, rnd, _progress = state
-        bid, val = bids(requested, nonzero, assigned, rnd)
+        (assigned, bid_scores, requested, nonzero, rnd, _progress,
+         sp_counts, tm_present, tm_blocked, tm_global) = state
+        bid, val = bids(
+            requested, nonzero, assigned, rnd, sp_counts,
+            (tm_present, tm_blocked, tm_global),
+        )
 
         # Per-node prefix acceptance in solve order: pre-permute pods into
         # solve order, then a *stable* sort by bid keeps that order within
@@ -189,20 +437,41 @@ def auction_assign(
         remaining = (cluster.allocatable - requested)[jnp.clip(sbid, 0, n - 1)]
         ok = ((sreq <= 0) | (within <= remaining)).all(axis=-1) & (sbid < n)
         accept = jnp.zeros(p, bool).at[perm].set(ok)
-
         nodes = jnp.clip(bid, 0, n - 1)
+
+        # constraint repair: releases only shrink the accept set, so
+        # capacity stays safe; released pods re-bid next round
+        pre_repair = accept
+        if features.spread:
+            accept = spread_repair(accept, nodes, sp_counts)
+        if features.interpod:
+            accept = interpod_repair(accept, nodes)
+        # a round that only RELEASES still progresses: the released pods
+        # re-bid under the next round's rotation and updated counts (the
+        # filter now excludes the domains that capped them); max_rounds
+        # bounds the loop regardless
+        progress = accept.any() | (pre_repair & ~accept).any()
+
         w = accept[:, None].astype(jnp.float32)
         requested = requested.at[nodes].add(pods.req * w)
         nonzero = nonzero.at[nodes].add(pods.nonzero_req * w)
+        if features.spread:
+            sp_counts = commit_spread(accept, nodes, sp_counts)
+        if features.interpod:
+            tm_present, tm_blocked, tm_global = commit_terms(
+                accept, nodes, tm_present, tm_blocked, tm_global
+            )
         assigned = jnp.where(accept, bid, assigned)
         bid_scores = jnp.where(accept, val, bid_scores)
-        return (assigned, bid_scores, requested, nonzero, rnd + 1, accept.any())
+        return (assigned, bid_scores, requested, nonzero, rnd + 1,
+                progress, sp_counts, tm_present, tm_blocked, tm_global)
 
     def cond(state):
-        assigned, _scores, _req, _nz, rnd, progress = state
+        assigned, _s, _r, _n, rnd, progress = state[:6]
         unplaced = ((assigned < 0) & pods.valid).any()
         return (rnd < max_rounds) & progress & unplaced
 
+    zero = jnp.zeros(())
     init = (
         jnp.full(p, -1, jnp.int32),
         jnp.full(p, NEG_INF),
@@ -210,9 +479,13 @@ def auction_assign(
         cluster.nonzero_requested,
         jnp.int32(0),
         jnp.bool_(True),
+        sp0.counts_node if features.spread else zero,
+        tm0.present_bits if features.interpod else zero,
+        tm0.blocked_bits if features.interpod else zero,
+        tm0.global_any if features.interpod else zero,
     )
-    assigned, bid_scores, requested, nonzero, rounds, _ = jax.lax.while_loop(
-        cond, body, init
+    (assigned, bid_scores, requested, nonzero, rounds, _, *_rest) = (
+        jax.lax.while_loop(cond, body, init)
     )
 
     # Gang post-pass: all-or-nothing groups.
@@ -243,24 +516,37 @@ def auction_assign_jit(
     tie_seed: int = 0,
     max_rounds: int = 64,
 ):
-    """Jitted closure; n_groups/features static per executable."""
+    """Jitted closure; n_groups/features/topo_z static per executable."""
 
-    @partial(jax.jit, static_argnums=(1, 2))
-    def run(snapshot: Snapshot, n_groups: int, features: FeatureFlags):
+    @partial(jax.jit, static_argnums=(1, 2, 3, 4))
+    def run(
+        snapshot: Snapshot,
+        n_groups: int,
+        features: FeatureFlags,
+        topo_z: Tuple[int, int],
+        tie_k: int,
+    ):
         return auction_assign(
             snapshot, cfg, n_groups=n_groups, tie_seed=tie_seed,
-            max_rounds=max_rounds, features=features,
+            max_rounds=max_rounds, features=features, topo_z=topo_z,
+            tie_k=tie_k,
         )
 
     def call(
         snapshot: Snapshot,
         n_groups: Optional[int] = None,
         features: Optional[FeatureFlags] = None,
+        topo_z: Optional[Tuple[int, int]] = None,
+        tie_k: Optional[int] = None,
     ) -> AuctionResult:
         if features is None:
             features = features_of(snapshot)
         if n_groups is None:
             n_groups = num_groups(snapshot)
-        return run(snapshot, n_groups, features)
+        if topo_z is None:
+            topo_z = required_topo_z_split(snapshot)
+        if tie_k is None:
+            tie_k = default_tie_k(snapshot)
+        return run(snapshot, n_groups, features, topo_z, tie_k)
 
     return call

@@ -228,7 +228,10 @@ class SnapshotLimits:
 
 @dataclass
 class SnapshotMeta:
-    """Host-side sidecar of a Snapshot: real counts and decode tables."""
+    """Host-side sidecar of a Snapshot: real counts and decode tables,
+    plus the routing statics the dispatcher needs (derived from the HOST
+    arrays at encode time — probing a device-resident snapshot costs one
+    tunnel round-trip per array)."""
 
     num_nodes: int
     num_pods: int
@@ -236,6 +239,12 @@ class SnapshotMeta:
     resource_names: List[str]
     limits: SnapshotLimits
     topo_z: int = 1  # padded max topology-value vocab size (the Z axis)
+    # routing statics (filled by TPUBatchScheduler.encode_pending; None
+    # means "recompute from the snapshot")
+    features: Optional[object] = None      # assign.FeatureFlags
+    topo_split: Optional[tuple] = None     # (z_spread, z_terms)
+    n_groups: Optional[int] = None
+    tie_k: Optional[int] = None
 
     def node_name(self, idx: int) -> Optional[str]:
         if 0 <= idx < self.num_nodes:
@@ -471,6 +480,7 @@ class SnapshotBuilder:
         spread, terms = self._build_constraints(
             pending_pods, bound_by_node, sel_index, n, p_dim
         )
+        pods = _refine_classes(pods, spread, terms)
         meta = SnapshotMeta(
             num_nodes=len(nodes),
             num_pods=len(pending_pods),
@@ -512,6 +522,7 @@ class SnapshotBuilder:
         spread, terms = self._build_constraints(
             pending_pods, state.bound_pods(), sel_index, n, p_dim
         )
+        pods = _refine_classes(pods, spread, terms)
         meta = SnapshotMeta(
             num_nodes=state._high,
             num_pods=len(pending_pods),
@@ -666,6 +677,35 @@ class SnapshotBuilder:
         pref_rows: List[Tuple[np.ndarray, np.ndarray]] = []
         pref_index: Dict[tuple, int] = {}
 
+        # Spec-row cache: real batches repeat a few hundred distinct specs
+        # across tens of thousands of pods (every replica of a workload is
+        # byte-identical up to its name), so the heavy per-pod encode —
+        # resource vectors, toleration bitsets, selector/preferred
+        # interning — runs once per distinct spec and every repeat is one
+        # dict hit + row copy.  The key walks exactly the fields the rows
+        # are derived from.
+        spec_cache: Dict[tuple, tuple] = {}
+
+        def spec_key(pod: api.Pod) -> tuple:
+            spec = pod.spec
+            aff = spec.affinity
+            na = aff.node_affinity if aff else None
+            return (
+                tuple(sorted(pod.resource_requests().items())),
+                tuple(pod.nonzero_requests()),
+                spec.node_name,
+                tuple(sorted(spec.node_selector.items())),
+                tuple(
+                    (t.key, t.op, t.value, t.effect) for t in spec.tolerations
+                ),
+                tuple(sorted(pod.host_ports())),
+                _selector_signature(na.required) if na and na.required else None,
+                tuple(
+                    (pt.weight, _term_signature(pt.preference))
+                    for pt in (na.preferred if na else ())
+                ),
+            )
+
         for i, pod in enumerate(pods):
             valid[i] = True
             priority[i] = float(pod.spec.priority)
@@ -673,6 +713,13 @@ class SnapshotBuilder:
                 group_id[i] = group_index.setdefault(
                     pod.spec.scheduling_group, len(group_index)
                 )
+            key = spec_key(pod)
+            cached = spec_cache.get(key)
+            if cached is not None:
+                (req[i], nonzero[i], name_id[i], sel_idx[i],
+                 tol_bits[:, i, :], tol_all[:, i], port_bits[i],
+                 pref_idx[i], pref_weight[i]) = cached
+                continue
             rv = self._resource_vector(pod.resource_requests(), r, grow=False)
             rv[RESOURCE_PODS] = 1.0
             req[i] = rv
@@ -717,6 +764,11 @@ class SnapshotBuilder:
                     )
                 pref_idx[i, j] = idx
                 pref_weight[i, j] = float(pt.weight)
+            spec_cache[key] = (
+                req[i].copy(), nonzero[i].copy(), name_id[i], sel_idx[i],
+                tol_bits[:, i, :].copy(), tol_all[:, i].copy(),
+                port_bits[i].copy(), pref_idx[i].copy(), pref_weight[i].copy(),
+            )
 
         s_dim = vb.pad_dim(len(sel_rows), 1)
         sel = SelectorTable(
@@ -786,6 +838,47 @@ class SnapshotBuilder:
         lim = self.limits
         tk = len(lim.topology_keys)
         mc, ma = lim.max_spread_per_pod, lim.max_pod_terms
+
+        # Distinct (namespace, labels) signatures across bound + pending
+        # pods.  Constraint rows match against SIGNATURES (a few hundred)
+        # instead of pods (tens of thousands): real clusters have far
+        # fewer label shapes than pods, and the naive rows x pods Python
+        # loop was the encode bottleneck at 10k-pod batches (2M+
+        # LabelSelector.matches calls per batch).
+        sig_of: Dict[tuple, int] = {}
+        distinct_sigs: List[Tuple[str, Dict[str, str]]] = []
+
+        def sig_id(pod: api.Pod) -> int:
+            key = (pod.meta.namespace, tuple(sorted(pod.meta.labels.items())))
+            idx = sig_of.get(key)
+            if idx is None:
+                idx = len(distinct_sigs)
+                sig_of[key] = idx
+                distinct_sigs.append((pod.meta.namespace, pod.meta.labels))
+            return idx
+
+        bound_sig = np.fromiter(
+            (sig_id(q) for q, _ in bound_by_node), np.int32, len(bound_by_node)
+        )
+        bound_node = np.fromiter(
+            (ni for _, ni in bound_by_node), np.int32, len(bound_by_node)
+        )
+        pend_sig = np.fromiter((sig_id(q) for q in pods), np.int32, len(pods))
+
+        def match_sigs(sel: api.LabelSelector, namespaces) -> np.ndarray:
+            """bool[n_sigs]: which distinct signatures the row matches.
+            `namespaces` is a container or a single owner namespace."""
+            ns_set = (
+                namespaces if isinstance(namespaces, tuple) else (namespaces,)
+            )
+            return np.fromiter(
+                (
+                    ns in ns_set and sel.matches(labels)
+                    for ns, labels in distinct_sigs
+                ),
+                bool,
+                len(distinct_sigs),
+            )
 
         # ---- topology spread constraints --------------------------------
         # A constraint instance is owner-scoped: eligibility honours the
@@ -857,13 +950,12 @@ class SnapshotBuilder:
             spread.owner_sel_idx[ci] = owner_sel_row
             for k in keys:
                 spread.owner_keys[ci, self._topo_slot(k)] = True
-            for q, ni in bound_by_node:
-                if q.meta.namespace == owner_ns and sel.matches(q.meta.labels):
-                    spread.node_matches[ci, ni] += 1.0
-            for i, pod in enumerate(pods):
-                spread.pod_matches[i, ci] = (
-                    pod.meta.namespace == owner_ns and sel.matches(pod.meta.labels)
-                )
+            match = match_sigs(sel, owner_ns)
+            if len(bound_sig):
+                m = match[bound_sig]
+                np.add.at(spread.node_matches[ci], bound_node[m], 1.0)
+            if len(pend_sig):
+                spread.pod_matches[: len(pods), ci] = match[pend_sig]
 
         # ---- inter-pod (anti-)affinity terms ----------------------------
         # A row is (topology_key slot, effective selector, namespaces);
@@ -935,19 +1027,21 @@ class SnapshotBuilder:
             self_match_all=np.zeros(p_dim, dtype=bool),
         )
 
-        def row_matches(sel: api.LabelSelector, namespaces, pod: api.Pod) -> bool:
-            return pod.meta.namespace in namespaces and sel.matches(pod.meta.labels)
-
         for ti, (topo_key, sel, namespaces) in enumerate(term_rows):
             terms.valid[ti] = True
             terms.slot[ti] = self._topo_slot(topo_key)
-            for q, ni in bound_by_node:
-                if row_matches(sel, namespaces, q):
-                    terms.node_matches[ti, ni] += 1.0
-            for i, pod in enumerate(pods):
-                terms.matches_incoming[i, ti] = row_matches(sel, namespaces, pod)
+            match = match_sigs(sel, namespaces)
+            if len(bound_sig):
+                m = match[bound_sig]
+                np.add.at(terms.node_matches[ti], bound_node[m], 1.0)
+            if len(pend_sig):
+                terms.matches_incoming[: len(pods), ti] = match[pend_sig]
         for ti, ni in bound_anti:
             terms.node_owners[ti, ni] += 1.0
+
+        def row_matches(sel: api.LabelSelector, namespaces, pod: api.Pod) -> bool:
+            return pod.meta.namespace in namespaces and sel.matches(pod.meta.labels)
+
         for i, pod in enumerate(pods):
             aff_terms, _ = pod_terms(pod)
             terms.self_match_all[i] = bool(aff_terms) and all(
@@ -1219,6 +1313,50 @@ class ClusterState:
             port_bits=self.port_bits[:n],
             topo_ids=self.topo_ids[:n],
         )
+
+
+def _refine_classes(pods: PodBatch, spread: SpreadTable, terms: TermTable) -> PodBatch:
+    """Split spec-equivalence classes by constraint identity.
+
+    _pod_classes groups on the static Filter/Score inputs only — enough
+    for the greedy scan, which evaluates spread/inter-pod per POD index.
+    The joint auction evaluates those families per CLASS representative,
+    so two pods with identical static state but different constraints
+    (e.g. two services' pods with self-anti-affinity) must not share a
+    class; the signature here adds each pod's spread rows + match flags
+    and (anti-)affinity term memberships."""
+    if not (spread.valid.any() or terms.valid.any()):
+        return pods
+    p = pods.class_id.shape[0]
+    sig = np.concatenate(
+        [
+            pods.class_id.view(np.uint32)[:, None],
+            spread.pod_idx.view(np.uint32),
+            spread.pod_matches.astype(np.uint8).view(np.uint8).reshape(p, -1).astype(np.uint32),
+            terms.aff_idx.view(np.uint32),
+            terms.anti_idx.view(np.uint32),
+            terms.matches_incoming.astype(np.uint32),
+            terms.self_match_all.astype(np.uint32)[:, None],
+        ],
+        axis=1,
+    )
+    sig = np.ascontiguousarray(sig)
+    row_bytes = sig.view(np.uint8).reshape(p, -1)
+    index: Dict[bytes, int] = {}
+    class_id = np.empty(p, dtype=np.int32)
+    reps: List[int] = []
+    for i in range(p):
+        key = row_bytes[i].tobytes()
+        c = index.get(key)
+        if c is None:
+            c = len(reps)
+            index[key] = c
+            reps.append(i)
+        class_id[i] = c
+    c_dim = vb.pad_dim(len(reps), 1)
+    class_rep = np.full(c_dim, -1, dtype=np.int32)
+    class_rep[: len(reps)] = reps
+    return pods._replace(class_id=class_id, class_rep=class_rep)
 
 
 def _pod_classes(

@@ -263,13 +263,12 @@ class PreemptionEvaluator:
 
     def _encode_static(self, pod: api.Pod):
         """Encode (under the caller-held lock) the single-pod snapshot the
-        static-feasibility kernels read; jnp.array forces a real copy
-        (device_put may zero-copy-alias on CPU) so later cache mutation
-        can't leak in."""
-        import jax.numpy as jnp
-
+        static-feasibility kernels read; the aliasing cluster leaves are
+        host-copied before device_put (which may zero-copy on CPU) so
+        later cache mutation can't leak in."""
         snap, _ = self.tpu.builder.build_from_state(self.tpu.state, [pod])
-        return jax.tree.map(jnp.array, snap)
+        snap = snap._replace(cluster=jax.tree.map(np.array, snap.cluster))
+        return jax.device_put(snap)
 
     def _static_row_from_snap(self, snap) -> np.ndarray:
         """bool[rows]: NodeName/taints/affinity/validity feasibility of the

@@ -100,11 +100,13 @@ def test_priority_wins_contended_slot():
 
 
 def test_routes_unsupported_families_to_greedy():
+    """Affinity-DIRECTION inter-pod terms (co-location) stay greedy-only;
+    spread and anti-affinity are auction-covered since round 3."""
     nodes = [make_node("n0").capacity(cpu_milli=8000, mem=8 * GI).zone("z").obj()]
     pods = [
         make_pod("p0")
         .label("app", "x")
-        .spread(1, api.LABEL_ZONE, "DoNotSchedule", {"app": "x"})
+        .pod_affinity({"app": "x"}, api.LABEL_ZONE)
         .obj()
     ]
     snap, _ = schema.SnapshotBuilder().build(nodes, pods)
