@@ -1,0 +1,12 @@
+"""Scheduler-extender endpoint: the out-of-tree integration contract
+(kube-scheduler extender v1 wire protocol backed by the TPU solver)."""
+
+from .server import ExtenderBackend, ExtenderServer
+from .types import ExtenderArgs, MAX_EXTENDER_PRIORITY
+
+__all__ = [
+    "ExtenderArgs",
+    "ExtenderBackend",
+    "ExtenderServer",
+    "MAX_EXTENDER_PRIORITY",
+]

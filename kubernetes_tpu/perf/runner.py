@@ -20,7 +20,7 @@ from typing import Any, Dict, List, Optional
 from ..api import store as st
 from ..api import types as api
 from ..scheduler import Scheduler
-from . import kubeyaml
+from ..api import kubeyaml
 from .collectors import DataItem, MetricsCollector, ThroughputCollector
 from .workload import Op, Workload
 

@@ -1,0 +1,169 @@
+"""Extender endpoint: wire-type conformance against recorded fixtures
+(the JSON a stock kube-scheduler's HTTPExtender sends/expects —
+extender.go:397 send(), extender/v1/types.go:73-132) and verb behaviour
+over live HTTP."""
+
+import json
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api import store as st
+from kubernetes_tpu.extender import ExtenderBackend, ExtenderServer
+from kubernetes_tpu.extender.types import ExtenderArgs
+from kubernetes_tpu.testing.wrappers import GI, MI, make_node, make_pod
+
+# The JSON document shape kube-scheduler POSTs in nodeCacheCapable mode
+# (field names = Go struct field names; no json tags in types.go).
+FILTER_REQUEST_FIXTURE = {
+    "Pod": {
+        "metadata": {"name": "p1", "namespace": "default", "labels": {"app": "web"}},
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "resources": {"requests": {"cpu": "500m", "memory": "512Mi"}},
+                }
+            ]
+        },
+    },
+    "Nodes": None,
+    "NodeNames": ["n0", "n1", "tiny"],
+}
+
+
+def _backend():
+    be = ExtenderBackend()
+    be.add_node(make_node("n0").capacity(cpu_milli=4000, mem=8 * GI, pods=10).obj())
+    be.add_node(make_node("n1").capacity(cpu_milli=4000, mem=8 * GI, pods=10).obj())
+    be.add_node(make_node("tiny").capacity(cpu_milli=100, mem=128 * MI, pods=10).obj())
+    return be
+
+
+def test_filter_result_wire_shape():
+    be = _backend()
+    res = be.filter(ExtenderArgs.from_dict(FILTER_REQUEST_FIXTURE))
+    # exact ExtenderFilterResult keys (types.go:88-104)
+    assert set(res.keys()) == {
+        "Nodes", "NodeNames", "FailedNodes",
+        "FailedAndUnresolvableNodes", "Error",
+    }
+    assert sorted(res["NodeNames"]) == ["n0", "n1"]
+    assert "tiny" in res["FailedNodes"]
+    assert res["Error"] == ""
+    json.dumps(res)  # serializable
+
+
+def test_prioritize_wire_shape():
+    be = _backend()
+    out = be.prioritize(ExtenderArgs.from_dict(FILTER_REQUEST_FIXTURE))
+    assert isinstance(out, list)
+    for item in out:
+        assert set(item.keys()) == {"Host", "Score"}
+        assert 0 <= item["Score"] <= 10  # MaxExtenderPriority
+    by_host = {i["Host"]: i["Score"] for i in out}
+    assert by_host["tiny"] == 0
+    assert max(by_host.values()) == 10
+
+
+def test_filter_non_cache_mode_ships_nodes():
+    """Nodes arrive as full v1.Node objects; the extender upserts and
+    evaluates without any pre-fed inventory."""
+    be = ExtenderBackend()
+    req = {
+        "Pod": FILTER_REQUEST_FIXTURE["Pod"],
+        "Nodes": {
+            "items": [
+                {
+                    "metadata": {"name": "fresh"},
+                    "status": {"capacity": {"cpu": "4", "memory": "8Gi", "pods": "10"}},
+                }
+            ]
+        },
+        "NodeNames": None,
+    }
+    res = be.filter(ExtenderArgs.from_dict(req))
+    assert res["NodeNames"] == ["fresh"]
+
+
+def test_filter_respects_taints_and_affinity():
+    be = ExtenderBackend()
+    be.add_node(
+        make_node("tainted")
+        .capacity(cpu_milli=4000, mem=8 * GI, pods=10)
+        .taint("dedicated", "gpu")
+        .obj()
+    )
+    be.add_node(make_node("plain").capacity(cpu_milli=4000, mem=8 * GI, pods=10).obj())
+    req = dict(FILTER_REQUEST_FIXTURE, NodeNames=["tainted", "plain"])
+    res = be.filter(ExtenderArgs.from_dict(req))
+    assert res["NodeNames"] == ["plain"]
+
+
+def test_bind_through_store():
+    store = st.Store()
+    store.create(make_pod("p1").req(cpu_milli=100).obj())
+    be = _backend()
+    be.store = store
+    res = be.bind(
+        {"PodName": "p1", "PodNamespace": "default", "PodUID": "u", "Node": "n0"}
+    )
+    assert res == {"Error": ""}
+    assert store.get("Pod", "p1").spec.node_name == "n0"
+
+
+def test_preemption_passthrough():
+    be = _backend()
+    victims = {"n0": {"Pods": [{"UID": "u1"}], "NumPDBViolations": 0}}
+    res = be.preemption({"NodeNameToMetaVictims": victims})
+    assert res == {"NodeNameToMetaVictims": victims}
+
+
+def test_http_server_end_to_end():
+    be = _backend()
+    srv = ExtenderServer(be).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(url + "/healthz") as r:
+            assert json.load(r) == {"ok": True}
+        req = urllib.request.Request(
+            url + "/filter",
+            data=json.dumps(FILTER_REQUEST_FIXTURE).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            res = json.load(r)
+        assert sorted(res["NodeNames"]) == ["n0", "n1"]
+        req = urllib.request.Request(
+            url + "/prioritize",
+            data=json.dumps(FILTER_REQUEST_FIXTURE).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            scores = json.load(r)
+        assert {i["Host"] for i in scores} == {"n0", "n1", "tiny"}
+    finally:
+        srv.stop()
+
+
+def test_sync_store_accounts_bound_pods():
+    store = st.Store()
+    store.create(make_node("n0").capacity(cpu_milli=1000, mem=8 * GI, pods=10).obj())
+    bound = make_pod("existing").req(cpu_milli=900).node_name("n0").obj()
+    store.create(bound)
+    be = ExtenderBackend()
+    be.sync_store(store)
+    req = {
+        "Pod": {
+            "metadata": {"name": "big"},
+            "spec": {
+                "containers": [
+                    {"resources": {"requests": {"cpu": "500m"}}}
+                ]
+            },
+        },
+        "Nodes": None,
+        "NodeNames": ["n0"],
+    }
+    res = be.filter(ExtenderArgs.from_dict(req))
+    assert res["NodeNames"] == []  # 900m bound + 500m pending > 1000m

@@ -18,7 +18,7 @@ from kubernetes_tpu.perf import (
     run_workloads,
     select,
 )
-from kubernetes_tpu.perf.kubeyaml import node_from_dict, parse_quantity, pod_from_dict
+from kubernetes_tpu.api.kubeyaml import node_from_dict, parse_quantity, pod_from_dict
 from kubernetes_tpu.perf.runner import _substitute_index
 
 
