@@ -466,6 +466,103 @@ class Node:
         return taints
 
 
+# ---------------------------------------------------------------------------
+# Workload APIs (reference: staging/src/k8s.io/api/apps/v1/types.go
+# ReplicaSet/Deployment, batch/v1/types.go Job) — the slice the workload
+# controllers reconcile.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PodTemplateSpec:
+    """v1.PodTemplateSpec: metadata (labels) + spec stamped onto pods."""
+
+    meta: ObjectMeta = field(default_factory=lambda: ObjectMeta(name=""))
+    spec: PodSpec = field(default_factory=PodSpec)
+
+
+@dataclass
+class ReplicaSetSpec:
+    replicas: int = 1
+    selector: LabelSelector = field(default_factory=LabelSelector)
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class ReplicaSetStatus:
+    replicas: int = 0
+    ready_replicas: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class ReplicaSet:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ReplicaSetSpec = field(default_factory=ReplicaSetSpec)
+    status: ReplicaSetStatus = field(default_factory=ReplicaSetStatus)
+
+    KIND = "ReplicaSet"
+
+
+@dataclass
+class DeploymentStrategy:
+    # "RollingUpdate" replaces the old ReplicaSet through a new one;
+    # "Recreate" scales old to zero first.  Surge/unavailable stepping is
+    # simplified to whole-RS transitions (documented divergence from
+    # pkg/controller/deployment/rolling.go).
+    type: str = "RollingUpdate"
+
+
+@dataclass
+class DeploymentSpec:
+    replicas: int = 1
+    selector: LabelSelector = field(default_factory=LabelSelector)
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    strategy: DeploymentStrategy = field(default_factory=DeploymentStrategy)
+
+
+@dataclass
+class DeploymentStatus:
+    replicas: int = 0
+    updated_replicas: int = 0
+    ready_replicas: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class Deployment:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: DeploymentSpec = field(default_factory=DeploymentSpec)
+    status: DeploymentStatus = field(default_factory=DeploymentStatus)
+
+    KIND = "Deployment"
+
+
+@dataclass
+class JobSpec:
+    parallelism: int = 1
+    completions: Optional[int] = 1
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    backoff_limit: int = 6
+
+
+@dataclass
+class JobStatus:
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    completion_time: Optional[float] = None
+
+
+@dataclass
+class Job:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: JobSpec = field(default_factory=JobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+    KIND = "Job"
+
+
 def clone(obj):
     """Deep copy an API object (the reference's generated DeepCopy)."""
     return dataclasses.replace(

@@ -1,1 +1,16 @@
-"""controllers layer (being built out; see package docstring for the layout map)."""
+"""Workload control loops over the informer/workqueue substrate
+(reference: pkg/controller, registered via controllermanager.go:515)."""
+
+from .base import Controller
+from .deployment import DeploymentController
+from .job import JobController
+from .manager import ControllerManager
+from .replicaset import ReplicaSetController
+
+__all__ = [
+    "Controller",
+    "ControllerManager",
+    "DeploymentController",
+    "JobController",
+    "ReplicaSetController",
+]

@@ -1,0 +1,141 @@
+"""Deployment controller: manage ReplicaSets per template revision.
+
+Reference: pkg/controller/deployment/deployment_controller.go +
+sync.go/rolling.go.  Revision identity is a stable hash of the pod
+template (the pod-template-hash label pattern); rollout is simplified to
+whole-RS transitions — the new revision's RS scales to spec.replicas and
+every old RS scales to 0 in one reconcile (maxSurge/maxUnavailable
+stepping is a documented divergence; capacity-safe stepping matters on
+real kubelets, not against the in-memory store).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..api import store as st
+from ..api import types as api
+from .base import Controller, controller_owner, split_key
+
+
+def template_hash(template: api.PodTemplateSpec) -> str:
+    """Stable content hash of a pod template (pod-template-hash)."""
+    import dataclasses
+    import json
+
+    def enc(o):
+        if dataclasses.is_dataclass(o) and not isinstance(o, type):
+            return {
+                f.name: enc(getattr(o, f.name))
+                for f in dataclasses.fields(o)
+            }
+        if isinstance(o, dict):
+            return {k: enc(v) for k, v in sorted(o.items())}
+        if isinstance(o, list):
+            return [enc(v) for v in o]
+        return o
+
+    doc = json.dumps(enc(template), sort_keys=True, default=str)
+    return hashlib.sha1(doc.encode()).hexdigest()[:10]
+
+
+class DeploymentController(Controller):
+    KIND = "Deployment"
+
+    def register(self) -> None:
+        self.informers.informer("Deployment").add_handler(self._on_dep)
+        self.informers.informer("ReplicaSet").add_handler(self._on_rs)
+
+    def _on_dep(self, typ: str, dep, old) -> None:
+        self.enqueue(dep)
+
+    def _on_rs(self, typ: str, rs, old) -> None:
+        ref = controller_owner(rs)
+        if ref is not None and ref.kind == "Deployment":
+            self.queue.add(f"{rs.meta.namespace}/{ref.name}")
+
+    def _owned_rs(self, namespace: str, name: str):
+        out = []
+        for rs in self.informers.informer("ReplicaSet").list():
+            if rs.meta.namespace != namespace:
+                continue
+            ref = controller_owner(rs)
+            if ref is not None and ref.kind == "Deployment" and ref.name == name:
+                out.append(rs)
+        return out
+
+    def sync(self, key: str) -> None:
+        namespace, name = split_key(key)
+        try:
+            dep = self.store.get("Deployment", name, namespace)
+        except st.NotFound:
+            for rs in self._owned_rs(namespace, name):
+                try:
+                    self.store.delete("ReplicaSet", rs.meta.name, namespace)
+                except st.NotFound:
+                    pass
+            return
+        rev = template_hash(dep.spec.template)
+        rs_name = f"{name}-{rev}"
+        owned = self._owned_rs(namespace, name)
+        current = next((r for r in owned if r.meta.name == rs_name), None)
+        if current is None:
+            template = api.clone(dep.spec.template)
+            template.meta.labels.setdefault("pod-template-hash", rev)
+            rs = api.ReplicaSet(
+                meta=api.ObjectMeta(
+                    name=rs_name,
+                    namespace=namespace,
+                    labels=dict(template.meta.labels),
+                    owner_references=[
+                        api.OwnerReference(
+                            kind="Deployment",
+                            name=name,
+                            uid=dep.meta.uid,
+                            controller=True,
+                        )
+                    ],
+                ),
+                spec=api.ReplicaSetSpec(
+                    replicas=dep.spec.replicas,
+                    selector=api.LabelSelector(
+                        match_labels=dict(template.meta.labels)
+                    ),
+                    template=template,
+                ),
+            )
+            try:
+                self.store.create(rs)
+            except st.AlreadyExists:
+                self.queue.add(key)
+                return
+        elif current.spec.replicas != dep.spec.replicas:
+            fresh = self.store.get("ReplicaSet", rs_name, namespace)
+            fresh.spec.replicas = dep.spec.replicas
+            self.store.update(fresh)
+        # scale old revisions down
+        for rs in owned:
+            if rs.meta.name != rs_name and rs.spec.replicas != 0:
+                fresh = self.store.get("ReplicaSet", rs.meta.name, namespace)
+                fresh.spec.replicas = 0
+                self.store.update(fresh)
+        # status from owned RS; write ONLY on change — an unconditional
+        # update MODIFIED-events this key back into a permanent loop
+        owned = self._owned_rs(namespace, name)
+        replicas = sum(r.status.replicas for r in owned)
+        updated = sum(
+            r.status.replicas for r in owned if r.meta.name == rs_name
+        )
+        ready = sum(r.status.ready_replicas for r in owned)
+        if (
+            dep.status.replicas != replicas
+            or dep.status.updated_replicas != updated
+            or dep.status.ready_replicas != ready
+            or dep.status.observed_generation != dep.meta.generation
+        ):
+            dep_fresh = self.store.get("Deployment", name, namespace)
+            dep_fresh.status.replicas = replicas
+            dep_fresh.status.updated_replicas = updated
+            dep_fresh.status.ready_replicas = ready
+            dep_fresh.status.observed_generation = dep_fresh.meta.generation
+            self.store.update(dep_fresh)

@@ -1,0 +1,106 @@
+"""Job controller: run template pods to `completions` with at most
+`parallelism` active.
+
+Reference: pkg/controller/job/job_controller.go syncJob — active =
+non-terminal owned pods, succeeded counts Succeeded phases, new pods
+created while active < parallelism and succeeded + active < completions;
+job completes when succeeded >= completions.  Pod phases are written by
+the node agent in the reference; tests (and the hollow-node sim) flip
+them through the store.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+
+from ..api import store as st
+from ..api import types as api
+from .base import Controller, split_key
+from .replicaset import pod_from_template
+
+_suffix = itertools.count(1)
+
+
+class JobController(Controller):
+    KIND = "Job"
+
+    def register(self) -> None:
+        self.informers.informer("Job").add_handler(self._on_job)
+        self.informers.informer("Pod").add_handler(self._on_pod)
+
+    def _on_job(self, typ: str, job, old) -> None:
+        # DELETED included: sync's NotFound path cascade-deletes owned pods
+        self.enqueue(job)
+
+    def _on_pod(self, typ: str, pod: api.Pod, old) -> None:
+        ref = None
+        for r in pod.meta.owner_references:
+            if r.controller and r.kind == self.KIND:
+                ref = r
+        if ref is not None:
+            key = f"{pod.meta.namespace}/{ref.name}"
+            if typ == st.ADDED:
+                self.expectations.creation_observed(key)
+            elif typ == st.DELETED:
+                self.expectations.deletion_observed(key)
+            self.queue.add(key)
+
+    def sync(self, key: str) -> None:
+        namespace, name = split_key(key)
+        try:
+            job = self.store.get("Job", name, namespace)
+        except st.NotFound:
+            self.expectations.forget(key)
+            for pod in self.pods_owned_by(namespace, "Job", name):
+                try:
+                    self.store.delete("Pod", pod.meta.name, namespace)
+                except st.NotFound:
+                    pass
+            return
+        owned = self.pods_owned_by(namespace, "Job", name)
+        succeeded = sum(1 for p in owned if p.status.phase == "Succeeded")
+        failed = sum(1 for p in owned if p.status.phase == "Failed")
+        active = [
+            p for p in owned if p.status.phase not in ("Succeeded", "Failed")
+        ]
+        completions = (
+            job.spec.completions
+            if job.spec.completions is not None
+            else job.spec.parallelism
+        )
+        done = succeeded >= completions
+        if (
+            not done
+            and failed <= job.spec.backoff_limit
+            and self.expectations.satisfied(key)
+        ):
+            want_new = min(
+                job.spec.parallelism - len(active),
+                completions - succeeded - len(active),
+            )
+            if want_new > 0:
+                self.expectations.expect_creations(key, want_new)
+            for _ in range(max(0, want_new)):
+                pod = pod_from_template(
+                    job.spec.template, job, f"{name}-{next(_suffix):05d}"
+                )
+                try:
+                    self.store.create(pod)
+                except st.AlreadyExists:
+                    self.expectations.creation_observed(key)
+                    self.queue.add(key)
+        # write status ONLY on change — an unconditional update MODIFIED-
+        # events this key back into a permanent reconcile loop
+        if (
+            job.status.active != len(active)
+            or job.status.succeeded != succeeded
+            or job.status.failed != failed
+            or (done and job.status.completion_time is None)
+        ):
+            job.status.active = len(active)
+            job.status.succeeded = succeeded
+            job.status.failed = failed
+            if done and job.status.completion_time is None:
+                job.status.completion_time = time.time()
+            self.store.update(job)

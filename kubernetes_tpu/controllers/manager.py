@@ -1,0 +1,53 @@
+"""Controller manager: shared informers + the registered control loops.
+
+Reference: cmd/kube-controller-manager/app/controllermanager.go:479-566
+builds descriptors and starts each controller against one shared
+informer factory; ours instantiates the implemented set and shares the
+store's InformerFactory the same way.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Type
+
+from ..api import store as st
+from ..client.informers import InformerFactory
+from .base import Controller
+from .deployment import DeploymentController
+from .job import JobController
+from .replicaset import ReplicaSetController
+
+DEFAULT_CONTROLLERS: List[Type[Controller]] = [
+    ReplicaSetController,
+    DeploymentController,
+    JobController,
+]
+
+
+class ControllerManager:
+    def __init__(
+        self,
+        store: st.Store,
+        controllers: Optional[List[Type[Controller]]] = None,
+        workers: int = 2,
+    ):
+        self.store = store
+        self.informers = InformerFactory(store)
+        self.controllers: Dict[str, Controller] = {
+            cls.KIND: cls(store, self.informers, workers=workers)
+            for cls in (controllers or DEFAULT_CONTROLLERS)
+        }
+
+    def start(self) -> "ControllerManager":
+        # informers for every kind any controller watches
+        for kind in ("Pod", "ReplicaSet", "Deployment", "Job"):
+            self.informers.informer(kind).start()
+        self.informers.wait_for_sync()
+        for c in self.controllers.values():
+            c.start()
+        return self
+
+    def stop(self) -> None:
+        for c in self.controllers.values():
+            c.stop()
+        self.informers.stop()

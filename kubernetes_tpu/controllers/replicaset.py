@@ -1,0 +1,130 @@
+"""ReplicaSet controller: keep spec.replicas pods alive from the template.
+
+Reference: pkg/controller/replicaset/replica_set.go — syncReplicaSet
+diffs filtered pods vs *(rs.Spec.Replicas) and calls
+slowStartBatch(create) / rank-and-delete; ours creates/deletes through
+the store in one reconcile step (no slow-start: the in-memory API
+doesn't rate-limit).  Deletion preference mirrors
+getPodsToDelete/ActivePodsWithRanks: pending (unscheduled) pods go
+before scheduled ones, younger before older.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from ..api import store as st
+from ..api import types as api
+from .base import Controller, split_key
+
+_suffix = itertools.count(1)
+
+
+def pod_from_template(
+    template: api.PodTemplateSpec, owner, name: str
+) -> api.Pod:
+    pod = api.Pod(
+        meta=api.ObjectMeta(
+            name=name,
+            namespace=owner.meta.namespace,
+            labels=dict(template.meta.labels),
+            owner_references=[
+                api.OwnerReference(
+                    kind=owner.KIND,
+                    name=owner.meta.name,
+                    uid=owner.meta.uid,
+                    controller=True,
+                )
+            ],
+        ),
+        spec=api.clone(template.spec),
+    )
+    return pod
+
+
+class ReplicaSetController(Controller):
+    KIND = "ReplicaSet"
+
+    def register(self) -> None:
+        self.informers.informer("ReplicaSet").add_handler(self._on_rs)
+        self.informers.informer("Pod").add_handler(self._on_pod)
+
+    def _on_rs(self, typ: str, rs, old) -> None:
+        # DELETED included: sync's NotFound path cascade-deletes the
+        # owned pods (the GC controller's job in the reference)
+        self.enqueue(rs)
+
+    def _on_pod(self, typ: str, pod: api.Pod, old) -> None:
+        ref = None
+        for r in pod.meta.owner_references:
+            if r.controller and r.kind == self.KIND:
+                ref = r
+        if ref is not None:
+            key = f"{pod.meta.namespace}/{ref.name}"
+            if typ == st.ADDED:
+                self.expectations.creation_observed(key)
+            elif typ == st.DELETED:
+                self.expectations.deletion_observed(key)
+            self.queue.add(key)
+
+    def sync(self, key: str) -> None:
+        namespace, name = split_key(key)
+        try:
+            rs = self.store.get("ReplicaSet", name, namespace)
+        except st.NotFound:
+            # RS deleted: cascade-delete owned pods (the GC controller's
+            # job in the reference; folded in here — no GC loop yet)
+            self.expectations.forget(key)
+            for pod in self.pods_owned_by(namespace, "ReplicaSet", name):
+                try:
+                    self.store.delete("Pod", pod.meta.name, namespace)
+                except st.NotFound:
+                    pass
+            return
+        all_owned = self.pods_owned_by(namespace, "ReplicaSet", name)
+        pods = [
+            p for p in all_owned
+            if p.status.phase not in ("Succeeded", "Failed")
+        ]
+        # Only manage replicas once prior creates/deletes are OBSERVED in
+        # the informer cache (ControllerExpectations) — counting early
+        # double-provisions, since fresh names defeat AlreadyExists.
+        if self.expectations.satisfied(key):
+            diff = rs.spec.replicas - len(pods)
+            if diff > 0:
+                self.expectations.expect_creations(key, diff)
+                for _ in range(diff):
+                    pod = pod_from_template(
+                        rs.spec.template, rs,
+                        f"{name}-{next(_suffix):05d}",
+                    )
+                    try:
+                        self.store.create(pod)
+                    except st.AlreadyExists:  # name race: retry next sync
+                        self.expectations.creation_observed(key)
+                        self.queue.add(key)
+            elif diff < 0:
+                # prefer deleting unscheduled pods (ActivePodsWithRanks)
+                victims = sorted(
+                    pods,
+                    key=lambda p: (bool(p.spec.node_name), -p.meta.resource_version),
+                )[: -diff]
+                self.expectations.expect_deletions(key, len(victims))
+                for pod in victims:
+                    try:
+                        self.store.delete("Pod", pod.meta.name, namespace)
+                    except st.NotFound:
+                        self.expectations.deletion_observed(key)
+        # status from the in-hand pod list; write ONLY on change (an
+        # unconditional update would MODIFIED-event this same key into a
+        # permanent self-triggering reconcile loop)
+        ready = sum(1 for p in pods if p.spec.node_name)
+        if (
+            rs.status.replicas != len(pods)
+            or rs.status.ready_replicas != ready
+            or rs.status.observed_generation != rs.meta.generation
+        ):
+            rs.status.replicas = len(pods)
+            rs.status.ready_replicas = ready
+            rs.status.observed_generation = rs.meta.generation
+            self.store.update(rs)

@@ -110,16 +110,19 @@ class ExtenderBackend:
             out_s[name] = float(scores[row]) if feas[row] else 0.0
         return out_f, out_s
 
+    def _candidates(self, args: wire.ExtenderArgs) -> List[str]:
+        """Candidate node names; non-cache mode also upserts the shipped
+        Node objects so both verbs work without a pre-fed inventory."""
+        if args.nodes is not None:
+            with self.lock:
+                for n in args.nodes:
+                    self.tpu.state.add_node(n)
+            return [n.meta.name for n in args.nodes]
+        return args.node_names or []
+
     def filter(self, args: wire.ExtenderArgs) -> dict:
         try:
-            if args.nodes is not None:
-                # non-nodeCacheCapable: upsert the shipped Node objects
-                with self.lock:
-                    for n in args.nodes:
-                        self.tpu.state.add_node(n)
-                candidates = [n.meta.name for n in args.nodes]
-            else:
-                candidates = args.node_names or []
+            candidates = self._candidates(args)
             feas, _ = self._evaluate(args.pod)
             passed = [n for n in candidates if feas.get(n)]
             failed = {
@@ -127,17 +130,29 @@ class ExtenderBackend:
                 for n in candidates
                 if not feas.get(n)
             }
+            if args.raw_nodes is not None:
+                # non-cache callers read Nodes.items, not NodeNames
+                passed_set = set(passed)
+                items = [
+                    d for d in args.raw_nodes
+                    if (d.get("metadata") or {}).get("name") in passed_set
+                ]
+                return wire.filter_result(
+                    node_names=passed, nodes=items, failed=failed
+                )
             return wire.filter_result(node_names=passed, failed=failed)
         except Exception as e:  # wire errors, never tracebacks
             return wire.filter_result(node_names=[], error=str(e))
 
     def prioritize(self, args: wire.ExtenderArgs) -> List[dict]:
-        candidates = (
-            [n.meta.name for n in args.nodes]
-            if args.nodes is not None
-            else (args.node_names or [])
-        )
-        _, scores = self._evaluate(args.pod)
+        try:
+            candidates = self._candidates(args)
+            _, scores = self._evaluate(args.pod)
+        except Exception:
+            # HostPriorityList has no Error field (types.go:125); a zeroed
+            # list keeps the scheduling cycle alive (the scheduler treats
+            # extender prioritize errors as fatal for the pod)
+            return wire.host_priority_list({})
         vals = [scores.get(n, 0.0) for n in candidates]
         hi = max(vals) if vals else 0.0
         out: Dict[str, int] = {}
@@ -161,6 +176,11 @@ class ExtenderBackend:
             pod.spec.node_name = node
             pod.status.phase = "Running"
             self.store.update(pod)
+            # account the placement in the extender's own state so later
+            # filters see the consumed capacity (sync_store is one-shot)
+            with self.lock:
+                if not self.tpu.state.has_pod(pod):
+                    self.tpu.state.add_pod(pod, node)
             return wire.binding_result()
         except Exception as e:
             return wire.binding_result(str(e))

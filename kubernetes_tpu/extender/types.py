@@ -29,33 +29,39 @@ class ExtenderArgs:
         pod: api.Pod,
         node_names: Optional[List[str]] = None,
         nodes: Optional[List[api.Node]] = None,
+        raw_nodes: Optional[List[Dict[str, Any]]] = None,
     ):
         self.pod = pod
         self.node_names = node_names
         self.nodes = nodes
+        # original v1.Node JSON items (non-cache mode): the RESPONSE must
+        # echo passing nodes as full objects — HTTPExtender.Filter reads
+        # result.Nodes.Items when nodeCacheCapable is off (extender.go)
+        self.raw_nodes = raw_nodes
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ExtenderArgs":
         pod = kubeyaml.pod_from_dict(d.get("Pod") or {})
         node_names = d.get("NodeNames")
-        nodes = None
+        nodes = raw = None
         if d.get("Nodes") is not None:
-            nodes = [
-                kubeyaml.node_from_dict(item)
-                for item in (d["Nodes"].get("items") or [])
-            ]
-        return cls(pod, node_names, nodes)
+            raw = list(d["Nodes"].get("items") or [])
+            nodes = [kubeyaml.node_from_dict(item) for item in raw]
+        return cls(pod, node_names, nodes, raw)
 
 
 def filter_result(
     node_names: Optional[List[str]] = None,
+    nodes: Optional[List[Dict[str, Any]]] = None,
     failed: Optional[Dict[str, str]] = None,
     failed_unresolvable: Optional[Dict[str, str]] = None,
     error: str = "",
 ) -> Dict[str, Any]:
-    """ExtenderFilterResult (types.go:88) in nodeCacheCapable form."""
+    """ExtenderFilterResult (types.go:88).  nodeCacheCapable callers read
+    NodeNames; non-cache callers read Nodes.items — populate whichever
+    matches the request's shape."""
     return {
-        "Nodes": None,
+        "Nodes": {"items": nodes} if nodes is not None else None,
         "NodeNames": node_names,
         "FailedNodes": failed or {},
         "FailedAndUnresolvableNodes": failed_unresolvable or {},

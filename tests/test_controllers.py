@@ -1,0 +1,323 @@
+"""Workload controllers on the informer/workqueue substrate.
+
+Reference pattern: controllermanager.go worker loops; the e2e here is
+VERDICT's acceptance: create Deployment → pods appear → scheduler binds
+them → scale down → pods deleted, with workqueue backoff exercised on
+injected conflicts.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import store as st
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.controllers import (
+    ControllerManager,
+    ReplicaSetController,
+)
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import GI, MI, make_node
+
+
+def _template(labels=None, cpu=100):
+    return api.PodTemplateSpec(
+        meta=api.ObjectMeta(name="", labels=dict(labels or {"app": "web"})),
+        spec=api.PodSpec(
+            containers=[api.Container(requests={api.CPU: cpu, api.MEMORY: 64 * MI})]
+        ),
+    )
+
+
+def _rs(name, replicas, labels=None):
+    labels = dict(labels or {"app": "web"})
+    return api.ReplicaSet(
+        meta=api.ObjectMeta(name=name),
+        spec=api.ReplicaSetSpec(
+            replicas=replicas,
+            selector=api.LabelSelector(match_labels=labels),
+            template=_template(labels),
+        ),
+    )
+
+
+def _wait(cond, timeout=10.0, every=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(every)
+    return False
+
+
+def _owned_pods(store, kind, name):
+    pods, _ = store.list("Pod")
+    return [
+        p
+        for p in pods
+        if any(
+            r.controller and r.kind == kind and r.name == name
+            for r in p.meta.owner_references
+        )
+    ]
+
+
+@pytest.fixture
+def manager_store():
+    store = st.Store()
+    mgr = ControllerManager(store).start()
+    yield store, mgr
+    mgr.stop()
+
+
+def test_replicaset_scales_up_and_down(manager_store):
+    store, _ = manager_store
+    store.create(_rs("web", 3))
+    assert _wait(lambda: len(_owned_pods(store, "ReplicaSet", "web")) == 3)
+    rs = store.get("ReplicaSet", "web")
+    rs.spec.replicas = 1
+    store.update(rs)
+    assert _wait(lambda: len(_owned_pods(store, "ReplicaSet", "web")) == 1)
+
+
+def test_replicaset_replaces_deleted_pod(manager_store):
+    store, _ = manager_store
+    store.create(_rs("web", 2))
+    assert _wait(lambda: len(_owned_pods(store, "ReplicaSet", "web")) == 2)
+    victim = _owned_pods(store, "ReplicaSet", "web")[0]
+    store.delete("Pod", victim.meta.name)
+    assert _wait(
+        lambda: len(_owned_pods(store, "ReplicaSet", "web")) == 2
+        and all(
+            p.meta.name != victim.meta.name
+            for p in _owned_pods(store, "ReplicaSet", "web")
+        )
+    )
+
+
+def test_replicaset_delete_cascades(manager_store):
+    store, _ = manager_store
+    store.create(_rs("web", 2))
+    assert _wait(lambda: len(_owned_pods(store, "ReplicaSet", "web")) == 2)
+    store.delete("ReplicaSet", "web")
+    assert _wait(lambda: len(_owned_pods(store, "ReplicaSet", "web")) == 0)
+
+
+def test_deployment_rollout_and_revision_change(manager_store):
+    store, _ = manager_store
+    dep = api.Deployment(
+        meta=api.ObjectMeta(name="front"),
+        spec=api.DeploymentSpec(
+            replicas=2,
+            selector=api.LabelSelector(match_labels={"app": "front"}),
+            template=_template({"app": "front"}, cpu=100),
+        ),
+    )
+    store.create(dep)
+    assert _wait(lambda: len(_owned_pods_by_dep(store, "front")) == 2)
+    rs_v1 = _deployment_rs(store, "front")
+    assert len(rs_v1) == 1
+
+    # template change → new revision RS; old scales to zero
+    fresh = store.get("Deployment", "front")
+    fresh.spec.template = _template({"app": "front"}, cpu=200)
+    store.update(fresh)
+    assert _wait(lambda: len(_deployment_rs(store, "front")) == 2)
+    assert _wait(
+        lambda: sorted(
+            rs.spec.replicas for rs in _deployment_rs(store, "front")
+        ) == [0, 2]
+    )
+    # pods converge to the new revision's template
+    assert _wait(
+        lambda: len(_owned_pods_by_dep(store, "front")) == 2
+        and all(
+            p.resource_requests()[api.CPU] == 200
+            for p in _owned_pods_by_dep(store, "front")
+        ),
+        timeout=15,
+    )
+
+
+def _deployment_rs(store, name):
+    rss, _ = store.list("ReplicaSet")
+    return [
+        r
+        for r in rss
+        if any(
+            ref.controller and ref.kind == "Deployment" and ref.name == name
+            for ref in r.meta.owner_references
+        )
+    ]
+
+
+def _owned_pods_by_dep(store, name):
+    out = []
+    for rs in _deployment_rs(store, name):
+        out.extend(_owned_pods(store, "ReplicaSet", rs.meta.name))
+    return out
+
+
+def test_job_runs_to_completion(manager_store):
+    store, _ = manager_store
+    job = api.Job(
+        meta=api.ObjectMeta(name="batch1"),
+        spec=api.JobSpec(
+            parallelism=2, completions=4, template=_template({"job": "batch1"})
+        ),
+    )
+    store.create(job)
+    # at most `parallelism` active at a time
+    assert _wait(lambda: len(_owned_pods(store, "Job", "batch1")) >= 2)
+    for _ in range(4):
+        # simulate the node agent finishing whatever is active
+        assert _wait(
+            lambda: any(
+                p.status.phase == "Pending"
+                for p in _owned_pods(store, "Job", "batch1")
+            ),
+            timeout=10,
+        )
+        active = [
+            p
+            for p in _owned_pods(store, "Job", "batch1")
+            if p.status.phase == "Pending"
+        ]
+        p = active[0]
+        p.status.phase = "Succeeded"
+        store.update(p, force=True)
+        time.sleep(0.05)
+    assert _wait(
+        lambda: store.get("Job", "batch1").status.succeeded >= 4, timeout=10
+    )
+    assert store.get("Job", "batch1").status.completion_time is not None
+
+
+def test_e2e_deployment_scheduler_binds_then_scales_down():
+    """The VERDICT acceptance: Deployment → pods appear → host scheduler
+    binds them through the API → scale down deletes pods and the
+    scheduler cache unaccounts them."""
+    store = st.Store()
+    for i in range(4):
+        store.create(
+            make_node(f"n{i}").capacity(cpu_milli=4000, mem=8 * GI, pods=10).obj()
+        )
+    mgr = ControllerManager(store).start()
+    sched = Scheduler(store)
+    sched.informers.informer("Node").start()
+    sched.informers.informer("Pod").start()
+    assert sched.informers.wait_for_sync(10)
+    try:
+        store.create(
+            api.Deployment(
+                meta=api.ObjectMeta(name="api"),
+                spec=api.DeploymentSpec(
+                    replicas=6,
+                    selector=api.LabelSelector(match_labels={"app": "api"}),
+                    template=_template({"app": "api"}, cpu=500),
+                ),
+            )
+        )
+        deadline = time.monotonic() + 20
+        bound = []
+        while time.monotonic() < deadline:
+            sched.schedule_batch(timeout=0.2)
+            bound = [p for p in _owned_pods_by_dep(store, "api") if p.spec.node_name]
+            if len(bound) == 6:
+                break
+        assert len(bound) == 6, f"only {len(bound)} bound"
+        # scale down; controller deletes pods; cache unaccounts them
+        fresh = store.get("Deployment", "api")
+        fresh.spec.replicas = 2
+        store.update(fresh)
+        assert _wait(
+            lambda: len(_owned_pods_by_dep(store, "api")) == 2, timeout=10
+        )
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            sched.schedule_batch(timeout=0.05)
+            if len(sched.tpu.state._pods) == 2:
+                break
+        assert len(sched.tpu.state._pods) == 2
+    finally:
+        sched.stop()
+        mgr.stop()
+
+
+def test_workqueue_backoff_on_conflict():
+    """Injected Conflict from the store exercises the rate-limited
+    requeue path: the sync eventually succeeds."""
+    store = st.Store()
+    informers_calls = {"n": 0}
+
+    class FlakyRS(ReplicaSetController):
+        def sync(self, key):
+            informers_calls["n"] += 1
+            if informers_calls["n"] < 3:
+                raise st.Conflict("injected")
+            return super().sync(key)
+
+    from kubernetes_tpu.client.informers import InformerFactory
+
+    factory = InformerFactory(store)
+    ctrl = FlakyRS(store, factory)
+    for kind in ("Pod", "ReplicaSet"):
+        factory.informer(kind).start()
+    factory.wait_for_sync()
+    ctrl.start()
+    try:
+        store.create(_rs("flaky", 1))
+        assert _wait(
+            lambda: len(_owned_pods(store, "ReplicaSet", "flaky")) == 1,
+            timeout=10,
+        )
+        assert informers_calls["n"] >= 3
+    finally:
+        ctrl.stop()
+        factory.stop()
+
+
+def test_no_reconcile_hot_loop(manager_store):
+    """Status writes are change-gated: a converged workload must not
+    MODIFIED-event itself into a permanent reconcile loop (review
+    finding).  After convergence the store's resourceVersion settles."""
+    store, _ = manager_store
+    store.create(_rs("calm", 2))
+    assert _wait(lambda: len(_owned_pods(store, "ReplicaSet", "calm")) == 2)
+    time.sleep(0.3)  # let status writes settle
+    rv1 = store.get("ReplicaSet", "calm").meta.resource_version
+    time.sleep(1.0)
+    rv2 = store.get("ReplicaSet", "calm").meta.resource_version
+    assert rv1 == rv2, "ReplicaSet kept self-updating after convergence"
+
+
+def test_no_overcreation_under_informer_lag(manager_store):
+    """Expectations hold back re-creation until informer observation —
+    the pod count must never overshoot replicas (review finding)."""
+    store, _ = manager_store
+    store.create(_rs("burst", 5))
+    peak = 0
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        n = len(_owned_pods(store, "ReplicaSet", "burst"))
+        peak = max(peak, n)
+        if n == 5 and time.monotonic() > deadline - 3:
+            break
+        time.sleep(0.005)
+    assert peak <= 5, f"over-created: peak={peak}"
+    assert len(_owned_pods(store, "ReplicaSet", "burst")) == 5
+
+
+def test_rs_ready_replicas_updates_after_binding(manager_store):
+    """ready_replicas must refresh when pods get scheduled AFTER the
+    replica count already converged (review finding)."""
+    store, _ = manager_store
+    store.create(_rs("ready", 2))
+    assert _wait(lambda: len(_owned_pods(store, "ReplicaSet", "ready")) == 2)
+    for p in _owned_pods(store, "ReplicaSet", "ready"):
+        fresh = store.get("Pod", p.meta.name)
+        fresh.spec.node_name = "n0"
+        store.update(fresh)
+    assert _wait(
+        lambda: store.get("ReplicaSet", "ready").status.ready_replicas == 2
+    )

@@ -167,3 +167,53 @@ def test_sync_store_accounts_bound_pods():
     }
     res = be.filter(ExtenderArgs.from_dict(req))
     assert res["NodeNames"] == []  # 900m bound + 500m pending > 1000m
+
+
+def test_filter_non_cache_mode_echoes_node_objects():
+    """nodeCacheCapable=false schedulers read result.Nodes.items, not
+    NodeNames (extender.go Filter) — passing nodes must echo as full
+    objects (review finding)."""
+    be = ExtenderBackend()
+    req = {
+        "Pod": FILTER_REQUEST_FIXTURE["Pod"],
+        "Nodes": {
+            "items": [
+                {
+                    "metadata": {"name": "okay"},
+                    "status": {"capacity": {"cpu": "4", "memory": "8Gi", "pods": "10"}},
+                },
+                {
+                    "metadata": {"name": "small"},
+                    "status": {"capacity": {"cpu": "100m", "memory": "64Mi", "pods": "10"}},
+                },
+            ]
+        },
+        "NodeNames": None,
+    }
+    res = be.filter(ExtenderArgs.from_dict(req))
+    items = res["Nodes"]["items"]
+    assert [d["metadata"]["name"] for d in items] == ["okay"]
+    assert res["NodeNames"] == ["okay"]
+
+
+def test_bind_accounts_capacity_in_extender_state():
+    """A /bind must consume capacity in the extender's own state so the
+    next /filter sees it (review finding)."""
+    store = st.Store()
+    store.create(make_pod("a").req(cpu_milli=900).obj())
+    be = ExtenderBackend()
+    be.store = store
+    be.add_node(make_node("n0").capacity(cpu_milli=1000, mem=8 * GI, pods=10).obj())
+    assert be.bind(
+        {"PodName": "a", "PodNamespace": "default", "Node": "n0"}
+    ) == {"Error": ""}
+    req = {
+        "Pod": {
+            "metadata": {"name": "b"},
+            "spec": {"containers": [{"resources": {"requests": {"cpu": "500m"}}}]},
+        },
+        "Nodes": None,
+        "NodeNames": ["n0"],
+    }
+    res = be.filter(ExtenderArgs.from_dict(req))
+    assert res["NodeNames"] == []
