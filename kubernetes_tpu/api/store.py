@@ -117,9 +117,23 @@ class Watch:
 
 
 class Store:
-    """The single-process control-plane store (see module docstring)."""
+    """The single-process control-plane store (see module docstring).
 
-    def __init__(self, buffer_size: int = 4096, watch_capacity: int = 1024):
+    With `journal_path`, every committed write appends one JSON line
+    (op, rv, type-tagged object — api.wire codec) and construction
+    replays the file: the crash-only resume property whose reference
+    counterpart is every component rebuilding from etcd on restart
+    (storage/etcd3/store.go; SURVEY §5.4).  Replay re-applies writes
+    without re-journaling and leaves the event buffer empty — watchers
+    attach after recovery and relist, exactly like a reflector hitting a
+    fresh apiserver."""
+
+    def __init__(
+        self,
+        buffer_size: int = 4096,
+        watch_capacity: int = 1024,
+        journal_path: Optional[str] = None,
+    ):
         self._lock = threading.RLock()
         self._rv = 0
         self._objects: Dict[str, Dict[str, Any]] = {}   # kind -> key -> obj
@@ -128,6 +142,107 @@ class Store:
         self._buffer_size = buffer_size
         self._watch_capacity = watch_capacity
         self._watchers: Dict[str, List[Watch]] = {}     # kind -> watches
+        self._journal = None
+        self._journal_path = journal_path
+        self._journal_records = 0
+        if journal_path:
+            replayed = self._replay_journal(journal_path)
+            live = sum(len(objs) for objs in self._objects.values())
+            if replayed > max(1024, 4 * live):
+                # compaction: rewrite history as one ADDED per live object
+                # (the etcd-compaction analogue) — otherwise churny
+                # writers (lease renewals every few seconds) grow the file
+                # and replay time without bound
+                self._compact_journal(journal_path)
+            else:
+                self._journal = open(journal_path, "a")
+                self._journal_records = replayed
+
+    # -- journal (crash-only durability) -----------------------------------
+
+    def _replay_journal(self, path: str) -> int:
+        import json
+        import os
+
+        from . import wire
+
+        if not os.path.exists(path):
+            return 0
+        replayed = 0
+        good_offset = 0
+        with open(path, "rb") as f:
+            for raw in f:
+                line = raw.decode(errors="replace").strip()
+                if not line:
+                    good_offset += len(raw)
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    # torn tail: the process died mid-append; the record
+                    # was never acknowledged durable — stop replay and
+                    # truncate so appends continue from the last good line
+                    with open(path, "r+b") as t:
+                        t.truncate(good_offset)
+                    break
+                op, rv, kind = rec["op"], rec["rv"], rec["kind"]
+                key = rec["key"]
+                objs = self._objects.setdefault(kind, {})
+                vers = self._versions.setdefault(kind, {})
+                if op == DELETED:
+                    objs.pop(key, None)
+                    vers.pop(key, None)
+                else:
+                    obj = wire.from_wire(rec["obj"])
+                    objs[key] = obj
+                    vers[key] = rv
+                self._rv = max(self._rv, rv)
+                replayed += 1
+                good_offset += len(raw)
+        return replayed
+
+    def _compact_journal(self, path: str) -> None:
+        import json
+        import os
+
+        from . import wire
+
+        tmp = path + ".compact"
+        n = 0
+        with open(tmp, "w") as f:
+            for kind, objs in self._objects.items():
+                for key, obj in objs.items():
+                    rec = {
+                        "op": ADDED,
+                        "rv": self._versions[kind][key],
+                        "kind": kind,
+                        "key": key,
+                        "obj": wire.to_wire(obj),
+                    }
+                    f.write(json.dumps(rec) + "\n")
+                    n += 1
+        os.replace(tmp, path)
+        self._journal = open(path, "a")
+        self._journal_records = n
+
+    def _append_journal(self, op: str, kind: str, key: str, obj, rv: int) -> None:
+        # caller holds the lock; called after the in-memory commit
+        if self._journal is None:
+            return
+        import json
+
+        from . import wire
+
+        rec = {"op": op, "rv": rv, "kind": kind, "key": key}
+        if op != DELETED:
+            rec["obj"] = wire.to_wire(obj)
+        self._journal.write(json.dumps(rec) + "\n")
+        self._journal.flush()
+        self._journal_records += 1
+        live = sum(len(objs) for objs in self._objects.values())
+        if self._journal_records > max(1024, 8 * max(live, 1)):
+            self._journal.close()
+            self._compact_journal(self._journal_path)
 
     # -- helpers -----------------------------------------------------------
 
@@ -169,6 +284,7 @@ class Store:
             obj.meta.resource_version = self._rv
             objs[key] = obj
             self._versions.setdefault(kind, {})[key] = self._rv
+            self._append_journal(ADDED, kind, key, obj, self._rv)
             self._dispatch(Event(ADDED, kind, copy.deepcopy(obj), self._rv))
             return copy.deepcopy(obj)
 
@@ -201,6 +317,7 @@ class Store:
             obj.meta.resource_version = self._rv
             objs[key] = obj
             self._versions[kind][key] = self._rv
+            self._append_journal(MODIFIED, kind, key, obj, self._rv)
             self._dispatch(Event(MODIFIED, kind, copy.deepcopy(obj), self._rv))
             return copy.deepcopy(obj)
 
@@ -213,6 +330,7 @@ class Store:
             obj = objs.pop(key)
             self._versions[kind].pop(key)
             self._rv += 1
+            self._append_journal(DELETED, kind, key, None, self._rv)
             self._dispatch(Event(DELETED, kind, copy.deepcopy(obj), self._rv))
             return obj
 

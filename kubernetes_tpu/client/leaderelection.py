@@ -1,0 +1,136 @@
+"""Lease-based leader election.
+
+Reference: client-go tools/leaderelection/leaderelection.go:181-245 —
+tryAcquireOrRenew under optimistic concurrency against a Lease object;
+the holder renews every RetryPeriod, standbys watch the renew time and
+take over when LeaseDuration elapses without one.  Fail-over therefore
+bounds at lease_duration + one retry period, and split-brain is
+excluded by the store's Conflict-on-stale-rv semantics (the etcd
+transaction's analogue).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from ..api import store as st
+from ..api import types as api
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        store: st.Store,
+        lease_name: str,
+        identity: str,
+        namespace: str = "kube-system",
+        lease_duration: float = 15.0,
+        renew_period: float = 2.0,
+        clock=time.monotonic,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+    ):
+        self.store = store
+        self.lease_name = lease_name
+        self.identity = identity
+        self.namespace = namespace
+        self.lease_duration = lease_duration
+        self.renew_period = renew_period
+        self._clock = clock
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._leading = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- the tryAcquireOrRenew step ----------------------------------------
+
+    def try_acquire_or_renew(self) -> bool:
+        now = self._clock()
+        try:
+            lease = self.store.get("Lease", self.lease_name, self.namespace)
+        except st.NotFound:
+            lease = api.Lease(
+                meta=api.ObjectMeta(
+                    name=self.lease_name, namespace=self.namespace
+                ),
+                spec=api.LeaseSpec(
+                    holder_identity=self.identity,
+                    lease_duration_seconds=int(self.lease_duration),
+                    acquire_time=now,
+                    renew_time=now,
+                ),
+            )
+            try:
+                self.store.create(lease)
+                return True
+            except st.AlreadyExists:
+                return False  # raced; retry next period
+        spec = lease.spec
+        if (
+            spec.holder_identity != self.identity
+            and now < spec.renew_time + self.lease_duration
+        ):
+            return False  # someone else holds a live lease
+        took_over = spec.holder_identity != self.identity
+        spec.holder_identity = self.identity
+        spec.renew_time = now
+        if took_over:
+            spec.acquire_time = now
+            spec.lease_transitions += 1
+        try:
+            self.store.update(lease)
+            return True
+        except (st.Conflict, st.NotFound):
+            return False  # raced with another candidate; retry
+
+    # -- run loop ----------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            got = self.try_acquire_or_renew()
+            if got and not self._leading.is_set():
+                self._leading.set()
+                if self.on_started_leading:
+                    self.on_started_leading()
+            elif not got and self._leading.is_set():
+                # failed to renew: step down (the reference cancels the
+                # leading context)
+                self._leading.clear()
+                if self.on_stopped_leading:
+                    self.on_stopped_leading()
+            self._stop.wait(self.renew_period)
+        if self._leading.is_set():
+            self._leading.clear()
+            if self.on_stopped_leading:
+                self.on_stopped_leading()
+
+    def start(self) -> "LeaderElector":
+        self._thread = threading.Thread(
+            target=self._run, name=f"leaderelection-{self.identity}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, release: bool = True) -> None:
+        """Stop; with release (the reference's ReleaseOnCancel), zero the
+        renew time so standbys take over immediately."""
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        if release:
+            try:
+                lease = self.store.get("Lease", self.lease_name, self.namespace)
+                if lease.spec.holder_identity == self.identity:
+                    lease.spec.renew_time = 0.0
+                    self.store.update(lease, force=True)
+            except st.NotFound:
+                pass
+
+    def is_leader(self) -> bool:
+        return self._leading.is_set()
+
+    def wait_for_leadership(self, timeout: float = 30.0) -> bool:
+        return self._leading.wait(timeout)

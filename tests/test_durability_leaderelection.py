@@ -1,0 +1,214 @@
+"""Crash-only durability (store journal) and Lease leader election.
+
+VERDICT acceptance: kill-and-restart resumes with identical state; a
+standby takes over within the lease period.  Reference:
+storage/etcd3 persistence + tools/leaderelection/leaderelection.go.
+"""
+
+import time
+
+from kubernetes_tpu.api import store as st
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.wire import from_wire, to_wire
+from kubernetes_tpu.client.leaderelection import LeaderElector
+from kubernetes_tpu.testing.wrappers import GI, make_node, make_pod
+
+
+def test_wire_roundtrip_pod():
+    pod = (
+        make_pod("p")
+        .req(cpu_milli=500, mem=GI)
+        .label("app", "x")
+        .pod_anti_affinity({"app": "x"})
+        .spread(2, api.LABEL_ZONE, "DoNotSchedule", {"app": "x"})
+        .toleration("k", "v")
+        .priority(7)
+        .obj()
+    )
+    back = from_wire(to_wire(pod))
+    assert back == pod
+
+
+def test_wire_roundtrip_workloads():
+    rs = api.ReplicaSet(
+        meta=api.ObjectMeta(name="rs"),
+        spec=api.ReplicaSetSpec(
+            replicas=3,
+            selector=api.LabelSelector(match_labels={"a": "b"}),
+            template=api.PodTemplateSpec(
+                meta=api.ObjectMeta(name="", labels={"a": "b"}),
+                spec=api.PodSpec(containers=[api.Container(requests={api.CPU: 1})]),
+            ),
+        ),
+    )
+    assert from_wire(to_wire(rs)) == rs
+    node = make_node("n").taint("k", "v").zone("z1").obj()
+    assert from_wire(to_wire(node)) == node
+
+
+def test_store_journal_replay(tmp_path):
+    """Kill-and-restart: a journaled store resumes with identical objects
+    and resourceVersion."""
+    path = str(tmp_path / "journal.jsonl")
+    s1 = st.Store(journal_path=path)
+    s1.create(make_node("n0").capacity(cpu_milli=4000, mem=8 * GI).obj())
+    s1.create(make_pod("keep").req(cpu_milli=100).obj())
+    doomed = s1.create(make_pod("gone").req(cpu_milli=100).obj())
+    kept = s1.get("Pod", "keep")
+    kept.spec.node_name = "n0"
+    s1.update(kept)
+    s1.delete("Pod", "gone", doomed.meta.namespace)
+    rv = s1.resource_version
+
+    # "crash": drop the instance, rebuild from the journal alone
+    s2 = st.Store(journal_path=path)
+    assert s2.resource_version == rv
+    pods, _ = s2.list("Pod")
+    assert [p.meta.name for p in pods] == ["keep"]
+    assert s2.get("Pod", "keep").spec.node_name == "n0"
+    assert s2.get("Node", "n0", namespace="").status.allocatable[api.CPU] == 4000
+    # writes continue after recovery and journal further restarts
+    s2.create(make_pod("after").obj())
+    s3 = st.Store(journal_path=path)
+    assert {p.meta.name for p in s3.list("Pod")[0]} == {"keep", "after"}
+    # optimistic concurrency still enforced post-replay
+    stale = s3.get("Pod", "keep")
+    stale.meta.resource_version = 1
+    try:
+        s3.update(stale)
+        assert False, "expected Conflict"
+    except st.Conflict:
+        pass
+
+
+def test_leader_election_single_winner():
+    store = st.Store()
+    a = LeaderElector(store, "sched", "A", lease_duration=0.5, renew_period=0.05).start()
+    b = LeaderElector(store, "sched", "B", lease_duration=0.5, renew_period=0.05).start()
+    try:
+        assert a.wait_for_leadership(5) or b.wait_for_leadership(5)
+        time.sleep(0.3)
+        assert a.is_leader() != b.is_leader(), "split brain"
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_leader_failover_within_lease():
+    store = st.Store()
+    a = LeaderElector(store, "sched", "A", lease_duration=0.6, renew_period=0.05).start()
+    assert a.wait_for_leadership(5)
+    b = LeaderElector(store, "sched", "B", lease_duration=0.6, renew_period=0.05).start()
+    time.sleep(0.2)
+    assert not b.is_leader()
+    # leader dies WITHOUT releasing (hard crash): standby must take over
+    # within lease_duration + renew_period
+    a._stop.set()
+    a._thread.join(timeout=5)
+    t0 = time.monotonic()
+    assert b.wait_for_leadership(5)
+    took = time.monotonic() - t0
+    assert took <= 0.6 + 0.5, f"failover took {took:.2f}s"
+    b.stop()
+
+
+def test_leader_graceful_release_is_fast():
+    store = st.Store()
+    a = LeaderElector(store, "sched", "A", lease_duration=5.0, renew_period=0.05).start()
+    assert a.wait_for_leadership(5)
+    b = LeaderElector(store, "sched", "B", lease_duration=5.0, renew_period=0.05).start()
+    a.stop(release=True)  # zeroes renew_time
+    assert b.wait_for_leadership(2), "release did not hand over quickly"
+    b.stop()
+
+
+def test_lease_transitions_recorded():
+    store = st.Store()
+    a = LeaderElector(store, "s", "A", lease_duration=0.3, renew_period=0.05).start()
+    assert a.wait_for_leadership(5)
+    a.stop(release=True)
+    b = LeaderElector(store, "s", "B", lease_duration=0.3, renew_period=0.05).start()
+    assert b.wait_for_leadership(5)
+    lease = store.get("Lease", "s", "kube-system")
+    assert lease.spec.holder_identity == "B"
+    assert lease.spec.lease_transitions >= 1
+    b.stop()
+
+
+def test_two_schedulers_fail_over():
+    """VERDICT acceptance: two Scheduler instances; the standby takes
+    over within the lease period after the leader dies and schedules the
+    remaining pods."""
+    from kubernetes_tpu.scheduler import Scheduler
+
+    store = st.Store()
+    store.create(make_node("n0").capacity(cpu_milli=8000, mem=8 * GI, pods=20).obj())
+    el_a = LeaderElector(store, "kube-scheduler", "A",
+                         lease_duration=0.6, renew_period=0.05).start()
+    el_b = LeaderElector(store, "kube-scheduler", "B",
+                         lease_duration=0.6, renew_period=0.05).start()
+    sa = Scheduler(store, leader_elector=el_a)
+    sb = Scheduler(store, leader_elector=el_b)
+    for s in (sa, sb):
+        s.informers.informer("Node").start()
+        s.informers.informer("Pod").start()
+        assert s.informers.wait_for_sync(10)
+        s._thread = __import__("threading").Thread(target=s._run, daemon=True)
+        s._thread.start()
+    try:
+        assert el_a.wait_for_leadership(5)
+        store.create(make_pod("p1").req(cpu_milli=100).obj())
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not store.get("Pod", "p1").spec.node_name:
+            time.sleep(0.05)
+        assert store.get("Pod", "p1").spec.node_name == "n0"
+        # hard-kill the leader (loop + elector stop, no release)
+        sa._stop.set()
+        el_a._stop.set()
+        el_a._thread.join(timeout=5)
+        assert el_b.wait_for_leadership(5), "standby never took over"
+        store.create(make_pod("p2").req(cpu_milli=100).obj())
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not store.get("Pod", "p2").spec.node_name:
+            time.sleep(0.05)
+        assert store.get("Pod", "p2").spec.node_name == "n0"
+    finally:
+        sa.stop()
+        sb.stop()
+        el_a.stop()
+        el_b.stop()
+
+
+def test_journal_tolerates_torn_tail(tmp_path):
+    """A crash mid-append leaves a truncated last line; replay must stop
+    at the last good record and keep working (review finding)."""
+    path = str(tmp_path / "j.jsonl")
+    s1 = st.Store(journal_path=path)
+    s1.create(make_pod("a").obj())
+    s1.create(make_pod("b").obj())
+    with open(path, "a") as f:
+        f.write('{"op": "ADDED", "rv": 99, "kind": "Pod", "ke')  # torn
+    s2 = st.Store(journal_path=path)
+    assert {p.meta.name for p in s2.list("Pod")[0]} == {"a", "b"}
+    s2.create(make_pod("c").obj())  # appends continue cleanly
+    s3 = st.Store(journal_path=path)
+    assert {p.meta.name for p in s3.list("Pod")[0]} == {"a", "b", "c"}
+
+
+def test_journal_compaction_bounds_growth(tmp_path):
+    """Churny updates (lease renewals) must not grow the journal without
+    bound: compaction rewrites to one record per live object."""
+    path = str(tmp_path / "j.jsonl")
+    s = st.Store(journal_path=path)
+    lease = api.Lease(meta=api.ObjectMeta(name="l", namespace="kube-system"))
+    s.create(lease)
+    for _ in range(3000):
+        fresh = s.get("Lease", "l", "kube-system")
+        fresh.spec.renew_time += 1
+        s.update(fresh)
+    with open(path) as f:
+        lines = sum(1 for _ in f)
+    assert lines < 2000, f"journal grew to {lines} lines for 1 live object"
+    # state survives compaction
+    s2 = st.Store(journal_path=path)
+    assert s2.get("Lease", "l", "kube-system").spec.renew_time >= 2999
