@@ -539,6 +539,25 @@ class Deployment:
 
 
 @dataclass
+class LeaseSpec:
+    """coordination.k8s.io/v1 LeaseSpec — the leader-election record."""
+
+    holder_identity: str = ""
+    lease_duration_seconds: int = 15
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_transitions: int = 0
+
+
+@dataclass
+class Lease:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LeaseSpec = field(default_factory=LeaseSpec)
+
+    KIND = "Lease"
+
+
+@dataclass
 class JobSpec:
     parallelism: int = 1
     completions: Optional[int] = 1

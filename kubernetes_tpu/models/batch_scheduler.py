@@ -60,9 +60,17 @@ class TPUBatchScheduler:
         score_config: ScoreConfig = DEFAULT_SCORE_CONFIG,
         limits: Optional[schema.SnapshotLimits] = None,
         mode: str = "auto",  # auto | greedy | auction
+        state: Optional[schema.ClusterState] = None,
     ):
-        self.builder = schema.SnapshotBuilder(limits)
-        self.state = schema.ClusterState(self.builder)
+        if state is not None:
+            # shared-state instance: multiple scheduler PROFILES solve the
+            # same cluster with different score configs (profile.Map —
+            # one frameworkImpl per profile over one cache)
+            self.builder = state.builder
+            self.state = state
+        else:
+            self.builder = schema.SnapshotBuilder(limits)
+            self.state = schema.ClusterState(self.builder)
         self.score_config = score_config
         self.mode = mode
         self._greedy = assign_ops.greedy_assign_jit(score_config)

@@ -126,11 +126,26 @@ def features_of(snapshot: Snapshot) -> FeatureFlags:
     )
 
 
+# Failure-reason codes: the FIRST filter stage that emptied the pod's
+# candidate set.  The queue's event-scoped requeue (QueueingHints-lite)
+# keys off these — e.g. an AssignedPodDelete frees resources but cannot
+# fix a node-affinity mismatch, so REASON_STATIC pods stay parked
+# (internal/queue/events.go's event→plugin map, reduced to stages).
+REASON_NONE = -1      # placed
+REASON_STATIC = 0     # NodeName/affinity/taints/validity (+ bound ports)
+REASON_RESOURCES = 1  # NodeResourcesFit
+REASON_PORTS = 2      # in-batch host-port conflicts
+REASON_SPREAD = 3     # PodTopologySpread (hard)
+REASON_INTERPOD = 4   # InterPodAffinity (required)
+REASON_GANG = 5       # placed individually but released with its gang
+
+
 class SolveResult(NamedTuple):
     assignment: jnp.ndarray   # i32[P]: node index, or -1 unschedulable
     scores: jnp.ndarray       # f32[P]: winning node's score (-inf if none)
     feasible_counts: jnp.ndarray  # i32[P]: feasible nodes seen by each pod
     cluster: ClusterTensors   # post-solve cluster (assumed placements applied)
+    reasons: jnp.ndarray = None   # i32[P]: REASON_* for unplaced pods
 
 
 def class_statics(
@@ -246,19 +261,37 @@ def greedy_assign(
         cl = cluster._replace(requested=requested, nonzero_requested=nonzero)
         pod = pod_view(pods, i)
         cls = jnp.clip(pods.class_id[i], 0, c_dim - 1)
-        feas = sfeas_c[cls] & fits_resources(cl, pod)
+        s_static = sfeas_c[cls]
+        feas = s_static & fits_resources(cl, pod)
+        a_res = feas.any()
         if features.ports:
             feas = feas & ~((new_ports & pod.port_bits[None, :]).any(axis=-1))
+        a_ports = feas.any()
         sp = tm = None
         if features.spread:
             sp = sp0._replace(counts_node=sp_counts)
             feas = feas & spread_filter(sp, spread, i)
+        a_spread = feas.any()
         if features.interpod:
             tm = tm0._replace(
                 present_bits=tm_present, blocked_bits=tm_blocked, global_any=tm_global
             )
             feas = feas & interpod_filter(tm, terms, i)
         found = feas.any()
+        # first stage whose filter emptied the candidate set
+        reason = jnp.where(
+            found, REASON_NONE,
+            jnp.where(
+                ~s_static.any(), REASON_STATIC,
+                jnp.where(
+                    ~a_res, REASON_RESOURCES,
+                    jnp.where(
+                        ~a_ports, REASON_PORTS,
+                        jnp.where(~a_spread, REASON_SPREAD, REASON_INTERPOD),
+                    ),
+                ),
+            ),
+        ).astype(jnp.int32)
         sp_score = (
             spread_score(sp, spread, i, feas) if features.soft_spread else None
         )
@@ -290,7 +323,7 @@ def greedy_assign(
                 tm.present_bits, tm.blocked_bits, tm.global_any
             )
         out = (i, idx, jnp.where(found, masked[choice], NEG_INF),
-               feas.sum().astype(jnp.int32))
+               feas.sum().astype(jnp.int32), reason)
         carry = (requested, nonzero, new_ports, sp_counts, tm_present, tm_blocked, tm_global)
         return carry, out
 
@@ -304,13 +337,14 @@ def greedy_assign(
         tm0.blocked_bits if features.interpod else zero,
         tm0.global_any if features.interpod else zero,
     )
-    (requested, nonzero, new_ports, *_rest), (pod_is, assign_o, win_o, feas_o) = (
+    (requested, nonzero, new_ports, *_rest), (pod_is, assign_o, win_o, feas_o, reason_o) = (
         jax.lax.scan(step, init, jnp.arange(p))
     )
     # Scatter scan outputs (priority order) back to batch positions.
     assignment = jnp.full(p, -1, jnp.int32).at[pod_is].set(assign_o)
     win_scores = jnp.full(p, NEG_INF).at[pod_is].set(win_o)
     feas_counts = jnp.zeros(p, jnp.int32).at[pod_is].set(feas_o)
+    reasons = jnp.full(p, REASON_NONE, jnp.int32).at[pod_is].set(reason_o)
 
     # Gang post-pass: release every placement of a group with an unplaced
     # member (all-or-nothing), mirroring ops.auction's post-pass.  Only
@@ -330,6 +364,7 @@ def greedy_assign(
         nonzero = nonzero.at[nodes].add(-pods.nonzero_req * w)
         assignment = jnp.where(dropped, -1, assignment)
         win_scores = jnp.where(dropped, NEG_INF, win_scores)
+        reasons = jnp.where(dropped, REASON_GANG, reasons)
 
     final = cluster._replace(
         requested=requested,
@@ -337,7 +372,7 @@ def greedy_assign(
         port_bits=(cluster.port_bits | new_ports) if features.ports
         else cluster.port_bits,
     )
-    return SolveResult(assignment, win_scores, feas_counts, final)
+    return SolveResult(assignment, win_scores, feas_counts, final, reasons)
 
 
 def greedy_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):

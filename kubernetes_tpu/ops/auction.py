@@ -69,6 +69,13 @@ import numpy as np
 
 from .assign import (
     NEG_INF,
+    REASON_GANG,
+    REASON_INTERPOD,
+    REASON_NONE,
+    REASON_PORTS,
+    REASON_RESOURCES,
+    REASON_SPREAD,
+    REASON_STATIC,
     FeatureFlags,
     class_statics,
     features_of,
@@ -90,6 +97,7 @@ class AuctionResult(NamedTuple):
     rounds: jnp.ndarray       # i32[]: bidding rounds executed
     gang_dropped: jnp.ndarray  # bool[P]: placed but released with its gang
     cluster: ClusterTensors   # post-solve cluster
+    reasons: jnp.ndarray = None  # i32[P]: assign.REASON_* for unplaced pods
 
 
 def auction_features_ok(features: FeatureFlags) -> bool:
@@ -484,9 +492,54 @@ def auction_assign(
         tm0.blocked_bits if features.interpod else zero,
         tm0.global_any if features.interpod else zero,
     )
-    (assigned, bid_scores, requested, nonzero, rounds, _, *_rest) = (
+    (assigned, bid_scores, requested, nonzero, rounds, _,
+     sp_counts_f, tm_present_f, tm_blocked_f, tm_global_f) = (
         jax.lax.while_loop(cond, body, init)
     )
+
+    # Failure reasons for unplaced pods (QueueingHints-lite): one staged
+    # [C, N] filter pass against the FINAL state per class — the first
+    # stage that empties the candidate set; a pod with survivors at every
+    # stage parked on capacity contention/max_rounds, which requeues like
+    # a resource failure.
+    cl_f = cluster._replace(requested=requested, nonzero_requested=nonzero)
+    sp_f = sp0._replace(counts_node=sp_counts_f) if features.spread else None
+    tm_f = (
+        tm0._replace(
+            present_bits=tm_present_f, blocked_bits=tm_blocked_f,
+            global_any=tm_global_f,
+        )
+        if features.interpod
+        else None
+    )
+
+    def class_reason(c, rep):
+        pod = pod_view(pods, rep)
+        s_static = sfeas_c[c]
+        f = s_static & fits_resources(cl_f, pod)
+        a_res = f.any()
+        if features.spread:
+            f = f & spread_filter(sp_f, spread, rep)
+        a_spread = f.any()
+        if features.interpod:
+            f = f & interpod_filter(tm_f, terms, rep)
+        a_inter = f.any()
+        return jnp.where(
+            a_inter, REASON_RESOURCES,  # feasible yet unplaced: contention
+            jnp.where(
+                ~s_static.any(), REASON_STATIC,
+                jnp.where(
+                    ~a_res, REASON_RESOURCES,
+                    jnp.where(~a_spread, REASON_SPREAD, REASON_INTERPOD),
+                ),
+            ),
+        ).astype(jnp.int32)
+
+    reason_c = jax.vmap(class_reason)(
+        jnp.arange(c_dim, dtype=jnp.int32), reps
+    )
+    cls_all = jnp.clip(pods.class_id, 0, c_dim - 1)
+    reasons = jnp.where(assigned >= 0, REASON_NONE, reason_c[cls_all])
 
     # Gang post-pass: all-or-nothing groups.
     gang_dropped = jnp.zeros(p, bool)
@@ -503,9 +556,12 @@ def auction_assign(
         nonzero = nonzero.at[nodes].add(-pods.nonzero_req * w)
         assigned = jnp.where(gang_dropped, -1, assigned)
         bid_scores = jnp.where(gang_dropped, NEG_INF, bid_scores)
+        reasons = jnp.where(gang_dropped, REASON_GANG, reasons)
 
     final = cluster._replace(requested=requested, nonzero_requested=nonzero)
-    return AuctionResult(assigned, bid_scores, rounds, gang_dropped, final)
+    return AuctionResult(
+        assigned, bid_scores, rounds, gang_dropped, final, reasons
+    )
 
 
 _ = num_groups  # canonical definition lives in ops.schema (re-exported here)

@@ -1,0 +1,108 @@
+"""The versioned scheduler configuration API.
+
+Reference: KubeSchedulerConfiguration (apis/config/types.go:37-100) —
+profiles with per-plugin weights/enablement, backoff bounds, parallelism
+and percentageOfNodesToScore — with defaulting and validation
+(apis/config/{v1,validation}).  Mapped onto the TPU design:
+
+  * score-plugin weights/disables become the profile's ScoreConfig (a
+    disabled score plugin is weight 0 — kernels read weights directly);
+  * FILTER plugins cannot be individually disabled: the filter chain is
+    one fused kernel, and validation rejects the attempt rather than
+    silently ignoring it;
+  * parallelism (goroutine fan-out, types.go:48) and
+    percentageOfNodesToScore (adaptive sampling) have no TPU meaning —
+    one dispatch filters and scores every node (SURVEY §2.7).  They are
+    accepted for config-file parity and validated, nothing more.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import List, Optional, Tuple
+
+from ..ops.schema import SnapshotLimits
+from ..ops.scores import DEFAULT_SCORE_CONFIG, ScoreConfig
+
+# Score plugins that map onto ScoreConfig weights (names/names.go:20-43).
+SCORE_PLUGIN_WEIGHTS = {
+    "NodeResourcesFit": "fit_weight",
+    "NodeResourcesBalancedAllocation": "balanced_weight",
+    "NodeAffinity": "node_affinity_weight",
+    "TaintToleration": "taint_weight",
+    "PodTopologySpread": "spread_weight",
+}
+
+
+@dataclass
+class ProfileConfig:
+    """One scheduler profile (apis/config KubeSchedulerProfile)."""
+
+    scheduler_name: str = "default-scheduler"
+    score_config: ScoreConfig = field(default_factory=lambda: DEFAULT_SCORE_CONFIG)
+    disabled_score_plugins: Tuple[str, ...] = ()
+
+    def effective_score_config(self) -> ScoreConfig:
+        cfg = self.score_config
+        for name in self.disabled_score_plugins:
+            cfg = replace(cfg, **{SCORE_PLUGIN_WEIGHTS[name]: 0.0})
+        return cfg
+
+
+@dataclass
+class SchedulerConfiguration:
+    profiles: List[ProfileConfig] = field(
+        default_factory=lambda: [ProfileConfig()]
+    )
+    batch_size: int = 4096
+    pod_initial_backoff_seconds: float = 1.0
+    pod_max_backoff_seconds: float = 10.0
+    assume_ttl_seconds: float = 30.0
+    unschedulable_flush_seconds: float = 300.0
+    max_preemptions_per_cycle: int = 16
+    # parity-only knobs (see module docstring)
+    parallelism: int = 16
+    percentage_of_nodes_to_score: int = 100
+    limits: Optional[SnapshotLimits] = None
+
+    def validate(self) -> "SchedulerConfiguration":
+        """Raise ValueError on an invalid configuration (the
+        apis/config/validation analogue); returns self for chaining."""
+        if not self.profiles:
+            raise ValueError("at least one profile is required")
+        names = [p.scheduler_name for p in self.profiles]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate profile schedulerName in {names}")
+        for p in self.profiles:
+            for plugin in p.disabled_score_plugins:
+                if plugin not in SCORE_PLUGIN_WEIGHTS:
+                    raise ValueError(
+                        f"unknown or non-disableable score plugin {plugin!r} "
+                        f"(filter plugins are fused; known: "
+                        f"{sorted(SCORE_PLUGIN_WEIGHTS)})"
+                    )
+            cfg = p.score_config
+            for f_name in (
+                "fit_weight", "balanced_weight", "node_affinity_weight",
+                "taint_weight", "spread_weight",
+            ):
+                if getattr(cfg, f_name) < 0:
+                    raise ValueError(f"{p.scheduler_name}: {f_name} < 0")
+            if cfg.fit_strategy not in ("LeastAllocated", "MostAllocated"):
+                raise ValueError(
+                    f"{p.scheduler_name}: unknown fit_strategy "
+                    f"{cfg.fit_strategy!r}"
+                )
+        if self.batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        if self.pod_initial_backoff_seconds <= 0:
+            raise ValueError("pod_initial_backoff_seconds must be positive")
+        if self.pod_max_backoff_seconds < self.pod_initial_backoff_seconds:
+            raise ValueError(
+                "pod_max_backoff_seconds < pod_initial_backoff_seconds"
+            )
+        if not (0 <= self.percentage_of_nodes_to_score <= 100):
+            raise ValueError("percentage_of_nodes_to_score must be 0..100")
+        if self.max_preemptions_per_cycle < 0:
+            raise ValueError("max_preemptions_per_cycle must be >= 0")
+        return self

@@ -1,0 +1,130 @@
+"""The scheduling framework seam: extension points + profiles.
+
+Reference: the 11-point plugin API (framework/interface.go:330-666) and
+profile.Map (profile/profile.go:46).  The TPU redesign keeps the
+HOST-side extension points as ordered plugin lists — out-of-tree code
+registers plain callables — while the device-side points (PreFilter/
+Filter/Score/Normalize) are the fused kernels, configured per profile
+through ScoreConfig rather than per-plugin chains (you cannot insert a
+Python callback into the middle of one XLA dispatch; that coupling is
+the design).
+
+Extension points exposed here and where they run:
+
+  pre_enqueue(pod) -> Optional[str]   gate a pod out of the queue with a
+                                      reason (SchedulingGates built in)
+  post_filter(pod) -> Optional[str]   after a failed cycle; returns a
+                                      nominated node (preemption default)
+  pre_bind(pod, node) -> None         before the API bind; raise to abort
+                                      (volume-attach analogue)
+  post_bind(pod, node) -> None        fire-and-forget after bind
+  filter_result(pod, node) -> node    final veto/override hook on a
+                                      placement before assume (the
+                                      extender call-site analogue)
+
+A Framework belongs to one profile; FrameworkRegistry maps
+pod.spec.scheduler_name -> Framework (frameworkForPod, scheduler.go:358
+— pods naming an unknown scheduler are not ours to schedule).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ..api import types as api
+from ..models.batch_scheduler import TPUBatchScheduler
+from ..ops import schema
+from .config import ProfileConfig, SchedulerConfiguration
+
+
+class Framework:
+    """One profile's runtime: its jitted solvers + host extension points."""
+
+    def __init__(self, profile: ProfileConfig, tpu: TPUBatchScheduler):
+        self.profile = profile
+        self.tpu = tpu
+        self.pre_enqueue: List[Callable[[api.Pod], Optional[str]]] = []
+        self.post_filter: List[Callable[[api.Pod], Optional[str]]] = []
+        self.pre_bind: List[Callable[[api.Pod, str], None]] = []
+        self.post_bind: List[Callable[[api.Pod, str], None]] = []
+        self.filter_result: List[Callable[[api.Pod, str], Optional[str]]] = []
+
+    @property
+    def scheduler_name(self) -> str:
+        return self.profile.scheduler_name
+
+    def register(self, point: str, fn: Callable) -> None:
+        """Out-of-tree plugin registration (the merge at scheduler.go:
+        278-281): `point` names one of the host extension lists."""
+        getattr(self, point).append(fn)
+
+    # -- runners -----------------------------------------------------------
+
+    def run_pre_enqueue(self, pod: api.Pod) -> Optional[str]:
+        for fn in self.pre_enqueue:
+            reason = fn(pod)
+            if reason:
+                return reason
+        return None
+
+    def run_post_filter(self, pod: api.Pod) -> Optional[str]:
+        for fn in self.post_filter:
+            nominated = fn(pod)
+            if nominated:
+                return nominated
+        return None
+
+    def run_pre_bind(self, pod: api.Pod, node: str) -> None:
+        for fn in self.pre_bind:
+            fn(pod, node)  # raising aborts the bind (reference semantics)
+
+    def run_post_bind(self, pod: api.Pod, node: str) -> None:
+        for fn in self.post_bind:
+            try:
+                fn(pod, node)
+            except Exception:
+                pass  # PostBind is informational (interface.go:624)
+
+    def run_filter_result(self, pod: api.Pod, node: str) -> Optional[str]:
+        for fn in self.filter_result:
+            node = fn(pod, node)
+            if node is None:
+                return None
+        return node
+
+
+class FrameworkRegistry:
+    """profile.Map: scheduler_name -> Framework, all profiles sharing ONE
+    cluster state (the reference shares one cache across profiles)."""
+
+    def __init__(
+        self,
+        config: SchedulerConfiguration,
+        state: Optional[schema.ClusterState] = None,
+    ):
+        config.validate()
+        self.config = config
+        first: Optional[TPUBatchScheduler] = None
+        self.frameworks: Dict[str, Framework] = {}
+        for profile in config.profiles:
+            tpu = TPUBatchScheduler(
+                score_config=profile.effective_score_config(),
+                limits=config.limits if first is None else None,
+                state=first.state if first is not None else state,
+            )
+            if first is None:
+                first = tpu
+            self.frameworks[profile.scheduler_name] = Framework(profile, tpu)
+        self.default = next(iter(self.frameworks.values()))
+
+    @property
+    def state(self) -> schema.ClusterState:
+        return self.default.tpu.state
+
+    def for_pod(self, pod: api.Pod) -> Optional[Framework]:
+        """frameworkForPod: None means the pod names another scheduler
+        and is not ours (scheduler.go:358-367 skipPodSchedule)."""
+        return self.frameworks.get(pod.spec.scheduler_name)
+
+    def __iter__(self):
+        return iter(self.frameworks.values())

@@ -32,6 +32,30 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ..api import types as api
+from ..ops import assign as assign_ops
+
+# Event → wake-set (QueueingHints-lite, internal/queue/events.go:25-89
+# reduced to the solver's failure stages).  None = wake every reason.
+# The payoff: pod churn (AssignedPodDelete at heartbeat rates) never
+# wakes pods that failed on node affinity/taints — freeing resources
+# cannot fix a static mismatch.
+EVENT_WAKES = {
+    "NodeAdd": None,
+    "NodeUpdate": None,  # labels/taints/capacity can change any stage
+    "NodeDelete": None,  # evicted pods re-enter; survivors re-place
+    "AssignedPodDelete": {
+        assign_ops.REASON_RESOURCES,
+        assign_ops.REASON_PORTS,
+        assign_ops.REASON_SPREAD,
+        assign_ops.REASON_INTERPOD,
+        assign_ops.REASON_GANG,
+    },
+    # adding a pod can satisfy AFFINITY-direction inter-pod terms AND
+    # raise a spread constraint's global minimum (a new match in the
+    # min-count domain lifts every other domain's cap)
+    "AssignedPodAdd": {assign_ops.REASON_INTERPOD, assign_ops.REASON_SPREAD},
+    "AssignedPodUpdate": {assign_ops.REASON_INTERPOD, assign_ops.REASON_SPREAD},
+}
 
 
 def pod_key(pod: api.Pod) -> str:
@@ -48,6 +72,8 @@ class QueuedPodInfo:
     initial_attempt_timestamp: float = 0.0
     unschedulable_since: float = 0.0
     gated: bool = False
+    # assign.REASON_* from the failing solve; -1 = unknown (always woken)
+    unschedulable_reason: int = -1
 
 
 class SchedulingQueue:
@@ -341,14 +367,19 @@ class SchedulingQueue:
             # a departing member can unblock a skipped gang in pop_batch
             self._cond.notify_all()
 
-    def add_unschedulable(self, info: QueuedPodInfo) -> None:
+    def add_unschedulable(
+        self, info: QueuedPodInfo, reason: int = -1
+    ) -> None:
         """A cycle failed to place the pod: park it until an event or the
-        flush interval (AddUnschedulableIfNotPresent)."""
+        flush interval (AddUnschedulableIfNotPresent).  `reason` is the
+        solver's failure stage — events wake only plausibly-affected
+        pods (move_for_event)."""
         with self._cond:
             key = pod_key(info.pod)
             if key not in self._infos:
                 return  # deleted meanwhile
             info.unschedulable_since = self._clock()
+            info.unschedulable_reason = reason
             self._unschedulable[key] = info
             self._tier[key] = "unsched"
 
@@ -364,14 +395,31 @@ class SchedulingQueue:
         """A cluster event may have made unschedulable pods schedulable:
         move them to backoff (still inside their backoff window) or
         active (MoveAllToActiveOrBackoffQueue, scheduling_queue.go:117)."""
+        self.move_for_event(None)
+
+    def move_for_event(self, event: Optional[str]) -> int:
+        """Event-scoped requeue: wake only pods whose recorded failure
+        reason the event can plausibly fix (EVENT_WAKES; unknown events
+        or reasons wake everything).  Returns the number woken — the
+        churn benchmark asserts this stays bounded."""
+        wakes = EVENT_WAKES.get(event) if event is not None else None
+        moved = 0
         with self._cond:
             now = self._clock()
             for key, info in list(self._unschedulable.items()):
+                if (
+                    wakes is not None
+                    and info.unschedulable_reason >= 0
+                    and info.unschedulable_reason not in wakes
+                ):
+                    continue
                 self._unschedulable.pop(key)
+                moved += 1
                 if now < info.unschedulable_since + self._backoff_duration(info):
                     self._push_backoff(info)
                 else:
                     self._push_active(info)
+        return moved
 
     # -- introspection -----------------------------------------------------
 

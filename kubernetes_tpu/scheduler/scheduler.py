@@ -27,11 +27,15 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from ..api import store as st
 from ..api import types as api
 from ..client.informers import InformerFactory
 from ..models.batch_scheduler import TPUBatchScheduler
 from .cache import SchedulerCache
+from .config import SchedulerConfiguration
+from .framework import Framework, FrameworkRegistry
 from .metrics import Registry
 from .preemption import PreemptionEvaluator
 from .queue import QueuedPodInfo, SchedulingQueue, pod_key
@@ -41,16 +45,34 @@ class Scheduler:
     def __init__(
         self,
         store: st.Store,
-        batch_size: int = 4096,
+        batch_size: Optional[int] = None,
         tpu: Optional[TPUBatchScheduler] = None,
-        assume_ttl: float = 30.0,
+        assume_ttl: Optional[float] = None,
         clock=time.monotonic,
+        leader_elector=None,
+        config: Optional[SchedulerConfiguration] = None,
     ):
         self.store = store
-        self.batch_size = batch_size
-        self.tpu = tpu or TPUBatchScheduler()
-        self.cache = SchedulerCache(self.tpu.state, ttl=assume_ttl, clock=clock)
-        self.queue = SchedulingQueue(clock=clock)
+        self.config = (config or SchedulerConfiguration()).validate()
+        self.batch_size = batch_size or self.config.batch_size
+        # profiles: scheduler_name -> Framework, one shared cluster state
+        # (profile/profile.go:46; explicit `tpu` keeps the single-profile
+        # constructor shape tests/benches use)
+        self.profiles = FrameworkRegistry(
+            self.config, state=tpu.state if tpu else None
+        )
+        self.tpu = tpu or self.profiles.default.tpu
+        self.cache = SchedulerCache(
+            self.tpu.state,
+            ttl=assume_ttl or self.config.assume_ttl_seconds,
+            clock=clock,
+        )
+        self.queue = SchedulingQueue(
+            backoff_base=self.config.pod_initial_backoff_seconds,
+            backoff_max=self.config.pod_max_backoff_seconds,
+            unschedulable_flush_after=self.config.unschedulable_flush_seconds,
+            clock=clock,
+        )
         self.metrics = Registry()
         self.preemption = PreemptionEvaluator(
             self.tpu, self.cache, store, self.metrics
@@ -58,8 +80,16 @@ class Scheduler:
         # PostFilter budget per cycle: preemption is the exceptional path;
         # cap the per-batch dry-run work so a mass of unschedulable pods
         # can't stall the hot loop.
-        self.max_preemptions_per_cycle = 16
+        self.max_preemptions_per_cycle = self.config.max_preemptions_per_cycle
+        # default PostFilter plugin on every profile: preemption
+        for fwk in self.profiles:
+            fwk.post_filter.append(self._preempt_plugin)
         self.informers = InformerFactory(store)
+        # Optional client.leaderelection.LeaderElector: when set, the hot
+        # loop only schedules while leading (app/server.go:170-180 —
+        # replicated schedulers, single active) — standbys keep informers
+        # warm so takeover is immediate.
+        self.leader_elector = leader_elector
         self._clock = clock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -74,10 +104,10 @@ class Scheduler:
     def _on_node(self, typ: str, node: api.Node, old) -> None:
         if typ == st.ADDED:
             self.cache.add_node(node)
-            self.queue.move_all_to_active_or_backoff("NodeAdd")
+            self.queue.move_for_event("NodeAdd")
         elif typ == st.MODIFIED:
             self.cache.update_node(node)
-            self.queue.move_all_to_active_or_backoff("NodeUpdate")
+            self.queue.move_for_event("NodeUpdate")
         elif typ == st.DELETED:
             self.cache.remove_node(node.meta.name)
 
@@ -87,8 +117,9 @@ class Scheduler:
             if assigned:
                 self.cache.remove_pod(pod)
                 # a terminated pod frees resources: unschedulable pods
-                # may fit now (AssignedPodDelete cluster event)
-                self.queue.move_all_to_active_or_backoff("AssignedPodDelete")
+                # may fit now — but only resource/port/spread/interpod
+                # failures can benefit (AssignedPodDelete wake set)
+                self.queue.move_for_event("AssignedPodDelete")
             else:
                 self.queue.delete(pod)
                 self.cache.remove_nomination(pod)
@@ -105,8 +136,21 @@ class Scheduler:
                 # already-bound pod changed (in-place resize, label edit):
                 # re-account so requested rows track the new spec
                 self.cache.update_pod(old, pod)
+                self.queue.move_for_event("AssignedPodUpdate")
             else:
                 self.cache.add_pod(pod)
+                # a newly bound pod can satisfy waiting affinity/spread
+                # constraints (AssignedPodAdd cluster event)
+                self.queue.move_for_event("AssignedPodAdd")
+            return
+        if self.profiles.for_pod(pod) is None:
+            return  # another scheduler's pod (skipPodSchedule)
+        fwk = self.profiles.for_pod(pod)
+        reason = fwk.run_pre_enqueue(pod)
+        if reason:
+            # PreEnqueue rejection: stay out of the queue until the next
+            # pod UPDATE re-runs the gate (schedulinggates semantics)
+            self.queue.delete(pod)
             return
         if typ == st.ADDED:
             self.queue.add(pod)
@@ -137,6 +181,9 @@ class Scheduler:
 
     def _run(self) -> None:
         while not self._stop.is_set():
+            if self.leader_elector and not self.leader_elector.is_leader():
+                time.sleep(0.05)
+                continue
             self.schedule_batch(timeout=0.2)
             for pod in self.cache.cleanup_expired():
                 # binding never confirmed: give the pod another chance
@@ -161,28 +208,78 @@ class Scheduler:
         reservations = self.cache.nominations_excluding(
             {pod_key(info.pod) for info in batch}
         )
-        try:
-            names = self.tpu.schedule_pending(
-                [info.pod for info in batch], lock=self.cache.lock,
-                reservations=reservations,
-            )
-        except (OverflowError, ValueError):
-            batch = self._reject_unencodable(batch)
-            if not batch:
-                return stats
-            names = self.tpu.schedule_pending(
-                [info.pod for info in batch], lock=self.cache.lock,
-                reservations=reservations,
-            )
+        # Group the popped batch by profile.  Each group runs its FULL
+        # cycle (solve -> assume -> bind) before the next group solves:
+        # assume lands the placements in the shared state, so a later
+        # profile's snapshot sees them — solving all groups first would
+        # double-book capacity across profiles.
+        by_fwk: Dict[str, List[QueuedPodInfo]] = {}
+        for info in batch:
+            by_fwk.setdefault(info.pod.spec.scheduler_name, []).append(info)
+        failed: List[QueuedPodInfo] = []
+        solved_any = False
+        for sched_name, group in by_fwk.items():
+            fwk = self.profiles.frameworks.get(sched_name)
+            if fwk is None:
+                continue  # another scheduler's pod slipped in; drop
+            try:
+                names = fwk.tpu.schedule_pending(
+                    [info.pod for info in group], lock=self.cache.lock,
+                    reservations=reservations,
+                )
+            except (OverflowError, ValueError):
+                group = self._reject_unencodable(group)
+                if not group:
+                    continue
+                names = fwk.tpu.schedule_pending(
+                    [info.pod for info in group], lock=self.cache.lock,
+                    reservations=reservations,
+                )
+            solved_any = True
+            result = fwk.tpu.last_result
+            if result is not None and result.reasons is not None:
+                reasons = [int(r) for r in np.asarray(result.reasons)[: len(group)]]
+            else:
+                reasons = [-1] * len(group)
+            self._commit_group(fwk, group, names, reasons, stats, failed)
+        if not solved_any:
+            return stats
         self.metrics.scheduling_algorithm_duration.observe(self._clock() - t0)
 
-        failed: List[QueuedPodInfo] = []
-        for info, node_name in zip(batch, names):
+        # PostFilter: preemption for unschedulable pods, highest priority
+        # first (handleSchedulingFailure -> Evaluator.Preempt,
+        # schedule_one.go:1017, preemption.go:150).  Victim deletes emit
+        # AssignedPodDelete events that requeue the nominee.
+        failed.sort(key=lambda i: -i.pod.spec.priority)
+        for info in failed[: self.max_preemptions_per_cycle]:
+            fwk = self.profiles.for_pod(info.pod)
+            if fwk is not None and fwk.run_post_filter(info.pod):
+                stats["preempted"] = stats.get("preempted", 0) + 1
+
+        qs = self.queue.stats()
+        for tier, v in qs.items():
+            self.metrics.pending_pods.set(v, tier)
+        return stats
+
+    def _commit_group(
+        self,
+        fwk: Framework,
+        group: List[QueuedPodInfo],
+        names: List[Optional[str]],
+        reasons: List[int],
+        stats: Dict[str, int],
+        failed: List[QueuedPodInfo],
+    ) -> None:
+        """Assume + bind one profile's placements (the per-pod tail of
+        ScheduleOne, schedule_one.go:118-133 batched)."""
+        for i, (info, node_name) in enumerate(zip(group, names)):
             t_attempt = self._clock()
+            if node_name is not None:
+                node_name = fwk.run_filter_result(info.pod, node_name)
             if node_name is None:
                 stats["unschedulable"] += 1
                 self.metrics.schedule_attempts.inc("unschedulable")
-                self.queue.add_unschedulable(info)
+                self.queue.add_unschedulable(info, reason=reasons[i])
                 failed.append(info)
                 continue
             try:
@@ -193,6 +290,7 @@ class Scheduler:
                 self.queue.requeue_backoff(info)
                 continue
             try:
+                fwk.run_pre_bind(info.pod, node_name)
                 self._bind(info.pod, node_name)
             except Exception:
                 self.cache.forget(info.pod)
@@ -200,6 +298,7 @@ class Scheduler:
                 self.metrics.schedule_attempts.inc("error")
                 self.queue.requeue_backoff(info)
                 continue
+            fwk.run_post_bind(info.pod, node_name)
             self.cache.finish_binding(info.pod)
             self.queue.done(info.pod)
             stats["scheduled"] += 1
@@ -211,21 +310,13 @@ class Scheduler:
                 self._clock() - info.initial_attempt_timestamp
             )
 
-        # PostFilter: preemption for unschedulable pods, highest priority
-        # first (handleSchedulingFailure -> Evaluator.Preempt,
-        # schedule_one.go:1017, preemption.go:150).  Victim deletes emit
-        # AssignedPodDelete events that requeue the nominee.
-        failed.sort(key=lambda i: -i.pod.spec.priority)
-        for info in failed[: self.max_preemptions_per_cycle]:
-            if self.preemption.eligible(info.pod):
-                result = self.preemption.preempt(info.pod)
-                if result is not None:
-                    stats["preempted"] = stats.get("preempted", 0) + 1
-
-        qs = self.queue.stats()
-        for tier, v in qs.items():
-            self.metrics.pending_pods.set(v, tier)
-        return stats
+    def _preempt_plugin(self, pod: api.Pod) -> Optional[str]:
+        """The DefaultPreemption PostFilter plugin (registered on every
+        profile; replaceable/augmentable via Framework.register)."""
+        if not self.preemption.eligible(pod):
+            return None
+        result = self.preemption.preempt(pod)
+        return result.nominated_node if result else None
 
     def _reject_unencodable(self, batch: List[QueuedPodInfo]) -> List[QueuedPodInfo]:
         """Batch encode failed: find the offending pods by encoding each
