@@ -539,6 +539,35 @@ class Deployment:
 
 
 @dataclass
+class ObjectReference:
+    """core/v1 ObjectReference — the involved object of an Event."""
+
+    kind: str = ""
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+
+
+@dataclass
+class Event:
+    """core/v1 Event, the slice the scheduler's EventRecorder emits
+    (schedule_one.go:1003 Eventf; aggregated by count like
+    client-go's correlator)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_object: ObjectReference = field(default_factory=ObjectReference)
+    reason: str = ""
+    message: str = ""
+    type: str = "Normal"          # Normal | Warning
+    count: int = 1
+    first_timestamp: float = 0.0
+    last_timestamp: float = 0.0
+    source_component: str = ""
+
+    KIND = "Event"
+
+
+@dataclass
 class LeaseSpec:
     """coordination.k8s.io/v1 LeaseSpec — the leader-election record."""
 

@@ -1,0 +1,90 @@
+"""EventRecorder: the record/events broadcaster reduced to direct store
+writes with client-go-style aggregation.
+
+Reference: client-go tools/record (EventBroadcaster/EventRecorder) and
+the scheduler's call sites (fwk.EventRecorder().Eventf,
+schedule_one.go:1003,1094).  Repeats of the same (object, reason,
+message) bump `count` on one Event object instead of flooding the store
+— the events correlator's aggregation behaviour.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from ..api import store as st
+from ..api import types as api
+
+
+class EventRecorder:
+    def __init__(
+        self,
+        store: st.Store,
+        component: str = "default-scheduler",
+        ttl: float = 3600.0,
+        clock=time.time,
+    ):
+        self.store = store
+        self.component = component
+        # the reference apiserver bounds Events with a TTL (default 1h,
+        # --event-ttl); without expiry a long-running scheduler grows the
+        # store (and journal compactions) without bound
+        self.ttl = ttl
+        self._clock = clock
+        self._writes = 0
+
+    def eventf(
+        self, obj: Any, event_type: str, reason: str, message: str
+    ) -> None:
+        """Record one event for obj; never raises into the caller (events
+        are best-effort observability, not control flow)."""
+        try:
+            self._record(obj, event_type, reason, message)
+        except Exception:
+            pass
+
+    def _record(self, obj: Any, event_type: str, reason: str, message: str) -> None:
+        meta = obj.meta
+        name = f"{meta.name}.{reason.lower()}"
+        now = self._clock()
+        self._writes += 1
+        if self._writes % 256 == 0:
+            self._expire(now)
+        try:
+            ev = self.store.get("Event", name, meta.namespace)
+            if ev.message == message and ev.type == event_type:
+                ev.count += 1
+                ev.last_timestamp = now
+                self.store.update(ev, force=True)
+                return
+            self.store.delete("Event", name, meta.namespace)
+        except KeyError:
+            pass
+        self.store.create(
+            api.Event(
+                meta=api.ObjectMeta(name=name, namespace=meta.namespace),
+                involved_object=api.ObjectReference(
+                    kind=getattr(obj, "KIND", ""),
+                    name=meta.name,
+                    namespace=meta.namespace,
+                    uid=meta.uid,
+                ),
+                reason=reason,
+                message=message,
+                type=event_type,
+                first_timestamp=now,
+                last_timestamp=now,
+                source_component=self.component,
+            )
+        )
+
+    def _expire(self, now: float) -> None:
+        """Drop events past the TTL (the --event-ttl sweep)."""
+        events, _ = self.store.list("Event")
+        for ev in events:
+            if now - ev.last_timestamp > self.ttl:
+                try:
+                    self.store.delete("Event", ev.meta.name, ev.meta.namespace)
+                except KeyError:
+                    pass

@@ -1,0 +1,125 @@
+"""Hollow nodes: control-plane scale simulation without real kubelets.
+
+Reference: pkg/kubemark/hollow_kubelet.go:63-87 — a real kubelet loop
+against a no-op runtime, used to exercise 5k-node control planes.  Ours
+registers Node objects, heartbeats them through the API (MODIFIED events
+— the NodeUpdate churn a real cluster produces), and plays the kubelet
+status half: bound pods transition to Running, so Jobs and controllers
+see lifecycle progress.
+
+This drives the FULL store/informer/queue path — the thing the solver
+bench can't see (VERDICT missing #10)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from .api import store as st
+from .api import types as api
+from .testing.wrappers import GI, make_node
+
+
+class HollowCluster:
+    def __init__(
+        self,
+        store: st.Store,
+        n_nodes: int,
+        zones: int = 8,
+        cpu_milli: int = 32000,
+        mem: int = 64 * GI,
+        pods_cap: int = 110,
+        heartbeat_interval: float = 10.0,
+        run_pods: bool = True,
+    ):
+        self.store = store
+        self.n_nodes = n_nodes
+        self.heartbeat_interval = heartbeat_interval
+        self.run_pods = run_pods
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self.node_names = [f"hollow-{i}" for i in range(n_nodes)]
+        self._specs = [
+            make_node(name)
+            .capacity(cpu_milli=cpu_milli, mem=mem, pods=pods_cap)
+            .zone(f"zone-{i % zones}")
+            .obj()
+            for i, name in enumerate(self.node_names)
+        ]
+
+    def register(self) -> None:
+        """Create every Node through the API (the kubelet registration)."""
+        for node in self._specs:
+            try:
+                self.store.create(node)
+            except st.AlreadyExists:
+                pass
+
+    def start(self) -> "HollowCluster":
+        self.register()
+        t = threading.Thread(
+            target=self._heartbeat_loop, name="hollow-heartbeat", daemon=True
+        )
+        t.start()
+        self._threads.append(t)
+        if self.run_pods:
+            t = threading.Thread(
+                target=self._pod_runner, name="hollow-pod-runner", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    # -- loops -------------------------------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        """Round-robin status heartbeats (nodeStatusUpdateFrequency):
+        each tick re-writes one batch of Node objects so the control
+        plane sees steady NodeUpdate churn like a real cluster."""
+        i = 0
+        per_tick = max(1, self.n_nodes // 10)
+        tick = self.heartbeat_interval / 10
+        while not self._stop.wait(tick):
+            for _ in range(per_tick):
+                name = self.node_names[i % self.n_nodes]
+                i += 1
+                try:
+                    node = self.store.get("Node", name, namespace="")
+                    node.meta.annotations["hollow/heartbeat"] = str(time.time())
+                    self.store.update(node, force=True)
+                except st.NotFound:
+                    pass
+
+    def _pod_runner(self) -> None:
+        """The kubelet status half: bound Pending pods become Running
+        (status written through the API, like status manager PATCHes)."""
+        w = self.store.watch("Pod")
+        try:
+            while not self._stop.is_set():
+                ev = w.get(timeout=0.2)
+                if ev is None:
+                    continue
+                pod = ev.obj
+                if (
+                    ev.type in (st.ADDED, st.MODIFIED)
+                    and pod.spec.node_name
+                    and pod.spec.node_name.startswith("hollow-")
+                    and pod.status.phase == "Pending"
+                ):
+                    try:
+                        fresh = self.store.get(
+                            "Pod", pod.meta.name, pod.meta.namespace
+                        )
+                        if fresh.status.phase == "Pending" and fresh.spec.node_name:
+                            fresh.status.phase = "Running"
+                            self.store.update(fresh, force=True)
+                    except st.NotFound:
+                        pass
+        finally:
+            w.stop()

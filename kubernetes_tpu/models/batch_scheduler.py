@@ -136,7 +136,7 @@ class TPUBatchScheduler:
         corrupts spread/inter-pod state, so it is validated (when those
         families are active — it is unused otherwise)."""
         features = assign_ops.features_of(snap)
-        if features.spread or features.interpod:
+        if assign_ops.needs_topo(features):
             required = assign_ops.required_topo_z(snap)
             if topo_z is None:
                 topo_z = required
@@ -165,9 +165,7 @@ class TPUBatchScheduler:
                 n_groups=n_groups, tie_k=meta.tie_k,
             )
         topo_z = (
-            max(topo_split)
-            if (features.spread or features.interpod)
-            else 1
+            max(topo_split) if assign_ops.needs_topo(features) else 1
         )
         return self._greedy(snap, topo_z, features, n_groups=n_groups)
 

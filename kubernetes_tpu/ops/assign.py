@@ -74,6 +74,7 @@ class FeatureFlags(NamedTuple):
                                # the joint auction covers anti-affinity
                                # only, so this gates its routing)
     spread_slots: Tuple[int, ...] = ()  # topology-key slots spread rows use
+    interpod_pref: bool = False  # any preferred (scoring) interpod terms
 
 
 def required_topo_z(snapshot: Snapshot) -> int:
@@ -104,7 +105,17 @@ def required_topo_z_split(snapshot: Snapshot) -> Tuple[int, int]:
     spread_slots = set(np.asarray(snapshot.spread.slot)[spread_valid].tolist())
     term_valid = np.asarray(snapshot.terms.valid)
     term_slots = set(np.asarray(snapshot.terms.slot)[term_valid].tolist())
+    pref_valid = np.asarray(snapshot.prefpod.valid)
+    term_slots |= set(np.asarray(snapshot.prefpod.slot)[pref_valid].tolist())
     return z_for(spread_slots), z_for(term_slots)
+
+
+def needs_topo(features: FeatureFlags) -> bool:
+    """True when the solve carries any topology-value state — spread,
+    required inter-pod terms, or PREFERRED inter-pod terms (forgetting
+    the last aliased every domain to value 0 and silently zeroed the
+    preferred-affinity scores on the dispatch path)."""
+    return features.spread or features.interpod or features.interpod_pref
 
 
 def features_of(snapshot: Snapshot) -> FeatureFlags:
@@ -123,6 +134,7 @@ def features_of(snapshot: Snapshot) -> FeatureFlags:
         spread_slots=tuple(
             sorted(set(np.asarray(snapshot.spread.slot)[spread_valid].tolist()))
         ),
+        interpod_pref=bool(np.asarray(snapshot.prefpod.valid).any()),
     )
 
 
@@ -232,7 +244,7 @@ def greedy_assign(
         features = features_of(snapshot)
     if topo_z is None:
         topo_z = required_topo_z(snapshot)
-    cluster, pods, sel, pref, spread, terms = jax.tree.map(
+    (cluster, pods, sel, pref, spread, terms, prefpod) = jax.tree.map(
         jnp.asarray, tuple(snapshot)
     )
     n = cluster.allocatable.shape[0]
@@ -242,6 +254,27 @@ def greedy_assign(
     pref_mask = preferred_match(cluster, pref)
     sfeas_c, aff_c, taint_c = class_statics(cluster, pods, sel_mask, pref_mask)
     c_dim = sfeas_c.shape[0]
+    extra_c = None
+    if features.interpod_pref:
+        # Preferred inter-pod affinity, hoisted per class: counts come
+        # from BOUND pods at prep (scoring.go PreScore over the cycle
+        # snapshot); in-batch placements don't attract later batchmates
+        # within this solve — they do from the next batch (documented
+        # divergence; the normalization set is the class's static-feasible
+        # nodes rather than the per-step filtered set).
+        from .interpod import pref_pod_raw, prep_pref_pod
+        from .scores import normalize_minmax
+
+        pp = prep_pref_pod(cluster, prefpod, topo_z)
+        reps_e = jnp.clip(pods.class_rep, 0, p - 1)
+
+        def one_extra(c, rep):
+            raw = pref_pod_raw(pp, prefpod, rep)
+            return cfg.interpod_weight * normalize_minmax(raw, sfeas_c[c])
+
+        extra_c = jax.vmap(one_extra)(
+            jnp.arange(c_dim, dtype=jnp.int32), reps_e
+        )
     sp0 = prep_spread(cluster, sel_mask, spread, topo_z) if features.spread else None
     tm0 = (
         prep_terms(cluster, terms, topo_z, slots=features.term_slots)
@@ -296,7 +329,8 @@ def greedy_assign(
             spread_score(sp, spread, i, feas) if features.soft_spread else None
         )
         scores = score_from_raw(
-            cl, pod, feas, aff_c[cls], taint_c[cls], cfg, spread_score=sp_score
+            cl, pod, feas, aff_c[cls], taint_c[cls], cfg, spread_score=sp_score,
+            extra=extra_c[cls] if extra_c is not None else None,
         )
         masked = jnp.where(feas, scores, NEG_INF)
         choice = _pick(masked, feas, keys[k] if keys is not None else None)
@@ -399,13 +433,9 @@ def greedy_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
             features = features_of(snapshot)
         if topo_z is None:
             # topo_z only shapes spread/inter-pod prep state; pinning it
-            # to 1 when neither family is active keeps the jit cache key
+            # to 1 when no family is active keeps the jit cache key
             # stable as topology vocabularies grow.
-            topo_z = (
-                required_topo_z(snapshot)
-                if (features.spread or features.interpod)
-                else 1
-            )
+            topo_z = required_topo_z(snapshot) if needs_topo(features) else 1
         if n_groups is None:
             n_groups = num_groups(snapshot)
         if n_groups > 0:
@@ -436,15 +466,11 @@ def evaluate_single(
     if features is None:
         features = features_of(snapshot)
     if topo_z is None:
-        topo_z = (
-            required_topo_z(snapshot)
-            if (features.spread or features.interpod)
-            else 1
-        )
-    cluster, pods, sel, pref, spread, terms = jax.tree.map(
+        topo_z = required_topo_z(snapshot) if needs_topo(features) else 1
+    (cluster, pods, sel, pref, spread, terms, prefpod) = jax.tree.map(
         jnp.asarray, tuple(snapshot)
     )
-    from .interpod import interpod_filter, prep_terms
+    from .interpod import interpod_filter, pref_pod_raw, prep_pref_pod, prep_terms
     from .topology import prep_spread, spread_filter, spread_score
 
     sel_mask = selector_match(cluster, sel)
@@ -463,10 +489,18 @@ def evaluate_single(
     if features.interpod:
         tm = prep_terms(cluster, terms, topo_z, slots=features.term_slots)
         feas = feas & interpod_filter(tm, terms, 0)
+    extra = None
+    if features.interpod_pref:
+        from .scores import normalize_minmax
+
+        pp = prep_pref_pod(cluster, prefpod, topo_z)
+        extra = cfg.interpod_weight * normalize_minmax(
+            pref_pod_raw(pp, prefpod, 0), feas
+        )
     scores = score_from_raw(
         cluster, pod, feas,
         node_affinity_raw(pod, pref_mask),
         taint_toleration_raw(cluster, pod),
-        cfg, spread_score=sp_score,
+        cfg, spread_score=sp_score, extra=extra,
     )
     return feas, jnp.where(feas, scores, NEG_INF)

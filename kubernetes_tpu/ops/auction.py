@@ -156,7 +156,7 @@ def auction_assign(
         topo_z = required_topo_z_split(snapshot)
     z_spread, z_terms = topo_z
     tie_k = min(tie_k, snapshot.cluster.allocatable.shape[0])
-    cluster, pods, sel, pref, spread, terms = jax.tree.map(
+    (cluster, pods, sel, pref, spread, terms, prefpod) = jax.tree.map(
         jnp.asarray, tuple(snapshot)
     )
     n = cluster.allocatable.shape[0]
@@ -165,6 +165,21 @@ def auction_assign(
     pref_mask = preferred_match(cluster, pref)
     sfeas_c, aff_c, taint_c = class_statics(cluster, pods, sel_mask, pref_mask)
     c_dim = sfeas_c.shape[0]
+    reps = jnp.clip(pods.class_rep, 0, p - 1)
+    extra_c = None
+    if features.interpod_pref:
+        # hoisted preferred-interpod score per class (see ops.assign's
+        # identical hoist for the divergence notes)
+        from .interpod import pref_pod_raw, prep_pref_pod
+        from .scores import normalize_minmax
+
+        pp = prep_pref_pod(cluster, prefpod, z_terms)
+        def one_extra(c, rep):
+            raw = pref_pod_raw(pp, prefpod, rep)
+            return cfg.interpod_weight * normalize_minmax(raw, sfeas_c[c])
+        extra_c = jax.vmap(one_extra)(
+            jnp.arange(c_dim, dtype=jnp.int32), reps
+        )
 
     order = solve_order(pods)
     # solve_pos[i] = pod i's rank in solve order (repair keeps prefixes
@@ -191,7 +206,6 @@ def auction_assign(
         slot_of_t = terms.slot                                    # [T]
 
     seed_c = jnp.uint32(tie_seed * 2 + 1)
-    reps = jnp.clip(pods.class_rep, 0, p - 1)
     arange_p = jnp.arange(p, dtype=jnp.int32)
 
     def bids(requested, nonzero, assigned, rnd, sp_counts, tm_bits):
@@ -230,7 +244,8 @@ def auction_assign(
                 else None
             )
             scores = score_from_raw(
-                cl, pod, feas, aff_c[c], taint_c[c], cfg, spread_score=sp_score
+                cl, pod, feas, aff_c[c], taint_c[c], cfg, spread_score=sp_score,
+                extra=extra_c[c] if extra_c is not None else None,
             )
             masked = jnp.where(feas, scores, NEG_INF)
             best = jnp.max(masked)

@@ -184,3 +184,54 @@ def interpod_update(
     return state._replace(
         present_bits=present, blocked_bits=blocked, global_any=global_any
     )
+
+
+class PrefPodState(NamedTuple):
+    """Domain-summed preferred-term match data (prep_pref_pod)."""
+
+    counts_dom: jnp.ndarray   # f32[U, N] matching bound pods in n's topology
+    ownerw_dom: jnp.ndarray   # f32[U, N] Σ signed owner weights in n's topology
+
+
+def prep_pref_pod(
+    cluster: ClusterTensors,
+    table,
+    z: int,
+    axis_name: str | None = None,
+) -> PrefPodState:
+    """Domain-sum the per-node match counts / owner weights over each
+    row's topology value (interpodaffinity/scoring.go PreScore builds the
+    same topology-pair score map).  Under shard_map, value-space sums
+    psum across node shards."""
+    v = jnp.take_along_axis(cluster.topo_ids, table.slot[None, :], axis=1).T
+    vc = jnp.clip(v, 0, z - 1)
+    ok = (v >= 0) & cluster.node_valid[None, :] & table.valid[:, None]
+
+    def per_u(vc_row, ok_row, c_row, w_row):
+        cz = jnp.zeros(z, jnp.float32).at[vc_row].add(c_row * ok_row)
+        wz = jnp.zeros(z, jnp.float32).at[vc_row].add(w_row * ok_row)
+        return cz, wz
+
+    cz, wz = jax.vmap(per_u)(vc, ok, table.node_counts, table.owner_weight)
+    if axis_name is not None:
+        cz = jax.lax.psum(cz, axis_name)
+        wz = jax.lax.psum(wz, axis_name)
+    counts_dom = jnp.where(ok, jnp.take_along_axis(cz, vc, axis=-1), 0.0)
+    ownerw_dom = jnp.where(ok, jnp.take_along_axis(wz, vc, axis=-1), 0.0)
+    return PrefPodState(counts_dom, ownerw_dom)
+
+
+def pref_pod_raw(state: PrefPodState, table, p: jnp.ndarray) -> jnp.ndarray:
+    """Raw preferred-interpod score of pod p over all nodes: f32[N].
+
+    Both directions of scoring.go processExistingPod:
+      Σ_j weight(p, j) * |matching existing pods in n's topology|   (own terms)
+      Σ_u [p matches u] * Σ owner weights of u in n's topology      (their terms)
+    """
+    u_dim = state.counts_dom.shape[0]
+    idx = jnp.clip(table.pod_idx[p], 0, u_dim - 1)          # [MA]
+    w = jnp.where(table.pod_idx[p] >= 0, table.pod_weight[p], 0.0)
+    own = (w[:, None] * state.counts_dom[idx]).sum(axis=0)   # [N]
+    mi = table.matches_incoming[p].astype(jnp.float32)       # [U]
+    theirs = (mi[:, None] * state.ownerw_dom).sum(axis=0)    # [N]
+    return own + theirs

@@ -178,6 +178,34 @@ class PodBatch(NamedTuple):
     group_id: np.ndarray     # i32[P]  gang/coscheduling group, -1 none
 
 
+class PrefPodTable(NamedTuple):
+    """Preferred inter-pod (anti-)affinity — the SCORING half of the
+    O(pods²) pairwise family (interpodaffinity/scoring.go), tensorized as
+    deduplicated term rows with per-node match data:
+
+      node_counts[u, n]   bound pods matching row u ON node n (prep
+                          domain-sums it over n's topology value) — the
+                          incoming-pod's-terms direction
+      owner_weight[u, n]  Σ signed weights of bound pods on node n whose
+                          OWN term is row u (preferred terms carry their
+                          weight, required affinity terms carry
+                          hardPodAffinityWeight) — the existing-pods'-
+                          terms direction, applied when the incoming pod
+                          matches the row
+      matches_incoming[i, u]  pending pod i matches row u's selector
+      pod_idx/pod_weight[i, j]  pending pod i's own preferred rows with
+                          signed weights (anti ⇒ negative)
+    """
+
+    valid: np.ndarray            # bool[U]
+    slot: np.ndarray             # i32[U] topology-key slot
+    node_counts: np.ndarray      # f32[U, N]
+    owner_weight: np.ndarray     # f32[U, N]
+    matches_incoming: np.ndarray  # bool[P, U]
+    pod_idx: np.ndarray          # i32[P, MA] -1 pad
+    pod_weight: np.ndarray       # f32[P, MA] signed
+
+
 class Snapshot(NamedTuple):
     cluster: ClusterTensors
     pods: PodBatch
@@ -185,6 +213,7 @@ class Snapshot(NamedTuple):
     preferred: PreferredTable
     spread: SpreadTable
     terms: TermTable
+    prefpod: PrefPodTable
 
 
 def num_groups(snapshot: Snapshot) -> int:
@@ -206,6 +235,9 @@ class SnapshotLimits:
     max_preferred: int = 4      # MT: preferred terms per pod
     max_spread_per_pod: int = 4  # MC: topology spread constraints per pod
     max_pod_terms: int = 4      # MA: required (anti-)affinity terms per pod
+    # scoring weight of bound pods' REQUIRED affinity terms in the
+    # preferred-interpod score (apis/config HardPodAffinityWeight default)
+    hard_pod_affinity_weight: float = 1.0
     label_capacity: int = 4096
     taint_capacity: int = 256
     port_capacity: int = 2048
@@ -477,10 +509,10 @@ class SnapshotBuilder:
             for p in bound_pods
             if p.spec.node_name in index_by_name
         ]
-        spread, terms = self._build_constraints(
+        spread, terms, prefpod = self._build_constraints(
             pending_pods, bound_by_node, sel_index, n, p_dim
         )
-        pods = _refine_classes(pods, spread, terms)
+        pods = _refine_classes(pods, spread, terms, prefpod)
         meta = SnapshotMeta(
             num_nodes=len(nodes),
             num_pods=len(pending_pods),
@@ -489,7 +521,7 @@ class SnapshotBuilder:
             limits=lim,
             topo_z=self._topo_z(),
         )
-        return Snapshot(cluster, pods, sel, pref, spread, terms), meta
+        return Snapshot(cluster, pods, sel, pref, spread, terms, prefpod), meta
 
     def _topo_z(self) -> int:
         return vb.pad_dim(
@@ -519,10 +551,10 @@ class SnapshotBuilder:
             max(len(pending_pods), num_pods_hint), self.limits.min_pods
         )
         pods, sel, pref, sel_index = self._build_pods(pending_pods, p_dim, r)
-        spread, terms = self._build_constraints(
+        spread, terms, prefpod = self._build_constraints(
             pending_pods, state.bound_pods(), sel_index, n, p_dim
         )
-        pods = _refine_classes(pods, spread, terms)
+        pods = _refine_classes(pods, spread, terms, prefpod)
         meta = SnapshotMeta(
             num_nodes=state._high,
             num_pods=len(pending_pods),
@@ -531,7 +563,7 @@ class SnapshotBuilder:
             limits=self.limits,
             topo_z=self._topo_z(),
         )
-        return Snapshot(cluster, pods, sel, pref, spread, terms), meta
+        return Snapshot(cluster, pods, sel, pref, spread, terms, prefpod), meta
 
     def _build_cluster(
         self,
@@ -965,27 +997,7 @@ class SnapshotBuilder:
         term_index: Dict[tuple, int] = {}
 
         def intern_term(term: api.PodAffinityTerm, owner: api.Pod) -> int:
-            if term.namespace_selector is not None:
-                raise OverflowError(
-                    "PodAffinityTerm.namespace_selector requires Namespace "
-                    "objects, which are not modelled; list namespaces "
-                    "explicitly instead"
-                )
-            namespaces = tuple(sorted(term.namespaces or [owner.meta.namespace]))
-            sel = _merge_match_label_keys(
-                term.label_selector, term.match_label_keys, owner.meta.labels
-            )
-            sig = (
-                term.topology_key,
-                _label_selector_signature(sel),
-                namespaces,
-            )
-            idx = term_index.get(sig)
-            if idx is None:
-                idx = len(term_rows)
-                term_index[sig] = idx
-                term_rows.append((term.topology_key, sel, namespaces))
-            return idx
+            return _intern_pod_term(term_rows, term_index, term, owner)
 
         def pod_terms(pod: api.Pod) -> Tuple[List[api.PodAffinityTerm], List[api.PodAffinityTerm]]:
             aff = pod.spec.affinity
@@ -1008,12 +1020,17 @@ class SnapshotBuilder:
                 anti_idx[i, j] = intern_term(t, pod)
         # Bound pods' anti-affinity terms participate in the
         # existing-pods-anti-affinity direction even if no pending pod
-        # carries them.
+        # carries them.  A BOUND pod with an unsupported field must not
+        # poison every future batch encode (it was admitted by someone
+        # else); its term is skipped, unlike pending pods which raise.
         bound_anti: List[Tuple[int, int]] = []  # (term row, node index)
         for q, ni in bound_by_node:
             _, anti_terms = pod_terms(q)
             for t in anti_terms:
-                bound_anti.append((intern_term(t, q), ni))
+                try:
+                    bound_anti.append((intern_term(t, q), ni))
+                except OverflowError:
+                    pass
 
         t_dim = vb.pad_dim(len(term_rows), 1)
         terms = TermTable(
@@ -1055,7 +1072,95 @@ class SnapshotBuilder:
                 for t in aff_terms
             )
 
-        return spread, terms
+        prefpod = self._build_prefpod(
+            pods, bound_by_node, n, p_dim, match_sigs, bound_sig, bound_node,
+            pend_sig,
+        )
+        return spread, terms, prefpod
+
+    def _build_prefpod(
+        self, pods, bound_by_node, n, p_dim, match_sigs, bound_sig,
+        bound_node, pend_sig,
+    ) -> PrefPodTable:
+        """Preferred inter-pod affinity rows (see PrefPodTable).  Rows
+        from both directions share one table: incoming pods' preferred
+        terms need node_counts; bound pods' preferred/required-affinity
+        terms need owner_weight + matches_incoming."""
+        lim = self.limits
+        ma = lim.max_pod_terms
+        rows: List[Tuple[str, api.LabelSelector, Tuple[str, ...]]] = []
+        index: Dict[tuple, int] = {}
+
+        def intern(term: api.PodAffinityTerm, owner: api.Pod) -> int:
+            return _intern_pod_term(rows, index, term, owner)
+
+        def signed_terms(pod: api.Pod):
+            aff = pod.spec.affinity
+            out = []
+            if aff and aff.pod_affinity:
+                out += [(w.weight, w.term) for w in aff.pod_affinity.preferred]
+            if aff and aff.pod_anti_affinity:
+                out += [
+                    (-w.weight, w.term) for w in aff.pod_anti_affinity.preferred
+                ]
+            return out
+
+        pod_idx = np.full((p_dim, ma), -1, dtype=np.int32)
+        pod_weight = np.zeros((p_dim, ma), dtype=np.float32)
+        for i, pod in enumerate(pods):
+            st = signed_terms(pod)
+            if len(st) > ma:
+                raise OverflowError(
+                    f"pod has {len(st)} preferred (anti-)affinity terms, "
+                    f"exceeding max_pod_terms={ma}"
+                )
+            for j, (w, t) in enumerate(st):
+                pod_idx[i, j] = intern(t, pod)
+                pod_weight[i, j] = float(w)
+
+        # owner direction: bound pods' preferred terms (signed weight) and
+        # REQUIRED affinity terms (hardPodAffinityWeight — scoring.go
+        # processExistingPod's hard-affinity contribution).  Unsupported
+        # fields on BOUND pods skip the term instead of poisoning every
+        # batch encode (pending pods still raise).
+        owner_entries: List[Tuple[int, int, float]] = []  # (row, node, w)
+        for q, ni in bound_by_node:
+            for w, t in signed_terms(q):
+                try:
+                    owner_entries.append((intern(t, q), ni, float(w)))
+                except OverflowError:
+                    pass
+            aff = q.spec.affinity
+            for t in (aff.pod_affinity.required if aff and aff.pod_affinity else []):
+                try:
+                    owner_entries.append(
+                        (intern(t, q), ni, float(lim.hard_pod_affinity_weight))
+                    )
+                except OverflowError:
+                    pass
+
+        u_dim = vb.pad_dim(len(rows), 1)
+        table = PrefPodTable(
+            valid=np.zeros(u_dim, dtype=bool),
+            slot=np.zeros(u_dim, dtype=np.int32),
+            node_counts=np.zeros((u_dim, n), dtype=np.float32),
+            owner_weight=np.zeros((u_dim, n), dtype=np.float32),
+            matches_incoming=np.zeros((p_dim, u_dim), dtype=bool),
+            pod_idx=pod_idx,
+            pod_weight=pod_weight,
+        )
+        for ui, (topo_key, sel, namespaces) in enumerate(rows):
+            table.valid[ui] = True
+            table.slot[ui] = self._topo_slot(topo_key)
+            match = match_sigs(sel, namespaces)
+            if len(bound_sig):
+                m = match[bound_sig]
+                np.add.at(table.node_counts[ui], bound_node[m], 1.0)
+            if len(pend_sig):
+                table.matches_incoming[: len(pods), ui] = match[pend_sig]
+        for ui, ni, w in owner_entries:
+            table.owner_weight[ui, ni] += w
+        return table
 
     def _encode_selector(
         self, selector: api.NodeSelector, t_cap: int, e_cap: int, k_cap: int
@@ -1315,7 +1420,38 @@ class ClusterState:
         )
 
 
-def _refine_classes(pods: PodBatch, spread: SpreadTable, terms: TermTable) -> PodBatch:
+def _intern_pod_term(
+    rows: List[tuple], index: Dict[tuple, int],
+    term: api.PodAffinityTerm, owner: api.Pod,
+) -> int:
+    """Shared (anti-)affinity term interning: rows key on
+    (topologyKey, merged selector signature, namespaces) — one
+    implementation for required, anti, and preferred term tables."""
+    if term.namespace_selector is not None:
+        raise OverflowError(
+            "PodAffinityTerm.namespace_selector requires Namespace "
+            "objects, which are not modelled; list namespaces "
+            "explicitly instead"
+        )
+    namespaces = tuple(sorted(term.namespaces or [owner.meta.namespace]))
+    sel = _merge_match_label_keys(
+        term.label_selector, term.match_label_keys, owner.meta.labels
+    )
+    sig = (term.topology_key, _label_selector_signature(sel), namespaces)
+    idx = index.get(sig)
+    if idx is None:
+        idx = len(rows)
+        index[sig] = idx
+        rows.append((term.topology_key, sel, namespaces))
+    return idx
+
+
+def _refine_classes(
+    pods: PodBatch,
+    spread: SpreadTable,
+    terms: TermTable,
+    prefpod: Optional[PrefPodTable] = None,
+) -> PodBatch:
     """Split spec-equivalence classes by constraint identity.
 
     _pod_classes groups on the static Filter/Score inputs only — enough
@@ -1325,11 +1461,11 @@ def _refine_classes(pods: PodBatch, spread: SpreadTable, terms: TermTable) -> Po
     (e.g. two services' pods with self-anti-affinity) must not share a
     class; the signature here adds each pod's spread rows + match flags
     and (anti-)affinity term memberships."""
-    if not (spread.valid.any() or terms.valid.any()):
+    has_pref = prefpod is not None and prefpod.valid.any()
+    if not (spread.valid.any() or terms.valid.any() or has_pref):
         return pods
     p = pods.class_id.shape[0]
-    sig = np.concatenate(
-        [
+    parts = [
             pods.class_id.view(np.uint32)[:, None],
             spread.pod_idx.view(np.uint32),
             spread.pod_matches.astype(np.uint8).view(np.uint8).reshape(p, -1).astype(np.uint32),
@@ -1337,9 +1473,14 @@ def _refine_classes(pods: PodBatch, spread: SpreadTable, terms: TermTable) -> Po
             terms.anti_idx.view(np.uint32),
             terms.matches_incoming.astype(np.uint32),
             terms.self_match_all.astype(np.uint32)[:, None],
-        ],
-        axis=1,
-    )
+    ]
+    if has_pref:
+        parts += [
+            prefpod.pod_idx.view(np.uint32),
+            prefpod.pod_weight.view(np.uint32),
+            prefpod.matches_incoming.astype(np.uint32),
+        ]
+    sig = np.concatenate(parts, axis=1)
     sig = np.ascontiguousarray(sig)
     row_bytes = sig.view(np.uint8).reshape(p, -1)
     index: Dict[bytes, int] = {}

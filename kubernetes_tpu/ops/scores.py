@@ -51,7 +51,13 @@ class ScoreConfig:
     )
     # resource indices for BalancedAllocation
     balanced_resources: Tuple[int, ...] = (RESOURCE_CPU, RESOURCE_MEMORY)
-    fit_strategy: str = "LeastAllocated"  # or "MostAllocated"
+    fit_strategy: str = "LeastAllocated"  # or MostAllocated | RequestedToCapacityRatio
+    interpod_weight: float = 2.0         # InterPodAffinity (preferred terms)
+    # RequestedToCapacityRatio shape: (utilization%, score) points,
+    # piecewise-linear (requested_to_capacity_ratio.go buildBrokenLinear).
+    # The default shape is the bin-packing example from the reference
+    # docs: score rises with utilization.
+    rtcr_shape: Tuple[Tuple[float, float], ...] = ((0.0, 0.0), (100.0, 10.0))
 
 
 DEFAULT_SCORE_CONFIG = ScoreConfig()
@@ -96,6 +102,32 @@ def most_allocated(
         ok = c > 0
         s = jnp.where(ok & (q <= c), _floor(q * MAX_NODE_SCORE / jnp.maximum(c, 1.0)), 0.0)
         total = total + weight * s * ok
+        wsum = wsum + weight * ok
+    return jnp.where(wsum > 0, _floor(total / jnp.maximum(wsum, 1.0)), 0.0)
+
+
+def requested_to_capacity_ratio(
+    cluster: ClusterTensors, pod: PodView, cfg: ScoreConfig
+) -> jnp.ndarray:
+    """Piecewise-linear score of utilization percent per resource,
+    weight-averaged (noderesources/requested_to_capacity_ratio.go
+    buildRequestedToCapacityRatioScorerFunction): the shape maps
+    utilization (0..100) to a 0..10 score, rescaled here to 0..100 like
+    the other strategies (MaxCustomPriorityScore=10 is scaled by
+    MaxNodeScore/10 in the reference runtime)."""
+    req = cluster.nonzero_requested + pod.nonzero_req[None, :]
+    cap = cluster.allocatable
+    xs = jnp.asarray([p[0] for p in cfg.rtcr_shape], jnp.float32)
+    ys = jnp.asarray([p[1] for p in cfg.rtcr_shape], jnp.float32)
+    total = jnp.zeros(cap.shape[0], dtype=jnp.float32)
+    wsum = jnp.zeros(cap.shape[0], dtype=jnp.float32)
+    for idx, weight in cfg.fit_resources:
+        c = cap[:, idx]
+        q = req[:, idx]
+        ok = c > 0
+        util = jnp.clip(q * 100.0 / jnp.maximum(c, 1.0), 0.0, 100.0)
+        s = jnp.interp(util, xs, ys) * (MAX_NODE_SCORE / 10.0)
+        total = total + weight * jnp.where(ok & (q <= c), _floor(s), 0.0)
         wsum = wsum + weight * ok
     return jnp.where(wsum > 0, _floor(total / jnp.maximum(wsum, 1.0)), 0.0)
 
@@ -174,6 +206,7 @@ def score_from_raw(
     cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,
     axis_name: str | None = None,
     spread_score: jnp.ndarray | None = None,
+    extra: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Weighted plugin-score sum with precomputed *raw* static scores.
 
@@ -181,9 +214,13 @@ def score_from_raw(
     (node_affinity_raw / taint_toleration_raw), hoisted out of the
     solver's scan per pod class; normalization stays per-step because its
     maxima range over the pod's current feasible set.  fit/balanced are
-    computed here from the carried requested state."""
+    computed here from the carried requested state.  `extra` is an
+    already-normalized, already-weighted additional score row (the
+    hoisted preferred-interpod contribution)."""
     if cfg.fit_strategy == "MostAllocated":
         fit = most_allocated(cluster, pod, cfg)
+    elif cfg.fit_strategy == "RequestedToCapacityRatio":
+        fit = requested_to_capacity_ratio(cluster, pod, cfg)
     else:
         fit = least_allocated(cluster, pod, cfg)
     bal = balanced_allocation(cluster, pod, cfg)
@@ -197,6 +234,8 @@ def score_from_raw(
     )
     if spread_score is not None:
         total = total + cfg.spread_weight * spread_score
+    if extra is not None:
+        total = total + extra
     return jnp.where(feasible, total, -1.0)
 
 
@@ -224,3 +263,24 @@ def score_for_pod(
         axis_name=axis_name,
         spread_score=spread_score,
     )
+
+
+def normalize_minmax(
+    raw: jnp.ndarray,
+    feasible: jnp.ndarray,
+    axis_name: str | None = None,
+) -> jnp.ndarray:
+    """interpodaffinity/scoring.go NormalizeScore: scale to [0,100] by
+    (raw - min) / (max - min) over feasible nodes — unlike the default
+    normalizer this handles NEGATIVE raws (anti-affinity weights)."""
+    big = jnp.float32(1e30)
+    mx = jnp.max(jnp.where(feasible, raw, -big))
+    mn = jnp.min(jnp.where(feasible, raw, big))
+    if axis_name is not None:
+        mx = jax.lax.pmax(mx, axis_name)
+        mn = jax.lax.pmin(mn, axis_name)
+    span = mx - mn
+    out = jnp.where(
+        span > 0, _floor(MAX_NODE_SCORE * (raw - mn) / jnp.maximum(span, 1e-30)), 0.0
+    )
+    return jnp.where(feasible, out, 0.0)

@@ -94,9 +94,15 @@ def sharded_greedy_assign(
     """
     if features is None:
         features = features_of(snapshot)
+    if getattr(features, "interpod_pref", False):
+        raise ValueError(
+            "sharded_greedy_assign does not score preferred inter-pod "
+            "affinity yet; route such batches through the single-device "
+            "solvers (the extra-score hoist needs a psum'd domain sum)"
+        )
     if topo_z is None:
         topo_z = required_topo_z(snapshot)
-    cluster, pods, sel, pref, spread, terms = jax.tree.map(
+    (cluster, pods, sel, pref, spread, terms, _prefpod) = jax.tree.map(
         jnp.asarray, tuple(snapshot)
     )
     n = cluster.allocatable.shape[0]

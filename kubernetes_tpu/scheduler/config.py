@@ -31,6 +31,7 @@ SCORE_PLUGIN_WEIGHTS = {
     "NodeAffinity": "node_affinity_weight",
     "TaintToleration": "taint_weight",
     "PodTopologySpread": "spread_weight",
+    "InterPodAffinity": "interpod_weight",
 }
 
 
@@ -84,11 +85,22 @@ class SchedulerConfiguration:
             cfg = p.score_config
             for f_name in (
                 "fit_weight", "balanced_weight", "node_affinity_weight",
-                "taint_weight", "spread_weight",
+                "taint_weight", "spread_weight", "interpod_weight",
             ):
                 if getattr(cfg, f_name) < 0:
                     raise ValueError(f"{p.scheduler_name}: {f_name} < 0")
-            if cfg.fit_strategy not in ("LeastAllocated", "MostAllocated"):
+            shape = cfg.rtcr_shape
+            if not shape or any(
+                b[0] <= a[0] for a, b in zip(shape, shape[1:])
+            ):
+                raise ValueError(
+                    f"{p.scheduler_name}: rtcr_shape utilization points "
+                    "must be non-empty and strictly increasing "
+                    "(apis/config/validation's shape check)"
+                )
+            if cfg.fit_strategy not in (
+                "LeastAllocated", "MostAllocated", "RequestedToCapacityRatio"
+            ):
                 raise ValueError(
                     f"{p.scheduler_name}: unknown fit_strategy "
                     f"{cfg.fit_strategy!r}"

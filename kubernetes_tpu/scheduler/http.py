@@ -1,0 +1,106 @@
+"""Health + metrics endpoints for the scheduler process.
+
+Reference: the scheduler binary serves healthz/readyz/livez and an
+authenticated /metrics (app/server.go:169-209,
+newHealthEndpointsAndMetricsHandler).  /metrics speaks the Prometheus
+text exposition format over the in-process Registry so standard scrapers
+ingest it.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .metrics import Counter, Gauge, Histogram, Registry
+
+
+def render_prometheus(registry: Registry) -> str:
+    """Text exposition of every metric in the registry."""
+    lines = []
+    for name, metric in sorted(registry.snapshot().items()):
+        if isinstance(metric, Histogram):
+            lines.append(f"# TYPE {name} histogram")
+            acc = 0
+            for bound, c in zip(metric.buckets, metric.counts):
+                acc += c
+                lines.append(f'{name}_bucket{{le="{bound}"}} {acc}')
+            lines.append(f'{name}_bucket{{le="+Inf"}} {metric.n}')
+            lines.append(f"{name}_sum {metric.total}")
+            lines.append(f"{name}_count {metric.n}")
+        elif isinstance(metric, (Counter, Gauge)):
+            kind = "counter" if isinstance(metric, Counter) else "gauge"
+            lines.append(f"# TYPE {name} {kind}")
+            with metric._lock:
+                items = dict(metric._v)
+            if not items:
+                lines.append(f"{name} 0")
+            for labels, v in sorted(items.items()):
+                if labels:
+                    lbl = ",".join(
+                        f'label{i}="{x}"' for i, x in enumerate(labels)
+                    )
+                    lines.append(f"{name}{{{lbl}}} {v}")
+                else:
+                    lines.append(f"{name} {v}")
+    return "\n".join(lines) + "\n"
+
+
+class HealthServer:
+    """healthz/readyz/livez + /metrics for one Scheduler."""
+
+    def __init__(self, scheduler, host: str = "127.0.0.1", port: int = 0):
+        sched = scheduler
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, body: str, code: int = 200,
+                       ctype: str = "text/plain") -> None:
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self) -> None:
+                if self.path in ("/healthz", "/livez"):
+                    self._reply("ok")
+                elif self.path == "/readyz":
+                    ready = sched.informers.wait_for_sync(0.01)
+                    leader = (
+                        sched.leader_elector.is_leader()
+                        if sched.leader_elector
+                        else True
+                    )
+                    if ready:
+                        self._reply(f"ok\nleader: {leader}")
+                    else:
+                        self._reply("informers not synced", 503)
+                elif self.path == "/metrics":
+                    self._reply(render_prometheus(sched.metrics))
+                else:
+                    self._reply("not found", 404)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> "HealthServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="scheduler-health", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -63,6 +63,8 @@ class PreemptionEvaluator:
         self.cache = cache
         self.store = store
         self.metrics = metrics
+        # optional client.events.EventRecorder (set by the Scheduler)
+        self.events = None
 
     # -- eligibility (PodEligibleToPreemptOthers) --------------------------
 
@@ -112,6 +114,12 @@ class PreemptionEvaluator:
             except KeyError:
                 pass  # already gone — the freed space is still freed
             self.cache.remove_pod(v)
+            if self.events:
+                self.events.eventf(
+                    v, "Normal", "Preempted",
+                    f"Preempted by {pod.meta.namespace}/{pod.meta.name} on "
+                    f"node {node_name}",
+                )
         self._nominate(pod, node_name)
         # reserve the freed space for the nominee: other batches see the
         # reservation; the nominee's own batch excludes it

@@ -31,14 +31,26 @@ import numpy as np
 
 from ..api import store as st
 from ..api import types as api
+from ..client.events import EventRecorder
 from ..client.informers import InformerFactory
 from ..models.batch_scheduler import TPUBatchScheduler
+from ..ops import assign as assign_ops
 from .cache import SchedulerCache
 from .config import SchedulerConfiguration
 from .framework import Framework, FrameworkRegistry
 from .metrics import Registry
 from .preemption import PreemptionEvaluator
 from .queue import QueuedPodInfo, SchedulingQueue, pod_key
+
+
+_REASON_TEXT = {
+    assign_ops.REASON_STATIC: "node affinity/taints/name mismatch",
+    assign_ops.REASON_RESOURCES: "insufficient resources",
+    assign_ops.REASON_PORTS: "host port conflict",
+    assign_ops.REASON_SPREAD: "topology spread constraints violated",
+    assign_ops.REASON_INTERPOD: "inter-pod (anti-)affinity rules",
+    assign_ops.REASON_GANG: "gang not fully placeable",
+}
 
 
 class Scheduler:
@@ -74,9 +86,11 @@ class Scheduler:
             clock=clock,
         )
         self.metrics = Registry()
+        self.events = EventRecorder(store, component="default-scheduler")
         self.preemption = PreemptionEvaluator(
             self.tpu, self.cache, store, self.metrics
         )
+        self.preemption.events = self.events
         # PostFilter budget per cycle: preemption is the exceptional path;
         # cap the per-batch dry-run work so a mass of unschedulable pods
         # can't stall the hot loop.
@@ -280,6 +294,10 @@ class Scheduler:
                 stats["unschedulable"] += 1
                 self.metrics.schedule_attempts.inc("unschedulable")
                 self.queue.add_unschedulable(info, reason=reasons[i])
+                self.events.eventf(
+                    info.pod, "Warning", "FailedScheduling",
+                    f"0 nodes available ({_REASON_TEXT.get(reasons[i], 'unschedulable')})",
+                )
                 failed.append(info)
                 continue
             try:
@@ -299,6 +317,10 @@ class Scheduler:
                 self.queue.requeue_backoff(info)
                 continue
             fwk.run_post_bind(info.pod, node_name)
+            self.events.eventf(
+                info.pod, "Normal", "Scheduled",
+                f"Successfully assigned {pod_key(info.pod)} to {node_name}",
+            )
             self.cache.finish_binding(info.pod)
             self.queue.done(info.pod)
             stats["scheduled"] += 1

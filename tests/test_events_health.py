@@ -1,0 +1,93 @@
+"""Events API + health/metrics endpoints (SURVEY §5.5: the scheduler
+emits observable Events; healthz/readyz + Prometheus /metrics —
+app/server.go:169-209, schedule_one.go:1003)."""
+
+import time
+import urllib.request
+
+from kubernetes_tpu.api import store as st
+from kubernetes_tpu.client.events import EventRecorder
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.scheduler.http import HealthServer, render_prometheus
+from kubernetes_tpu.testing.wrappers import GI, make_node, make_pod
+
+
+def test_event_recorder_aggregates():
+    store = st.Store()
+    rec = EventRecorder(store)
+    pod = make_pod("p").obj()
+    store.create(pod)
+    for _ in range(3):
+        rec.eventf(pod, "Warning", "FailedScheduling", "0 nodes available")
+    events, _ = store.list("Event")
+    assert len(events) == 1
+    assert events[0].count == 3
+    assert events[0].involved_object.name == "p"
+    rec.eventf(pod, "Normal", "Scheduled", "assigned")
+    events, _ = store.list("Event")
+    assert len(events) == 2
+
+
+def test_scheduler_emits_events():
+    store = st.Store()
+    store.create(make_node("n0").capacity(cpu_milli=1000, mem=8 * GI).obj())
+    sched = Scheduler(store)
+    sched.informers.informer("Node").start()
+    sched.informers.informer("Pod").start()
+    assert sched.informers.wait_for_sync(10)
+    try:
+        store.create(make_pod("fits").req(cpu_milli=100).obj())
+        store.create(make_pod("big").req(cpu_milli=64000).obj())
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            sched.schedule_batch(timeout=0.2)
+            if store.get("Pod", "fits").spec.node_name:
+                break
+        events, _ = store.list("Event")
+        by_reason = {e.reason: e for e in events}
+        assert "Scheduled" in by_reason
+        assert "FailedScheduling" in by_reason
+        assert "insufficient resources" in by_reason["FailedScheduling"].message
+    finally:
+        sched.stop()
+
+
+def test_health_and_metrics_endpoints():
+    store = st.Store()
+    store.create(make_node("n0").capacity(cpu_milli=4000, mem=8 * GI).obj())
+    sched = Scheduler(store)
+    sched.informers.informer("Node").start()
+    sched.informers.informer("Pod").start()
+    assert sched.informers.wait_for_sync(10)
+    srv = HealthServer(sched).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(url + "/healthz") as r:
+            assert r.read() == b"ok"
+        with urllib.request.urlopen(url + "/readyz") as r:
+            assert r.status == 200
+        store.create(make_pod("p").req(cpu_milli=100).obj())
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            sched.schedule_batch(timeout=0.2)
+            if store.get("Pod", "p").spec.node_name:
+                break
+        with urllib.request.urlopen(url + "/metrics") as r:
+            body = r.read().decode()
+        assert "scheduler_schedule_attempts_total" in body
+        assert "scheduler_scheduling_attempt_duration_seconds_count" in body
+    finally:
+        srv.stop()
+        sched.stop()
+
+
+def test_prometheus_rendering_shape():
+    from kubernetes_tpu.scheduler.metrics import Registry
+
+    reg = Registry()
+    reg.schedule_attempts.inc("scheduled")
+    reg.scheduling_attempt_duration.observe(0.005)
+    text = render_prometheus(reg)
+    assert "# TYPE scheduler_schedule_attempts_total counter" in text
+    assert "# TYPE scheduler_scheduling_attempt_duration_seconds histogram" in text
+    assert "_bucket{le=" in text

@@ -1,0 +1,172 @@
+"""Preferred inter-pod (anti-)affinity scoring — the O(pods²) pairwise
+scoring family (interpodaffinity/scoring.go) as domain-summed term rows.
+
+Both directions are covered: the incoming pod's preferred terms against
+existing pods, and existing pods' preferred/required terms judging the
+incoming pod (hardPodAffinityWeight)."""
+
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.ops import assign, auction, schema
+from kubernetes_tpu.testing.wrappers import GI, MI, make_node, make_pod
+
+
+def _zone_nodes(n, zones=2):
+    return [
+        make_node(f"n{i}")
+        .capacity(cpu_milli=8000, mem=16 * GI, pods=110)
+        .zone(f"z{i % zones}")
+        .obj()
+        for i in range(n)
+    ]
+
+
+def _pref_aff(pw, selector, weight=50, anti=False, topo=api.LABEL_ZONE):
+    aff = pw.pod.spec.affinity or api.Affinity()
+    pw.pod.spec.affinity = aff
+    term = api.WeightedPodAffinityTerm(
+        weight=weight,
+        term=api.PodAffinityTerm(
+            label_selector=api.LabelSelector(match_labels=selector),
+            topology_key=topo,
+        ),
+    )
+    if anti:
+        if aff.pod_anti_affinity is None:
+            aff.pod_anti_affinity = api.PodAntiAffinity()
+        aff.pod_anti_affinity.preferred.append(term)
+    else:
+        if aff.pod_affinity is None:
+            aff.pod_affinity = api.PodAffinity()
+        aff.pod_affinity.preferred.append(term)
+    return pw
+
+
+def test_feature_flag_set():
+    nodes = _zone_nodes(2)
+    pods = [_pref_aff(make_pod("p").req(cpu_milli=100), {"app": "x"}).obj()]
+    snap, _ = schema.SnapshotBuilder().build(nodes, pods)
+    assert assign.features_of(snap).interpod_pref
+
+
+def test_preferred_affinity_attracts():
+    """All else equal, the pod lands in the zone holding the matching
+    bound pod."""
+    nodes = _zone_nodes(4)  # z0: n0,n2  z1: n1,n3
+    bound = [make_pod("b").label("app", "x").node_name("n1").obj()]
+    pods = [_pref_aff(make_pod("p").req(cpu_milli=100), {"app": "x"}).obj()]
+    snap, _ = schema.SnapshotBuilder().build(nodes, pods, bound_pods=bound)
+    r = assign.greedy_assign(snap)
+    assert int(r.assignment[0]) % 2 == 1, "did not land in z1"
+
+
+def test_preferred_anti_affinity_repels():
+    nodes = _zone_nodes(4)
+    bound = [make_pod("b").label("app", "x").node_name("n1").obj()]
+    pods = [
+        _pref_aff(
+            make_pod("p").req(cpu_milli=100), {"app": "x"}, anti=True
+        ).obj()
+    ]
+    snap, _ = schema.SnapshotBuilder().build(nodes, pods, bound_pods=bound)
+    r = assign.greedy_assign(snap)
+    assert int(r.assignment[0]) % 2 == 0, "did not avoid z1"
+
+
+def test_owner_preferred_terms_judge_incoming():
+    """A bound pod PREFERRING app=y pulls an incoming app=y pod into its
+    topology (the existing-pods'-terms direction)."""
+    nodes = _zone_nodes(4)
+    owner = _pref_aff(
+        make_pod("owner"), {"app": "y"}, weight=80
+    ).node_name("n3").obj()
+    pods = [make_pod("p").req(cpu_milli=100).label("app", "y").obj()]
+    snap, _ = schema.SnapshotBuilder().build(nodes, pods, bound_pods=[owner])
+    r = assign.greedy_assign(snap)
+    assert int(r.assignment[0]) % 2 == 1, "owner's preference ignored"
+
+
+def test_required_affinity_of_bound_pod_contributes_hard_weight():
+    """Bound pods' REQUIRED affinity terms score with
+    hardPodAffinityWeight (scoring.go processExistingPod)."""
+    nodes = _zone_nodes(4)
+    owner = (
+        make_pod("owner")
+        .pod_affinity({"app": "z"}, api.LABEL_ZONE)
+        .label("app", "z")  # self-match so it could have scheduled
+        .node_name("n1")
+        .obj()
+    )
+    pods = [make_pod("p").req(cpu_milli=100).label("app", "z").obj()]
+    snap, _ = schema.SnapshotBuilder().build(nodes, pods, bound_pods=[owner])
+    r = assign.greedy_assign(snap)
+    assert int(r.assignment[0]) % 2 == 1
+
+
+def test_auction_route_scores_preferred_terms():
+    nodes = _zone_nodes(8)
+    bound = [make_pod("b").label("app", "x").node_name("n1").obj()]
+    pods = [
+        _pref_aff(
+            make_pod(f"p{i}").req(cpu_milli=100), {"app": "x"}, weight=90
+        ).obj()
+        for i in range(4)
+    ]
+    snap, _ = schema.SnapshotBuilder().build(nodes, pods, bound_pods=bound)
+    r = auction.auction_assign(snap)
+    a = np.asarray(r.assignment)[:4]
+    assert (a >= 0).all()
+    assert (a % 2 == 1).all(), f"auction ignored preferred affinity: {a}"
+
+
+def test_weights_balance_between_terms():
+    """Two preferred terms with different weights: the heavier wins."""
+    nodes = _zone_nodes(4)
+    bound = [
+        make_pod("bx").label("app", "x").node_name("n0").obj(),  # z0
+        make_pod("by").label("app", "y").node_name("n1").obj(),  # z1
+    ]
+    pw = make_pod("p").req(cpu_milli=100)
+    _pref_aff(pw, {"app": "x"}, weight=10)
+    _pref_aff(pw, {"app": "y"}, weight=90)
+    snap, _ = schema.SnapshotBuilder().build(nodes, [pw.obj()], bound_pods=bound)
+    r = assign.greedy_assign(snap)
+    assert int(r.assignment[0]) % 2 == 1, "heavier preferred term lost"
+
+
+def test_requested_to_capacity_ratio_strategy():
+    """RTCR with a rising shape prefers the fuller node (bin packing)."""
+    from kubernetes_tpu.ops.scores import ScoreConfig
+
+    nodes = [
+        make_node("empty").capacity(cpu_milli=8000, mem=16 * GI, pods=10).obj(),
+        make_node("half").capacity(cpu_milli=8000, mem=16 * GI, pods=10).obj(),
+    ]
+    bound = [make_pod("b").req(cpu_milli=4000, mem=8 * GI).node_name("half").obj()]
+    pods = [make_pod("p").req(cpu_milli=500, mem=GI).obj()]
+    cfg = ScoreConfig(
+        fit_strategy="RequestedToCapacityRatio",
+        balanced_weight=0.0,
+    )
+    snap, meta = schema.SnapshotBuilder().build(nodes, pods, bound_pods=bound)
+    r = assign.greedy_assign(snap, cfg)
+    assert meta.node_name(int(r.assignment[0])) == "half"
+
+
+def test_dispatch_path_scores_preferred_terms():
+    """Through TPUBatchScheduler (the production dispatch): a batch with
+    ONLY preferred interpod terms must still size topo_z for its slots —
+    the old gate aliased every domain to one value and silently zeroed
+    the scores (review-confirmed bug)."""
+    from kubernetes_tpu.models.batch_scheduler import TPUBatchScheduler
+
+    sched = TPUBatchScheduler()
+    nodes = _zone_nodes(4)
+    bound = make_pod("b").label("app", "x").node_name("n1").obj()
+    for n in nodes:
+        sched.add_node(n)
+    sched.assume(bound, "n1")
+    pods = [_pref_aff(make_pod("p").req(cpu_milli=100), {"app": "x"}).obj()]
+    placements = sched.schedule_pending(pods)
+    assert placements[0] in ("n1", "n3"), placements  # z1 nodes
