@@ -1,0 +1,207 @@
+"""The API server: the store's REST + watch surface.
+
+Reference shape (reduced): the generic apiserver's REST endpoints +
+watch streams (staging/src/k8s.io/apiserver endpoints/handlers,
+watch.go) over the storage layer.  One process-boundary protocol so
+out-of-process clients — the CLI, remote controllers, a kube shim — use
+the same store the in-process components do:
+
+  GET    /api/v1/{kind}                      list (+ ?namespace=)
+  GET    /api/v1/{kind}/{ns}/{name}          get
+  POST   /api/v1/{kind}                      create (wire-coded body)
+  PUT    /api/v1/{kind}/{ns}/{name}          update (optimistic rv;
+                                             ?force=1 overrides)
+  DELETE /api/v1/{kind}/{ns}/{name}          delete
+  GET    /api/v1/watch/{kind}?from_rv=N      newline-delimited JSON
+                                             event stream (chunked)
+
+Objects travel as api.wire documents (type-tagged dataclass JSON) —
+the codec the journal already uses.  Errors map to the reference's
+status codes: 404 NotFound, 409 AlreadyExists/Conflict, 410 Expired.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from . import store as st
+from . import wire
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store: st.Store  # bound by serve()
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    # -- helpers -----------------------------------------------------------
+
+    def _reply(self, obj, code: int = 200) -> None:
+        data = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _error(self, exc: Exception) -> None:
+        code = (
+            404 if isinstance(exc, st.NotFound)
+            else 409 if isinstance(exc, (st.AlreadyExists, st.Conflict))
+            else 410 if isinstance(exc, st.Expired)
+            else 400
+        )
+        self._reply({"error": str(exc), "reason": type(exc).__name__}, code)
+
+    def _parts(self):
+        parsed = urlparse(self.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        return parts, parse_qs(parsed.query)
+
+    # -- verbs -------------------------------------------------------------
+
+    def do_GET(self) -> None:
+        parts, q = self._parts()
+        try:
+            if len(parts) >= 3 and parts[:2] == ["api", "v1"]:
+                if parts[2] == "watch" and len(parts) == 4:
+                    return self._watch(parts[3], q)
+                if len(parts) == 3:
+                    namespace = q.get("namespace", [None])[0]
+                    items, rv = self.store.list(parts[2], namespace=namespace)
+                    return self._reply(
+                        {
+                            "items": [wire.to_wire(o) for o in items],
+                            "resourceVersion": rv,
+                        }
+                    )
+                if len(parts) == 5:
+                    ns = "" if parts[3] == "-" else parts[3]
+                    obj = self.store.get(parts[2], parts[4], ns)
+                    return self._reply(wire.to_wire(obj))
+            if parts == ["healthz"] or parts == ["readyz"]:
+                return self._reply({"ok": True})
+            self._reply({"error": f"unknown path {self.path}"}, 404)
+        except Exception as e:
+            self._error(e)
+
+    def do_POST(self) -> None:
+        parts, _ = self._parts()
+        try:
+            if len(parts) == 3 and parts[:2] == ["api", "v1"]:
+                obj = wire.from_wire(self._body())
+                created = self.store.create(obj)
+                return self._reply(wire.to_wire(created), 201)
+            self._reply({"error": f"unknown path {self.path}"}, 404)
+        except Exception as e:
+            self._error(e)
+
+    def do_PUT(self) -> None:
+        parts, q = self._parts()
+        try:
+            if len(parts) == 5 and parts[:2] == ["api", "v1"]:
+                obj = wire.from_wire(self._body())
+                force = q.get("force", ["0"])[0] == "1"
+                updated = self.store.update(obj, force=force)
+                return self._reply(wire.to_wire(updated))
+            self._reply({"error": f"unknown path {self.path}"}, 404)
+        except Exception as e:
+            self._error(e)
+
+    def do_DELETE(self) -> None:
+        parts, _ = self._parts()
+        try:
+            if len(parts) == 5 and parts[:2] == ["api", "v1"]:
+                ns = "" if parts[3] == "-" else parts[3]
+                self.store.delete(parts[2], parts[4], ns)
+                return self._reply({"deleted": True})
+            self._reply({"error": f"unknown path {self.path}"}, 404)
+        except Exception as e:
+            self._error(e)
+
+    def _body(self):
+        length = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(length) or b"{}")
+
+    def _watch(self, kind: str, q) -> None:
+        """Newline-delimited JSON watch stream (endpoints/handlers/
+        watch.go's chunked frames).  Ends when the client disconnects or
+        the store terminates the watch."""
+        from_rv = q.get("from_rv", [None])[0]
+        w = self.store.watch(kind, int(from_rv) if from_rv else None)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def frame(payload: bytes) -> None:
+            self.wfile.write(f"{len(payload):x}\r\n".encode())
+            self.wfile.write(payload + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            while True:
+                ev = w.get(timeout=1.0)
+                if w.stopped:
+                    break
+                if ev is None:
+                    # idle keepalive (the watch-bookmark pattern): the
+                    # write is how a dead client surfaces — without it an
+                    # idle watch leaks its thread + store registration
+                    frame(
+                        (json.dumps({"type": "BOOKMARK",
+                                     "rv": self.store.resource_version})
+                         + "\n").encode()
+                    )
+                    continue
+                doc = {
+                    "type": ev.type,
+                    "kind": ev.kind,
+                    "rv": ev.rv,
+                    "object": wire.to_wire(ev.obj),
+                }
+                frame((json.dumps(doc) + "\n").encode())
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            w.stop()
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except Exception:
+                pass
+
+
+class APIServer:
+    """Threaded HTTP server exposing one Store."""
+
+    def __init__(self, store: st.Store, host: str = "127.0.0.1", port: int = 0):
+        handler = type("BoundHandler", (_Handler,), {"store": store})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "APIServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="apiserver", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

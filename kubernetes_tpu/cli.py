@@ -1,0 +1,180 @@
+"""kubectl-style CLI over the REST API.
+
+Reference: the kubectl verb set (staging/src/k8s.io/kubectl
+pkg/cmd/cmd.go) reduced to the operational core — get, describe,
+create -f, delete, scale, events, top-level cluster state — speaking
+the APIServer's wire protocol.
+
+    python -m kubernetes_tpu.cli --server http://127.0.0.1:8080 get pods
+    python -m kubernetes_tpu.cli get nodes
+    python -m kubernetes_tpu.cli describe pod default/web-1
+    python -m kubernetes_tpu.cli create -f deployment.yaml
+    python -m kubernetes_tpu.cli scale deployment front --replicas 5
+    python -m kubernetes_tpu.cli delete pod web-1
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+from .api import types as api
+from .client.rest import RestClient
+
+# kubectl-ish aliases
+KINDS = {
+    "pod": "Pod", "pods": "Pod", "po": "Pod",
+    "node": "Node", "nodes": "Node", "no": "Node",
+    "replicaset": "ReplicaSet", "replicasets": "ReplicaSet", "rs": "ReplicaSet",
+    "deployment": "Deployment", "deployments": "Deployment", "deploy": "Deployment",
+    "job": "Job", "jobs": "Job",
+    "event": "Event", "events": "Event", "ev": "Event",
+    "lease": "Lease", "leases": "Lease",
+}
+
+
+def _kind(word: str) -> str:
+    k = KINDS.get(word.lower())
+    if not k:
+        raise SystemExit(f"unknown resource kind {word!r} (known: {sorted(set(KINDS.values()))})")
+    return k
+
+
+def _fmt_pod(p: api.Pod) -> List[str]:
+    return [
+        f"{p.meta.namespace}/{p.meta.name}",
+        p.status.phase,
+        p.spec.node_name or "<none>",
+        f"cpu={p.resource_requests().get(api.CPU, 0)}m",
+    ]
+
+
+def _fmt_any(o) -> List[str]:
+    name = f"{o.meta.namespace}/{o.meta.name}" if o.meta.namespace else o.meta.name
+    if isinstance(o, api.Pod):
+        return _fmt_pod(o)
+    if isinstance(o, api.Node):
+        alloc = o.status.allocatable
+        return [name, f"cpu={alloc.get(api.CPU, 0)}m", f"pods={alloc.get(api.PODS, 0)}"]
+    if isinstance(o, api.Deployment):
+        return [name, f"{o.status.ready_replicas}/{o.spec.replicas} ready"]
+    if isinstance(o, api.ReplicaSet):
+        return [name, f"{o.status.ready_replicas}/{o.spec.replicas} ready"]
+    if isinstance(o, api.Job):
+        return [name, f"succeeded={o.status.succeeded}", f"active={o.status.active}"]
+    if isinstance(o, api.Event):
+        return [name, o.type, o.reason, f"x{o.count}", o.message[:60]]
+    return [name]
+
+
+def _ns_for(kind: str, args) -> str:
+    # cluster-scoped kinds live in namespace ""
+    return "" if kind == "Node" else args.namespace
+
+
+def cmd_get(client: RestClient, args) -> None:
+    kind = _kind(args.resource)
+    if args.name:
+        obj = client.get(kind, args.name, _ns_for(kind, args))
+        print("  ".join(_fmt_any(obj)))
+        return
+    namespace = (
+        None
+        if kind == "Node" or getattr(args, "all_namespaces", False)
+        else args.namespace
+    )
+    items, rv = client.list(kind, namespace=namespace)
+    for o in items:
+        print("  ".join(_fmt_any(o)))
+    print(f"# {len(items)} {kind}(s) at rv {rv}", file=sys.stderr)
+
+
+def cmd_describe(client: RestClient, args) -> None:
+    from .api import wire
+
+    kind = _kind(args.resource)
+    obj = client.get(kind, args.name, _ns_for(kind, args))
+    print(json.dumps(wire.to_wire(obj), indent=2, default=str))
+
+
+def cmd_create(client: RestClient, args) -> None:
+    import yaml
+
+    from .api import kubeyaml
+
+    with open(args.filename) as f:
+        docs = list(yaml.safe_load_all(f))
+    for d in docs:
+        if not d:
+            continue
+        kind = d.get("kind", "Pod")
+        if kind == "Node":
+            obj = kubeyaml.node_from_dict(d)
+        elif kind == "Pod":
+            obj = kubeyaml.pod_from_dict(d)
+        else:
+            raise SystemExit(f"create -f supports Pod/Node YAML; got {kind}")
+        created = client.create(obj)
+        print(f"{kind.lower()}/{created.meta.name} created")
+
+
+def cmd_delete(client: RestClient, args) -> None:
+    kind = _kind(args.resource)
+    client.delete(kind, args.name, _ns_for(kind, args))
+    print(f"{args.resource.lower()}/{args.name} deleted")
+
+
+def cmd_scale(client: RestClient, args) -> None:
+    kind = _kind(args.resource)
+    if kind not in ("Deployment", "ReplicaSet", "Job"):
+        raise SystemExit(f"cannot scale {kind}")
+    obj = client.get(kind, args.name, args.namespace)
+    if kind == "Job":
+        obj.spec.parallelism = args.replicas
+    else:
+        obj.spec.replicas = args.replicas
+    client.update(obj)
+    print(f"{args.resource.lower()}/{args.name} scaled to {args.replicas}")
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="kubernetes_tpu.cli", description=__doc__)
+    ap.add_argument("--server", default="http://127.0.0.1:8080")
+    ap.add_argument("-n", "--namespace", default="default")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("get")
+    g.add_argument("resource")
+    g.add_argument("name", nargs="?")
+    g.add_argument("-A", "--all-namespaces", action="store_true")
+    g.set_defaults(fn=cmd_get)
+
+    d = sub.add_parser("describe")
+    d.add_argument("resource")
+    d.add_argument("name")
+    d.set_defaults(fn=cmd_describe)
+
+    c = sub.add_parser("create")
+    c.add_argument("-f", "--filename", required=True)
+    c.set_defaults(fn=cmd_create)
+
+    rm = sub.add_parser("delete")
+    rm.add_argument("resource")
+    rm.add_argument("name")
+    rm.set_defaults(fn=cmd_delete)
+
+    s = sub.add_parser("scale")
+    s.add_argument("resource")
+    s.add_argument("name")
+    s.add_argument("--replicas", type=int, required=True)
+    s.set_defaults(fn=cmd_scale)
+
+    args = ap.parse_args(argv)
+    client = RestClient(args.server)
+    args.fn(client, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+"""REST client for the API server — the rest.Request analogue
+(client-go rest/request.go reduced to the verbs our server speaks).
+Returns api.types objects via the wire codec; raises the store's own
+exception types on the mapped status codes."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, List, Optional, Tuple
+
+from ..api import store as st
+from ..api import wire
+
+
+def _ns_seg(namespace: str) -> str:
+    """URL segment for a namespace; cluster-scoped objects (Node) use
+    namespace "" which would collapse out of the path — '-' is the
+    reserved sentinel the server maps back."""
+    return namespace if namespace else "-"
+
+
+class RestClient:
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _call(self, method: str, path: str, body: Any = None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return json.load(r)
+        except urllib.error.HTTPError as e:
+            try:
+                doc = json.load(e)
+            except Exception:
+                doc = {"error": str(e), "reason": ""}
+            exc = {
+                "NotFound": st.NotFound,
+                "AlreadyExists": st.AlreadyExists,
+                "Conflict": st.Conflict,
+                "Expired": st.Expired,
+            }.get(doc.get("reason"), RuntimeError)
+            raise exc(doc.get("error", str(e))) from None
+
+    # -- typed verbs -------------------------------------------------------
+
+    def list(
+        self, kind: str, namespace: Optional[str] = None
+    ) -> Tuple[List[Any], int]:
+        path = f"/api/v1/{kind}"
+        if namespace is not None:
+            path += f"?namespace={namespace}"
+        doc = self._call("GET", path)
+        return [wire.from_wire(d) for d in doc["items"]], doc["resourceVersion"]
+
+    def get(self, kind: str, name: str, namespace: str = "default"):
+        return wire.from_wire(
+            self._call("GET", f"/api/v1/{kind}/{_ns_seg(namespace)}/{name}")
+        )
+
+    def create(self, obj: Any):
+        kind = obj.KIND
+        return wire.from_wire(
+            self._call("POST", f"/api/v1/{kind}", wire.to_wire(obj))
+        )
+
+    def update(self, obj: Any, force: bool = False):
+        kind = obj.KIND
+        path = f"/api/v1/{kind}/{_ns_seg(obj.meta.namespace)}/{obj.meta.name}"
+        if force:
+            path += "?force=1"
+        return wire.from_wire(self._call("PUT", path, wire.to_wire(obj)))
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        self._call("DELETE", f"/api/v1/{kind}/{_ns_seg(namespace)}/{name}")
+
+    def watch(self, kind: str, from_rv: Optional[int] = None):
+        """Generator of (type, obj, rv) from the chunked watch stream."""
+        path = f"/api/v1/watch/{kind}"
+        if from_rv is not None:
+            path += f"?from_rv={from_rv}"
+        req = urllib.request.Request(self.base + path)
+        with urllib.request.urlopen(req) as r:
+            for line in r:
+                line = line.strip()
+                if not line:
+                    continue
+                doc = json.loads(line)
+                if doc["type"] == "BOOKMARK":
+                    continue  # idle keepalive frames (watch bookmarks)
+                yield doc["type"], wire.from_wire(doc["object"]), doc["rv"]

@@ -5,6 +5,7 @@ from .base import Controller
 from .deployment import DeploymentController
 from .job import JobController
 from .manager import ControllerManager
+from .nodelifecycle import NodeLifecycleController
 from .replicaset import ReplicaSetController
 
 __all__ = [
@@ -12,5 +13,6 @@ __all__ = [
     "ControllerManager",
     "DeploymentController",
     "JobController",
+    "NodeLifecycleController",
     "ReplicaSetController",
 ]

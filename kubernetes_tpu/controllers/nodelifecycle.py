@@ -1,0 +1,129 @@
+"""Node lifecycle: heartbeat monitoring → NoExecute taint → eviction.
+
+Reference: pkg/controller/nodelifecycle/node_lifecycle_controller.go:668
+monitorNodeHealth marks nodes NotReady when their heartbeat goes stale,
+taints them node.kubernetes.io/unreachable:NoExecute, and the taint
+eviction controller (pkg/controller/tainteviction) deletes their pods so
+they requeue and reschedule elsewhere.
+
+Ours folds both loops into one controller: heartbeats are OBSERVED from
+Node write events (any update counts — kubelets PATCH status on a
+cadence; kubemark.HollowCluster produces exactly that), a sweep thread
+taints nodes silent past `grace_period` and evicts their pods
+(tolerationSeconds staging is not modelled — eviction is immediate, the
+zero-tolerations default), and a resumed heartbeat clears the taint.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+from ..api import store as st
+from ..api import types as api
+from .base import Controller
+
+
+class NodeLifecycleController(Controller):
+    KIND = "Node"
+
+    def __init__(
+        self,
+        store: st.Store,
+        informers,
+        grace_period: float = 40.0,
+        sweep_interval: float = 5.0,
+        clock=time.monotonic,
+        workers: int = 1,
+    ):
+        super().__init__(store, informers, workers=workers)
+        self.grace_period = grace_period
+        self.sweep_interval = sweep_interval
+        self._clock = clock
+        self._last_seen: Dict[str, float] = {}
+        self._sweeper: threading.Thread = None
+
+    def register(self) -> None:
+        self.informers.informer("Node").add_handler(self._on_node)
+
+    def _on_node(self, typ: str, node: api.Node, old) -> None:
+        if typ == st.DELETED:
+            self._last_seen.pop(node.meta.name, None)
+            return
+        if old is not None and (
+            old.meta.annotations == node.meta.annotations
+            and old.status == node.status
+        ):
+            # spec-only change (e.g. OUR taint/untaint write echoing back)
+            # is not a kubelet heartbeat — counting it would clear the
+            # unreachable taint one sweep after setting it, forever
+            # (observed flapping); heartbeats touch status/annotations
+            return
+        self._last_seen[node.meta.name] = self._clock()
+
+    def start(self) -> None:
+        super().start()
+        self._sweeper = threading.Thread(
+            target=self._sweep_loop, name="nodelifecycle-sweep", daemon=True
+        )
+        self._sweeper.start()
+
+    def stop(self) -> None:
+        super().stop()
+        if self._sweeper:
+            self._sweeper.join(timeout=5)
+
+    def _sweep_loop(self) -> None:
+        while not self._stop.wait(self.sweep_interval):
+            self.sweep()
+
+    def sweep(self) -> None:
+        """One monitorNodeHealth pass (exposed for tests/sim drivers)."""
+        now = self._clock()
+        for name, seen in list(self._last_seen.items()):
+            stale = now - seen > self.grace_period
+            try:
+                node = self.store.get("Node", name, namespace="")
+            except st.NotFound:
+                continue
+            tainted = any(
+                t.key == api.TAINT_NODE_UNREACHABLE for t in node.spec.taints
+            )
+            if stale and not tainted:
+                node.spec.taints.append(
+                    api.Taint(api.TAINT_NODE_UNREACHABLE, "", api.NO_EXECUTE)
+                )
+                try:
+                    self.store.update(node, force=True)
+                except st.NotFound:
+                    continue
+                self._evict_pods(name)
+            elif not stale and tainted:
+                node.spec.taints = [
+                    t for t in node.spec.taints
+                    if t.key != api.TAINT_NODE_UNREACHABLE
+                ]
+                try:
+                    self.store.update(node, force=True)
+                except st.NotFound:
+                    continue
+
+    def _evict_pods(self, node_name: str) -> None:
+        """Taint eviction: delete the silent node's pods unless they
+        tolerate unreachable:NoExecute; they requeue and reschedule."""
+        pods = self.informers.informer("Pod").list()
+        taint = api.Taint(api.TAINT_NODE_UNREACHABLE, "", api.NO_EXECUTE)
+        for pod in pods:
+            if pod.spec.node_name != node_name:
+                continue
+            if api.tolerations_tolerate_taint(pod.spec.tolerations, taint):
+                continue
+            try:
+                self.store.delete("Pod", pod.meta.name, pod.meta.namespace)
+            except st.NotFound:
+                pass
+
+    def sync(self, key: str) -> None:
+        """Level-triggered reconcile is the sweep; per-key work is a
+        no-op (events only refresh _last_seen)."""

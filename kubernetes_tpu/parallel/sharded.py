@@ -270,9 +270,7 @@ def sharded_greedy_jit(mesh: Mesh, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
             features = features_of(snapshot)
         if topo_z is None:
             topo_z = (
-                required_topo_z(snapshot)
-                if (features.spread or features.interpod)
-                else 1
+                required_topo_z(snapshot) if needs_topo(features) else 1
             )
         return run(snapshot, topo_z, features)
 

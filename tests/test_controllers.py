@@ -321,3 +321,114 @@ def test_rs_ready_replicas_updates_after_binding(manager_store):
     assert _wait(
         lambda: store.get("ReplicaSet", "ready").status.ready_replicas == 2
     )
+
+
+def test_nodelifecycle_taints_and_evicts_silent_node():
+    """monitorNodeHealth analogue: a node that stops heartbeating gets
+    the unreachable:NoExecute taint, its pods are evicted and reschedule
+    elsewhere; a resumed heartbeat clears the taint."""
+    from kubernetes_tpu.client.informers import InformerFactory
+    from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing.wrappers import GI
+
+    store = st.Store()
+    for name in ("alive", "silent"):
+        store.create(
+            make_node(name).capacity(cpu_milli=4000, mem=8 * GI, pods=10).obj()
+        )
+    factory = InformerFactory(store)
+    ctrl = NodeLifecycleController(
+        store, factory, grace_period=0.5, sweep_interval=0.1
+    )
+    for kind in ("Node", "Pod"):
+        factory.informer(kind).start()
+    factory.wait_for_sync()
+    ctrl.start()
+    sched = Scheduler(store)
+    sched.informers.informer("Node").start()
+    sched.informers.informer("Pod").start()
+    assert sched.informers.wait_for_sync(10)
+    try:
+        # pin a pod to 'silent' via the RS-free path: bind directly
+        victim = api.Pod(
+            meta=api.ObjectMeta(name="victim"),
+            spec=api.PodSpec(
+                containers=[api.Container(requests={api.CPU: 100})],
+                node_name="silent",
+            ),
+        )
+        store.create(victim)
+        # keep 'alive' heartbeating; let 'silent' go stale
+        deadline = time.monotonic() + 10
+        tainted = False
+        while time.monotonic() < deadline and not tainted:
+            n = store.get("Node", "alive", namespace="")
+            n.meta.annotations["hb"] = str(time.monotonic())  # heartbeat
+            store.update(n, force=True)
+            node = store.get("Node", "silent", namespace="")
+            tainted = any(
+                t.key == api.TAINT_NODE_UNREACHABLE for t in node.spec.taints
+            )
+            time.sleep(0.1)
+        assert tainted, "silent node never tainted"
+        # the pod was evicted
+        assert _wait(
+            lambda: not any(
+                p.meta.name == "victim" for p in store.list("Pod")[0]
+            ),
+            timeout=5,
+        )
+        # heartbeat resumes: taint clears
+        deadline = time.monotonic() + 10
+        cleared = False
+        while time.monotonic() < deadline and not cleared:
+            n = store.get("Node", "silent", namespace="")
+            n.meta.annotations["hb"] = str(time.monotonic())
+            store.update(n, force=True)  # resumed heartbeat
+            n = store.get("Node", "silent", namespace="")
+            cleared = not any(
+                t.key == api.TAINT_NODE_UNREACHABLE for t in n.spec.taints
+            )
+            time.sleep(0.1)
+        assert cleared, "taint never cleared after heartbeat resumed"
+    finally:
+        sched.stop()
+        ctrl.stop()
+        factory.stop()
+
+
+def test_nodelifecycle_taint_does_not_flap():
+    """The controller's own taint write must not count as a heartbeat —
+    a silent node stays tainted (review finding: taint flapped on/off)."""
+    from kubernetes_tpu.client.informers import InformerFactory
+    from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
+
+    store = st.Store()
+    store.create(make_node("dead").capacity(cpu_milli=4000).obj())
+    factory = InformerFactory(store)
+    ctrl = NodeLifecycleController(
+        store, factory, grace_period=0.3, sweep_interval=0.05
+    )
+    for kind in ("Node", "Pod"):
+        factory.informer(kind).start()
+    factory.wait_for_sync()
+    ctrl.start()
+    try:
+        assert _wait(
+            lambda: any(
+                t.key == api.TAINT_NODE_UNREACHABLE
+                for t in store.get("Node", "dead", namespace="").spec.taints
+            ),
+            timeout=5,
+        )
+        # stays tainted across many sweeps
+        for _ in range(10):
+            time.sleep(0.1)
+            assert any(
+                t.key == api.TAINT_NODE_UNREACHABLE
+                for t in store.get("Node", "dead", namespace="").spec.taints
+            ), "taint flapped off a silent node"
+    finally:
+        ctrl.stop()
+        factory.stop()
