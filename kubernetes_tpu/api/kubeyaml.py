@@ -210,3 +210,49 @@ def node_from_dict(d: Dict[str, Any]) -> api.Node:
             )
         )
     return node
+
+
+def _pod_template_from_dict(d: Dict[str, Any]) -> api.PodTemplateSpec:
+    meta = d.get("metadata") or {}
+    pod = pod_from_dict({"spec": d.get("spec") or {}})
+    return api.PodTemplateSpec(
+        meta=api.ObjectMeta(name="", labels=dict(meta.get("labels") or {})),
+        spec=pod.spec,
+    )
+
+
+def deployment_from_dict(d: Dict[str, Any]) -> api.Deployment:
+    meta = d.get("metadata") or {}
+    spec = d.get("spec") or {}
+    return api.Deployment(
+        meta=api.ObjectMeta(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            labels=dict(meta.get("labels") or {}),
+        ),
+        spec=api.DeploymentSpec(
+            replicas=int(spec.get("replicas", 1)),
+            selector=_label_selector(spec.get("selector")) or api.LabelSelector(),
+            template=_pod_template_from_dict(spec.get("template") or {}),
+        ),
+    )
+
+
+def job_from_dict(d: Dict[str, Any]) -> api.Job:
+    meta = d.get("metadata") or {}
+    spec = d.get("spec") or {}
+    return api.Job(
+        meta=api.ObjectMeta(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            labels=dict(meta.get("labels") or {}),
+        ),
+        spec=api.JobSpec(
+            parallelism=int(spec.get("parallelism", 1)),
+            completions=(
+                int(spec["completions"]) if "completions" in spec else 1
+            ),
+            template=_pod_template_from_dict(spec.get("template") or {}),
+            backoff_limit=int(spec.get("backoffLimit", 6)),
+        ),
+    )

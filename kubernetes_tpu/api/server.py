@@ -166,7 +166,12 @@ class _Handler(BaseHTTPRequestHandler):
                     "object": wire.to_wire(ev.obj),
                 }
                 frame((json.dumps(doc) + "\n").encode())
-        except (BrokenPipeError, ConnectionResetError):
+        except Exception:
+            # after headers are sent there is no sane error response —
+            # any write/socket failure (BrokenPipe, ConnectionAborted,
+            # arbitrary OSError) just tears the stream down; letting it
+            # escape would make do_GET write a fresh status line into the
+            # middle of a chunked body
             pass
         finally:
             w.stop()

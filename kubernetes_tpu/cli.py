@@ -110,13 +110,18 @@ def cmd_create(client: RestClient, args) -> None:
         if not d:
             continue
         kind = d.get("kind", "Pod")
-        if kind == "Node":
-            obj = kubeyaml.node_from_dict(d)
-        elif kind == "Pod":
-            obj = kubeyaml.pod_from_dict(d)
-        else:
-            raise SystemExit(f"create -f supports Pod/Node YAML; got {kind}")
-        created = client.create(obj)
+        converters = {
+            "Node": kubeyaml.node_from_dict,
+            "Pod": kubeyaml.pod_from_dict,
+            "Deployment": kubeyaml.deployment_from_dict,
+            "Job": kubeyaml.job_from_dict,
+        }
+        conv = converters.get(kind)
+        if conv is None:
+            raise SystemExit(
+                f"create -f supports {sorted(converters)}; got {kind}"
+            )
+        created = client.create(conv(d))
         print(f"{kind.lower()}/{created.meta.name} created")
 
 

@@ -26,6 +26,22 @@ class RestClient:
         self.base = base_url.rstrip("/")
         self.timeout = timeout
 
+    @staticmethod
+    def _map_http_error(e: urllib.error.HTTPError):
+        try:
+            doc = json.load(e)
+        except Exception:
+            doc = {"error": str(e), "reason": ""}
+        exc = {
+            "NotFound": st.NotFound,
+            "AlreadyExists": st.AlreadyExists,
+            "Conflict": st.Conflict,
+            "Expired": st.Expired,
+        }.get(doc.get("reason"), RuntimeError)
+        if exc is RuntimeError and e.code == 410:
+            exc = st.Expired
+        raise exc(doc.get("error", str(e))) from None
+
     def _call(self, method: str, path: str, body: Any = None):
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(
@@ -36,17 +52,7 @@ class RestClient:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
                 return json.load(r)
         except urllib.error.HTTPError as e:
-            try:
-                doc = json.load(e)
-            except Exception:
-                doc = {"error": str(e), "reason": ""}
-            exc = {
-                "NotFound": st.NotFound,
-                "AlreadyExists": st.AlreadyExists,
-                "Conflict": st.Conflict,
-                "Expired": st.Expired,
-            }.get(doc.get("reason"), RuntimeError)
-            raise exc(doc.get("error", str(e))) from None
+            self._map_http_error(e)
 
     # -- typed verbs -------------------------------------------------------
 
@@ -81,12 +87,25 @@ class RestClient:
         self._call("DELETE", f"/api/v1/{kind}/{_ns_seg(namespace)}/{name}")
 
     def watch(self, kind: str, from_rv: Optional[int] = None):
-        """Generator of (type, obj, rv) from the chunked watch stream."""
+        """Generator of (type, obj, rv) from the chunked watch stream.
+
+        Error contract: a stale from_rv raises st.Expired up front (the
+        410 relist signal), and a stream the SERVER ends (overflowed
+        watcher terminated, server restart) raises st.Expired at the end
+        — a silent return would freeze a remote reflector on stale state;
+        relist-and-rewatch is always the correct reaction.  The read
+        timeout is safe because the server emits 1s BOOKMARK keepalives."""
         path = f"/api/v1/watch/{kind}"
         if from_rv is not None:
             path += f"?from_rv={from_rv}"
         req = urllib.request.Request(self.base + path)
-        with urllib.request.urlopen(req) as r:
+        try:
+            stream = urllib.request.urlopen(
+                req, timeout=max(self.timeout, 5.0)
+            )
+        except urllib.error.HTTPError as e:
+            self._map_http_error(e)
+        with stream as r:
             for line in r:
                 line = line.strip()
                 if not line:
@@ -95,3 +114,4 @@ class RestClient:
                 if doc["type"] == "BOOKMARK":
                     continue  # idle keepalive frames (watch bookmarks)
                 yield doc["type"], wire.from_wire(doc["object"]), doc["rv"]
+        raise st.Expired(f"watch stream for {kind} ended; relist and rewatch")

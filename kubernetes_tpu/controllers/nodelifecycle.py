@@ -90,24 +90,48 @@ class NodeLifecycleController(Controller):
             tainted = any(
                 t.key == api.TAINT_NODE_UNREACHABLE for t in node.spec.taints
             )
-            if stale and not tainted:
+            if stale:
+                if not tainted:
+                    self._set_taint(name, add=True)
+                # level-triggered eviction: pods can land on an
+                # already-tainted node (pinned nodeName, in-flight
+                # binding, informer lag at the first eviction) — every
+                # sweep clears them, like the taint-eviction controller
+                self._evict_pods(name)
+            elif tainted:
+                self._set_taint(name, add=False)
+
+    def _set_taint(self, name: str, add: bool) -> None:
+        """Optimistic-concurrency taint edit: re-read + retry instead of
+        force-writing a stale object — a forced write would revert
+        concurrent heartbeat/label updates (and the revert would then
+        count as a heartbeat, flapping the taint)."""
+        for _ in range(5):
+            try:
+                node = self.store.get("Node", name, namespace="")
+            except st.NotFound:
+                return
+            has = any(
+                t.key == api.TAINT_NODE_UNREACHABLE for t in node.spec.taints
+            )
+            if has == add:
+                return
+            if add:
                 node.spec.taints.append(
                     api.Taint(api.TAINT_NODE_UNREACHABLE, "", api.NO_EXECUTE)
                 )
-                try:
-                    self.store.update(node, force=True)
-                except st.NotFound:
-                    continue
-                self._evict_pods(name)
-            elif not stale and tainted:
+            else:
                 node.spec.taints = [
                     t for t in node.spec.taints
                     if t.key != api.TAINT_NODE_UNREACHABLE
                 ]
-                try:
-                    self.store.update(node, force=True)
-                except st.NotFound:
-                    continue
+            try:
+                self.store.update(node)
+                return
+            except st.Conflict:
+                continue
+            except st.NotFound:
+                return
 
     def _evict_pods(self, node_name: str) -> None:
         """Taint eviction: delete the silent node's pods unless they

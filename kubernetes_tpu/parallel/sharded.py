@@ -30,6 +30,7 @@ from ..ops.assign import (
     SolveResult,
     class_statics,
     features_of,
+    needs_topo,
     required_topo_z,
     solve_order,
 )

@@ -197,3 +197,45 @@ def test_idle_watch_gets_bookmarks(server):
         line = r.readline()
     doc = __import__("json").loads(line)
     assert doc["type"] == "BOOKMARK"
+
+
+def test_cli_create_deployment_yaml(server, tmp_path):
+    """create -f accepts workload YAML, as the CLI help advertises
+    (review finding: only Pod/Node were handled)."""
+    store, srv = server
+    f = tmp_path / "deploy.yaml"
+    f.write_text(
+        "kind: Deployment\n"
+        "metadata: {name: front}\n"
+        "spec:\n"
+        "  replicas: 3\n"
+        "  selector: {matchLabels: {app: front}}\n"
+        "  template:\n"
+        "    metadata: {labels: {app: front}}\n"
+        "    spec:\n"
+        "      containers:\n"
+        "      - resources: {requests: {cpu: 250m}}\n"
+    )
+    out = _run_cli(["--server", srv.url, "create", "-f", str(f)])
+    assert "deployment/front created" in out
+    dep = store.get("Deployment", "front")
+    assert dep.spec.replicas == 3
+    assert dep.spec.template.meta.labels == {"app": "front"}
+    assert dep.spec.template.spec.containers[0].requests["cpu"] == 250
+
+
+def test_watch_raises_expired_on_stale_rv(server):
+    store, srv = server
+    client = RestClient(srv.url)
+    # overflow the event buffer so rv 1 falls out
+    small = st.Store(buffer_size=8)
+    srv2 = APIServer(small).start()
+    try:
+        c2 = RestClient(srv2.url)
+        for i in range(50):
+            small.create(make_pod(f"x{i}").obj())
+        with pytest.raises(st.Expired):
+            for _ in c2.watch("Pod", from_rv=1):
+                break
+    finally:
+        srv2.stop()

