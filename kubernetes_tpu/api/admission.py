@@ -1,0 +1,115 @@
+"""Admission: the mutating → validating plugin chain on API writes.
+
+Reference: apiserver/pkg/admission ({chain,interfaces}.go) — every
+create/update runs mutators (defaulting) then validators (reject) before
+the storage commit.  Ours is a chain of plain callables installed on the
+Store; the built-in set covers the defaulting/validation the scheduler
+stack depends on (the slice of pkg/registry/core/pod/strategy.go and
+pkg/apis/core/validation that would otherwise let malformed objects
+poison batch encodes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List
+
+from . import types as api
+
+
+class AdmissionError(ValueError):
+    """A validating plugin rejected the write (HTTP 400/422 family)."""
+
+
+Mutator = Callable[[Any, str], None]    # (obj, operation) — edit in place
+Validator = Callable[[Any, str], None]  # raise AdmissionError to reject
+
+
+class AdmissionChain:
+    def __init__(self):
+        self.mutators: List[Mutator] = []
+        self.validators: List[Validator] = []
+
+    def register_mutator(self, fn: Mutator) -> None:
+        self.mutators.append(fn)
+
+    def register_validator(self, fn: Validator) -> None:
+        self.validators.append(fn)
+
+    def admit(self, obj: Any, operation: str) -> Any:
+        """Run the chain (mutate, then validate).  Raises AdmissionError
+        on rejection; returns the (mutated) object."""
+        for m in self.mutators:
+            m(obj, operation)
+        for v in self.validators:
+            v(obj, operation)
+        return obj
+
+
+# -- built-in plugins -------------------------------------------------------
+
+
+def default_pod(obj: Any, operation: str) -> None:
+    """Pod defaulting (strategy.PrepareForCreate slice): ensure at least
+    one container and a restart policy."""
+    if not isinstance(obj, api.Pod):
+        return
+    if not obj.spec.containers:
+        obj.spec.containers = [api.Container()]
+    if not obj.spec.restart_policy:
+        obj.spec.restart_policy = "Always"
+
+
+def validate_meta(obj: Any, operation: str) -> None:
+    meta = getattr(obj, "meta", None)
+    if meta is None or not meta.name:
+        raise AdmissionError("metadata.name is required")
+    if any(c.isspace() or c == "/" for c in meta.name):
+        raise AdmissionError(f"invalid name {meta.name!r}")
+
+
+def validate_pod(obj: Any, operation: str) -> None:
+    """The validation slice that protects the scheduler: non-negative
+    requests, sane priority/gang fields, known spread/affinity enums
+    (pkg/apis/core/validation ValidatePodSpec reduced)."""
+    if not isinstance(obj, api.Pod):
+        return
+    for c in obj.spec.containers + obj.spec.init_containers:
+        for k, v in c.requests.items():
+            if v < 0:
+                raise AdmissionError(f"negative request {k}={v}")
+    if obj.spec.preemption_policy not in ("PreemptLowerPriority", "Never"):
+        raise AdmissionError(
+            f"invalid preemptionPolicy {obj.spec.preemption_policy!r}"
+        )
+    gsize = obj.spec.scheduling_group_size
+    if gsize is not None and gsize < 1:
+        raise AdmissionError(f"schedulingGroupSize must be >= 1, got {gsize}")
+    if gsize and not obj.spec.scheduling_group:
+        raise AdmissionError("schedulingGroupSize set without schedulingGroup")
+    for con in obj.spec.topology_spread_constraints:
+        if con.max_skew < 1:
+            raise AdmissionError(f"maxSkew must be >= 1, got {con.max_skew}")
+        if con.when_unsatisfiable not in ("DoNotSchedule", "ScheduleAnyway"):
+            raise AdmissionError(
+                f"invalid whenUnsatisfiable {con.when_unsatisfiable!r}"
+            )
+
+
+def validate_node(obj: Any, operation: str) -> None:
+    if not isinstance(obj, api.Node):
+        return
+    for k, v in obj.status.allocatable.items():
+        if v < 0:
+            raise AdmissionError(f"negative allocatable {k}={v}")
+    for t in obj.spec.taints:
+        if t.effect not in api.TAINT_EFFECTS:
+            raise AdmissionError(f"invalid taint effect {t.effect!r}")
+
+
+def default_chain() -> AdmissionChain:
+    chain = AdmissionChain()
+    chain.register_mutator(default_pod)
+    chain.register_validator(validate_meta)
+    chain.register_validator(validate_pod)
+    chain.register_validator(validate_node)
+    return chain

@@ -133,6 +133,7 @@ class Store:
         buffer_size: int = 4096,
         watch_capacity: int = 1024,
         journal_path: Optional[str] = None,
+        admission=None,
     ):
         self._lock = threading.RLock()
         self._rv = 0
@@ -142,6 +143,10 @@ class Store:
         self._buffer_size = buffer_size
         self._watch_capacity = watch_capacity
         self._watchers: Dict[str, List[Watch]] = {}     # kind -> watches
+        # optional api.admission.AdmissionChain: mutate-then-validate on
+        # every create/update before the commit (the apiserver admission
+        # chain's position in the write path, server/config.go:983)
+        self._admission = admission
         self._journal = None
         self._journal_path = journal_path
         self._journal_records = 0
@@ -272,6 +277,12 @@ class Store:
     # -- CRUD --------------------------------------------------------------
 
     def create(self, obj: Any) -> Any:
+        if self._admission is not None:
+            # admit a server-side COPY: mutators must never edit the
+            # caller's object (a rejected or conflicting write would
+            # leave the caller's template silently modified — every other
+            # store path deep-copies for exactly this isolation)
+            obj = self._admission.admit(copy.deepcopy(obj), "CREATE")
         kind = self._kind_of(obj)
         meta = self._meta(obj)
         key = _key(meta.namespace, meta.name)
@@ -300,6 +311,8 @@ class Store:
         """Optimistic-concurrency update: obj.meta.resource_version must
         match the stored version unless force (the GuaranteedUpdate retry
         loop's compare step)."""
+        if self._admission is not None:
+            obj = self._admission.admit(copy.deepcopy(obj), "UPDATE")
         kind = self._kind_of(obj)
         meta = self._meta(obj)
         key = _key(meta.namespace, meta.name)

@@ -1,0 +1,72 @@
+// The pod-sandbox holder: the TPU-native equivalent of the reference's
+// only in-tree C component (build/pause/linux/pause.c — hold the pod's
+// namespaces, reap orphans, exit on TERM/INT).  Re-designed, not
+// transliterated: a blocked-signal + sigsuspend loop (no lost-wakeup
+// window), PR_SET_CHILD_SUBREAPER so orphans reparent here even outside
+// a PID namespace, and a -v flag for the image version handshake.
+//
+// Build: make -C native pause   (static; see native/Makefile)
+
+#include <csignal>
+#include <cstdio>
+#include <cstring>
+#include <sys/prctl.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+namespace {
+
+volatile sig_atomic_t should_exit = 0;
+
+void on_terminate(int) { should_exit = 1; }
+
+void on_child(int) {
+  // reap every exited child without blocking; WNOHANG drains the queue
+  while (waitpid(-1, nullptr, WNOHANG) > 0) {
+  }
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  for (int i = 1; i < argc; ++i) {
+    if (std::strcmp(argv[i], "-v") == 0) {
+      std::printf("kubernetes_tpu pause 1.0\n");
+      return 0;
+    }
+  }
+  // orphaned descendants reparent to the nearest subreaper — us — so the
+  // reap loop sees them even when we are not PID 1 of a namespace
+  prctl(PR_SET_CHILD_SUBREAPER, 1, 0, 0, 0);
+
+  struct sigaction term {};
+  term.sa_handler = on_terminate;
+  sigaction(SIGINT, &term, nullptr);
+  sigaction(SIGTERM, &term, nullptr);
+
+  struct sigaction chld {};
+  chld.sa_handler = on_child;
+  chld.sa_flags = SA_RESTART;
+  sigaction(SIGCHLD, &chld, nullptr);
+  // drain children that died before the handler existed (a shell that
+  // exec'd us may have left an already-exited child behind — its
+  // SIGCHLD was discarded under the default disposition)
+  on_child(0);
+
+  // Block the signals outside sigsuspend: checking should_exit and THEN
+  // parking with plain pause() loses a signal delivered in between (the
+  // classic lost-wakeup; the reference avoids it by exiting from the
+  // handler).  With the set blocked, delivery happens only inside
+  // sigsuspend, atomically with the wakeup.
+  sigset_t block, orig;
+  sigemptyset(&block);
+  sigaddset(&block, SIGINT);
+  sigaddset(&block, SIGTERM);
+  sigaddset(&block, SIGCHLD);
+  sigprocmask(SIG_BLOCK, &block, &orig);
+  while (!should_exit) {
+    sigsuspend(&orig);
+  }
+  return 0;
+}

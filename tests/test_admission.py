@@ -1,0 +1,80 @@
+"""Admission chain: mutate-then-validate on store writes (apiserver/pkg/
+admission's position in the write path, reduced to the slice that
+protects the scheduler from malformed objects)."""
+
+import pytest
+
+from kubernetes_tpu.api import store as st
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.admission import (
+    AdmissionChain,
+    AdmissionError,
+    default_chain,
+)
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+@pytest.fixture
+def store():
+    return st.Store(admission=default_chain())
+
+
+def test_defaulting_fills_containers(store):
+    pod = api.Pod(meta=api.ObjectMeta(name="bare"))
+    pod.spec.containers = []
+    created = store.create(pod)
+    assert len(created.spec.containers) == 1
+
+
+def test_rejects_negative_requests(store):
+    with pytest.raises(AdmissionError, match="negative request"):
+        store.create(make_pod("bad").req(cpu_milli=-5).obj())
+
+
+def test_rejects_bad_names(store):
+    with pytest.raises(AdmissionError, match="invalid name"):
+        store.create(make_pod("has space").obj())
+    with pytest.raises(AdmissionError, match="required"):
+        store.create(make_pod("").obj())
+
+
+def test_rejects_invalid_spread_and_gang(store):
+    pod = make_pod("p").obj()
+    pod.spec.topology_spread_constraints.append(
+        api.TopologySpreadConstraint(max_skew=0)
+    )
+    with pytest.raises(AdmissionError, match="maxSkew"):
+        store.create(pod)
+    pod2 = make_pod("q").obj()
+    pod2.spec.scheduling_group_size = 3  # size without group
+    with pytest.raises(AdmissionError, match="without schedulingGroup"):
+        store.create(pod2)
+
+
+def test_rejects_invalid_node_taint(store):
+    node = make_node("n").obj()
+    node.spec.taints.append(api.Taint("k", "v", "Sometimes"))
+    with pytest.raises(AdmissionError, match="taint effect"):
+        store.create(node)
+
+
+def test_update_also_admitted(store):
+    store.create(make_pod("p").req(cpu_milli=100).obj())
+    fresh = store.get("Pod", "p")
+    fresh.spec.containers[0].requests[api.CPU] = -1
+    with pytest.raises(AdmissionError):
+        store.update(fresh)
+
+
+def test_custom_webhook_style_plugin():
+    chain = default_chain()
+    chain.register_validator(
+        lambda obj, op: (_ for _ in ()).throw(AdmissionError("quota"))
+        if getattr(obj, "KIND", "") == "Pod"
+        and obj.resource_requests().get(api.CPU, 0) > 1000
+        else None
+    )
+    store = st.Store(admission=chain)
+    store.create(make_pod("small").req(cpu_milli=500).obj())
+    with pytest.raises(AdmissionError, match="quota"):
+        store.create(make_pod("big").req(cpu_milli=8000).obj())

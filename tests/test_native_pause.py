@@ -1,0 +1,70 @@
+"""The native pause sandbox holder (the reference's only in-tree C
+component, build/pause/linux/pause.c): builds with g++, reaps zombies,
+exits on TERM."""
+
+import os
+import shutil
+import signal
+import subprocess
+import time
+
+import pytest
+
+NATIVE = os.path.join(os.path.dirname(__file__), "..", "native")
+
+
+@pytest.fixture(scope="module")
+def pause_bin(tmp_path_factory):
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ in this environment")
+    out = str(tmp_path_factory.mktemp("native") / "pause")
+    subprocess.run(
+        ["g++", "-O2", "-static", "-o", out, os.path.join(NATIVE, "pause.cpp")],
+        check=True,
+    )
+    return out
+
+
+def test_version_flag(pause_bin):
+    r = subprocess.run([pause_bin, "-v"], capture_output=True, text=True)
+    assert r.returncode == 0
+    assert "pause" in r.stdout
+
+
+def test_exits_on_term(pause_bin):
+    p = subprocess.Popen([pause_bin])
+    time.sleep(0.2)
+    assert p.poll() is None  # parked
+    p.send_signal(signal.SIGTERM)
+    assert p.wait(timeout=5) == 0
+
+
+def test_reaps_reparented_orphans(pause_bin):
+    """pause sets PR_SET_CHILD_SUBREAPER: an orphaned grandchild
+    reparents to it and must be REAPED, not left a zombie (the
+    component's actual job)."""
+    # shell child of pause double-forks: the intermediate exits, the
+    # grandchild reparents to pause (nearest subreaper) and exits 0.3s
+    # later — pause's SIGCHLD reap loop must collect it
+    # pause is exec'd over a shell that pre-forked the orphan-maker, so
+    # the maker's processes are pause's children/reparent targets.
+    maker = (
+        # the subshell waits 0.2s so pause has installed its subreaper +
+        # handlers, THEN forks the grandchild and exits; the grandchild
+        # reparents to pause and dies at 0.5s
+        "( sleep 0.2; (sleep 0.3; exit 0) & exit 0 ) & "
+        f"exec {pause_bin}"
+    )
+    p = subprocess.Popen(["/bin/sh", "-c", maker])
+    try:
+        time.sleep(1.0)  # orphan reparented to pause (subreaper) + exited
+        assert p.poll() is None, "pause exited early"
+        # no zombie children of pause remain
+        out = subprocess.run(
+            ["ps", "--ppid", str(p.pid), "-o", "stat="],
+            capture_output=True, text=True,
+        ).stdout
+        assert "Z" not in out, f"zombie children linger: {out!r}"
+    finally:
+        p.send_signal(signal.SIGTERM)
+        assert p.wait(timeout=5) == 0
