@@ -277,12 +277,14 @@ class Store:
     # -- CRUD --------------------------------------------------------------
 
     def create(self, obj: Any) -> Any:
+        admitted = False
         if self._admission is not None:
             # admit a server-side COPY: mutators must never edit the
             # caller's object (a rejected or conflicting write would
             # leave the caller's template silently modified — every other
             # store path deep-copies for exactly this isolation)
             obj = self._admission.admit(copy.deepcopy(obj), "CREATE")
+            admitted = True
         kind = self._kind_of(obj)
         meta = self._meta(obj)
         key = _key(meta.namespace, meta.name)
@@ -291,7 +293,8 @@ class Store:
             if key in objs:
                 raise AlreadyExists(f"{kind} {key} exists")
             self._rv += 1
-            obj = copy.deepcopy(obj)
+            if not admitted:  # the admitted copy is already unaliased
+                obj = copy.deepcopy(obj)
             obj.meta.resource_version = self._rv
             objs[key] = obj
             self._versions.setdefault(kind, {})[key] = self._rv
@@ -311,8 +314,10 @@ class Store:
         """Optimistic-concurrency update: obj.meta.resource_version must
         match the stored version unless force (the GuaranteedUpdate retry
         loop's compare step)."""
+        admitted = False
         if self._admission is not None:
             obj = self._admission.admit(copy.deepcopy(obj), "UPDATE")
+            admitted = True
         kind = self._kind_of(obj)
         meta = self._meta(obj)
         key = _key(meta.namespace, meta.name)
@@ -326,7 +331,8 @@ class Store:
                     f"{kind} {key}: rv {meta.resource_version} != {current_rv}"
                 )
             self._rv += 1
-            obj = copy.deepcopy(obj)
+            if not admitted:
+                obj = copy.deepcopy(obj)
             obj.meta.resource_version = self._rv
             objs[key] = obj
             self._versions[kind][key] = self._rv

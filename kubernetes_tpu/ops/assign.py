@@ -150,6 +150,8 @@ REASON_PORTS = 2      # in-batch host-port conflicts
 REASON_SPREAD = 3     # PodTopologySpread (hard)
 REASON_INTERPOD = 4   # InterPodAffinity (required)
 REASON_GANG = 5       # placed individually but released with its gang
+REASON_UNENCODABLE = 6  # spec exceeds encoder caps / unsupported field —
+                        # only a pod UPDATE can help; no event wakes it
 
 
 class SolveResult(NamedTuple):

@@ -407,11 +407,13 @@ class SchedulingQueue:
         with self._cond:
             now = self._clock()
             for key, info in list(self._unschedulable.items()):
-                if (
-                    wakes is not None
-                    and info.unschedulable_reason >= 0
-                    and info.unschedulable_reason not in wakes
-                ):
+                reason = info.unschedulable_reason
+                if reason == assign_ops.REASON_UNENCODABLE:
+                    # no cluster event can fix a spec the encoder rejects;
+                    # only update() (spec change) or the flush interval
+                    # revives it — even all-reason events skip it
+                    continue
+                if wakes is not None and reason >= 0 and reason not in wakes:
                     continue
                 self._unschedulable.pop(key)
                 moved += 1

@@ -35,6 +35,7 @@ from ..client.events import EventRecorder
 from ..client.informers import InformerFactory
 from ..models.batch_scheduler import TPUBatchScheduler
 from ..ops import assign as assign_ops
+from ..utils.trace import Trace
 from .cache import SchedulerCache
 from .config import SchedulerConfiguration
 from .framework import Framework, FrameworkRegistry
@@ -222,6 +223,15 @@ class Scheduler:
         reservations = self.cache.nominations_excluding(
             {pod_key(info.pod) for info in batch}
         )
+        # slow cycles self-describe on EVERY exit path (utiltrace
+        # LogIfLong, schedule_one.go:391-431); threshold is generous
+        # because first-shape compiles legitimately run tens of seconds
+        with Trace("schedule_batch", threshold=1.0, pods=len(batch)) as trace:
+            return self._schedule_groups(
+                batch, reservations, stats, t0, trace
+            )
+
+    def _schedule_groups(self, batch, reservations, stats, t0, trace):
         # Group the popped batch by profile.  Each group runs its FULL
         # cycle (solve -> assume -> bind) before the next group solves:
         # assume lands the placements in the shared state, so a later
@@ -242,20 +252,33 @@ class Scheduler:
                     reservations=reservations,
                 )
             except (OverflowError, ValueError):
-                group = self._reject_unencodable(group)
+                group = self._reject_unencodable(group, fwk)
                 if not group:
                     continue
-                names = fwk.tpu.schedule_pending(
-                    [info.pod for info in group], lock=self.cache.lock,
-                    reservations=reservations,
-                )
+                try:
+                    names = fwk.tpu.schedule_pending(
+                        [info.pod for info in group], lock=self.cache.lock,
+                        reservations=reservations,
+                    )
+                except (OverflowError, ValueError):
+                    # cumulative/batch-level encode failure even though
+                    # each pod encodes alone: park the whole group rather
+                    # than killing the scheduler thread
+                    for info in group:
+                        self.metrics.schedule_attempts.inc("error")
+                        self.queue.add_unschedulable(
+                            info, reason=assign_ops.REASON_UNENCODABLE
+                        )
+                    continue
             solved_any = True
             result = fwk.tpu.last_result
             if result is not None and result.reasons is not None:
                 reasons = [int(r) for r in np.asarray(result.reasons)[: len(group)]]
             else:
                 reasons = [-1] * len(group)
+            trace.step(f"solve[{sched_name}]")
             self._commit_group(fwk, group, names, reasons, stats, failed)
+            trace.step(f"commit[{sched_name}]")
         if not solved_any:
             return stats
         self.metrics.scheduling_algorithm_duration.observe(self._clock() - t0)
@@ -270,9 +293,11 @@ class Scheduler:
             if fwk is not None and fwk.run_post_filter(info.pod):
                 stats["preempted"] = stats.get("preempted", 0) + 1
 
+        trace.step("postfilter")
         qs = self.queue.stats()
         for tier, v in qs.items():
             self.metrics.pending_pods.set(v, tier)
+        trace.log_if_long()
         return stats
 
     def _commit_group(
@@ -340,19 +365,26 @@ class Scheduler:
         result = self.preemption.preempt(pod)
         return result.nominated_node if result else None
 
-    def _reject_unencodable(self, batch: List[QueuedPodInfo]) -> List[QueuedPodInfo]:
+    def _reject_unencodable(
+        self, batch: List[QueuedPodInfo], fwk: Optional[Framework] = None
+    ) -> List[QueuedPodInfo]:
         """Batch encode failed: find the offending pods by encoding each
-        alone (rare path; the per-pod encode is the authoritative
-        validation, so checks are never duplicated here) and park them
+        alone against the SAME profile's builder (rare path; the per-pod
+        encode is the authoritative validation) and park them
         unschedulable.  Returns the encodable remainder."""
+        tpu = fwk.tpu if fwk is not None else self.tpu
         good: List[QueuedPodInfo] = []
         for info in batch:
             try:
-                self.tpu.encode_pending([info.pod], lock=self.cache.lock)
+                tpu.encode_pending([info.pod], lock=self.cache.lock)
                 good.append(info)
             except (OverflowError, ValueError):
                 self.metrics.schedule_attempts.inc("error")
-                self.queue.add_unschedulable(info)
+                # only a pod UPDATE (spec change) can help — no cluster
+                # event wakes this reason (queue.move_for_event)
+                self.queue.add_unschedulable(
+                    info, reason=assign_ops.REASON_UNENCODABLE
+                )
         return good
 
     def _bind(self, pod: api.Pod, node_name: str) -> None:

@@ -1,0 +1,56 @@
+"""Poor-man's op tracing: timed steps logged when a threshold is blown.
+
+Reference: utiltrace.New("Scheduling", ...) with LogIfLong(100ms) steps
+inside schedulePod (schedule_one.go:391-431) — the lightweight always-on
+layer under the OTel integration.  A Trace collects named steps; if the
+total exceeds the threshold at the end of the `with` block, every step
+is logged with its share, so slow cycles self-describe in logs without a
+profiler attached.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Tuple
+
+logger = logging.getLogger("kubernetes_tpu.trace")
+
+
+class Trace:
+    def __init__(self, name: str, threshold: float = 0.1, clock=time.monotonic,
+                 **fields):
+        self.name = name
+        self.threshold = threshold
+        self._clock = clock
+        self.fields = fields
+        self._t0 = clock()
+        self._last = self._t0
+        self.steps: List[Tuple[str, float]] = []
+
+    def step(self, what: str) -> None:
+        now = self._clock()
+        self.steps.append((what, now - self._last))
+        self._last = now
+
+    @property
+    def total(self) -> float:
+        return self._clock() - self._t0
+
+    def __enter__(self) -> "Trace":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.log_if_long()
+
+    def log_if_long(self, threshold: Optional[float] = None) -> None:
+        limit = self.threshold if threshold is None else threshold
+        total = self.total
+        if total < limit:
+            return
+        tags = ",".join(f"{k}={v}" for k, v in self.fields.items())
+        parts = "; ".join(f"{w}: {dt * 1e3:.1f}ms" for w, dt in self.steps)
+        logger.warning(
+            "trace %s (%s) took %.1fms (threshold %.0fms): %s",
+            self.name, tags, total * 1e3, limit * 1e3, parts,
+        )

@@ -17,11 +17,11 @@ NATIVE = os.path.join(os.path.dirname(__file__), "..", "native")
 def pause_bin(tmp_path_factory):
     if shutil.which("g++") is None:
         pytest.skip("no g++ in this environment")
+    # build through the Makefile — one authoritative recipe
+    subprocess.run(["make", "-C", NATIVE, "pause"], check=True)
     out = str(tmp_path_factory.mktemp("native") / "pause")
-    subprocess.run(
-        ["g++", "-O2", "-static", "-o", out, os.path.join(NATIVE, "pause.cpp")],
-        check=True,
-    )
+    shutil.copy(os.path.join(NATIVE, "pause"), out)
+    subprocess.run(["make", "-C", NATIVE, "clean"], check=True)
     return out
 
 

@@ -1,0 +1,27 @@
+"""Op tracing (utiltrace LogIfLong analogue)."""
+
+import logging
+
+from kubernetes_tpu.utils.trace import Trace
+
+
+def test_trace_logs_only_when_slow(caplog):
+    t = [0.0]
+
+    def clock():
+        return t[0]
+
+    with caplog.at_level(logging.WARNING, logger="kubernetes_tpu.trace"):
+        with Trace("fast", threshold=1.0, clock=clock) as tr:
+            t[0] += 0.1
+            tr.step("a")
+        assert caplog.records == []
+        with Trace("slow", threshold=1.0, clock=clock, pods=7) as tr:
+            t[0] += 0.4
+            tr.step("solve")
+            t[0] += 0.8
+            tr.step("bind")
+        assert len(caplog.records) == 1
+        msg = caplog.records[0].getMessage()
+        assert "slow" in msg and "pods=7" in msg
+        assert "solve: 400.0ms" in msg and "bind: 800.0ms" in msg
