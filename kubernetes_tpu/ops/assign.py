@@ -75,6 +75,7 @@ class FeatureFlags(NamedTuple):
                                # only, so this gates its routing)
     spread_slots: Tuple[int, ...] = ()  # topology-key slots spread rows use
     interpod_pref: bool = False  # any preferred (scoring) interpod terms
+    images: bool = False         # any pending pod names a known image
 
 
 def required_topo_z(snapshot: Snapshot) -> int:
@@ -135,6 +136,10 @@ def features_of(snapshot: Snapshot) -> FeatureFlags:
             sorted(set(np.asarray(snapshot.spread.slot)[spread_valid].tolist()))
         ),
         interpod_pref=bool(np.asarray(snapshot.prefpod.valid).any()),
+        images=bool(
+            (np.asarray(snapshot.images.pod_ids) >= 0).any()
+            and np.asarray(snapshot.cluster.image_bits).any()
+        ),
     )
 
 
@@ -246,7 +251,7 @@ def greedy_assign(
         features = features_of(snapshot)
     if topo_z is None:
         topo_z = required_topo_z(snapshot)
-    (cluster, pods, sel, pref, spread, terms, prefpod) = jax.tree.map(
+    (cluster, pods, sel, pref, spread, terms, prefpod, images) = jax.tree.map(
         jnp.asarray, tuple(snapshot)
     )
     n = cluster.allocatable.shape[0]
@@ -257,26 +262,27 @@ def greedy_assign(
     sfeas_c, aff_c, taint_c = class_statics(cluster, pods, sel_mask, pref_mask)
     c_dim = sfeas_c.shape[0]
     extra_c = None
-    if features.interpod_pref:
-        # Preferred inter-pod affinity, hoisted per class: counts come
-        # from BOUND pods at prep (scoring.go PreScore over the cycle
-        # snapshot); in-batch placements don't attract later batchmates
-        # within this solve — they do from the next batch (documented
-        # divergence; the normalization set is the class's static-feasible
-        # nodes rather than the per-step filtered set).
-        from .interpod import pref_pod_raw, prep_pref_pod
-        from .scores import normalize_minmax
+    if features.interpod_pref or features.images:
+        # Hoisted per-class static score extras: preferred inter-pod
+        # affinity (counts from BOUND pods at prep — scoring.go PreScore
+        # over the cycle snapshot; in-batch placements don't attract
+        # later batchmates within this solve, documented divergence, and
+        # the normalization set is the class's static-feasible nodes) and
+        # ImageLocality (image presence never changes mid-solve).
+        from .interpod import prep_pref_pod
+        from .scores import static_extra
 
-        pp = prep_pref_pod(cluster, prefpod, topo_z)
-        reps_e = jnp.clip(pods.class_rep, 0, p - 1)
-
-        def one_extra(c, rep):
-            raw = pref_pod_raw(pp, prefpod, rep)
-            return cfg.interpod_weight * normalize_minmax(raw, sfeas_c[c])
-
-        extra_c = jax.vmap(one_extra)(
-            jnp.arange(c_dim, dtype=jnp.int32), reps_e
+        pp = (
+            prep_pref_pod(cluster, prefpod, topo_z)
+            if features.interpod_pref
+            else None
         )
+        reps_e = jnp.clip(pods.class_rep, 0, p - 1)
+        extra_c = jax.vmap(
+            lambda c, rep: static_extra(
+                cluster, prefpod, images, features, cfg, rep, sfeas_c[c], pp
+            )
+        )(jnp.arange(c_dim, dtype=jnp.int32), reps_e)
     sp0 = prep_spread(cluster, sel_mask, spread, topo_z) if features.spread else None
     tm0 = (
         prep_terms(cluster, terms, topo_z, slots=features.term_slots)
@@ -469,7 +475,7 @@ def evaluate_single(
         features = features_of(snapshot)
     if topo_z is None:
         topo_z = required_topo_z(snapshot) if needs_topo(features) else 1
-    (cluster, pods, sel, pref, spread, terms, prefpod) = jax.tree.map(
+    (cluster, pods, sel, pref, spread, terms, prefpod, images) = jax.tree.map(
         jnp.asarray, tuple(snapshot)
     )
     from .interpod import interpod_filter, pref_pod_raw, prep_pref_pod, prep_terms
@@ -492,12 +498,16 @@ def evaluate_single(
         tm = prep_terms(cluster, terms, topo_z, slots=features.term_slots)
         feas = feas & interpod_filter(tm, terms, 0)
     extra = None
-    if features.interpod_pref:
-        from .scores import normalize_minmax
+    if features.interpod_pref or features.images:
+        from .scores import static_extra
 
-        pp = prep_pref_pod(cluster, prefpod, topo_z)
-        extra = cfg.interpod_weight * normalize_minmax(
-            pref_pod_raw(pp, prefpod, 0), feas
+        pp = (
+            prep_pref_pod(cluster, prefpod, topo_z)
+            if features.interpod_pref
+            else None
+        )
+        extra = static_extra(
+            cluster, prefpod, images, features, cfg, 0, feas, pp
         )
     scores = score_from_raw(
         cluster, pod, feas,

@@ -156,7 +156,7 @@ def auction_assign(
         topo_z = required_topo_z_split(snapshot)
     z_spread, z_terms = topo_z
     tie_k = min(tie_k, snapshot.cluster.allocatable.shape[0])
-    (cluster, pods, sel, pref, spread, terms, prefpod) = jax.tree.map(
+    (cluster, pods, sel, pref, spread, terms, prefpod, images) = jax.tree.map(
         jnp.asarray, tuple(snapshot)
     )
     n = cluster.allocatable.shape[0]
@@ -167,19 +167,22 @@ def auction_assign(
     c_dim = sfeas_c.shape[0]
     reps = jnp.clip(pods.class_rep, 0, p - 1)
     extra_c = None
-    if features.interpod_pref:
-        # hoisted preferred-interpod score per class (see ops.assign's
-        # identical hoist for the divergence notes)
-        from .interpod import pref_pod_raw, prep_pref_pod
-        from .scores import normalize_minmax
+    if features.interpod_pref or features.images:
+        # hoisted per-class static extras (shared scores.static_extra;
+        # see ops.assign's hoist for the divergence notes)
+        from .interpod import prep_pref_pod
+        from .scores import static_extra
 
-        pp = prep_pref_pod(cluster, prefpod, z_terms)
-        def one_extra(c, rep):
-            raw = pref_pod_raw(pp, prefpod, rep)
-            return cfg.interpod_weight * normalize_minmax(raw, sfeas_c[c])
-        extra_c = jax.vmap(one_extra)(
-            jnp.arange(c_dim, dtype=jnp.int32), reps
+        pp = (
+            prep_pref_pod(cluster, prefpod, z_terms)
+            if features.interpod_pref
+            else None
         )
+        extra_c = jax.vmap(
+            lambda c, rep: static_extra(
+                cluster, prefpod, images, features, cfg, rep, sfeas_c[c], pp
+            )
+        )(jnp.arange(c_dim, dtype=jnp.int32), reps)
 
     order = solve_order(pods)
     # solve_pos[i] = pod i's rank in solve order (repair keeps prefixes

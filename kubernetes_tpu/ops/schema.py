@@ -88,6 +88,7 @@ class ClusterTensors(NamedTuple):
     taint_bits: np.ndarray         # u32[3, N, TW]  effect-major
     port_bits: np.ndarray          # u32[N, PW]
     topo_ids: np.ndarray           # i32[N, TK]  per-key value id, -1 absent
+    image_bits: np.ndarray         # u32[N, IW]  images present on the node
 
 
 class SelectorTable(NamedTuple):
@@ -206,6 +207,16 @@ class PrefPodTable(NamedTuple):
     pod_weight: np.ndarray       # f32[P, MA] signed
 
 
+class ImageTable(NamedTuple):
+    """ImageLocality inputs (imagelocality/image_locality.go): interned
+    image sizes and each pending pod's image ids; presence rides
+    ClusterTensors.image_bits."""
+
+    sizes: np.ndarray         # f32[I_pad] bytes (0 = unknown image)
+    pod_ids: np.ndarray       # i32[P, MI] -1 pad
+    n_containers: np.ndarray  # f32[P] image-bearing containers (incl init)
+
+
 class Snapshot(NamedTuple):
     cluster: ClusterTensors
     pods: PodBatch
@@ -214,6 +225,7 @@ class Snapshot(NamedTuple):
     spread: SpreadTable
     terms: TermTable
     prefpod: PrefPodTable
+    images: ImageTable
 
 
 def num_groups(snapshot: Snapshot) -> int:
@@ -239,6 +251,8 @@ class SnapshotLimits:
     # preferred-interpod score (apis/config HardPodAffinityWeight default)
     hard_pod_affinity_weight: float = 1.0
     label_capacity: int = 4096
+    image_capacity: int = 512   # distinct container images tracked
+    max_pod_images: int = 8     # container images per pod (ImageLocality)
     taint_capacity: int = 256
     port_capacity: int = 2048
     topology_keys: Tuple[str, ...] = (api.LABEL_HOSTNAME, api.LABEL_ZONE, api.LABEL_REGION)
@@ -256,6 +270,10 @@ class SnapshotLimits:
     @property
     def port_words(self) -> int:
         return vb.words_for(self.port_capacity)
+
+    @property
+    def image_words(self) -> int:
+        return vb.words_for(self.image_capacity)
 
 
 @dataclass
@@ -300,6 +318,11 @@ class SnapshotBuilder:
         self.taint_vocab = vb.PairVocab()
         self.port_vocab = vb.Vocab()
         self.name_vocab = vb.Vocab()
+        # image name -> id (capped; images beyond image_capacity are
+        # ignored for scoring rather than erroring — locality is a
+        # best-effort score, not a correctness constraint)
+        self.image_vocab = vb.Vocab()
+        self.image_sizes: Dict[int, float] = {}
         self.topo_vocabs: Dict[str, vb.Vocab] = {
             k: vb.Vocab() for k in self.limits.topology_keys
         }
@@ -345,6 +368,72 @@ class SnapshotBuilder:
                     self.label_vocab.intern((k, v))
             for t in node.effective_taints():
                 self.taint_vocab.intern((t.key, t.value))
+            for img in node.status.images:
+                self._intern_image(img.names, img.size_bytes)
+
+    @staticmethod
+    def _normalize_image(name: str) -> str:
+        """normalizedImageName (imagelocality/image_locality.go): an
+        untagged, undigested name means ':latest'."""
+        tail = name.rsplit("/", 1)[-1]
+        if ":" not in tail and "@" not in tail:
+            return name + ":latest"
+        return name
+
+    def _intern_image(self, names, size_bytes: float = 0.0) -> int:
+        """Intern an image under ALL its (normalized) names — tags and
+        digests alias one id; returns the id or -1 when the vocabulary is
+        full."""
+        if not names:
+            return -1
+        names = [self._normalize_image(n) for n in names]
+        known = [self.image_vocab.get(n) for n in names]
+        ident = next((i for i in known if i >= 0), -1)
+        if ident < 0:
+            if len(self.image_vocab) >= self.limits.image_capacity:
+                return -1
+            ident = self.image_vocab.intern(names[0])
+        for n in names:
+            self.image_vocab.alias(n, ident)
+        if size_bytes:
+            self.image_sizes[ident] = max(
+                self.image_sizes.get(ident, 0.0), float(size_bytes)
+            )
+        return ident
+
+    def _image_row(self, node: api.Node, row: np.ndarray) -> None:
+        row[:] = 0
+        for img in node.status.images:
+            ident = self._intern_image(img.names, img.size_bytes)
+            if ident >= 0:
+                vb.set_bit(row, ident)
+
+    def image_table(self, pods: Sequence[api.Pod], p_dim: int) -> ImageTable:
+        mi = self.limits.max_pod_images
+        ids = np.full((p_dim, mi), -1, dtype=np.int32)
+        n_containers = np.zeros(p_dim, dtype=np.float32)
+        for i, pod in enumerate(pods):
+            imgs = [
+                c.image
+                for c in pod.spec.init_containers + pod.spec.containers
+                if c.image
+            ]
+            if len(imgs) > mi:
+                raise OverflowError(
+                    f"pod has {len(imgs)} container images, exceeding "
+                    f"max_pod_images={mi}"
+                )
+            # the reference scales maxThreshold by the pod's TOTAL
+            # image-bearing container count, known to the cluster or not
+            n_containers[i] = len(imgs)
+            for j, name in enumerate(imgs):
+                ids[i, j] = self.image_vocab.get(self._normalize_image(name))
+        i_pad = vb.pad_dim(max(len(self.image_vocab), 1), 1)
+        sizes = np.zeros(i_pad, dtype=np.float32)
+        for ident, sz in self.image_sizes.items():
+            if ident < i_pad:
+                sizes[ident] = sz
+        return ImageTable(sizes=sizes, pod_ids=ids, n_containers=n_containers)
 
     # -- selector expansion ------------------------------------------------
 
@@ -512,7 +601,8 @@ class SnapshotBuilder:
         spread, terms, prefpod = self._build_constraints(
             pending_pods, bound_by_node, sel_index, n, p_dim
         )
-        pods = _refine_classes(pods, spread, terms, prefpod)
+        images = self.image_table(pending_pods, p_dim)
+        pods = _refine_classes(pods, spread, terms, prefpod, images)
         meta = SnapshotMeta(
             num_nodes=len(nodes),
             num_pods=len(pending_pods),
@@ -521,7 +611,9 @@ class SnapshotBuilder:
             limits=lim,
             topo_z=self._topo_z(),
         )
-        return Snapshot(cluster, pods, sel, pref, spread, terms, prefpod), meta
+        return Snapshot(
+            cluster, pods, sel, pref, spread, terms, prefpod, images
+        ), meta
 
     def _topo_z(self) -> int:
         return vb.pad_dim(
@@ -554,7 +646,8 @@ class SnapshotBuilder:
         spread, terms, prefpod = self._build_constraints(
             pending_pods, state.bound_pods(), sel_index, n, p_dim
         )
-        pods = _refine_classes(pods, spread, terms, prefpod)
+        images = self.image_table(pending_pods, p_dim)
+        pods = _refine_classes(pods, spread, terms, prefpod, images)
         meta = SnapshotMeta(
             num_nodes=state._high,
             num_pods=len(pending_pods),
@@ -563,7 +656,9 @@ class SnapshotBuilder:
             limits=self.limits,
             topo_z=self._topo_z(),
         )
-        return Snapshot(cluster, pods, sel, pref, spread, terms, prefpod), meta
+        return Snapshot(
+            cluster, pods, sel, pref, spread, terms, prefpod, images
+        ), meta
 
     def _build_cluster(
         self,
@@ -583,10 +678,12 @@ class SnapshotBuilder:
         taint_bits = np.zeros((3, n, lim.taint_words), dtype=np.uint32)
         port_bits = np.zeros((n, lim.port_words), dtype=np.uint32)
         topo_ids = np.full((n, len(lim.topology_keys)), -1, dtype=np.int32)
+        image_bits = np.zeros((n, lim.image_words), dtype=np.uint32)
 
         for i, node in enumerate(nodes):
             self._write_node_row(
-                node, i, valid, name_id, alloc, label_bits, taint_bits, topo_ids
+                node, i, valid, name_id, alloc, label_bits, taint_bits,
+                topo_ids, image_bits,
             )
 
         for pod in bound_pods:
@@ -608,6 +705,7 @@ class SnapshotBuilder:
             taint_bits=taint_bits,
             port_bits=port_bits,
             topo_ids=topo_ids,
+            image_bits=image_bits,
         )
 
     def _write_node_row(
@@ -620,6 +718,7 @@ class SnapshotBuilder:
         label_bits: np.ndarray,
         taint_bits: np.ndarray,
         topo_ids: np.ndarray,
+        image_bits: Optional[np.ndarray] = None,
     ) -> None:
         """Encode one node's static state into row i of the given arrays.
         Interns the node's strings first, so it is safe for incremental
@@ -646,6 +745,8 @@ class SnapshotBuilder:
             val = node.meta.labels.get(key)
             if val is not None:
                 topo_ids[i, j] = self.topo_vocabs[key].get(val)
+        if image_bits is not None:
+            self._image_row(node, image_bits[i])
 
     def _check_f32_exact(self, node_name: str, alloc_row: np.ndarray) -> None:
         """Warn (once per builder) when a node's allocatable exceeds the
@@ -1221,6 +1322,7 @@ class ClusterState:
         self.taint_bits = np.zeros((3, cap, lim.taint_words), dtype=np.uint32)
         self.port_bits = np.zeros((cap, lim.port_words), dtype=np.uint32)
         self.topo_ids = np.full((cap, len(lim.topology_keys)), -1, dtype=np.int32)
+        self.image_bits = np.zeros((cap, lim.image_words), dtype=np.uint32)
 
     def _grow(self, cap: int) -> None:
         old = self.tensors(pad=False)
@@ -1235,6 +1337,7 @@ class ClusterState:
         self.taint_bits[:, :h] = old.taint_bits[:, :h]
         self.port_bits[:h] = old.port_bits[:h]
         self.topo_ids[:h] = old.topo_ids[:h]
+        self.image_bits[:h] = old.image_bits[:h]
         self._cap = cap
 
     def ensure_resources(self) -> None:
@@ -1272,7 +1375,7 @@ class ClusterState:
         self._pods_by_node.setdefault(name, [])
         self.builder._write_node_row(
             node, i, self.node_valid, self.name_id, self.allocatable,
-            self.label_bits, self.taint_bits, self.topo_ids,
+            self.label_bits, self.taint_bits, self.topo_ids, self.image_bits,
         )
 
     def update_node(self, node: api.Node) -> None:
@@ -1284,7 +1387,7 @@ class ClusterState:
         self.ensure_resources()
         self.builder._write_node_row(
             node, i, self.node_valid, self.name_id, self.allocatable,
-            self.label_bits, self.taint_bits, self.topo_ids,
+            self.label_bits, self.taint_bits, self.topo_ids, self.image_bits,
         )
 
     def remove_node(self, name: str) -> None:
@@ -1306,6 +1409,7 @@ class ClusterState:
         self.taint_bits[:, i] = 0
         self.port_bits[i] = 0
         self.topo_ids[i] = -1
+        self.image_bits[i] = 0
         self.node_names[i] = None
 
     def _move_row(self, src: int, dst: int) -> None:
@@ -1318,6 +1422,7 @@ class ClusterState:
         self.taint_bits[:, dst] = self.taint_bits[:, src]
         self.port_bits[dst] = self.port_bits[src]
         self.topo_ids[dst] = self.topo_ids[src]
+        self.image_bits[dst] = self.image_bits[src]
         name = self.node_names[src]
         self.node_names[dst] = name
         self._rows[name] = dst
@@ -1417,6 +1522,7 @@ class ClusterState:
             taint_bits=self.taint_bits[:, :n],
             port_bits=self.port_bits[:n],
             topo_ids=self.topo_ids[:n],
+            image_bits=self.image_bits[:n],
         )
 
 
@@ -1451,6 +1557,7 @@ def _refine_classes(
     spread: SpreadTable,
     terms: TermTable,
     prefpod: Optional[PrefPodTable] = None,
+    images: Optional[ImageTable] = None,
 ) -> PodBatch:
     """Split spec-equivalence classes by constraint identity.
 
@@ -1462,7 +1569,8 @@ def _refine_classes(
     class; the signature here adds each pod's spread rows + match flags
     and (anti-)affinity term memberships."""
     has_pref = prefpod is not None and prefpod.valid.any()
-    if not (spread.valid.any() or terms.valid.any() or has_pref):
+    has_images = images is not None and (images.pod_ids >= 0).any()
+    if not (spread.valid.any() or terms.valid.any() or has_pref or has_images):
         return pods
     p = pods.class_id.shape[0]
     parts = [
@@ -1480,6 +1588,8 @@ def _refine_classes(
             prefpod.pod_weight.view(np.uint32),
             prefpod.matches_incoming.astype(np.uint32),
         ]
+    if has_images:
+        parts += [images.pod_ids.view(np.uint32)]
     sig = np.concatenate(parts, axis=1)
     sig = np.ascontiguousarray(sig)
     row_bytes = sig.view(np.uint8).reshape(p, -1)

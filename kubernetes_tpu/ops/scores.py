@@ -53,6 +53,7 @@ class ScoreConfig:
     balanced_resources: Tuple[int, ...] = (RESOURCE_CPU, RESOURCE_MEMORY)
     fit_strategy: str = "LeastAllocated"  # or MostAllocated | RequestedToCapacityRatio
     interpod_weight: float = 2.0         # InterPodAffinity (preferred terms)
+    image_weight: float = 1.0            # ImageLocality
     # RequestedToCapacityRatio shape: (utilization%, score) points,
     # piecewise-linear (requested_to_capacity_ratio.go buildBrokenLinear).
     # The default shape is the bin-packing example from the reference
@@ -263,6 +264,67 @@ def score_for_pod(
         axis_name=axis_name,
         spread_score=spread_score,
     )
+
+
+_IMG_MB = 1024.0 * 1024.0
+_IMG_MIN = 23.0 * _IMG_MB              # minThreshold (image_locality.go)
+_IMG_MAX_PER_CONTAINER = 1000.0 * _IMG_MB
+
+
+def image_locality_score(cluster, images, p) -> jnp.ndarray:
+    """ImageLocality Score, 0..100 per node
+    (imagelocality/image_locality.go): sum of the pod's image sizes
+    already present on the node, each scaled by its cluster spread ratio
+    (nodes-having-it / valid nodes), clamped into
+    [23MB, 1000MB x containers] and linearly mapped to the score range.
+    No NormalizeScore pass — the reference plugin returns the scaled
+    value directly."""
+    ids = images.pod_ids[p]                                  # [MI]
+    active = ids >= 0
+    idc = jnp.clip(ids, 0, images.sizes.shape[0] - 1)
+    word = idc // 32
+    bit = idc % 32
+    present = ((cluster.image_bits[:, word] >> bit) & 1).astype(jnp.float32)
+    n_valid = jnp.maximum(cluster.node_valid.sum(), 1).astype(jnp.float32)
+    counts = (present * cluster.node_valid[:, None]).sum(axis=0)  # [MI]
+    scaled = images.sizes[idc] * counts / n_valid                 # [MI]
+    raw = (present * (scaled * active)[None, :]).sum(axis=-1)     # [N]
+    # the threshold scales with the pod's TOTAL image-bearing container
+    # count (incl. init and cluster-unknown images) — scaling by known
+    # images only would inflate scores ~2x vs the reference
+    n_containers = jnp.maximum(images.n_containers[p], 1.0)
+    lo = _IMG_MIN
+    hi = _IMG_MAX_PER_CONTAINER * n_containers
+    score = _floor(MAX_NODE_SCORE * (jnp.clip(raw, lo, hi) - lo) / (hi - lo))
+    return jnp.where(active.any(), score, 0.0)
+
+
+def static_extra(
+    cluster,
+    prefpod,
+    images,
+    features,
+    cfg: ScoreConfig,
+    rep,
+    feasible,
+    pp_state=None,
+) -> jnp.ndarray:
+    """The hoisted per-class static score extras (preferred inter-pod
+    affinity + ImageLocality), shared by the greedy/auction hoists and
+    evaluate_single so the families can't drift apart.  `feasible` is
+    the normalization set; `pp_state` the prep_pref_pod output (required
+    when features.interpod_pref)."""
+    from .interpod import pref_pod_raw
+
+    total = jnp.zeros(cluster.allocatable.shape[0], jnp.float32)
+    if features.interpod_pref:
+        raw = pref_pod_raw(pp_state, prefpod, rep)
+        total = total + cfg.interpod_weight * normalize_minmax(raw, feasible)
+    if features.images:
+        total = total + cfg.image_weight * image_locality_score(
+            cluster, images, rep
+        )
+    return total
 
 
 def normalize_minmax(

@@ -59,6 +59,7 @@ CLUSTER_SPECS = ClusterTensors(
     taint_bits=P(None, AXIS, None),
     port_bits=P(AXIS, None),
     topo_ids=P(AXIS, None),
+    image_bits=P(AXIS, None),
 )
 
 
@@ -95,15 +96,16 @@ def sharded_greedy_assign(
     """
     if features is None:
         features = features_of(snapshot)
-    if getattr(features, "interpod_pref", False):
+    if getattr(features, "interpod_pref", False) or getattr(features, "images", False):
         raise ValueError(
             "sharded_greedy_assign does not score preferred inter-pod "
-            "affinity yet; route such batches through the single-device "
-            "solvers (the extra-score hoist needs a psum'd domain sum)"
+            "affinity or image locality yet; route such batches through "
+            "the single-device solvers (the extra-score hoist needs "
+            "psum'd domain sums / spread ratios)"
         )
     if topo_z is None:
         topo_z = required_topo_z(snapshot)
-    (cluster, pods, sel, pref, spread, terms, _prefpod) = jax.tree.map(
+    (cluster, pods, sel, pref, spread, terms, _prefpod, _images) = jax.tree.map(
         jnp.asarray, tuple(snapshot)
     )
     n = cluster.allocatable.shape[0]

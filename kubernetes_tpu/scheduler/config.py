@@ -32,6 +32,7 @@ SCORE_PLUGIN_WEIGHTS = {
     "TaintToleration": "taint_weight",
     "PodTopologySpread": "spread_weight",
     "InterPodAffinity": "interpod_weight",
+    "ImageLocality": "image_weight",
 }
 
 
@@ -86,6 +87,7 @@ class SchedulerConfiguration:
             for f_name in (
                 "fit_weight", "balanced_weight", "node_affinity_weight",
                 "taint_weight", "spread_weight", "interpod_weight",
+                "image_weight",
             ):
                 if getattr(cfg, f_name) < 0:
                     raise ValueError(f"{p.scheduler_name}: {f_name} < 0")

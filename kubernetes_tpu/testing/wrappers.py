@@ -71,6 +71,10 @@ class PodWrapper:
         )
         return self
 
+    def image(self, name: str) -> "PodWrapper":
+        self.pod.spec.containers[0].image = name
+        return self
+
     def host_port(self, port: int, protocol: str = "TCP") -> "PodWrapper":
         self.pod.spec.containers[0].ports.append(
             api.ContainerPort(container_port=port, host_port=port, protocol=protocol)
@@ -194,6 +198,12 @@ class NodeWrapper:
 
     def taint(self, key: str, value: str = "", effect: str = api.NO_SCHEDULE) -> "NodeWrapper":
         self.node.spec.taints.append(api.Taint(key, value, effect))
+        return self
+
+    def image(self, name: str, size_bytes: int = 500 * 1024 * 1024) -> "NodeWrapper":
+        self.node.status.images.append(
+            api.ContainerImage(names=[name], size_bytes=size_bytes)
+        )
         return self
 
     def unschedulable(self, flag: bool = True) -> "NodeWrapper":

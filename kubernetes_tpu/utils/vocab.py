@@ -48,6 +48,11 @@ class Vocab:
     def get(self, item: Hashable, default: int = -1) -> int:
         return self._ids.get(item, default)
 
+    def alias(self, item: Hashable, ident: int) -> None:
+        """Map an additional name onto an existing id (image tags/digests
+        aliasing one image).  Does not grow the id space."""
+        self._ids[item] = ident
+
     def item(self, i: int) -> Hashable:
         return self._items[i]
 

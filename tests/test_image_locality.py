@@ -1,0 +1,83 @@
+"""ImageLocality scoring (imagelocality/image_locality.go): image
+presence bitsets on nodes, size x spread-ratio scaling, 0..100 band."""
+
+import numpy as np
+
+from kubernetes_tpu.ops import assign, auction, schema
+from kubernetes_tpu.testing.wrappers import GI, MI, make_node, make_pod
+
+BIG = 800 * 1024 * 1024  # well above the 23MB threshold
+
+
+def test_feature_flag():
+    nodes = [make_node("n0").image("nginx:1", BIG).obj(), make_node("n1").obj()]
+    pods = [make_pod("p").req(cpu_milli=100).image("nginx:1").obj()]
+    snap, _ = schema.SnapshotBuilder().build(nodes, pods)
+    f = assign.features_of(snap)
+    assert f.images
+    # pods without images on the same cluster: flag off
+    snap2, _ = schema.SnapshotBuilder().build(nodes, [make_pod("q").obj()])
+    assert not assign.features_of(snap2).images
+
+
+def test_prefers_node_with_image():
+    nodes = [
+        make_node("warm").image("ml:v1", BIG).obj(),
+        make_node("cold").obj(),
+    ]
+    pods = [make_pod("p").req(cpu_milli=100).image("ml:v1").obj()]
+    snap, meta = schema.SnapshotBuilder().build(nodes, pods)
+    r = assign.greedy_assign(snap)
+    assert meta.node_name(int(r.assignment[0])) == "warm"
+
+
+def test_image_aliases_share_identity():
+    """Tag + digest of one image intern to one id (ContainerImage.names)."""
+    from kubernetes_tpu.api import types as api
+
+    node = make_node("warm").obj()
+    node.status.images.append(
+        api.ContainerImage(names=["app@sha256:abc", "app:latest"], size_bytes=BIG)
+    )
+    nodes = [node, make_node("cold").obj()]
+    pods = [make_pod("p").req(cpu_milli=100).image("app:latest").obj()]
+    snap, meta = schema.SnapshotBuilder().build(nodes, pods)
+    r = assign.greedy_assign(snap)
+    assert meta.node_name(int(r.assignment[0])) == "warm"
+
+
+def test_tiny_image_below_threshold_no_preference():
+    """Images under the 23MB minThreshold score 0 everywhere — ties break
+    by node order, not image presence."""
+    nodes = [
+        make_node("n0").obj(),
+        make_node("warm").image("tiny:v1", 1 * 1024 * 1024).obj(),
+    ]
+    pods = [make_pod("p").req(cpu_milli=100).image("tiny:v1").obj()]
+    snap, meta = schema.SnapshotBuilder().build(nodes, pods)
+    r = assign.greedy_assign(snap)
+    assert meta.node_name(int(r.assignment[0])) == "n0"  # first-index tie
+
+
+def test_auction_route_scores_images():
+    nodes = [make_node("warm").image("ml:v1", BIG).obj()] + [
+        make_node(f"cold{i}").obj() for i in range(7)
+    ]
+    pods = [make_pod(f"p{i}").req(cpu_milli=100).image("ml:v1").obj() for i in range(2)]
+    snap, meta = schema.SnapshotBuilder().build(nodes, pods)
+    r = auction.auction_assign(snap)
+    a = np.asarray(r.assignment)[:2]
+    # first pod lands warm; second may also (capacity allows)
+    assert meta.node_name(int(a[0])) == "warm" or meta.node_name(int(a[1])) == "warm"
+
+
+def test_incremental_state_tracks_images():
+    from kubernetes_tpu.models.batch_scheduler import TPUBatchScheduler
+
+    sched = TPUBatchScheduler()
+    sched.add_node(make_node("warm").image("ml:v1", BIG).obj())
+    sched.add_node(make_node("cold").obj())
+    out = sched.schedule_pending(
+        [make_pod("p").req(cpu_milli=100).image("ml:v1").obj()]
+    )
+    assert out == ["warm"]
