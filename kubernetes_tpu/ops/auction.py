@@ -83,9 +83,20 @@ from .assign import (
     solve_order,
 )
 from .filters import fits_resources, pod_view, preferred_match, selector_match
-from .interpod import _idx_to_bits, _pack_bits_t, interpod_filter, prep_terms
+from .interpod import (
+    _idx_to_bits,
+    _pack_bits_t,
+    _unpack_bits_t,
+    interpod_filter,
+    prep_terms,
+)
 from .schema import ClusterTensors, Snapshot, num_groups
-from .scores import DEFAULT_SCORE_CONFIG, ScoreConfig, score_from_raw
+from .scores import (
+    DEFAULT_SCORE_CONFIG,
+    ScoreConfig,
+    combine_scores,
+    resource_score_parts,
+)
 from .topology import prep_spread, spread_filter, spread_score
 
 _BIG_I = jnp.int32(2**30)
@@ -163,26 +174,58 @@ def auction_assign(
     p = pods.req.shape[0]
     sel_mask = selector_match(cluster, sel)
     pref_mask = preferred_match(cluster, pref)
-    sfeas_c, aff_c, taint_c = class_statics(cluster, pods, sel_mask, pref_mask)
-    c_dim = sfeas_c.shape[0]
+    # Factorized class axes (PodBatch docstring): heavy per-row kernels
+    # run on the small spec / constraint factors; the joint axis only
+    # gathers + combines.  sfeas/aff/taint rows are identical across
+    # joint classes sharing a spec class, so computing them on the spec
+    # axis is exact, not an approximation.
+    s_reps = jnp.clip(pods.spec_rep, 0, p - 1)      # [Cs]
+    k_reps = jnp.clip(pods.cons_rep, 0, p - 1)      # [Cc]
+    c_dim = pods.class_rep.shape[0]
+    cs_dim = pods.spec_rep.shape[0]
+    cc_dim = pods.cons_rep.shape[0]
+    jspec = jnp.clip(pods.joint_spec, 0, cs_dim - 1)  # [C]
+    jcons = jnp.clip(pods.joint_cons, 0, cc_dim - 1)  # [C]
+    sfeas_s, aff_s, taint_s = class_statics(
+        cluster, pods, sel_mask, pref_mask, reps=s_reps
+    )
     reps = jnp.clip(pods.class_rep, 0, p - 1)
-    extra_c = None
-    if features.interpod_pref or features.images:
-        # hoisted per-class static extras (shared scores.static_extra;
-        # see ops.assign's hoist for the divergence notes)
-        from .interpod import prep_pref_pod
-        from .scores import static_extra
+    pref_raw_k = img_k = None
+    if features.interpod_pref:
+        # raw preferred-interpod rows per CONSTRAINT class; the joint
+        # combine normalizes each against its spec class's static
+        # feasibility (static_extra's contract — the normalization set
+        # is placement-independent)
+        from .interpod import prep_pref_pod, pref_pod_raw
 
-        pp = (
-            prep_pref_pod(cluster, prefpod, z_terms)
-            if features.interpod_pref
-            else None
+        pp = prep_pref_pod(
+            cluster, prefpod, z_terms, has_bound=features.bound_pref
         )
-        extra_c = jax.vmap(
-            lambda c, rep: static_extra(
-                cluster, prefpod, images, features, cfg, rep, sfeas_c[c], pp
+        pref_raw_k = jax.vmap(lambda rep: pref_pod_raw(pp, prefpod, rep))(
+            k_reps
+        )
+    if features.images:
+        from .scores import image_locality_score
+
+        img_k = jax.vmap(
+            lambda rep: image_locality_score(cluster, images, rep)
+        )(k_reps)
+
+    def joint_extra(s, k):
+        """Already-weighted extra score row for joint class (s, k), or
+        None when neither family is active (matches static_extra)."""
+        if pref_raw_k is None and img_k is None:
+            return None
+        from .scores import normalize_minmax
+
+        total = jnp.zeros(n, jnp.float32)
+        if pref_raw_k is not None:
+            total = total + cfg.interpod_weight * normalize_minmax(
+                pref_raw_k[k], sfeas_s[s]
             )
-        )(jnp.arange(c_dim, dtype=jnp.int32), reps)
+        if img_k is not None:
+            total = total + cfg.image_weight * img_k[k]
+        return total
 
     order = solve_order(pods)
     # solve_pos[i] = pod i's rank in solve order (repair keeps prefixes
@@ -192,19 +235,28 @@ def auction_assign(
     )
 
     sp0 = (
-        prep_spread(cluster, sel_mask, spread, z_spread)
+        prep_spread(
+            cluster, sel_mask, spread, z_spread,
+            has_bound=features.bound_spread,
+        )
         if features.spread
         else None
     )
     tm0 = (
-        prep_terms(cluster, terms, z_terms, slots=features.term_slots)
+        prep_terms(
+            cluster, terms, z_terms, slots=features.term_slots,
+            has_bound=features.bound_terms,
+        )
         if features.interpod
         else None
     )
     if features.interpod:
         t_dim = terms.valid.shape[0]
         # dense [P, T] involvement tables for the within-round repair
-        mi_dense = terms.matches_incoming & terms.valid[None, :]
+        mi_dense = (
+            _unpack_bits_t(terms.matches_incoming, t_dim)
+            & terms.valid[None, :]
+        )
         anti_dense = _idx_to_bits(terms.anti_idx, t_dim) & terms.valid[None, :]
         slot_of_t = terms.slot                                    # [T]
 
@@ -214,15 +266,19 @@ def auction_assign(
     def bids(requested, nonzero, assigned, rnd, sp_counts, tm_bits):
         # Pods of one class (byte-identical spec incl. requests) see
         # identical filter masks and score rows against the current pool,
-        # so filtering + scoring runs once per *class* — [C, N] with C
-        # typically tens.  Within a round the class's max-score tie set
-        # is fixed, so bidding needs no per-pod (P x N) pass either: rank
-        # the tie nodes once per class in counter-hash order (uniform,
-        # like the reference's selectHost sampling schedule_one.go:867)
-        # and hand the class's j-th active pod the j-th tie node.  Pods
-        # of a class thus bid *distinct* nodes while ties last — fewer
-        # conflicts than independent sampling — and the whole per-pod
-        # step is O(P) gathers.
+        # so filtering + scoring runs once per *class* — and the class
+        # axis itself factorizes: resource fit + fit/balanced score rows
+        # per SPEC class ([Cs, N], a handful of rows), spread/inter-pod
+        # filter rows per CONSTRAINT class ([Cc, N], one per service
+        # shape), with the joint [C, N] pass reduced to gathers, the
+        # normalize-and-weight combine, and top_k.  Within a round the
+        # class's max-score tie set is fixed, so bidding needs no per-pod
+        # (P x N) pass either: rank the tie nodes once per class in
+        # counter-hash order (uniform, like the reference's selectHost
+        # sampling schedule_one.go:867) and hand the class's j-th active
+        # pod the j-th tie node.  Pods of a class thus bid *distinct*
+        # nodes while ties last — fewer conflicts than independent
+        # sampling — and the whole per-pod step is O(P) gathers.
         cl = cluster._replace(requested=requested, nonzero_requested=nonzero)
         sp = sp0._replace(counts_node=sp_counts) if features.spread else None
         tm = (
@@ -234,21 +290,38 @@ def auction_assign(
             else None
         )
 
-        def per_class(c, rep):
+        def per_spec(rep):
             pod = pod_view(pods, rep)
-            feas = sfeas_c[c] & fits_resources(cl, pod)
+            fit, bal = resource_score_parts(cl, pod, cfg)
+            return fits_resources(cl, pod), fit, bal
+
+        fits_s, fit_s, bal_s = jax.vmap(per_spec)(s_reps)   # [Cs, N]
+        spf_k = (
+            jax.vmap(lambda rep: spread_filter(sp, spread, rep))(k_reps)
+            if features.spread
+            else None
+        )
+        ipf_k = (
+            jax.vmap(lambda rep: interpod_filter(tm, terms, rep))(k_reps)
+            if features.interpod
+            else None
+        )
+
+        def per_class(c, rep):
+            s, k = jspec[c], jcons[c]
+            feas = sfeas_s[s] & fits_s[s]
             if features.spread:
-                feas = feas & spread_filter(sp, spread, rep)
+                feas = feas & spf_k[k]
             if features.interpod:
-                feas = feas & interpod_filter(tm, terms, rep)
+                feas = feas & ipf_k[k]
             sp_score = (
                 spread_score(sp, spread, rep, feas)
                 if features.soft_spread
                 else None
             )
-            scores = score_from_raw(
-                cl, pod, feas, aff_c[c], taint_c[c], cfg, spread_score=sp_score,
-                extra=extra_c[c] if extra_c is not None else None,
+            scores = combine_scores(
+                fit_s[s], bal_s[s], aff_s[s], taint_s[s], feas, cfg,
+                spread_score=sp_score, extra=joint_extra(s, k),
             )
             masked = jnp.where(feas, scores, NEG_INF)
             best = jnp.max(masked)
@@ -305,29 +378,57 @@ def auction_assign(
     # sequential scan's continuously-rising min, approximated in k steps
     SPREAD_REPAIR_ITERS = 3
 
-    def _spread_ranks(cand, nodes):
-        """rank[C, P]: among `cand` pods matching row c, this pod's
-        0-based position (solve order) within its (row, value) group.
-        One value-sort per spread SLOT + a segmented [C, P] cumsum
-        (per-row sorts serialize on TPU)."""
-        cmax = sp0.counts_node.shape[0]
-        vj_cp = sp0.v[:, nodes]                                  # [C, P]
-        act_cp = cand[None, :] & spread.pod_matches.T & (vj_cp >= 0)
-        rank_cp = jnp.zeros((cmax, p), jnp.int32)
+    if features.spread:
+        # [N, C] row-gather layouts: axis-1 (per-column) gathers and
+        # scatters of [C, P] tables serialize on TPU (~0.08 s each at
+        # 16k pods); row gathers of the transposed layout are contiguous
+        v_nc = sp0.v.T
+        elig_nc = sp0.eligible.T
+        cmax_sp = sp0.counts_node.shape[0]
+        # per-slot value one-hots [Z, N]: value-space -> node-space maps
+        # become small matmuls on the MXU instead of [C, N] gathers from
+        # [C, Z] tables (gathers serialize: ~0.08 s per call at 16k
+        # nodes; the matmul is [C, Z] @ [Z, N] with Z tiny)
+        spread_onehot = {}
         for s in features.spread_slots:
-            v_p = cluster.topo_ids[nodes, s]                     # [P]
+            v_n = cluster.topo_ids[:, s]
+            spread_onehot[s] = (
+                (v_n[None, :] == jnp.arange(z_spread)[:, None])
+                & (v_n >= 0)[None, :]
+            ).astype(jnp.float32)                                # [Z, N]
+
+    def _slot_sorts(nodes):
+        """Per-slot (perm, inv, firstv) of the round's bid values —
+        depends only on the bids, so it hoists out of the repair's
+        admit iterations."""
+        out = {}
+        for s in features.spread_slots:
+            v_p = cluster.topo_ids[nodes, s]
             key = jnp.where(v_p >= 0, v_p, _BIG_I)
             perm = order[jnp.argsort(key[order], stable=True)]
             skey = key[perm]
             firstv = jnp.searchsorted(skey, skey, side="left")   # [P]
+            inv = jnp.zeros(p, jnp.int32).at[perm].set(arange_p)
+            out[s] = (perm, inv, firstv)
+        return out
+
+    def _spread_ranks(cand, v_pc, slot_sorts):
+        """rank[P, C]: among `cand` pods matching row c, this pod's
+        0-based position (solve order) within its (row, value) group.
+        One value-sort per spread SLOT (hoisted) + a segmented [P, C]
+        cumsum with row gathers (per-row sorts serialize on TPU)."""
+        act_pc = cand[:, None] & spread.pod_matches & (v_pc >= 0)  # [P, C]
+        rank_pc = jnp.zeros((p, cmax_sp), jnp.int32)
+        for s in features.spread_slots:
+            perm, inv, firstv = slot_sorts[s]
             rows_s = spread.slot == s                            # [C]
-            act_s = act_cp & rows_s[:, None]
-            srt = act_s[:, perm].astype(jnp.int32)               # [C, P]
-            exc = jnp.cumsum(srt, axis=1) - srt                  # exclusive
-            seg = exc - exc[:, firstv]                           # segmented
-            back = jnp.zeros((cmax, p), jnp.int32).at[:, perm].set(seg)
-            rank_cp = jnp.where(rows_s[:, None], back, rank_cp)
-        return rank_cp, vj_cp
+            act_s = act_pc & rows_s[None, :]
+            srt = act_s[perm].astype(jnp.int32)                  # [P, C]
+            exc = jnp.cumsum(srt, axis=0) - srt                  # exclusive
+            seg = exc - exc[firstv]                              # segmented
+            back = seg[inv]                                      # unsort
+            rank_pc = jnp.where(rows_s[None, :], back, rank_pc)
+        return rank_pc
 
     def spread_repair(accept, nodes, sp_counts):
         """Keep the subset of capacity-accepted pods whose placements
@@ -338,10 +439,11 @@ def auction_assign(
         into a working copy of the counts so the global minimum rises
         WITHIN the round — without this, a round can only advance each
         constraint by maxSkew per topology value."""
-        cmax = sp0.counts_node.shape[0]
         md = spread.min_domains
         kept = jnp.zeros(p, bool)
         counts_it = sp_counts
+        v_pc = v_nc[nodes]                                       # [P, C]
+        slot_sorts = _slot_sorts(nodes)
         for _ in range(SPREAD_REPAIR_ITERS):
             cand = accept & ~kept
             min_c = jnp.min(
@@ -349,12 +451,12 @@ def auction_assign(
             )
             min_c = jnp.where(min_c >= _BIGF, 0.0, min_c)
             min_c = jnp.where((md > 0) & (sp0.sizes < md), 0.0, min_c)
-            rank_cp, vj_cp = _spread_ranks(cand, nodes)
+            rank_pc = _spread_ranks(cand, v_pc, slot_sorts)
             admit = cand
             for j in range(spread.pod_idx.shape[1]):
                 cidx = spread.pod_idx[:, j]
-                c = jnp.clip(cidx, 0, cmax - 1)
-                vj = vj_cp[c, arange_p]
+                c = jnp.clip(cidx, 0, cmax_sp - 1)
+                vj = v_pc[arange_p, c]
                 own = cand & (cidx >= 0) & spread.hard[c] & (vj >= 0)
                 cnt = counts_it[c, nodes]
                 # sequential criterion: count + rank + selfMatch - min <=
@@ -366,10 +468,10 @@ def auction_assign(
                 allowed = (
                     spread.max_skew[c] + min_c[c] - cnt + (1.0 - self_m)
                 )
-                rank = rank_cp[c, arange_p].astype(jnp.float32)
+                rank = rank_pc[arange_p, c].astype(jnp.float32)
                 admit = admit & ~(own & (rank >= allowed))
             kept = kept | admit
-            counts_it = commit_spread(admit, nodes, counts_it)
+            counts_it = commit_spread(admit, nodes, counts_it, v_pc)
         return kept
 
     def interpod_repair(accept, nodes):
@@ -401,21 +503,35 @@ def auction_assign(
             release = release | viol.any(axis=1)
         return accept & ~release
 
-    def commit_spread(accept, nodes, sp_counts):
+    def commit_spread(accept, nodes, sp_counts, v_pc=None):
         """Fold net accepts into the node-space counts (the batched
         spread_update): every row a placed pod matches gains one on every
         node sharing the placement's topology value."""
-        cmax = sp0.counts_node.shape[0]
-        vj_cp = sp0.v[:, nodes]                                  # [C, P]
-        elig_cp = sp0.eligible[:, nodes]
+        if v_pc is None:
+            v_pc = v_nc[nodes]                                   # [P, C]
+        elig_pc = elig_nc[nodes]
         act = (
-            accept[None, :] & spread.pod_matches.T & elig_cp & (vj_cp >= 0)
-        )
-        adds = jnp.zeros((cmax, z_spread), jnp.float32).at[
-            jnp.arange(cmax)[:, None], jnp.clip(vj_cp, 0, z_spread - 1)
-        ].add(act.astype(jnp.float32))
-        vc = jnp.clip(sp0.v, 0, z_spread - 1)
-        delta = jnp.take_along_axis(adds, vc, axis=-1)
+            accept[:, None] & spread.pod_matches & elig_pc & (v_pc >= 0)
+        ).astype(jnp.float32)
+        # Both directions ride the MXU: pod-space -> value-space counts
+        # as act^T @ onehot(pod value), then value-space -> node-space
+        # as adds @ onehot(node value).  The equivalent scatter-add +
+        # take_along_axis each serialized at ~0.08 s per repair pass.
+        adds = jnp.zeros((cmax_sp, z_spread), jnp.float32)
+        zr = jnp.arange(z_spread)
+        for s in features.spread_slots:
+            v_p = cluster.topo_ids[nodes, s]                     # [P]
+            oh_pz = (
+                (v_p[:, None] == zr[None, :]) & (v_p >= 0)[:, None]
+            ).astype(jnp.float32)                                # [P, Z]
+            rows_s = spread.slot == s                            # [C]
+            act_s = act * rows_s[None, :]
+            adds = adds + jnp.einsum("pc,pz->cz", act_s, oh_pz)
+        delta = jnp.zeros_like(sp_counts)
+        for s in features.spread_slots:
+            rows_s = spread.slot == s                            # [C]
+            d = adds @ spread_onehot[s]                          # [C, N]
+            delta = jnp.where(rows_s[:, None], d, delta)
         return sp_counts + jnp.where(sp0.v >= 0, delta, 0.0)
 
     def commit_terms(accept, nodes, present, blocked, global_any):
@@ -531,16 +647,30 @@ def auction_assign(
         else None
     )
 
+    fits_f_s = jax.vmap(
+        lambda rep: fits_resources(cl_f, pod_view(pods, rep))
+    )(s_reps)
+    spf_f_k = (
+        jax.vmap(lambda rep: spread_filter(sp_f, spread, rep))(k_reps)
+        if features.spread
+        else None
+    )
+    ipf_f_k = (
+        jax.vmap(lambda rep: interpod_filter(tm_f, terms, rep))(k_reps)
+        if features.interpod
+        else None
+    )
+
     def class_reason(c, rep):
-        pod = pod_view(pods, rep)
-        s_static = sfeas_c[c]
-        f = s_static & fits_resources(cl_f, pod)
+        s, k = jspec[c], jcons[c]
+        s_static = sfeas_s[s]
+        f = s_static & fits_f_s[s]
         a_res = f.any()
         if features.spread:
-            f = f & spread_filter(sp_f, spread, rep)
+            f = f & spf_f_k[k]
         a_spread = f.any()
         if features.interpod:
-            f = f & interpod_filter(tm_f, terms, rep)
+            f = f & ipf_f_k[k]
         a_inter = f.any()
         return jnp.where(
             a_inter, REASON_RESOURCES,  # feasible yet unplaced: contention

@@ -73,44 +73,66 @@ def _idx_to_bits(idx: jnp.ndarray, t_dim: int) -> jnp.ndarray:
     return (jnp.arange(t_dim)[None, None, :] == idx[:, :, None]).any(axis=1)
 
 
+def _unpack_bits_t(bits: jnp.ndarray, t_dim: int) -> jnp.ndarray:
+    """u32[..., W] packed (little-endian per word) -> bool[..., T]."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    expanded = (bits[..., :, None] >> shifts) & jnp.uint32(1)
+    flat = expanded.reshape(*bits.shape[:-1], bits.shape[-1] * 32)
+    return flat[..., :t_dim].astype(bool)
+
+
 def prep_terms(
     cluster: ClusterTensors,
     terms: TermTable,
     z: int,
     axis_name: str | None = None,
     slots: tuple = (),
+    has_bound: bool = True,
 ) -> TermState:
     """One-time assembly (the PreFilter analogue).  z is the topo-value
     vocab bound, used only for the prep-time count scatter.  Under
     shard_map pass axis_name: global_any must OR across node shards
     (pre-pack — psum on packed bitsets would carry between bits), and
     counts must be psum-reduced so a topology domain spanning shards is
-    seen whole."""
+    seen whole.  has_bound=False (FeatureFlags.bound_terms) statically
+    elides the count scatter + [T, N] value-space gathers — the tables
+    are runtime arrays, so XLA cannot fold them even when zero, and the
+    gathers cost ~0.2 s at 32k nodes x 256 terms."""
     t_dim = terms.valid.shape[0]
     v = jnp.take_along_axis(cluster.topo_ids, terms.slot[None, :], axis=1).T  # [T, N]
     vc = jnp.clip(v, 0, z - 1)
     ok = (v >= 0) & cluster.node_valid[None, :] & terms.valid[:, None]
 
-    def per_t(vc_row, ok_row, m_row, o_row):
-        cm = jnp.zeros(z, jnp.float32).at[vc_row].add(m_row * ok_row)
-        co = jnp.zeros(z, jnp.float32).at[vc_row].add(o_row * ok_row)
-        return cm, co
+    if has_bound:
+        def per_t(vc_row, ok_row, m_row, o_row):
+            cm = jnp.zeros(z, jnp.float32).at[vc_row].add(m_row * ok_row)
+            co = jnp.zeros(z, jnp.float32).at[vc_row].add(o_row * ok_row)
+            return cm, co
 
-    cm, co = jax.vmap(per_t)(vc, ok, terms.node_matches, terms.node_owners)
-    if axis_name is not None:
-        cm = jax.lax.psum(cm, axis_name)
-        co = jax.lax.psum(co, axis_name)
-    present = ok & (jnp.take_along_axis(cm, vc, axis=-1) > 0)   # [T, N]
-    blocked = ok & (jnp.take_along_axis(co, vc, axis=-1) > 0)   # [T, N]
-    global_any = _pack_bits_t((cm.sum(axis=-1) > 0) & terms.valid)
+        cm, co = jax.vmap(per_t)(vc, ok, terms.node_matches, terms.node_owners)
+        if axis_name is not None:
+            cm = jax.lax.psum(cm, axis_name)
+            co = jax.lax.psum(co, axis_name)
+        present = ok & (jnp.take_along_axis(cm, vc, axis=-1) > 0)   # [T, N]
+        blocked = ok & (jnp.take_along_axis(co, vc, axis=-1) > 0)   # [T, N]
+        global_any = _pack_bits_t((cm.sum(axis=-1) > 0) & terms.valid)
+    else:
+        shape = (t_dim, cluster.node_valid.shape[0])
+        present = jnp.zeros(shape, bool)
+        blocked = jnp.zeros(shape, bool)
+        global_any = _pack_bits_t(jnp.zeros(t_dim, bool))
 
-    mi = terms.matches_incoming & terms.valid[None, :]           # [P, T]
+    # matches_incoming arrives PACKED (u32 words, schema.TermTable) —
+    # slot splitting happens directly in word space.
+    valid_words = _pack_bits_t(terms.valid)                      # [W]
+    mi_bits = terms.matches_incoming & valid_words[None, :]      # [P, W]
     # Only the topology-key slots some term actually uses get a row in the
     # per-slot bit tables (static from FeatureFlags.term_slots) — real
     # workloads use one or two keys, so the per-step slot loop shrinks
     # from TK to that count.
     used = jnp.asarray(slots or tuple(range(cluster.topo_ids.shape[1])), dtype=jnp.int32)
     slot_onehot = terms.slot[None, :] == used[:, None]           # [U, T]
+    slot_words = _pack_bits_t(slot_onehot)                       # [U, W]
     anti_membership = _idx_to_bits(terms.anti_idx, t_dim) & terms.valid[None, :]
     aff_membership = _idx_to_bits(terms.aff_idx, t_dim) & terms.valid[None, :]
 
@@ -120,7 +142,7 @@ def prep_terms(
         global_any=global_any,
         key_bits=_pack_bits_t(ok.T),
         slot_v=cluster.topo_ids.T[used],
-        mi_slot_bits=_pack_bits_t(mi[None, :, :] & slot_onehot[:, None, :]),
+        mi_slot_bits=mi_bits[None, :, :] & slot_words[:, None, :],
         anti_slot_bits=_pack_bits_t(
             anti_membership[None, :, :] & slot_onehot[:, None, :]
         ),
@@ -198,11 +220,18 @@ def prep_pref_pod(
     table,
     z: int,
     axis_name: str | None = None,
+    has_bound: bool = True,
 ) -> PrefPodState:
     """Domain-sum the per-node match counts / owner weights over each
     row's topology value (interpodaffinity/scoring.go PreScore builds the
     same topology-pair score map).  Under shard_map, value-space sums
-    psum across node shards."""
+    psum across node shards.  has_bound=False
+    (FeatureFlags.bound_pref) statically folds the zero tables away."""
+    if not has_bound:
+        shape = (table.valid.shape[0], cluster.node_valid.shape[0])
+        return PrefPodState(
+            jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32)
+        )
     v = jnp.take_along_axis(cluster.topo_ids, table.slot[None, :], axis=1).T
     vc = jnp.clip(v, 0, z - 1)
     ok = (v >= 0) & cluster.node_valid[None, :] & table.valid[:, None]

@@ -146,7 +146,9 @@ class TermTable(NamedTuple):
     slot: np.ndarray             # i32[T]   topology-key slot
     node_matches: np.ndarray     # f32[T, N] bound pods on n matching term t
     node_owners: np.ndarray      # f32[T, N] bound pods on n owning anti-term t
-    matches_incoming: np.ndarray  # bool[P, T] batch pod p matches term t
+    matches_incoming: np.ndarray  # u32[P, ceil(T/32)] packed: pod p matches term t
+                                  # (bit t%32 of word t//32 — transfer-
+                                  # efficient; unpack on device as needed)
     aff_idx: np.ndarray          # i32[P, MA] pod's required affinity terms
     anti_idx: np.ndarray         # i32[P, MA] pod's required anti-affinity terms
     self_match_all: np.ndarray   # bool[P] pod matches all its own affinity terms
@@ -161,7 +163,18 @@ class PodBatch(NamedTuple):
     overwhelmingly collapse (a Deployment's replicas are one class), so
     the solver hoists static feasibility and raw score rows out of its
     scan as [C, N] tables instead of [P, N].  class_rep[c] is the index of
-    one representative pod of class c (-1 pad)."""
+    one representative pod of class c (-1 pad).
+
+    The class axis FACTORIZES (joint = spec × constraint): class_id is
+    the joint axis (distinct (spec, constraint-identity) pairs — what the
+    auction's tie machinery needs), while the expensive per-row kernels
+    depend on only one factor each: static feasibility / resource fit /
+    raw scores on the SPEC factor (spec_rep, typically a handful of
+    rows), spread / inter-pod filters on the CONSTRAINT factor
+    (cons_rep, one row per distinct service-shaped constraint set).
+    joint_spec/joint_cons map each joint class to its factors, so the
+    joint-axis combine is pure gathers + elementwise — 200 services × 5
+    pod shapes costs 205 heavy rows, not 1000."""
 
     valid: np.ndarray        # bool[P]
     req: np.ndarray          # f32[P, R]
@@ -173,10 +186,14 @@ class PodBatch(NamedTuple):
     port_bits: np.ndarray    # u32[P, PW]
     pref_idx: np.ndarray     # i32[P, MT]  rows of PreferredTable, -1 pad
     pref_weight: np.ndarray  # f32[P, MT]
-    class_id: np.ndarray     # i32[P]  static-equivalence class per pod
+    class_id: np.ndarray     # i32[P]  joint equivalence class per pod
     class_rep: np.ndarray    # i32[C]  representative pod index, -1 pad
     priority: np.ndarray     # f32[P]  pod priority (queuesort order)
     group_id: np.ndarray     # i32[P]  gang/coscheduling group, -1 none
+    spec_rep: np.ndarray     # i32[Cs] representative pod per spec class
+    joint_spec: np.ndarray   # i32[C]  spec class of each joint class
+    cons_rep: np.ndarray     # i32[Cc] representative pod per constraint class
+    joint_cons: np.ndarray   # i32[C]  constraint class of each joint class
 
 
 class PrefPodTable(NamedTuple):
@@ -948,6 +965,11 @@ class SnapshotBuilder:
             class_rep=class_rep,
             priority=priority,
             group_id=group_id,
+            # unrefined: joint == spec, one trivial constraint class
+            spec_rep=class_rep,
+            joint_spec=np.arange(class_rep.shape[0], dtype=np.int32),
+            cons_rep=np.zeros(1, dtype=np.int32),
+            joint_cons=np.zeros(class_rep.shape[0], dtype=np.int32),
         )
         return batch, sel, pref, sel_index
 
@@ -1134,12 +1156,13 @@ class SnapshotBuilder:
                     pass
 
         t_dim = vb.pad_dim(len(term_rows), 1)
+        t_words = (t_dim + 31) // 32
         terms = TermTable(
             valid=np.zeros(t_dim, dtype=bool),
             slot=np.zeros(t_dim, dtype=np.int32),
             node_matches=np.zeros((t_dim, n), dtype=np.float32),
             node_owners=np.zeros((t_dim, n), dtype=np.float32),
-            matches_incoming=np.zeros((p_dim, t_dim), dtype=bool),
+            matches_incoming=np.zeros((p_dim, t_words), dtype=np.uint32),
             aff_idx=aff_idx,
             anti_idx=anti_idx,
             self_match_all=np.zeros(p_dim, dtype=bool),
@@ -1153,7 +1176,9 @@ class SnapshotBuilder:
                 m = match[bound_sig]
                 np.add.at(terms.node_matches[ti], bound_node[m], 1.0)
             if len(pend_sig):
-                terms.matches_incoming[: len(pods), ti] = match[pend_sig]
+                terms.matches_incoming[: len(pods), ti // 32] |= (
+                    match[pend_sig].astype(np.uint32) << np.uint32(ti % 32)
+                )
         for ti, ni in bound_anti:
             terms.node_owners[ti, ni] += 1.0
 
@@ -1307,7 +1332,22 @@ class ClusterState:
         self._pods: Dict[str, api.Pod] = {}       # bound/assumed, by pod key
         self._pod_node: Dict[str, str] = {}
         self._pods_by_node: Dict[str, List[str]] = {}
+        # Generation protocol for device-resident mirrors (the
+        # cache.go:185-260 snapshotGeneration analogue, per ROW and split
+        # by mutation family so consumers re-upload only what moved):
+        #   _static_gen[i] — node-object state (allocatable, labels,
+        #       taints, topology, images) last changed at this generation;
+        #   _usage_gen[i]  — accumulated pod usage (requested, ports);
+        #   _struct_gen    — array identity/axis changes (grow, resource
+        #       widen, compaction): mirrors older than this must resync
+        #       in full.
+        self._gen = 1
+        self._struct_gen = 1
         self._alloc(self._cap, self._r)
+
+    def _bump(self) -> int:
+        self._gen += 1
+        return self._gen
 
     # -- storage ----------------------------------------------------------
 
@@ -1323,9 +1363,12 @@ class ClusterState:
         self.port_bits = np.zeros((cap, lim.port_words), dtype=np.uint32)
         self.topo_ids = np.full((cap, len(lim.topology_keys)), -1, dtype=np.int32)
         self.image_bits = np.zeros((cap, lim.image_words), dtype=np.uint32)
+        self._static_gen = np.zeros(cap, dtype=np.int64)
+        self._usage_gen = np.zeros(cap, dtype=np.int64)
 
     def _grow(self, cap: int) -> None:
         old = self.tensors(pad=False)
+        old_sg, old_ug = self._static_gen, self._usage_gen
         self._alloc(cap, self._r)
         h = self._high
         self.allocatable[:h] = old.allocatable[:h]
@@ -1338,7 +1381,10 @@ class ClusterState:
         self.port_bits[:h] = old.port_bits[:h]
         self.topo_ids[:h] = old.topo_ids[:h]
         self.image_bits[:h] = old.image_bits[:h]
+        self._static_gen[:h] = old_sg[:h]
+        self._usage_gen[:h] = old_ug[:h]
         self._cap = cap
+        self._struct_gen = self._bump()
 
     def ensure_resources(self) -> None:
         """Widen the resource axis after new scalar resources appeared in
@@ -1352,6 +1398,7 @@ class ClusterState:
         self.requested = np.pad(self.requested, pad)
         self.nonzero_requested = np.pad(self.nonzero_requested, pad)
         self._r = r
+        self._struct_gen = self._bump()
 
     # -- node lifecycle ---------------------------------------------------
 
@@ -1377,6 +1424,7 @@ class ClusterState:
             node, i, self.node_valid, self.name_id, self.allocatable,
             self.label_bits, self.taint_bits, self.topo_ids, self.image_bits,
         )
+        self._static_gen[i] = self._usage_gen[i] = self._bump()
 
     def update_node(self, node: api.Node) -> None:
         """Re-encode a node's static state in place; accumulated pod usage
@@ -1389,6 +1437,7 @@ class ClusterState:
             node, i, self.node_valid, self.name_id, self.allocatable,
             self.label_bits, self.taint_bits, self.topo_ids, self.image_bits,
         )
+        self._static_gen[i] = self._bump()
 
     def remove_node(self, name: str) -> None:
         i = self._rows.pop(name)
@@ -1411,6 +1460,7 @@ class ClusterState:
         self.topo_ids[i] = -1
         self.image_bits[i] = 0
         self.node_names[i] = None
+        self._static_gen[i] = self._usage_gen[i] = self._bump()
 
     def _move_row(self, src: int, dst: int) -> None:
         self.node_valid[dst] = self.node_valid[src]
@@ -1426,6 +1476,7 @@ class ClusterState:
         name = self.node_names[src]
         self.node_names[dst] = name
         self._rows[name] = dst
+        self._static_gen[dst] = self._usage_gen[dst] = self._bump()
         self._clear_row(src)
 
     def _maybe_compact(self) -> None:
@@ -1468,6 +1519,7 @@ class ClusterState:
         self.requested[i] += req
         self.nonzero_requested[i] += nz
         self.port_bits[i] |= ports
+        self._usage_gen[i] = self._bump()
         self._pods[key] = pod
         self._pod_node[key] = node_name
         self._pods_by_node[node_name].append(key)
@@ -1488,6 +1540,7 @@ class ClusterState:
         for pk in self._pods_by_node[node_name]:
             ports |= self.builder.pod_usage(self._pods[pk], self._r)[2]
         self.port_bits[i] = ports
+        self._usage_gen[i] = self._bump()
 
     def has_pod(self, pod: api.Pod) -> bool:
         return self._pod_key(pod) in self._pods
@@ -1524,6 +1577,27 @@ class ClusterState:
             topo_ids=self.topo_ids[:n],
             image_bits=self.image_bits[:n],
         )
+
+    # -- device-mirror sync protocol --------------------------------------
+
+    @property
+    def generation(self) -> int:
+        return self._gen
+
+    @property
+    def struct_generation(self) -> int:
+        """Mirrors synced before this generation must full-resync: the
+        backing arrays were reallocated or re-axised since."""
+        return self._struct_gen
+
+    def dirty_rows(self, synced_gen: int, n: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Row indices (static-family, usage-family) changed since
+        synced_gen, within the first n rows.  Callers must already have
+        checked struct_generation and the padded shape."""
+        n = min(n, self._cap)
+        static = np.nonzero(self._static_gen[:n] > synced_gen)[0]
+        usage = np.nonzero(self._usage_gen[:n] > synced_gen)[0]
+        return static.astype(np.int32), usage.astype(np.int32)
 
 
 def _intern_pod_term(
@@ -1579,7 +1653,7 @@ def _refine_classes(
             spread.pod_matches.astype(np.uint8).view(np.uint8).reshape(p, -1).astype(np.uint32),
             terms.aff_idx.view(np.uint32),
             terms.anti_idx.view(np.uint32),
-            terms.matches_incoming.astype(np.uint32),
+            terms.matches_incoming,  # packed u32 words: already a signature
             terms.self_match_all.astype(np.uint32)[:, None],
     ]
     if has_pref:
@@ -1589,25 +1663,53 @@ def _refine_classes(
             prefpod.matches_incoming.astype(np.uint32),
         ]
     if has_images:
-        parts += [images.pod_ids.view(np.uint32)]
-    sig = np.concatenate(parts, axis=1)
-    sig = np.ascontiguousarray(sig)
-    row_bytes = sig.view(np.uint8).reshape(p, -1)
-    index: Dict[bytes, int] = {}
-    class_id = np.empty(p, dtype=np.int32)
-    reps: List[int] = []
-    for i in range(p):
-        key = row_bytes[i].tobytes()
-        c = index.get(key)
-        if c is None:
-            c = len(reps)
-            index[key] = c
-            reps.append(i)
-        class_id[i] = c
+        # n_containers drives the ImageLocality clamp threshold
+        # (image_locality_score hi = 1000MB x containers) and the auction
+        # scores images per CONSTRAINT class — two pods with identical
+        # known-image rows but different container counts must not share
+        # a constraint class or one inherits the other's threshold
+        parts += [
+            images.pod_ids.view(np.uint32),
+            images.n_containers.view(np.uint32)[:, None],
+        ]
+    cons_sig = np.ascontiguousarray(np.concatenate(parts[1:], axis=1))
+    cons_id, cons_reps = _first_seen_unique(cons_sig)
+    joint_sig = np.ascontiguousarray(
+        np.stack([pods.class_id.view(np.uint32), cons_id.view(np.uint32)], axis=1)
+    )
+    class_id, reps = _first_seen_unique(joint_sig)
     c_dim = vb.pad_dim(len(reps), 1)
     class_rep = np.full(c_dim, -1, dtype=np.int32)
     class_rep[: len(reps)] = reps
-    return pods._replace(class_id=class_id, class_rep=class_rep)
+    joint_spec = np.zeros(c_dim, dtype=np.int32)
+    joint_spec[: len(reps)] = pods.class_id[reps]
+    joint_cons = np.zeros(c_dim, dtype=np.int32)
+    joint_cons[: len(reps)] = cons_id[reps]
+    cc_dim = vb.pad_dim(len(cons_reps), 1)
+    cons_rep = np.full(cc_dim, -1, dtype=np.int32)
+    cons_rep[: len(cons_reps)] = cons_reps
+    return pods._replace(
+        class_id=class_id, class_rep=class_rep,
+        spec_rep=pods.class_rep, joint_spec=joint_spec,
+        cons_rep=cons_rep, joint_cons=joint_cons,
+    )
+
+
+def _first_seen_unique(sig: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Group rows of a 2-D signature array, ids in first-seen order.
+    Returns (ids i32[P], first-row-index per group).  Vectorized — a
+    Python dict loop here cost ~40ms per 10k pods on the per-batch
+    encode path."""
+    p = sig.shape[0]
+    row_bytes = sig.view(np.uint8).reshape(p, -1)
+    void = row_bytes.view(np.dtype((np.void, row_bytes.shape[1]))).reshape(p)
+    _, first_idx, inverse = np.unique(
+        void, return_index=True, return_inverse=True
+    )
+    order = np.argsort(first_idx, kind="stable")
+    remap = np.empty(order.shape[0], dtype=np.int32)
+    remap[order] = np.arange(order.shape[0], dtype=np.int32)
+    return remap[inverse].astype(np.int32), first_idx[order]
 
 
 def _pod_classes(

@@ -218,13 +218,44 @@ def score_from_raw(
     computed here from the carried requested state.  `extra` is an
     already-normalized, already-weighted additional score row (the
     hoisted preferred-interpod contribution)."""
+    fit, bal = resource_score_parts(cluster, pod, cfg)
+    return combine_scores(
+        fit, bal, aff_raw, taint_raw, feasible, cfg,
+        axis_name=axis_name, spread_score=spread_score, extra=extra,
+    )
+
+
+def resource_score_parts(
+    cluster: ClusterTensors, pod: PodView, cfg: ScoreConfig
+) -> tuple:
+    """(fit, bal) — the requested-state-dependent score rows.  These
+    depend only on the pod's SPEC (requests), so solvers with a
+    factorized class axis compute them once per spec class and combine
+    per joint class (combine_scores)."""
     if cfg.fit_strategy == "MostAllocated":
         fit = most_allocated(cluster, pod, cfg)
     elif cfg.fit_strategy == "RequestedToCapacityRatio":
         fit = requested_to_capacity_ratio(cluster, pod, cfg)
     else:
         fit = least_allocated(cluster, pod, cfg)
-    bal = balanced_allocation(cluster, pod, cfg)
+    return fit, balanced_allocation(cluster, pod, cfg)
+
+
+def combine_scores(
+    fit: jnp.ndarray,
+    bal: jnp.ndarray,
+    aff_raw: jnp.ndarray,
+    taint_raw: jnp.ndarray,
+    feasible: jnp.ndarray,
+    cfg: ScoreConfig,
+    axis_name: str | None = None,
+    spread_score: jnp.ndarray | None = None,
+    extra: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Normalize + weight-sum precomputed score rows over a feasible
+    set.  Normalization is per-(pod, feasible-set) — the RunScorePlugins
+    NormalizeScore pass (runtime/framework.go:1147) — so it stays in the
+    per-class combine even when the raw rows are hoisted."""
     aff = normalize(aff_raw, feasible, axis_name=axis_name)
     taint = normalize(taint_raw, feasible, reverse=True, axis_name=axis_name)
     total = (

@@ -51,6 +51,7 @@ def prep_spread(
     spread: SpreadTable,
     z: int,
     axis_name: str | None = None,
+    has_bound: bool = True,
 ) -> SpreadState:
     """One-time (per batch) assembly — the PreFilter/PreScore analogue.
     Eligibility honours the owner pod's node selector/affinity and
@@ -58,7 +59,11 @@ def prep_spread(
     the prep-only value-space scatter that folds bound-pod counts.
     Under shard_map pass axis_name: value-space counts psum across node
     shards before mapping back to (local) node space, so a topology
-    domain spanning shards is counted whole."""
+    domain spanning shards is counted whole.  has_bound=False
+    (FeatureFlags.bound_spread) statically elides the bound-count
+    scatter+gather (the tables are runtime arrays — XLA cannot fold
+    them even when zero); the distinct-value sizes pass stays, it does
+    not depend on bound pods."""
     c_dim, tk = spread.owner_keys.shape
     n = cluster.node_valid.shape[0]
 
@@ -78,19 +83,31 @@ def prep_spread(
     ).T                                                        # [C, N]
     vc = jnp.clip(v, 0, z - 1)
 
-    def per_c(vc_row, ok_row, vrow, nm_row):
-        ok = ok_row & (vrow >= 0)
-        counts = jnp.zeros(z, jnp.float32).at[vc_row].add(nm_row * ok)
-        mask = jnp.zeros(z, bool).at[vc_row].max(ok)
-        return counts, mask
+    if has_bound:
+        def per_c(vc_row, ok_row, vrow, nm_row):
+            ok = ok_row & (vrow >= 0)
+            counts = jnp.zeros(z, jnp.float32).at[vc_row].add(nm_row * ok)
+            mask = jnp.zeros(z, bool).at[vc_row].max(ok)
+            return counts, mask
 
-    counts_z, vmask = jax.vmap(per_c)(vc, eligible, v, spread.node_matches)
+        counts_z, vmask = jax.vmap(per_c)(vc, eligible, v, spread.node_matches)
+    else:
+        def per_c_mask(vc_row, ok_row, vrow):
+            ok = ok_row & (vrow >= 0)
+            return jnp.zeros(z, bool).at[vc_row].max(ok)
+
+        counts_z = None
+        vmask = jax.vmap(per_c_mask)(vc, eligible, v)
     if axis_name is not None:
-        counts_z = jax.lax.psum(counts_z, axis_name)
+        if counts_z is not None:
+            counts_z = jax.lax.psum(counts_z, axis_name)
         vmask = jax.lax.psum(vmask.astype(jnp.int32), axis_name) > 0
-    # back to node space for the scan
-    counts_node = jnp.take_along_axis(counts_z, vc, axis=-1)
-    counts_node = jnp.where(v >= 0, counts_node, 0.0)
+    if counts_z is not None:
+        # back to node space for the scan
+        counts_node = jnp.take_along_axis(counts_z, vc, axis=-1)
+        counts_node = jnp.where(v >= 0, counts_node, 0.0)
+    else:
+        counts_node = jnp.zeros((c_dim, n), jnp.float32)
     return SpreadState(
         counts_node=counts_node,
         eligible=eligible,

@@ -158,10 +158,14 @@ def sharded_greedy_assign(
         # v/eligible/blocked stay node-sharded.
         sp0 = tm0 = None
         if features.spread:
-            sp0 = prep_spread(cl, sel_mask, spread, topo_z, axis_name=AXIS)
+            sp0 = prep_spread(
+                cl, sel_mask, spread, topo_z, axis_name=AXIS,
+                has_bound=features.bound_spread,
+            )
         if features.interpod:
             tm0 = prep_terms(
-                cl, terms, topo_z, axis_name=AXIS, slots=features.term_slots
+                cl, terms, topo_z, axis_name=AXIS, slots=features.term_slots,
+                has_bound=features.bound_terms,
             )
 
         def step(carry, k):

@@ -1,0 +1,155 @@
+"""DeviceClusterMirror: delta sync must equal a fresh full upload after
+any mutation sequence (the generation-protocol analogue of the
+reference's cache_test.go snapshot-consistency cases around
+internal/cache/cache.go:185-260)."""
+
+import numpy as np
+import jax
+import pytest
+
+from kubernetes_tpu.models.mirror import DeviceClusterMirror
+from kubernetes_tpu.ops import schema
+from kubernetes_tpu.testing.wrappers import GI, MI, make_node, make_pod
+
+
+def _mk_state(n=12):
+    state = schema.ClusterState()
+    for i in range(n):
+        state.add_node(
+            make_node(f"n-{i}")
+            .capacity(cpu_milli=8000, mem=16 * GI, pods=110)
+            .zone(f"z-{i % 3}")
+            .obj()
+        )
+    return state
+
+
+def _assert_mirror_matches(mirror, state):
+    dev = mirror.sync()
+    want = state.tensors()
+    for name in want._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(dev, name)),
+            np.asarray(getattr(want, name)),
+            err_msg=f"leaf {name} diverged",
+        )
+
+
+def test_initial_sync_and_noop_resync():
+    state = _mk_state()
+    mirror = DeviceClusterMirror(state)
+    _assert_mirror_matches(mirror, state)
+    dev1 = mirror.sync()
+    dev2 = mirror.sync()  # no mutations: must return the same arrays
+    assert dev1.allocatable is dev2.allocatable
+
+
+def test_pod_usage_deltas():
+    state = _mk_state()
+    mirror = DeviceClusterMirror(state)
+    mirror.sync()
+    pods = [
+        make_pod(f"p-{i}").req(cpu_milli=500, mem=256 * MI).obj()
+        for i in range(5)
+    ]
+    for i, p in enumerate(pods):
+        state.add_pod(p, f"n-{i % 3}")
+    _assert_mirror_matches(mirror, state)
+    state.remove_pod(pods[0])
+    state.remove_pod(pods[3])
+    _assert_mirror_matches(mirror, state)
+
+
+def test_node_lifecycle_deltas():
+    state = _mk_state()
+    mirror = DeviceClusterMirror(state)
+    mirror.sync()
+    state.update_node(
+        make_node("n-1").capacity(cpu_milli=32000, mem=64 * GI, pods=200)
+        .zone("z-9").label("disk", "ssd").obj()
+    )
+    _assert_mirror_matches(mirror, state)
+    state.remove_node("n-2")
+    _assert_mirror_matches(mirror, state)
+    state.add_node(
+        make_node("n-new").capacity(cpu_milli=1000, mem=GI, pods=10)
+        .taint("dedicated", "gpu", "NoSchedule").obj()
+    )
+    _assert_mirror_matches(mirror, state)
+
+
+def test_growth_forces_struct_resync():
+    state = _mk_state(4)
+    mirror = DeviceClusterMirror(state)
+    mirror.sync()
+    gen0 = state.struct_generation
+    for i in range(200):  # cross several growth buckets
+        state.add_node(
+            make_node(f"g-{i}").capacity(cpu_milli=4000, mem=8 * GI, pods=50)
+            .obj()
+        )
+    assert state.struct_generation > gen0
+    _assert_mirror_matches(mirror, state)
+
+
+def test_resource_widen_forces_struct_resync():
+    state = _mk_state()
+    mirror = DeviceClusterMirror(state)
+    mirror.sync()
+    state.add_node(
+        make_node("tpu-node")
+        .capacity(cpu_milli=8000, mem=16 * GI, pods=110,
+                  **{"google.com/tpu": 8})
+        .obj()
+    )
+    _assert_mirror_matches(mirror, state)
+
+
+def test_compaction_deltas():
+    state = _mk_state(40)
+    mirror = DeviceClusterMirror(state)
+    mirror.sync()
+    for i in range(5, 40):
+        state.remove_node(f"n-{i}")  # triggers _maybe_compact
+    _assert_mirror_matches(mirror, state)
+
+
+def test_two_mirrors_one_state():
+    """Profiles: two consumers sync independently through the shared
+    generation counters."""
+    state = _mk_state()
+    m1 = DeviceClusterMirror(state)
+    m2 = DeviceClusterMirror(state)
+    m1.sync()
+    state.add_pod(make_pod("p").req(cpu_milli=100, mem=MI).obj(), "n-0")
+    m2.sync()
+    state.add_pod(make_pod("q").req(cpu_milli=100, mem=MI).obj(), "n-1")
+    _assert_mirror_matches(m1, state)
+    _assert_mirror_matches(m2, state)
+
+
+def test_scheduler_steps_use_mirror():
+    """End-to-end: repeated schedule_pending steps with assumes between
+    them stay correct (the steady-state loop the mirror accelerates)."""
+    from kubernetes_tpu.models.batch_scheduler import TPUBatchScheduler
+
+    sched = TPUBatchScheduler()
+    for i in range(8):
+        sched.add_node(
+            make_node(f"n-{i}").capacity(cpu_milli=4000, mem=8 * GI, pods=20)
+            .obj()
+        )
+    placed = {}
+    for step in range(4):
+        pods = [
+            make_pod(f"s{step}-p{i}").req(cpu_milli=1000, mem=GI).obj()
+            for i in range(6)
+        ]
+        names = sched.schedule_pending(pods)
+        for p, nm in zip(pods, names):
+            assert nm is not None
+            sched.assume(p, nm)
+            placed[p.meta.name] = nm
+    # every node's accumulated usage is visible: a final over-ask fails
+    big = [make_pod("big").req(cpu_milli=4000, mem=GI).obj()]
+    assert sched.schedule_pending(big) == [None]
