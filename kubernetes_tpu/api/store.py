@@ -27,6 +27,7 @@ cacher.go dispatchEvent) and must relist.
 from __future__ import annotations
 
 import copy
+import logging
 import queue
 import threading
 from dataclasses import dataclass, field
@@ -175,6 +176,7 @@ class Store:
             return 0
         replayed = 0
         good_offset = 0
+        size = os.path.getsize(path)
         with open(path, "rb") as f:
             for raw in f:
                 line = raw.decode(errors="replace").strip()
@@ -184,12 +186,24 @@ class Store:
                 try:
                     rec = json.loads(line)
                 except json.JSONDecodeError:
-                    # torn tail: the process died mid-append; the record
-                    # was never acknowledged durable — stop replay and
-                    # truncate so appends continue from the last good line
-                    with open(path, "r+b") as t:
-                        t.truncate(good_offset)
-                    break
+                    if good_offset + len(raw) >= size:
+                        # torn TAIL: the process died mid-append; the
+                        # record was never acknowledged durable — stop
+                        # replay and truncate so appends continue from
+                        # the last good line
+                        with open(path, "r+b") as t:
+                            t.truncate(good_offset)
+                        break
+                    # mid-file corruption (partial page write): records
+                    # AFTER it were acknowledged durable — skip the bad
+                    # line, keep replaying, do NOT truncate them away
+                    logging.getLogger(__name__).error(
+                        "journal %s: undecodable record at offset %d "
+                        "(not tail); skipping it and keeping later "
+                        "records", path, good_offset,
+                    )
+                    good_offset += len(raw)
+                    continue
                 op, rv, kind = rec["op"], rec["rv"], rec["kind"]
                 key = rec["key"]
                 objs = self._objects.setdefault(kind, {})

@@ -80,9 +80,6 @@ class DeviceClusterMirror:
         self._struct_gen = 0
         self._shape: Optional[Tuple] = None
 
-    def invalidate(self) -> None:
-        self._dev = None
-
     def sync(self) -> schema.ClusterTensors:
         """Return device-resident cluster tensors matching the state's
         current contents.  Caller must hold the cache lock (the host

@@ -517,6 +517,11 @@ def auction_assign(
         # as act^T @ onehot(pod value), then value-space -> node-space
         # as adds @ onehot(node value).  The equivalent scatter-add +
         # take_along_axis each serialized at ~0.08 s per repair pass.
+        # Precision.HIGHEST: spread counts are exact integers feeding the
+        # exact admit criterion (count + rank + selfMatch - min <=
+        # maxSkew).  Default TPU matmul precision casts to bf16, which
+        # rounds counts past 256 and flips admit/release decisions.
+        hi = jax.lax.Precision.HIGHEST
         adds = jnp.zeros((cmax_sp, z_spread), jnp.float32)
         zr = jnp.arange(z_spread)
         for s in features.spread_slots:
@@ -526,11 +531,13 @@ def auction_assign(
             ).astype(jnp.float32)                                # [P, Z]
             rows_s = spread.slot == s                            # [C]
             act_s = act * rows_s[None, :]
-            adds = adds + jnp.einsum("pc,pz->cz", act_s, oh_pz)
+            adds = adds + jnp.einsum(
+                "pc,pz->cz", act_s, oh_pz, precision=hi
+            )
         delta = jnp.zeros_like(sp_counts)
         for s in features.spread_slots:
             rows_s = spread.slot == s                            # [C]
-            d = adds @ spread_onehot[s]                          # [C, N]
+            d = jnp.matmul(adds, spread_onehot[s], precision=hi)  # [C, N]
             delta = jnp.where(rows_s[:, None], d, delta)
         return sp_counts + jnp.where(sp0.v >= 0, delta, 0.0)
 

@@ -130,12 +130,26 @@ class PreemptionEvaluator:
         return PreemptionResult(node_name, victims)
 
     def _nominate(self, pod: api.Pod, node_name: str) -> None:
-        try:
-            current = self.store.get("Pod", pod.meta.name, pod.meta.namespace)
-            current.status.nominated_node_name = node_name
-            self.store.update(current)
-        except KeyError:
-            pass  # pod deleted while we worked
+        # Best-effort status write (the reference's nominatedNodeName
+        # PATCH is equally fire-and-forget).  Conflict is a ValueError,
+        # not a KeyError — an uncaught race here after victims were
+        # already evicted would kill the scheduler thread, so retry once
+        # against the fresh object and then give up: the in-cache
+        # nomination (cache.nominate) still reserves the space.
+        from ..api import store as st
+
+        for _ in range(2):
+            try:
+                current = self.store.get(
+                    "Pod", pod.meta.name, pod.meta.namespace
+                )
+                current.status.nominated_node_name = node_name
+                self.store.update(current)
+                return
+            except st.NotFound:
+                return  # pod deleted while we worked
+            except st.Conflict:
+                continue  # concurrent writer; re-read and retry once
 
     # -- planning (findCandidates + SelectCandidate + verify) --------------
 

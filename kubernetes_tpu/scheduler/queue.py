@@ -193,13 +193,25 @@ class SchedulingQueue:
                 self._gang_staged[key] = info
                 self._tier[key] = "gangstage"
                 return
-            # Gang is whole: release any members still staged.
-            for k in [
-                k for k in self._group_keys[group]
-                if self._tier.get(k) == "gangstage" and k != key
-            ]:
-                self._push_active(self._gang_staged.pop(k))
+            self._release_gang_locked(group)
         self._push_active(info)
+
+    def _release_gang_locked(self, group: str) -> None:
+        """Release every still-staged member of a gang that is now whole
+        (no-op while it is short).  Runs from _admit_locked AND from
+        update() — a pod can complete its gang by JOINING via update
+        (or a same-group update can newly declare the size); without the
+        update-side call the staged members stayed in 'gangstage'
+        forever.  Callers hold self._cond."""
+        size = self._group_size.get(group, 0)
+        keys = self._group_keys.get(group, set())
+        if size and len(keys) < size:
+            return
+        for k in [
+            k for k in keys
+            if self._tier.get(k) == "gangstage" and k in self._gang_staged
+        ]:
+            self._push_active(self._gang_staged.pop(k))
 
     def update(self, pod: api.Pod) -> None:
         """Spec/labels changed: gated pods re-check gates; unschedulable
@@ -237,6 +249,29 @@ class SchedulingQueue:
                         self._group_size[new_group] = max(
                             declared, self._group_size.get(new_group, 0)
                         )
+                    # joining may have completed the gang — wake its
+                    # staged members (they won't get another event)
+                    self._release_gang_locked(new_group)
+            elif new_group:
+                # same group: a size declaration arriving via update must
+                # take effect (first add may have omitted it).  A
+                # newly-satisfied size releases the staged members; a
+                # newly-SHORT gang re-stages queued members (mirroring
+                # delete()) so a partial gang never reaches a solve.
+                declared = pod.spec.scheduling_group_size
+                if declared:
+                    self._group_size[new_group] = max(
+                        declared, self._group_size.get(new_group, 0)
+                    )
+                size = self._group_size.get(new_group, 0)
+                if size and len(self._group_keys.get(new_group, ())) < size:
+                    for k in list(self._group_keys.get(new_group, ())):
+                        if self._tier.get(k) in ("active", "backoff"):
+                            inf = self._infos[k]
+                            self._gang_staged[k] = inf
+                            self._tier[k] = "gangstage"
+                else:
+                    self._release_gang_locked(new_group)
             if tier == "gated" and not pod.spec.scheduling_gates:
                 self._gated.pop(key, None)
                 info.gated = False

@@ -23,6 +23,7 @@ TPUBatchScheduler (models/batch_scheduler.py).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Dict, List, Optional
@@ -74,6 +75,12 @@ class Scheduler:
         self.profiles = FrameworkRegistry(
             self.config, state=tpu.state if tpu else None
         )
+        if tpu is not None:
+            # the injected instance IS the default profile's solver —
+            # sharing only its state would silently drop a custom
+            # mode/score_config/limits on the scheduling path (the
+            # registry-built instance would solve instead)
+            self.profiles.default.tpu = tpu
         self.tpu = tpu or self.profiles.default.tpu
         self.cache = SchedulerCache(
             self.tpu.state,
@@ -199,7 +206,16 @@ class Scheduler:
             if self.leader_elector and not self.leader_elector.is_leader():
                 time.sleep(0.05)
                 continue
-            self.schedule_batch(timeout=0.2)
+            try:
+                self.schedule_batch(timeout=0.2)
+            except Exception:  # noqa: BLE001 — per-cycle containment
+                # the reference contains per-cycle errors (ScheduleOne
+                # logs and returns; the wait.Until loop re-enters) — one
+                # lost race must not kill the scheduling thread for the
+                # process's lifetime
+                logging.getLogger(__name__).exception(
+                    "schedule_batch cycle failed; continuing"
+                )
             for pod in self.cache.cleanup_expired():
                 # binding never confirmed: give the pod another chance
                 self.queue.add(pod)

@@ -195,6 +195,29 @@ def test_journal_tolerates_torn_tail(tmp_path):
     assert {p.meta.name for p in s3.list("Pod")[0]} == {"a", "b", "c"}
 
 
+def test_journal_mid_file_corruption_keeps_later_records(tmp_path):
+    """A corrupted NON-tail line (partial page write) must not discard
+    the acknowledged-durable records after it — only a torn tail may be
+    truncated (advisor finding r3)."""
+    path = str(tmp_path / "j.jsonl")
+    s1 = st.Store(journal_path=path)
+    s1.create(make_pod("a").obj())
+    s1.create(make_pod("b").obj())
+    s1.create(make_pod("c").obj())
+    lines = open(path, "rb").read().splitlines(keepends=True)
+    assert len(lines) == 3
+    lines[1] = b'{"op": "ADDED", "rv": 2, "kind": "Pod", "ke\xff\xfe\n'
+    with open(path, "wb") as f:
+        f.writelines(lines)
+    s2 = st.Store(journal_path=path)
+    names = {p.meta.name for p in s2.list("Pod")[0]}
+    assert "c" in names, "record after corruption was dropped"
+    assert names == {"a", "c"}
+    s2.create(make_pod("d").obj())  # appends continue cleanly
+    s3 = st.Store(journal_path=path)
+    assert {p.meta.name for p in s3.list("Pod")[0]} >= {"a", "c", "d"}
+
+
 def test_journal_compaction_bounds_growth(tmp_path):
     """Churny updates (lease renewals) must not grow the journal without
     bound: compaction rewrites to one record per live object."""

@@ -275,3 +275,58 @@ def test_delete_below_declared_size_restages_members():
     # replacement arrives: gang whole again
     q.add(make_pod("g-2b").group("g", size=3).obj())
     assert len(q.pop_batch(10, timeout=0.1)) == 3
+
+
+def test_gang_completed_by_update_releases_staged():
+    """A pod can complete a gang by JOINING via update(); the staged
+    members must wake without waiting for an unrelated event (advisor
+    finding r3: the release loop only ran from add)."""
+    q = SchedulingQueue()
+    q.add(make_pod("g-0").group("g", size=2).obj())
+    assert q.stats()["gang_staged"] == 1
+    # p1 arrives ungrouped (active), then an update joins it to the gang
+    loner = make_pod("p-1").obj()
+    q.add(loner)
+    q.update(make_pod("p-1").group("g", size=2).obj())
+    assert q.stats()["gang_staged"] == 0
+    batch = q.pop_batch(10, timeout=0.2)
+    assert sorted(i.pod.meta.name for i in batch) == ["g-0", "p-1"]
+
+
+def test_gang_size_declared_via_update_takes_effect():
+    """A same-group update that newly declares scheduling_group_size must
+    be recorded — and a now-satisfied size releases the staging."""
+    q = SchedulingQueue()
+    # both members arrive with group but NO declared size -> active
+    q.add(make_pod("g-0").group("g").obj())
+    q.add(make_pod("g-1").group("g").obj())
+    assert q.stats()["gang_staged"] == 0
+    # update declares size=3: gang is short; nothing staged yet (queued
+    # members stay queued until a delete/restage path runs), but the size
+    # must be recorded so the NEXT member completes or stages correctly
+    q.update(make_pod("g-0").group("g", size=3).obj())
+    assert q._group_size["g"] == 3
+    q.add(make_pod("g-2").group("g", size=3).obj())
+    # gang whole: the new member must not strand in staging
+    assert q.stats()["gang_staged"] == 0
+    batch = q.pop_batch(10, timeout=0.2)
+    assert len(batch) == 3
+
+
+def test_gang_size_raised_via_update_restages_active():
+    """Declaring (or raising) the size via update on a gang whose queued
+    members no longer satisfy it must RE-STAGE them — a partial gang must
+    never reach a solve (review finding r4)."""
+    q = SchedulingQueue()
+    for i in range(3):
+        q.add(make_pod(f"g-{i}").group("g").obj())  # no size -> active
+    assert q.stats()["gang_staged"] == 0
+    q.update(make_pod("g-0").group("g", size=5).obj())
+    assert q.stats()["gang_staged"] == 3
+    batch = q.pop_batch(10, timeout=0.1)
+    assert batch == []
+    # the remaining two arrive: gang whole, everyone released
+    q.add(make_pod("g-3").group("g", size=5).obj())
+    q.add(make_pod("g-4").group("g", size=5).obj())
+    batch = q.pop_batch(10, timeout=0.2)
+    assert len(batch) == 5

@@ -302,3 +302,43 @@ def test_nomination_lifecycle_in_cache():
     # assuming the pod (it landed) spends the nomination
     cache.assume(pod, "n0")
     assert cache.nominations_excluding(set()) == []
+
+
+def test_nominate_survives_conflict_and_notfound():
+    """_nominate is best-effort: a concurrent writer between its get and
+    update raises Conflict (a ValueError) — it must retry/drop, never
+    propagate and kill the scheduling thread (advisor finding r3)."""
+    from kubernetes_tpu.api import store as st
+    from kubernetes_tpu.scheduler.preemption import PreemptionEvaluator
+
+    store = st.Store()
+    pod = make_pod("prey").obj()
+    store.create(pod)
+
+    class RacingStore:
+        """First update hits a conflict (someone else wrote); the retry
+        against the re-read object succeeds."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.calls = 0
+
+        def get(self, *a, **k):
+            return self.inner.get(*a, **k)
+
+        def update(self, obj):
+            self.calls += 1
+            if self.calls == 1:
+                raise st.Conflict("resourceVersion mismatch")
+            return self.inner.update(obj)
+
+    ev = object.__new__(PreemptionEvaluator)
+    ev.store = RacingStore(store)
+    ev._nominate(pod, "node-x")
+    got = store.get("Pod", "prey", pod.meta.namespace)
+    assert got.status.nominated_node_name == "node-x"
+
+    # NotFound (pod deleted mid-flight) is silently dropped
+    ev.store = store
+    missing = make_pod("gone").obj()
+    ev._nominate(missing, "node-y")  # must not raise
