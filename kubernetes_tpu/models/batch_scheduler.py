@@ -192,6 +192,8 @@ class TPUBatchScheduler:
         limits: Optional[schema.SnapshotLimits] = None,
         mode: str = "auto",  # auto | greedy | auction
         state: Optional[schema.ClusterState] = None,
+        mesh=None,  # jax.sharding.Mesh: shard the node axis across chips
+        use_mirror: bool = True,  # DeviceClusterMirror feature gate
     ):
         if state is not None:
             # shared-state instance: multiple scheduler PROFILES solve the
@@ -204,9 +206,23 @@ class TPUBatchScheduler:
             self.state = schema.ClusterState(self.builder)
         self.score_config = score_config
         self.mode = mode
+        self.mesh = mesh
         self._greedy = assign_ops.greedy_assign_jit(score_config)
         self._auction = auction_ops.auction_assign_jit(score_config)
+        if mesh is not None:
+            # multi-chip: node axis sharded over the mesh (SURVEY §2.7
+            # row 8) — both solver families have sharded twins with
+            # placement parity (tests/test_sharded.py)
+            from ..parallel import sharded as _sharded
+
+            self._greedy_sharded = _sharded.sharded_greedy_jit(
+                mesh, score_config
+            )
+            self._auction_sharded = _sharded.sharded_auction_jit(
+                mesh, score_config
+            )
         self._mirror = DeviceClusterMirror(self.state)
+        self.use_mirror = use_mirror
         self._fill_cache: dict = {}
         self._unpack_cache: dict = {}
         self.last_result: Optional[Result] = None
@@ -294,13 +310,21 @@ class TPUBatchScheduler:
         )
         route = self._route(snap, features, topo_split, n_groups)
         if route == "auction":
-            return self._auction(
+            solver = (
+                self._auction_sharded if self.mesh is not None
+                else self._auction
+            )
+            return solver(
                 snap, features=features, topo_z=topo_split,
                 n_groups=n_groups, tie_k=meta.tie_k,
             )
         topo_z = (
             max(topo_split) if assign_ops.needs_topo(features) else 1
         )
+        if self.mesh is not None and n_groups == 0:
+            # sharded greedy has no gang post-pass; gang batches that
+            # fall off the auction route stay single-chip
+            return self._greedy_sharded(snap, topo_z, features)
         return self._greedy(snap, topo_z, features, n_groups=n_groups)
 
     def encode_pending(
@@ -358,12 +382,25 @@ class TPUBatchScheduler:
             # device-resident across steps; only dirty rows transfer
             # (models.mirror).  The pod/constraint tables are freshly
             # allocated per batch, so device_put cannot alias live state.
-            snap = snap._replace(cluster=self._mirror.sync())
-            snap = _device_fill_shortcut(
-                snap, self._fill_cache, no_bound_pods=no_bound,
-                features=meta.features,
-            )
-            snap = _packed_device_put(snap, self._unpack_cache)
+            # Mesh mode hands host copies straight to the sharded jits
+            # (shard_map owns placement; a single-device-committed mirror
+            # would fight the mesh sharding).
+            if self.mesh is None and self.use_mirror:
+                snap = snap._replace(cluster=self._mirror.sync())
+                snap = _device_fill_shortcut(
+                    snap, self._fill_cache, no_bound_pods=no_bound,
+                    features=meta.features,
+                )
+                snap = _packed_device_put(snap, self._unpack_cache)
+            else:
+                # mesh mode (shard_map owns placement) or the
+                # DeviceClusterMirror gate is off: full host copy +
+                # transfer every step (the pre-mirror behavior — the
+                # rollback knob the gate exists for)
+                snap = snap._replace(
+                    cluster=jax.tree.map(np.array, snap.cluster)
+                )
+                snap = jax.device_put(snap) if self.mesh is None else snap
         if rows:
             idx = jnp.asarray(np.array(rows, dtype=np.int32))
             cluster = snap.cluster._replace(

@@ -109,6 +109,7 @@ class AuctionResult(NamedTuple):
     gang_dropped: jnp.ndarray  # bool[P]: placed but released with its gang
     cluster: ClusterTensors   # post-solve cluster
     reasons: jnp.ndarray = None  # i32[P]: assign.REASON_* for unplaced pods
+    debug_sp_counts: jnp.ndarray = None  # [C, N] final spread counts (debug)
 
 
 def auction_features_ok(features: FeatureFlags) -> bool:
@@ -139,6 +140,7 @@ def auction_assign(
     features: Optional[FeatureFlags] = None,
     topo_z: Optional[Tuple[int, int]] = None,
     tie_k: int = 128,
+    axis_name: Optional[str] = None,
 ) -> AuctionResult:
     """Jointly assign the pending batch: rounds of (parallel bid →
     per-node prefix acceptance → constraint repair).  n_groups:
@@ -154,6 +156,17 @@ def auction_assign(
     repairs keep every committed placement constraint-valid.  Where no
     two pods contend, round-1 bids equal the greedy picks (same
     filter/score kernels).
+
+    axis_name: mesh axis when called under shard_map with the NODE axis
+    sharded (parallel.sharded.sharded_auction_assign).  One
+    implementation serves both layouts: pod-space state (bids,
+    acceptance, repair ranks, gang bookkeeping) is replicated; node-space
+    state (capacity, spread counts, interpod bits) stays sharded, with
+    ownership-masked psum gathers at the pod<->node boundary, pmax/pmin
+    for score normalization and election, and an all_gather merge of the
+    per-shard tie sets.  Placements are bit-identical to the single-chip
+    solve (top_k ties resolve to the lowest global node index in both
+    layouts).
     """
     if features is None:
         features = features_of(snapshot)
@@ -166,12 +179,79 @@ def auction_assign(
     if topo_z is None:
         topo_z = required_topo_z_split(snapshot)
     z_spread, z_terms = topo_z
-    tie_k = min(tie_k, snapshot.cluster.allocatable.shape[0])
+    if axis_name is None:
+        tie_k = min(tie_k, snapshot.cluster.allocatable.shape[0])
+    # sharded: the wrapper guarantees tie_k <= GLOBAL node count; the
+    # local shape here is one shard, so clamping against it would
+    # silently shrink the tie set (each shard's top_k clamps to its
+    # local size below; the merge restores the global tie_k)
     (cluster, pods, sel, pref, spread, terms, prefpod, images) = jax.tree.map(
         jnp.asarray, tuple(snapshot)
     )
-    n = cluster.allocatable.shape[0]
+    n = cluster.allocatable.shape[0]      # LOCAL node count under shard_map
     p = pods.req.shape[0]
+
+    # -- shard-layout helpers (identity when axis_name is None) -----------
+    if axis_name is not None:
+        n_shards = jax.lax.psum(1, axis_name)
+        offset = jax.lax.axis_index(axis_name) * n
+        n_total = n * n_shards
+    else:
+        offset = 0
+        n_total = n
+
+    def _pmax(x):
+        return x if axis_name is None else jax.lax.pmax(x, axis_name)
+
+    def _pmin(x):
+        return x if axis_name is None else jax.lax.pmin(x, axis_name)
+
+    def _psum(x):
+        return x if axis_name is None else jax.lax.psum(x, axis_name)
+
+    def _any(x):
+        if axis_name is None:
+            return x.any()
+        return jax.lax.pmax(x.any().astype(jnp.int32), axis_name) > 0
+
+    def node_rows(mat, idx):
+        """Gather rows of a node-axis tensor at GLOBAL node ids [P].
+        Sharded: the owning shard contributes, psum replicates."""
+        if axis_name is None:
+            return mat[idx]
+        own = (idx >= offset) & (idx < offset + n)
+        loc = jnp.clip(idx - offset, 0, n - 1)
+        vals = mat[loc]
+        mask = own.reshape(own.shape + (1,) * (vals.ndim - own.ndim))
+        if vals.dtype == jnp.bool_:
+            out = jax.lax.psum(
+                jnp.where(mask, vals, False).astype(jnp.int32), axis_name
+            )
+            return out > 0
+        return jax.lax.psum(
+            jnp.where(mask, vals, jnp.zeros_like(vals)), axis_name
+        )
+
+    def node_cell_gather(mat, rows, idx):
+        """mat[rows[p], idx[p]] where mat is [R, N]-sharded on axis 1 and
+        idx holds GLOBAL node ids."""
+        if axis_name is None:
+            return mat[rows, idx]
+        own = (idx >= offset) & (idx < offset + n)
+        loc = jnp.clip(idx - offset, 0, n - 1)
+        return jax.lax.psum(
+            jnp.where(own, mat[rows, loc], jnp.zeros((), mat.dtype)),
+            axis_name,
+        )
+
+    def scatter_add_rows(dst, idx, vals, mask):
+        """dst.at[idx].add(vals * mask) with idx GLOBAL; sharded, only
+        the owning shard writes its local rows."""
+        if axis_name is None:
+            return dst.at[idx].add(vals * mask[:, None])
+        own = mask & (idx >= offset) & (idx < offset + n)
+        loc = jnp.clip(idx - offset, 0, n - 1)
+        return dst.at[loc].add(vals * own[:, None].astype(vals.dtype))
     sel_mask = selector_match(cluster, sel)
     pref_mask = preferred_match(cluster, pref)
     # Factorized class axes (PodBatch docstring): heavy per-row kernels
@@ -199,7 +279,8 @@ def auction_assign(
         from .interpod import prep_pref_pod, pref_pod_raw
 
         pp = prep_pref_pod(
-            cluster, prefpod, z_terms, has_bound=features.bound_pref
+            cluster, prefpod, z_terms, axis_name=axis_name,
+            has_bound=features.bound_pref,
         )
         pref_raw_k = jax.vmap(lambda rep: pref_pod_raw(pp, prefpod, rep))(
             k_reps
@@ -208,7 +289,9 @@ def auction_assign(
         from .scores import image_locality_score
 
         img_k = jax.vmap(
-            lambda rep: image_locality_score(cluster, images, rep)
+            lambda rep: image_locality_score(
+                cluster, images, rep, axis_name=axis_name
+            )
         )(k_reps)
 
     def joint_extra(s, k):
@@ -221,7 +304,7 @@ def auction_assign(
         total = jnp.zeros(n, jnp.float32)
         if pref_raw_k is not None:
             total = total + cfg.interpod_weight * normalize_minmax(
-                pref_raw_k[k], sfeas_s[s]
+                pref_raw_k[k], sfeas_s[s], axis_name=axis_name
             )
         if img_k is not None:
             total = total + cfg.image_weight * img_k[k]
@@ -236,7 +319,7 @@ def auction_assign(
 
     sp0 = (
         prep_spread(
-            cluster, sel_mask, spread, z_spread,
+            cluster, sel_mask, spread, z_spread, axis_name=axis_name,
             has_bound=features.bound_spread,
         )
         if features.spread
@@ -244,8 +327,8 @@ def auction_assign(
     )
     tm0 = (
         prep_terms(
-            cluster, terms, z_terms, slots=features.term_slots,
-            has_bound=features.bound_terms,
+            cluster, terms, z_terms, axis_name=axis_name,
+            slots=features.term_slots, has_bound=features.bound_terms,
         )
         if features.interpod
         else None
@@ -297,7 +380,11 @@ def auction_assign(
 
         fits_s, fit_s, bal_s = jax.vmap(per_spec)(s_reps)   # [Cs, N]
         spf_k = (
-            jax.vmap(lambda rep: spread_filter(sp, spread, rep))(k_reps)
+            jax.vmap(
+                lambda rep: spread_filter(
+                    sp, spread, rep, axis_name=axis_name
+                )
+            )(k_reps)
             if features.spread
             else None
         )
@@ -315,35 +402,49 @@ def auction_assign(
             if features.interpod:
                 feas = feas & ipf_k[k]
             sp_score = (
-                spread_score(sp, spread, rep, feas)
+                spread_score(sp, spread, rep, feas, axis_name=axis_name)
                 if features.soft_spread
                 else None
             )
             scores = combine_scores(
                 fit_s[s], bal_s[s], aff_s[s], taint_s[s], feas, cfg,
-                spread_score=sp_score, extra=joint_extra(s, k),
+                axis_name=axis_name, spread_score=sp_score,
+                extra=joint_extra(s, k),
             )
             masked = jnp.where(feas, scores, NEG_INF)
-            best = jnp.max(masked)
+            best = _pmax(jnp.max(masked))
             tie = jnp.asarray(feas & (masked == best))
             # Tie nodes enumerated by top_k over a per-(class, round)
             # hashed node ordering: one fused top_k per class instead of
             # the earlier full-[N] inverse scatter (TPU scatters
             # serialize; at hundreds of classes the scatter dominated the
             # round).  The hash randomizes which tie nodes surface and
-            # rotates every round, so re-bidding classes diversify.
+            # rotates every round, so re-bidding classes diversify.  The
+            # hash input is the GLOBAL node id, so the tie ORDER is
+            # layout-independent; sharded, each shard takes its local
+            # top-k and an all_gather + re-top_k merges them (equal keys
+            # resolve to the lowest global id in both layouts).
             rot = (
                 (c.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
                 ^ (rnd.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B))
                 ^ seed_c
             ) * jnp.uint32(0x27D4EB2F)
-            hkey = (
-                (jnp.arange(n, dtype=jnp.uint32) + jnp.uint32(1))
-                * jnp.uint32(0x9E3779B9)
-            ) ^ rot
+            gids = jnp.arange(n, dtype=jnp.uint32) + jnp.uint32(1)
+            if axis_name is not None:
+                gids = gids + jnp.uint32(offset)
+            hkey = (gids * jnp.uint32(0x9E3779B9)) ^ rot
             key = jnp.where(tie, (hkey >> 2).astype(jnp.int32), -1)
-            _vals, topk_idx = jax.lax.top_k(key, tie_k)    # i32[K]
-            cnt = jnp.minimum(tie.sum(), tie_k).astype(jnp.int32)
+            local_k = min(tie_k, n)  # a shard holds at most n tie nodes
+            _vals, topk_idx = jax.lax.top_k(key, local_k)  # i32[K_local]
+            if axis_name is not None:
+                topk_idx = topk_idx + offset
+                vals_g = jax.lax.all_gather(_vals, axis_name)    # [D, Kl]
+                idx_g = jax.lax.all_gather(topk_idx, axis_name)  # [D, Kl]
+                m_vals, m_pos = jax.lax.top_k(vals_g.reshape(-1), tie_k)
+                topk_idx = idx_g.reshape(-1)[m_pos]
+            cnt = jnp.minimum(
+                _psum(tie.sum()), tie_k
+            ).astype(jnp.int32)
             return topk_idx, cnt, best
 
         inv_c, cnt_c, best_c = jax.vmap(per_class)(
@@ -366,7 +467,7 @@ def auction_assign(
         # the per-round rotation lives in the tie hash; j indexes the
         # class's hash-ordered tie list directly
         slot = j % jnp.maximum(cnt, 1)
-        bid = jnp.where(has, inv_c[cls, slot], n).astype(jnp.int32)
+        bid = jnp.where(has, inv_c[cls, slot], n_total).astype(jnp.int32)
         val = jnp.where(has, best_c[cls], NEG_INF)
         return bid, val
 
@@ -397,13 +498,14 @@ def auction_assign(
                 & (v_n >= 0)[None, :]
             ).astype(jnp.float32)                                # [Z, N]
 
-    def _slot_sorts(nodes):
+    def _slot_sorts(topo_pt):
         """Per-slot (perm, inv, firstv) of the round's bid values —
         depends only on the bids, so it hoists out of the repair's
-        admit iterations."""
+        admit iterations.  topo_pt: [P, TK] bid nodes' topo values
+        (gathered once per round; replicated under shard_map)."""
         out = {}
         for s in features.spread_slots:
-            v_p = cluster.topo_ids[nodes, s]
+            v_p = topo_pt[:, s]
             key = jnp.where(v_p >= 0, v_p, _BIG_I)
             perm = order[jnp.argsort(key[order], stable=True)]
             skey = key[perm]
@@ -430,7 +532,7 @@ def auction_assign(
             rank_pc = jnp.where(rows_s[None, :], back, rank_pc)
         return rank_pc
 
-    def spread_repair(accept, nodes, sp_counts):
+    def spread_repair(accept, nodes, sp_counts, topo_pt):
         """Keep the subset of capacity-accepted pods whose placements
         satisfy every hard constraint (rank r in its (row, value) group
         kept iff count + r + 1 - min <= maxSkew — the filtering.go:336
@@ -442,13 +544,13 @@ def auction_assign(
         md = spread.min_domains
         kept = jnp.zeros(p, bool)
         counts_it = sp_counts
-        v_pc = v_nc[nodes]                                       # [P, C]
-        slot_sorts = _slot_sorts(nodes)
+        v_pc = node_rows(v_nc, nodes)                            # [P, C]
+        slot_sorts = _slot_sorts(topo_pt)
         for _ in range(SPREAD_REPAIR_ITERS):
             cand = accept & ~kept
-            min_c = jnp.min(
+            min_c = _pmin(jnp.min(
                 jnp.where(sp0.eligible, counts_it, _BIGF), axis=-1
-            )
+            ))
             min_c = jnp.where(min_c >= _BIGF, 0.0, min_c)
             min_c = jnp.where((md > 0) & (sp0.sizes < md), 0.0, min_c)
             rank_pc = _spread_ranks(cand, v_pc, slot_sorts)
@@ -458,7 +560,7 @@ def auction_assign(
                 c = jnp.clip(cidx, 0, cmax_sp - 1)
                 vj = v_pc[arange_p, c]
                 own = cand & (cidx >= 0) & spread.hard[c] & (vj >= 0)
-                cnt = counts_it[c, nodes]
+                cnt = node_cell_gather(counts_it, c, nodes)
                 # sequential criterion: count + rank + selfMatch - min <=
                 # maxSkew.  A carrier whose own labels don't match its
                 # constraint's selector (selfMatch=0, legal in k8s) gets
@@ -471,10 +573,12 @@ def auction_assign(
                 rank = rank_pc[arange_p, c].astype(jnp.float32)
                 admit = admit & ~(own & (rank >= allowed))
             kept = kept | admit
-            counts_it = commit_spread(admit, nodes, counts_it, v_pc)
+            counts_it = commit_spread(
+                admit, nodes, counts_it, topo_pt, v_pc
+            )
         return kept
 
-    def interpod_repair(accept, nodes):
+    def interpod_repair(accept, topo_pt):
         """Release within-round anti-affinity conflicts: in each (term,
         topology value) group containing an accepted CARRIER of the term,
         only the first accepted involved pod (solve order) survives."""
@@ -483,7 +587,7 @@ def auction_assign(
             range(cluster.topo_ids.shape[1])
         )
         for s in slots_used:
-            v_p = cluster.topo_ids[nodes, s]                     # [P]
+            v_p = topo_pt[:, s]                                  # [P]
             rel_t = slot_of_t == s                               # [T]
             inv = (mi_dense | anti_dense) & rel_t[None, :]       # [P, T]
             involved = inv & accept[:, None] & (v_p >= 0)[:, None]
@@ -503,13 +607,13 @@ def auction_assign(
             release = release | viol.any(axis=1)
         return accept & ~release
 
-    def commit_spread(accept, nodes, sp_counts, v_pc=None):
+    def commit_spread(accept, nodes, sp_counts, topo_pt, v_pc=None):
         """Fold net accepts into the node-space counts (the batched
         spread_update): every row a placed pod matches gains one on every
         node sharing the placement's topology value."""
         if v_pc is None:
-            v_pc = v_nc[nodes]                                   # [P, C]
-        elig_pc = elig_nc[nodes]
+            v_pc = node_rows(v_nc, nodes)                        # [P, C]
+        elig_pc = node_rows(elig_nc, nodes)
         act = (
             accept[:, None] & spread.pod_matches & elig_pc & (v_pc >= 0)
         ).astype(jnp.float32)
@@ -525,7 +629,7 @@ def auction_assign(
         adds = jnp.zeros((cmax_sp, z_spread), jnp.float32)
         zr = jnp.arange(z_spread)
         for s in features.spread_slots:
-            v_p = cluster.topo_ids[nodes, s]                     # [P]
+            v_p = topo_pt[:, s]                                  # [P]
             oh_pz = (
                 (v_p[:, None] == zr[None, :]) & (v_p >= 0)[:, None]
             ).astype(jnp.float32)                                # [P, Z]
@@ -541,16 +645,17 @@ def auction_assign(
             delta = jnp.where(rows_s[:, None], d, delta)
         return sp_counts + jnp.where(sp0.v >= 0, delta, 0.0)
 
-    def commit_terms(accept, nodes, present, blocked, global_any):
+    def commit_terms(accept, nodes, topo_pt, present, blocked, global_any):
         """Batched interpod_update: matched terms turn present (and
         global) in each placement's topology; carried anti terms turn
-        blocked there.  Scatter in value space as bools, then map back to
-        nodes and pack."""
+        blocked there.  Scatter in value space as bools (replicated —
+        built from pod-space data), then map back to LOCAL nodes and
+        pack."""
         slots_used = features.term_slots or tuple(
             range(cluster.topo_ids.shape[1])
         )
         for s in slots_used:
-            v_p = cluster.topo_ids[nodes, s]                     # [P]
+            v_p = topo_pt[:, s]                                  # [P]
             rel_t = slot_of_t == s
             ok_p = accept & (v_p >= 0)
             vcp = jnp.clip(v_p, 0, z_terms - 1)
@@ -576,39 +681,52 @@ def auction_assign(
 
         # Per-node prefix acceptance in solve order: pre-permute pods into
         # solve order, then a *stable* sort by bid keeps that order within
-        # each node group (no composite integer key to overflow).
+        # each node group (no composite integer key to overflow).  Bids
+        # are GLOBAL node ids; pod-space state is replicated, so this
+        # whole block is layout-independent except the remaining-capacity
+        # gather and the requested scatter.
         perm = order[jnp.argsort(bid[order], stable=True)]
         sbid = bid[perm]
         sreq = pods.req[perm]                                   # [P, R]
         prefix = jnp.cumsum(sreq, axis=0)
         first = jnp.searchsorted(sbid, sbid, side="left")       # [P]
         within = prefix - prefix[first] + sreq[first]
-        remaining = (cluster.allocatable - requested)[jnp.clip(sbid, 0, n - 1)]
-        ok = ((sreq <= 0) | (within <= remaining)).all(axis=-1) & (sbid < n)
+        remaining = node_rows(
+            cluster.allocatable - requested, jnp.clip(sbid, 0, n_total - 1)
+        )
+        ok = ((sreq <= 0) | (within <= remaining)).all(axis=-1) & (
+            sbid < n_total
+        )
         accept = jnp.zeros(p, bool).at[perm].set(ok)
-        nodes = jnp.clip(bid, 0, n - 1)
+        nodes = jnp.clip(bid, 0, n_total - 1)
+        topo_pt = (
+            node_rows(cluster.topo_ids, nodes)
+            if (features.spread or features.interpod)
+            else None
+        )
 
         # constraint repair: releases only shrink the accept set, so
         # capacity stays safe; released pods re-bid next round
         pre_repair = accept
         if features.spread:
-            accept = spread_repair(accept, nodes, sp_counts)
+            accept = spread_repair(accept, nodes, sp_counts, topo_pt)
         if features.interpod:
-            accept = interpod_repair(accept, nodes)
+            accept = interpod_repair(accept, topo_pt)
         # a round that only RELEASES still progresses: the released pods
         # re-bid under the next round's rotation and updated counts (the
         # filter now excludes the domains that capped them); max_rounds
         # bounds the loop regardless
         progress = accept.any() | (pre_repair & ~accept).any()
 
-        w = accept[:, None].astype(jnp.float32)
-        requested = requested.at[nodes].add(pods.req * w)
-        nonzero = nonzero.at[nodes].add(pods.nonzero_req * w)
+        requested = scatter_add_rows(requested, nodes, pods.req, accept)
+        nonzero = scatter_add_rows(
+            nonzero, nodes, pods.nonzero_req, accept
+        )
         if features.spread:
-            sp_counts = commit_spread(accept, nodes, sp_counts)
+            sp_counts = commit_spread(accept, nodes, sp_counts, topo_pt)
         if features.interpod:
             tm_present, tm_blocked, tm_global = commit_terms(
-                accept, nodes, tm_present, tm_blocked, tm_global
+                accept, nodes, topo_pt, tm_present, tm_blocked, tm_global
             )
         assigned = jnp.where(accept, bid, assigned)
         bid_scores = jnp.where(accept, val, bid_scores)
@@ -658,7 +776,9 @@ def auction_assign(
         lambda rep: fits_resources(cl_f, pod_view(pods, rep))
     )(s_reps)
     spf_f_k = (
-        jax.vmap(lambda rep: spread_filter(sp_f, spread, rep))(k_reps)
+        jax.vmap(
+            lambda rep: spread_filter(sp_f, spread, rep, axis_name=axis_name)
+        )(k_reps)
         if features.spread
         else None
     )
@@ -672,17 +792,17 @@ def auction_assign(
         s, k = jspec[c], jcons[c]
         s_static = sfeas_s[s]
         f = s_static & fits_f_s[s]
-        a_res = f.any()
+        a_res = _any(f)
         if features.spread:
             f = f & spf_f_k[k]
-        a_spread = f.any()
+        a_spread = _any(f)
         if features.interpod:
             f = f & ipf_f_k[k]
-        a_inter = f.any()
+        a_inter = _any(f)
         return jnp.where(
             a_inter, REASON_RESOURCES,  # feasible yet unplaced: contention
             jnp.where(
-                ~s_static.any(), REASON_STATIC,
+                ~_any(s_static), REASON_STATIC,
                 jnp.where(
                     ~a_res, REASON_RESOURCES,
                     jnp.where(~a_spread, REASON_SPREAD, REASON_INTERPOD),
@@ -705,17 +825,21 @@ def auction_assign(
             (assigned < 0) & pods.valid & (g >= 0)
         )
         gang_dropped = (g >= 0) & incomplete[gc] & (assigned >= 0)
-        nodes = jnp.clip(assigned, 0, n - 1)
-        w = gang_dropped[:, None].astype(jnp.float32)
-        requested = requested.at[nodes].add(-pods.req * w)
-        nonzero = nonzero.at[nodes].add(-pods.nonzero_req * w)
+        nodes = jnp.clip(assigned, 0, n_total - 1)
+        requested = scatter_add_rows(
+            requested, nodes, -pods.req, gang_dropped
+        )
+        nonzero = scatter_add_rows(
+            nonzero, nodes, -pods.nonzero_req, gang_dropped
+        )
         assigned = jnp.where(gang_dropped, -1, assigned)
         bid_scores = jnp.where(gang_dropped, NEG_INF, bid_scores)
         reasons = jnp.where(gang_dropped, REASON_GANG, reasons)
 
     final = cluster._replace(requested=requested, nonzero_requested=nonzero)
     return AuctionResult(
-        assigned, bid_scores, rounds, gang_dropped, final, reasons
+        assigned, bid_scores, rounds, gang_dropped, final, reasons,
+        sp_counts_f if features.spread else None,
     )
 
 

@@ -302,14 +302,15 @@ _IMG_MIN = 23.0 * _IMG_MB              # minThreshold (image_locality.go)
 _IMG_MAX_PER_CONTAINER = 1000.0 * _IMG_MB
 
 
-def image_locality_score(cluster, images, p) -> jnp.ndarray:
+def image_locality_score(cluster, images, p, axis_name=None) -> jnp.ndarray:
     """ImageLocality Score, 0..100 per node
     (imagelocality/image_locality.go): sum of the pod's image sizes
     already present on the node, each scaled by its cluster spread ratio
     (nodes-having-it / valid nodes), clamped into
     [23MB, 1000MB x containers] and linearly mapped to the score range.
     No NormalizeScore pass — the reference plugin returns the scaled
-    value directly."""
+    value directly.  Under shard_map the spread ratio must span shards:
+    pass axis_name and the per-image node counts psum."""
     ids = images.pod_ids[p]                                  # [MI]
     active = ids >= 0
     idc = jnp.clip(ids, 0, images.sizes.shape[0] - 1)
@@ -318,6 +319,9 @@ def image_locality_score(cluster, images, p) -> jnp.ndarray:
     present = ((cluster.image_bits[:, word] >> bit) & 1).astype(jnp.float32)
     n_valid = jnp.maximum(cluster.node_valid.sum(), 1).astype(jnp.float32)
     counts = (present * cluster.node_valid[:, None]).sum(axis=0)  # [MI]
+    if axis_name is not None:
+        n_valid = jnp.maximum(jax.lax.psum(cluster.node_valid.sum(), axis_name), 1).astype(jnp.float32)
+        counts = jax.lax.psum(counts, axis_name)
     scaled = images.sizes[idc] * counts / n_valid                 # [MI]
     raw = (present * (scaled * active)[None, :]).sum(axis=-1)     # [N]
     # the threshold scales with the pod's TOTAL image-bearing container
@@ -339,21 +343,26 @@ def static_extra(
     rep,
     feasible,
     pp_state=None,
+    axis_name=None,
 ) -> jnp.ndarray:
     """The hoisted per-class static score extras (preferred inter-pod
     affinity + ImageLocality), shared by the greedy/auction hoists and
     evaluate_single so the families can't drift apart.  `feasible` is
     the normalization set; `pp_state` the prep_pref_pod output (required
-    when features.interpod_pref)."""
+    when features.interpod_pref).  axis_name: mesh axis when the node
+    axis is sharded — normalization extrema and image spread ratios span
+    shards."""
     from .interpod import pref_pod_raw
 
     total = jnp.zeros(cluster.allocatable.shape[0], jnp.float32)
     if features.interpod_pref:
         raw = pref_pod_raw(pp_state, prefpod, rep)
-        total = total + cfg.interpod_weight * normalize_minmax(raw, feasible)
+        total = total + cfg.interpod_weight * normalize_minmax(
+            raw, feasible, axis_name=axis_name
+        )
     if features.images:
         total = total + cfg.image_weight * image_locality_score(
-            cluster, images, rep
+            cluster, images, rep, axis_name=axis_name
         )
     return total
 

@@ -32,7 +32,14 @@ from ..ops.assign import (
     features_of,
     needs_topo,
     required_topo_z,
+    required_topo_z_split,
     solve_order,
+)
+from ..ops.auction import (
+    AuctionResult,
+    auction_assign,
+    auction_features_ok,
+    default_tie_k,
 )
 from ..ops.filters import (
     fits_resources,
@@ -40,9 +47,27 @@ from ..ops.filters import (
     preferred_match,
     selector_match,
 )
-from ..ops.interpod import interpod_filter, interpod_update, prep_terms
-from ..ops.schema import ClusterTensors, Snapshot, SpreadTable, TermTable
-from ..ops.scores import DEFAULT_SCORE_CONFIG, ScoreConfig, score_from_raw
+from ..ops.interpod import (
+    interpod_filter,
+    interpod_update,
+    prep_pref_pod,
+    prep_terms,
+)
+from ..ops.schema import (
+    ClusterTensors,
+    ImageTable,
+    PrefPodTable,
+    Snapshot,
+    SpreadTable,
+    TermTable,
+    num_groups,
+)
+from ..ops.scores import (
+    DEFAULT_SCORE_CONFIG,
+    ScoreConfig,
+    score_from_raw,
+    static_extra,
+)
 from ..ops.topology import prep_spread, spread_filter, spread_score, spread_update
 
 AXIS = "nodes"
@@ -67,6 +92,30 @@ def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     if devices is None:
         devices = jax.devices()[: n_devices or len(jax.devices())]
     return Mesh(devices, (AXIS,))
+
+
+def _spread_specs(rep):
+    return SpreadTable(
+        valid=rep, slot=rep, max_skew=rep, min_domains=rep, hard=rep,
+        owner_sel_idx=rep, owner_keys=rep, node_matches=P(None, AXIS),
+        pod_matches=rep, pod_idx=rep,
+    )
+
+
+def _term_specs(rep):
+    return TermTable(
+        valid=rep, slot=rep, node_matches=P(None, AXIS),
+        node_owners=P(None, AXIS), matches_incoming=rep, aff_idx=rep,
+        anti_idx=rep, self_match_all=rep,
+    )
+
+
+def _prefpod_specs(rep):
+    return PrefPodTable(
+        valid=rep, slot=rep, node_counts=P(None, AXIS),
+        owner_weight=P(None, AXIS), matches_incoming=rep, pod_idx=rep,
+        pod_weight=rep,
+    )
 
 
 def _broadcast_column(matrix: jnp.ndarray, local_idx: jnp.ndarray, own: jnp.ndarray):
@@ -96,16 +145,9 @@ def sharded_greedy_assign(
     """
     if features is None:
         features = features_of(snapshot)
-    if getattr(features, "interpod_pref", False) or getattr(features, "images", False):
-        raise ValueError(
-            "sharded_greedy_assign does not score preferred inter-pod "
-            "affinity or image locality yet; route such batches through "
-            "the single-device solvers (the extra-score hoist needs "
-            "psum'd domain sums / spread ratios)"
-        )
     if topo_z is None:
         topo_z = required_topo_z(snapshot)
-    (cluster, pods, sel, pref, spread, terms, _prefpod, _images) = jax.tree.map(
+    (cluster, pods, sel, pref, spread, terms, prefpod, images) = jax.tree.map(
         jnp.asarray, tuple(snapshot)
     )
     n = cluster.allocatable.shape[0]
@@ -115,22 +157,15 @@ def sharded_greedy_assign(
     p = pods.req.shape[0]
 
     rep = P()
-    spread_specs = SpreadTable(
-        valid=rep, slot=rep, max_skew=rep, min_domains=rep, hard=rep,
-        owner_sel_idx=rep, owner_keys=rep, node_matches=P(None, AXIS),
-        pod_matches=rep, pod_idx=rep,
-    )
-    term_specs = TermTable(
-        valid=rep, slot=rep, node_matches=P(None, AXIS), node_owners=P(None, AXIS),
-        matches_incoming=rep, aff_idx=rep, anti_idx=rep, self_match_all=rep,
-    )
     in_specs = (
         CLUSTER_SPECS,
         jax.tree.map(lambda _: rep, pods),
         jax.tree.map(lambda _: rep, sel),
         jax.tree.map(lambda _: rep, pref),
-        spread_specs,
-        term_specs,
+        _spread_specs(rep),
+        _term_specs(rep),
+        _prefpod_specs(rep),
+        jax.tree.map(lambda _: rep, images),
     )
     out_specs = SolveResult(
         assignment=rep, scores=rep, feasible_counts=rep, cluster=CLUSTER_SPECS
@@ -143,7 +178,9 @@ def sharded_greedy_assign(
         out_specs=out_specs,
         check_vma=False,
     )
-    def run(cl: ClusterTensors, pods, sel, pref, spread, terms) -> SolveResult:
+    def run(
+        cl: ClusterTensors, pods, sel, pref, spread, terms, prefpod, images
+    ) -> SolveResult:
         n_local = cl.allocatable.shape[0]
         offset = jax.lax.axis_index(AXIS) * n_local
         sel_mask = selector_match(cl, sel)
@@ -167,6 +204,27 @@ def sharded_greedy_assign(
                 cl, terms, topo_z, axis_name=AXIS, slots=features.term_slots,
                 has_bound=features.bound_terms,
             )
+        extra_c = None
+        if features.interpod_pref or features.images:
+            # hoisted per-class extras over the LOCAL node shard; the
+            # preps/normalizers span shards via psum/pmax (same hoist as
+            # ops.assign's — shared scores.static_extra keeps them from
+            # drifting)
+            pp = (
+                prep_pref_pod(
+                    cl, prefpod, topo_z, axis_name=AXIS,
+                    has_bound=features.bound_pref,
+                )
+                if features.interpod_pref
+                else None
+            )
+            reps_e = jnp.clip(pods.class_rep, 0, p - 1)
+            extra_c = jax.vmap(
+                lambda c, rep: static_extra(
+                    cl, prefpod, images, features, cfg, rep, sfeas_c[c],
+                    pp, axis_name=AXIS,
+                )
+            )(jnp.arange(c_dim, dtype=jnp.int32), reps_e)
 
         def step(carry, k):
             requested, nonzero, new_ports, sp_counts, tm_present, tm_blocked, tm_global = carry
@@ -195,6 +253,7 @@ def sharded_greedy_assign(
             scores = score_from_raw(
                 cur, pod, feas, aff_c[cls], taint_c[cls], cfg,
                 axis_name=AXIS, spread_score=sp_score,
+                extra=extra_c[cls] if extra_c is not None else None,
             )
             masked = jnp.where(feas, scores, NEG_INF)
 
@@ -258,7 +317,116 @@ def sharded_greedy_assign(
         )
         return SolveResult(assignment, win, nf, final)
 
-    return run(cluster, pods, sel, pref, spread, terms)
+    return run(cluster, pods, sel, pref, spread, terms, prefpod, images)
+
+
+def sharded_auction_assign(
+    snapshot: Snapshot,
+    mesh: Mesh,
+    cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,
+    n_groups: int = 0,
+    tie_seed: int = 0,
+    max_rounds: int = 64,
+    features: Optional[FeatureFlags] = None,
+    topo_z=None,
+    tie_k: Optional[int] = None,
+) -> AuctionResult:
+    """auction_assign with the node axis sharded over `mesh` — the
+    multi-chip joint solve (the north-star gang-burst config at scales
+    one chip's HBM can't hold).
+
+    One implementation, two layouts: this wrapper only sets up
+    shard_map specs and calls ops.auction.auction_assign(axis_name=...)
+    — pod-space state is replicated, node-space state sharded, and the
+    boundary crossings are ownership-masked psums, a pmax/pmin election,
+    and an all_gather tie-set merge (see auction_assign's docstring).
+    Placements are bit-identical to the single-chip auction.
+    """
+    if features is None:
+        features = features_of(snapshot)
+    if not auction_features_ok(features):
+        raise ValueError(
+            "auction does not cover in-batch host ports or "
+            "affinity-direction inter-pod terms; route through "
+            "sharded_greedy_assign"
+        )
+    if topo_z is None:
+        topo_z = required_topo_z_split(snapshot)
+    if tie_k is None:
+        tie_k = default_tie_k(snapshot)
+    (cluster, pods, sel, pref, spread, terms, prefpod, images) = jax.tree.map(
+        jnp.asarray, tuple(snapshot)
+    )
+    n = cluster.allocatable.shape[0]
+    n_dev = mesh.devices.size
+    if n % n_dev:
+        raise ValueError(f"padded node count {n} not divisible by mesh size {n_dev}")
+    # tie_k bounds the GLOBAL tie list; each shard's local top_k clamps
+    # to its shard size inside auction_assign and the all_gather merge
+    # restores the global length
+    tie_k = min(tie_k, n)
+
+    rep = P()
+    in_specs = (
+        CLUSTER_SPECS,
+        jax.tree.map(lambda _: rep, pods),
+        jax.tree.map(lambda _: rep, sel),
+        jax.tree.map(lambda _: rep, pref),
+        _spread_specs(rep),
+        _term_specs(rep),
+        _prefpod_specs(rep),
+        jax.tree.map(lambda _: rep, images),
+    )
+    out_specs = AuctionResult(
+        assignment=rep, scores=rep, rounds=rep, gang_dropped=rep,
+        cluster=CLUSTER_SPECS, reasons=rep,
+        debug_sp_counts=P(None, AXIS) if features.spread else None,
+    )
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    def run(cl, pods, sel, pref, spread, terms, prefpod, images):
+        local = Snapshot(cl, pods, sel, pref, spread, terms, prefpod, images)
+        return auction_assign(
+            local, cfg, n_groups=n_groups, tie_seed=tie_seed,
+            max_rounds=max_rounds, features=features, topo_z=topo_z,
+            tie_k=tie_k, axis_name=AXIS,
+        )
+
+    return run(cluster, pods, sel, pref, spread, terms, prefpod, images)
+
+
+def sharded_auction_jit(mesh: Mesh, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
+    @partial(jax.jit, static_argnums=(1, 2, 3, 4))
+    def run(snapshot, n_groups, features, topo_z, tie_k):
+        return sharded_auction_assign(
+            snapshot, mesh, cfg, n_groups=n_groups, features=features,
+            topo_z=topo_z, tie_k=tie_k,
+        )
+
+    def call(
+        snapshot: Snapshot,
+        n_groups: Optional[int] = None,
+        features: Optional[FeatureFlags] = None,
+        topo_z=None,
+        tie_k: Optional[int] = None,
+    ) -> AuctionResult:
+        if features is None:
+            features = features_of(snapshot)
+        if n_groups is None:
+            n_groups = num_groups(snapshot)
+        if topo_z is None:
+            topo_z = required_topo_z_split(snapshot)
+        if tie_k is None:
+            tie_k = default_tie_k(snapshot)
+        return run(snapshot, n_groups, features, topo_z, tie_k)
+
+    return call
 
 
 def sharded_greedy_jit(mesh: Mesh, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):

@@ -118,3 +118,137 @@ def test_sharded_with_spread_and_interpod():
     got = [meta.node_name(int(i)) for i in np.asarray(single.assignment)[:24]]
     want = Oracle(nodes).schedule(pods)
     assert got == want
+
+
+def test_sharded_greedy_scores_prefpod_and_images():
+    """Round-4: the extra-score families (preferred inter-pod affinity,
+    ImageLocality) are now psum-hoisted — the sharded greedy must match
+    the single-chip solve instead of raising."""
+    nodes = []
+    for i in range(16):
+        nw = (
+            make_node(f"n{i}").capacity(cpu_milli=8000, mem=16 * GI, pods=20)
+            .zone(f"z{i % 3}")
+        )
+        if i % 2 == 0:
+            nw.image(f"img-{i % 4}", 500 * MI)
+        nodes.append(nw.obj())
+    def _pref(pw, selector):
+        aff = pw.pod.spec.affinity or api.Affinity()
+        pw.pod.spec.affinity = aff
+        if aff.pod_affinity is None:
+            aff.pod_affinity = api.PodAffinity()
+        aff.pod_affinity.preferred.append(
+            api.WeightedPodAffinityTerm(
+                weight=40,
+                term=api.PodAffinityTerm(
+                    label_selector=api.LabelSelector(match_labels=selector),
+                    topology_key=api.LABEL_ZONE,
+                ),
+            )
+        )
+
+    pods = []
+    for i in range(20):
+        pw = make_pod(f"p{i}").labels(app=f"a{i % 2}").req(cpu_milli=400)
+        if i % 2 == 0:
+            _pref(pw, {"app": f"a{i % 2}"})
+        if i % 3 == 0:
+            pw.image(f"img-{i % 4}")
+        pods.append(pw.obj())
+    snap, meta = schema.SnapshotBuilder().build(nodes, pods)
+    feats = assign.features_of(snap)
+    assert feats.interpod_pref or feats.images
+    single = assign.greedy_assign(snap, topo_z=meta.topo_z)
+    mesh = sharded.make_mesh(8)
+    multi = sharded.sharded_greedy_assign(snap, mesh, topo_z=meta.topo_z)
+    np.testing.assert_array_equal(
+        np.asarray(single.assignment), np.asarray(multi.assignment)
+    )
+
+
+def _auction_parity(nodes, pods, tie_k=64, n_dev=8):
+    from kubernetes_tpu.ops import auction as auc
+
+    snap, meta = schema.SnapshotBuilder().build(nodes, pods)
+    feats = assign.features_of(snap)
+    tsplit = assign.required_topo_z_split(snap)
+    ng = schema.num_groups(snap)
+    single = auc.auction_assign(
+        snap, n_groups=ng, features=feats, topo_z=tsplit, tie_k=tie_k
+    )
+    mesh = sharded.make_mesh(n_dev)
+    multi = sharded.sharded_auction_assign(
+        snap, mesh, n_groups=ng, features=feats, topo_z=tsplit, tie_k=tie_k
+    )
+    np.testing.assert_array_equal(
+        np.asarray(single.assignment), np.asarray(multi.assignment)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(single.reasons), np.asarray(multi.reasons)
+    )
+    np.testing.assert_allclose(
+        np.asarray(single.cluster.requested),
+        np.asarray(multi.cluster.requested),
+        rtol=0, atol=0,
+    )
+    return single, multi, meta
+
+
+def test_sharded_auction_basic_parity():
+    """Sharded auction == single-chip auction, resources-only + gangs."""
+    rng = np.random.default_rng(11)
+    nodes = [
+        make_node(f"n{i}")
+        .capacity(cpu_milli=int(rng.choice([8000, 16000])), mem=32 * GI, pods=64)
+        .zone(f"z{i % 3}").obj()
+        for i in range(32)
+    ]
+    pods = [
+        make_pod(f"p{i}")
+        .req(cpu_milli=int(rng.choice([500, 1000])), mem=512 * MI)
+        .group(f"g{i % 4}", size=8)
+        .obj()
+        for i in range(32)
+    ]
+    single, multi, _ = _auction_parity(nodes, pods)
+    assert (np.asarray(single.assignment) >= 0).sum() == 32
+
+
+def test_sharded_auction_spread_interpod_parity():
+    """Sharded auction must repair spread + anti-affinity identically."""
+    nodes = [
+        make_node(f"n{i}").capacity(cpu_milli=8000, mem=16 * GI, pods=20)
+        .zone(f"z{i % 4}").obj()
+        for i in range(32)
+    ]
+    pods = []
+    for i in range(40):
+        pw = make_pod(f"p{i}").labels(app=f"s{i % 5}").req(cpu_milli=300)
+        if i % 2 == 0:
+            pw.spread(1, api.LABEL_ZONE, "DoNotSchedule", {"app": f"s{i % 5}"})
+        else:
+            pw.pod_anti_affinity({"app": f"s{i % 5}"}, api.LABEL_HOSTNAME)
+        pods.append(pw.obj())
+    single, multi, meta = _auction_parity(nodes, pods)
+    placed = (np.asarray(single.assignment)[:40] >= 0).sum()
+    assert placed > 0
+
+
+def test_sharded_auction_gang_release_parity():
+    """An unplaceable gang releases identically on both layouts."""
+    nodes = [
+        make_node(f"n{i}").capacity(cpu_milli=2000, mem=4 * GI, pods=4).obj()
+        for i in range(8)
+    ]
+    # gang of 12 pods each needing 1500m: at most 8 can place -> released
+    pods = [
+        make_pod(f"g{i}").req(cpu_milli=1500, mem=GI).group("g", size=12).obj()
+        for i in range(12)
+    ]
+    single, multi, _ = _auction_parity(nodes, pods, n_dev=4)
+    assert (np.asarray(single.assignment)[:12] == -1).all()
+    assert np.asarray(single.gang_dropped).any()
+    np.testing.assert_array_equal(
+        np.asarray(single.gang_dropped), np.asarray(multi.gang_dropped)
+    )
