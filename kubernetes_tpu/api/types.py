@@ -190,6 +190,28 @@ class LabelSelector:
         return reqs
 
 
+def and_selectors(
+    a: Optional["NodeSelector"], b: Optional["NodeSelector"]
+) -> Optional["NodeSelector"]:
+    """AND of two OR-of-AND NodeSelectors: the term cross product (the
+    same distribution GetRequiredNodeAffinity applies to nodeSelector +
+    affinity)."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return NodeSelector(
+        terms=[
+            NodeSelectorTerm(
+                match_expressions=list(ta.match_expressions)
+                + list(tb.match_expressions)
+            )
+            for ta in a.terms
+            for tb in b.terms
+        ]
+    )
+
+
 @dataclass
 class PodAffinityTerm:
     """reference: v1.PodAffinityTerm."""
@@ -342,6 +364,7 @@ class PodSpec:
     scheduling_gates: List[str] = field(default_factory=list)
     restart_policy: str = "Always"
     termination_grace_period_seconds: int = 30
+    volumes: List["Volume"] = field(default_factory=list)
 
 
 @dataclass
@@ -466,6 +489,168 @@ class Node:
         return taints
 
 
+@dataclass
+class NamespaceStatus:
+    phase: str = "Active"  # Active | Terminating
+
+
+@dataclass
+class Namespace:
+    """core/v1 Namespace: the unit of multi-tenancy; deleting one reaps
+    its objects (namespace lifecycle controller)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    status: NamespaceStatus = field(default_factory=NamespaceStatus)
+
+    KIND = "Namespace"
+
+
+# ---------------------------------------------------------------------------
+# Policy APIs (reference: staging/src/k8s.io/api/policy/v1/types.go
+# PodDisruptionBudget) — consumed by preemption's victim ranking and
+# maintained by the disruption controller.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PodDisruptionBudgetSpec:
+    selector: Optional[LabelSelector] = None
+    min_available: Optional[int] = None     # at least this many healthy
+    max_unavailable: Optional[int] = None   # at most this many disrupted
+
+
+@dataclass
+class PodDisruptionBudgetStatus:
+    disruptions_allowed: int = 0
+    current_healthy: int = 0
+    desired_healthy: int = 0
+    expected_pods: int = 0
+
+
+@dataclass
+class PodDisruptionBudget:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodDisruptionBudgetSpec = field(
+        default_factory=PodDisruptionBudgetSpec
+    )
+    status: PodDisruptionBudgetStatus = field(
+        default_factory=PodDisruptionBudgetStatus
+    )
+
+    KIND = "PodDisruptionBudget"
+
+    def matches(self, pod: "Pod") -> bool:
+        if pod.meta.namespace != self.meta.namespace:
+            return False
+        sel = self.spec.selector
+        return sel is not None and sel.matches(pod.meta.labels)
+
+
+# ---------------------------------------------------------------------------
+# Storage APIs (reference: staging/src/k8s.io/api/core/v1/types.go
+# PersistentVolume/PersistentVolumeClaim, storage/v1/types.go
+# StorageClass) — the slice VolumeBinding schedules against.
+# ---------------------------------------------------------------------------
+
+STORAGE = "storage"                       # PVC resource request key
+VOLUME_BINDING_IMMEDIATE = "Immediate"
+VOLUME_BINDING_WAIT = "WaitForFirstConsumer"
+PV_AVAILABLE = "Available"
+PV_BOUND = "Bound"
+PV_RELEASED = "Released"
+PVC_PENDING = "Pending"
+PVC_BOUND = "Bound"
+# node-allocatable key prefix for attach limits (the reference models
+# CSI attach limits as node-published countable resources —
+# nodevolumelimits/csi.go GetVolumeLimitKey)
+ATTACH_LIMIT_PREFIX = "attachable-volumes-"
+
+
+def attach_limit_resource(driver: str) -> str:
+    return ATTACH_LIMIT_PREFIX + driver
+
+
+@dataclass
+class Volume:
+    """Pod volume: only the PVC source is modelled (the scheduling-
+    relevant one; core/v1/types.go Volume has ~30 sources)."""
+
+    name: str = ""
+    persistent_volume_claim: Optional[str] = None  # claim name in pod ns
+
+
+@dataclass
+class PersistentVolumeSpec:
+    capacity: Dict[str, int] = field(default_factory=dict)  # {storage: bytes}
+    access_modes: List[str] = field(default_factory=list)
+    storage_class_name: str = ""
+    # topology constraint: node must satisfy this to mount the volume
+    # (core/v1 VolumeNodeAffinity.required)
+    node_affinity: Optional[NodeSelector] = None
+    claim_ref: Optional[str] = None       # "namespace/name" of bound claim
+    driver: str = ""                      # CSI driver (attach-limit bucket)
+
+
+@dataclass
+class PersistentVolumeStatus:
+    phase: str = PV_AVAILABLE
+
+
+@dataclass
+class PersistentVolume:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PersistentVolumeSpec = field(default_factory=PersistentVolumeSpec)
+    status: PersistentVolumeStatus = field(
+        default_factory=PersistentVolumeStatus
+    )
+
+    KIND = "PersistentVolume"
+
+    def storage(self) -> int:
+        return int(self.spec.capacity.get(STORAGE, 0))
+
+
+@dataclass
+class PersistentVolumeClaimSpec:
+    access_modes: List[str] = field(default_factory=list)
+    storage_class_name: str = ""
+    resources: Dict[str, int] = field(default_factory=dict)  # {storage: bytes}
+    volume_name: str = ""                 # set when bound to a PV
+
+
+@dataclass
+class PersistentVolumeClaimStatus:
+    phase: str = PVC_PENDING
+
+
+@dataclass
+class PersistentVolumeClaim:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PersistentVolumeClaimSpec = field(
+        default_factory=PersistentVolumeClaimSpec
+    )
+    status: PersistentVolumeClaimStatus = field(
+        default_factory=PersistentVolumeClaimStatus
+    )
+
+    KIND = "PersistentVolumeClaim"
+
+    def requested_storage(self) -> int:
+        return int(self.spec.resources.get(STORAGE, 0))
+
+
+@dataclass
+class StorageClass:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    provisioner: str = ""
+    volume_binding_mode: str = VOLUME_BINDING_IMMEDIATE
+    # restrict dynamic provisioning to these topologies (storage/v1
+    # StorageClass.allowedTopologies, as OR-of-AND selector terms)
+    allowed_topologies: Optional[NodeSelector] = None
+
+    KIND = "StorageClass"
+
+
 # ---------------------------------------------------------------------------
 # Workload APIs (reference: staging/src/k8s.io/api/apps/v1/types.go
 # ReplicaSet/Deployment, batch/v1/types.go Job) — the slice the workload
@@ -506,11 +691,14 @@ class ReplicaSet:
 
 @dataclass
 class DeploymentStrategy:
-    # "RollingUpdate" replaces the old ReplicaSet through a new one;
-    # "Recreate" scales old to zero first.  Surge/unavailable stepping is
-    # simplified to whole-RS transitions (documented divergence from
-    # pkg/controller/deployment/rolling.go).
+    # "RollingUpdate" steps the new ReplicaSet up and old ones down under
+    # the surge/unavailable bounds (pkg/controller/deployment/rolling.go);
+    # "Recreate" drains old revisions fully before scaling the new one.
     type: str = "RollingUpdate"
+    # absolute counts (the reference also accepts percentages; validation
+    # rejects 0/0 — ours falls back to max_unavailable=1 in that case)
+    max_surge: int = 1
+    max_unavailable: int = 0
 
 
 @dataclass

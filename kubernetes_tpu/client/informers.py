@@ -194,6 +194,10 @@ class InformerFactory:
                 inf.stop()
 
     def wait_for_sync(self, timeout: Optional[float] = 10) -> bool:
+        """WaitForCacheSync over STARTED informers — a registered but
+        never-started informer cannot sync (tests start subsets; the
+        reference's WaitForCacheSync likewise takes the informers the
+        caller chose to run)."""
         with self._lock:
-            infs = list(self._informers.values())
+            infs = [i for i in self._informers.values() if i._thread]
         return all(inf.wait_for_sync(timeout) for inf in infs)

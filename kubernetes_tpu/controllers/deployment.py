@@ -1,12 +1,13 @@
 """Deployment controller: manage ReplicaSets per template revision.
 
 Reference: pkg/controller/deployment/deployment_controller.go +
-sync.go/rolling.go.  Revision identity is a stable hash of the pod
-template (the pod-template-hash label pattern); rollout is simplified to
-whole-RS transitions — the new revision's RS scales to spec.replicas and
-every old RS scales to 0 in one reconcile (maxSurge/maxUnavailable
-stepping is a documented divergence; capacity-safe stepping matters on
-real kubelets, not against the in-memory store).
+sync.go/rolling.go/recreate.go.  Revision identity is a stable hash of
+the pod template (the pod-template-hash label pattern).  RollingUpdate
+steps the new revision up and old ones down under maxSurge /
+maxUnavailable (absolute counts; the availability floor is
+desired - maxUnavailable, the capacity ceiling desired + maxSurge),
+advancing as RS status events report pods ready; Recreate drains old
+revisions fully before scaling the new one.
 """
 
 from __future__ import annotations
@@ -69,17 +70,35 @@ class DeploymentController(Controller):
         try:
             dep = self.store.get("Deployment", name, namespace)
         except st.NotFound:
-            for rs in self._owned_rs(namespace, name):
-                try:
-                    self.store.delete("ReplicaSet", rs.meta.name, namespace)
-                except st.NotFound:
-                    pass
+            # Deployment deleted: the garbage collector cascades to owned
+            # ReplicaSets via ownerReferences — deleting here too would
+            # bypass the orphan annotation
             return
         rev = template_hash(dep.spec.template)
         rs_name = f"{name}-{rev}"
         owned = self._owned_rs(namespace, name)
         current = next((r for r in owned if r.meta.name == rs_name), None)
+        old_active = [
+            r for r in owned
+            if r.meta.name != rs_name and r.spec.replicas > 0
+        ]
+        strategy = dep.spec.strategy
+        surge, unavail = self._bounds(strategy)
         if current is None:
+            # Initial replica count honours the rollout bounds: a fresh
+            # deployment (no old revisions) starts at full scale; a
+            # template change starts the new RS within maxSurge
+            # (rolling.go NewRSNewReplicas) or at 0 for Recreate.
+            if not old_active:
+                initial = dep.spec.replicas
+            elif strategy.type == "Recreate":
+                initial = 0
+            else:
+                total = sum(r.spec.replicas for r in old_active)
+                initial = max(
+                    0, min(dep.spec.replicas,
+                           dep.spec.replicas + surge - total)
+                )
             template = api.clone(dep.spec.template)
             template.meta.labels.setdefault("pod-template-hash", rev)
             rs = api.ReplicaSet(
@@ -97,7 +116,7 @@ class DeploymentController(Controller):
                     ],
                 ),
                 spec=api.ReplicaSetSpec(
-                    replicas=dep.spec.replicas,
+                    replicas=initial,
                     selector=api.LabelSelector(
                         match_labels=dict(template.meta.labels)
                     ),
@@ -109,16 +128,83 @@ class DeploymentController(Controller):
             except st.AlreadyExists:
                 self.queue.add(key)
                 return
-        elif current.spec.replicas != dep.spec.replicas:
-            fresh = self.store.get("ReplicaSet", rs_name, namespace)
-            fresh.spec.replicas = dep.spec.replicas
-            self.store.update(fresh)
-        # scale old revisions down
-        for rs in owned:
-            if rs.meta.name != rs_name and rs.spec.replicas != 0:
+        elif not old_active:
+            # steady state / plain scaling: no rollout in progress
+            if current.spec.replicas != dep.spec.replicas:
+                fresh = self.store.get("ReplicaSet", rs_name, namespace)
+                fresh.spec.replicas = dep.spec.replicas
+                self.store.update(fresh)
+        elif strategy.type == "Recreate":
+            # drain old revisions fully, then bring the new one up
+            # (pkg/controller/deployment/recreate.go)
+            for rs in old_active:
                 fresh = self.store.get("ReplicaSet", rs.meta.name, namespace)
                 fresh.spec.replicas = 0
                 self.store.update(fresh)
+            drained = all(
+                r.status.replicas == 0
+                for r in owned
+                if r.meta.name != rs_name
+            )
+            if drained and current.spec.replicas != dep.spec.replicas:
+                fresh = self.store.get("ReplicaSet", rs_name, namespace)
+                fresh.spec.replicas = dep.spec.replicas
+                self.store.update(fresh)
+        else:
+            self._rolling_step(
+                dep, namespace, current, old_active, surge, unavail
+            )
+        self._write_status(dep, namespace, name, rs_name)
+
+    @staticmethod
+    def _bounds(strategy: api.DeploymentStrategy):
+        surge = max(0, int(strategy.max_surge))
+        unavail = max(0, int(strategy.max_unavailable))
+        if surge == 0 and unavail == 0:
+            unavail = 1  # validation rejects 0/0; make progress possible
+        return surge, unavail
+
+    def _rolling_step(
+        self, dep, namespace, current, old_active, surge, unavail
+    ) -> None:
+        """One bounded rollout step (rolling.go reconcileNewReplicaSet /
+        reconcileOldReplicaSets): scale the new RS up to
+        desired+maxSurge minus what exists, scale old RSes down by the
+        ready headroom above desired-maxUnavailable.  RS status events
+        re-enqueue the deployment, so the rollout advances as pods come
+        up — availability never drops below desired - maxUnavailable and
+        total never exceeds desired + maxSurge."""
+        desired = dep.spec.replicas
+        all_rs = [current] + old_active
+        total = sum(r.spec.replicas for r in all_rs)
+        # scale up new within surge budget
+        if current.spec.replicas < desired:
+            allowed = desired + surge - total
+            if allowed > 0:
+                fresh = self.store.get(
+                    "ReplicaSet", current.meta.name, namespace
+                )
+                fresh.spec.replicas = min(
+                    desired, current.spec.replicas + allowed
+                )
+                self.store.update(fresh)
+                return  # re-enqueued by the RS event; one step at a time
+        # scale down old within the availability budget
+        ready_total = sum(r.status.ready_replicas for r in all_rs)
+        min_available = desired - unavail
+        can_remove = ready_total - min_available
+        for rs in sorted(old_active, key=lambda r: r.meta.name):
+            if can_remove <= 0:
+                break
+            step = min(rs.spec.replicas, can_remove)
+            if step <= 0:
+                continue
+            fresh = self.store.get("ReplicaSet", rs.meta.name, namespace)
+            fresh.spec.replicas = max(0, fresh.spec.replicas - step)
+            self.store.update(fresh)
+            can_remove -= step
+
+    def _write_status(self, dep, namespace, name, rs_name) -> None:
         # status from owned RS; write ONLY on change — an unconditional
         # update MODIFIED-events this key back into a permanent loop
         owned = self._owned_rs(namespace, name)

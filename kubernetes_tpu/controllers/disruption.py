@@ -1,0 +1,75 @@
+"""Disruption controller: maintains PodDisruptionBudget status.
+
+Reference: pkg/controller/disruption/disruption.go — watches PDBs and
+pods, recomputes expectedPods / currentHealthy / desiredHealthy /
+disruptionsAllowed on every relevant event.  Preemption consults
+status.disruptions_allowed when ranking victims
+(framework/preemption/preemption.go:290 filterPodsWithPDBViolation).
+
+Healthy = Running phase (the reference checks the Ready condition; our
+node agent surface reports phase).  desiredHealthy:
+  minAvailable set   -> minAvailable
+  maxUnavailable set -> expectedPods - maxUnavailable
+"""
+
+from __future__ import annotations
+
+from ..api import store as st
+from ..api import types as api
+from .base import Controller, obj_key, split_key
+
+
+class DisruptionController(Controller):
+    KIND = "PodDisruptionBudget"
+
+    def register(self) -> None:
+        self.informers.informer("PodDisruptionBudget").add_handler(
+            self._on_pdb
+        )
+        self.informers.informer("Pod").add_handler(self._on_pod)
+
+    def _on_pdb(self, typ: str, pdb: api.PodDisruptionBudget, old) -> None:
+        if typ != st.DELETED:
+            self.enqueue(pdb)
+
+    def _on_pod(self, typ: str, pod: api.Pod, old) -> None:
+        # any pod event can change a matching budget's health counts
+        for pdb in self.informers.informer("PodDisruptionBudget").list():
+            if pdb.matches(pod) or (old is not None and pdb.matches(old)):
+                self.queue.add(obj_key(pdb))
+
+    def sync(self, key: str) -> None:
+        namespace, name = split_key(key)
+        try:
+            pdb = self.store.get("PodDisruptionBudget", name, namespace)
+        except KeyError:
+            return
+        pods = [
+            p
+            for p in self.informers.informer("Pod").list()
+            if pdb.matches(p)
+        ]
+        expected = len(pods)
+        healthy = sum(1 for p in pods if p.status.phase == "Running")
+        if pdb.spec.min_available is not None:
+            desired = min(pdb.spec.min_available, expected)
+        elif pdb.spec.max_unavailable is not None:
+            desired = max(expected - pdb.spec.max_unavailable, 0)
+        else:
+            desired = expected
+        allowed = max(healthy - desired, 0)
+        status = pdb.status
+        if (
+            status.expected_pods == expected
+            and status.current_healthy == healthy
+            and status.desired_healthy == desired
+            and status.disruptions_allowed == allowed
+        ):
+            return
+        pdb.status = api.PodDisruptionBudgetStatus(
+            disruptions_allowed=allowed,
+            current_healthy=healthy,
+            desired_healthy=desired,
+            expected_pods=expected,
+        )
+        self.store.update(pdb)

@@ -1,0 +1,122 @@
+"""Garbage collector: ownerReference cascade deletion.
+
+Reference: pkg/controller/garbagecollector — builds a cluster-wide
+dependency graph from ownerReferences and, when an owner disappears,
+deletes its dependents (background policy) unless they carry the orphan
+finalizer.  Ours keeps the graph implicit: owner-delete events enqueue a
+sweep of that owner's dependents, and a periodic full scan reaps
+orphans whose controller owner no longer exists (covering events missed
+across restarts — the reference gets the same property from its initial
+graph build).
+
+Orphan policy: deleting an owner with
+`meta.annotations["kubernetes.io/orphan"] = "true"` skips the cascade
+and strips the dependents' ownerReferences instead (the
+DeletePropagationOrphan analogue without finalizer machinery).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List
+
+from ..api import store as st
+from ..api import types as api
+from .base import Controller, obj_key, split_key
+
+# kinds that can OWN dependents (watching these for deletes drives the
+# cascade; the orphan scan covers everything else)
+OWNER_KINDS = ("Deployment", "ReplicaSet", "Job")
+# kinds swept for dependents
+DEPENDENT_KINDS = ("ReplicaSet", "Pod")
+
+ORPHAN_ANNOTATION = "kubernetes.io/orphan"
+
+
+class GarbageCollector(Controller):
+    KIND = "GarbageCollection"
+    ORPHAN_SCAN_INTERVAL = 5.0
+
+    def register(self) -> None:
+        for kind in OWNER_KINDS:
+            self.informers.informer(kind).add_handler(self._on_owner)
+        self._scan_stop = threading.Event()
+        self._scan_thread = threading.Thread(
+            target=self._orphan_scan_loop, name="gc-orphan-scan", daemon=True
+        )
+        self._scan_thread.start()
+
+    def stop(self) -> None:
+        if hasattr(self, "_scan_stop"):
+            self._scan_stop.set()
+        super().stop()
+
+    def _on_owner(self, typ: str, obj, old) -> None:
+        if typ == st.DELETED:
+            orphan = (
+                obj.meta.annotations.get(ORPHAN_ANNOTATION) == "true"
+                if hasattr(obj.meta, "annotations")
+                else False
+            )
+            mode = "orphan" if orphan else "delete"
+            self.queue.add(
+                f"{mode}|{obj.KIND}|{obj.meta.namespace}|{obj.meta.name}"
+            )
+
+    def sync(self, key: str) -> None:
+        mode, kind, namespace, name = key.split("|", 3)
+        for dep_kind in DEPENDENT_KINDS:
+            deps, _ = self.store.list(dep_kind, namespace=namespace)
+            for dep in deps:
+                refs = [
+                    r for r in dep.meta.owner_references
+                    if r.kind == kind and r.name == name
+                ]
+                if not refs:
+                    continue
+                if mode == "orphan":
+                    dep.meta.owner_references = [
+                        r for r in dep.meta.owner_references if r not in refs
+                    ]
+                    try:
+                        self.store.update(dep)
+                    except (st.NotFound, st.Conflict):
+                        pass
+                else:
+                    self._delete(dep)
+
+    def _delete(self, obj) -> None:
+        try:
+            self.store.delete(obj.KIND, obj.meta.name, obj.meta.namespace)
+        except KeyError:
+            pass  # already gone
+
+    # -- orphan scan (the graph-rebuild half) ------------------------------
+
+    def _orphan_scan_loop(self) -> None:
+        while not self._scan_stop.wait(self.ORPHAN_SCAN_INTERVAL):
+            try:
+                self.scan_orphans()
+            except Exception:
+                pass
+
+    def scan_orphans(self) -> int:
+        """Delete dependents whose CONTROLLER owner no longer exists
+        (deletes missed while down; the reference's initial graph sync).
+        Returns the number reaped."""
+        reaped = 0
+        for dep_kind in DEPENDENT_KINDS:
+            deps, _ = self.store.list(dep_kind)
+            for dep in deps:
+                ctrl = next(
+                    (r for r in dep.meta.owner_references if r.controller),
+                    None,
+                )
+                if ctrl is None:
+                    continue
+                try:
+                    self.store.get(ctrl.kind, ctrl.name, dep.meta.namespace)
+                except KeyError:
+                    self._delete(dep)
+                    reaped += 1
+        return reaped

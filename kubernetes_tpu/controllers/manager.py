@@ -14,13 +14,19 @@ from ..api import store as st
 from ..client.informers import InformerFactory
 from .base import Controller
 from .deployment import DeploymentController
+from .disruption import DisruptionController
+from .garbagecollector import GarbageCollector
 from .job import JobController
+from .namespace import NamespaceController
 from .replicaset import ReplicaSetController
 
 DEFAULT_CONTROLLERS: List[Type[Controller]] = [
     ReplicaSetController,
     DeploymentController,
     JobController,
+    DisruptionController,
+    GarbageCollector,
+    NamespaceController,
 ]
 
 
@@ -40,7 +46,10 @@ class ControllerManager:
 
     def start(self) -> "ControllerManager":
         # informers for every kind any controller watches
-        for kind in ("Pod", "ReplicaSet", "Deployment", "Job"):
+        for kind in (
+            "Pod", "ReplicaSet", "Deployment", "Job", "PodDisruptionBudget",
+            "Namespace",
+        ):
             self.informers.informer(kind).start()
         self.informers.wait_for_sync()
         for c in self.controllers.values():

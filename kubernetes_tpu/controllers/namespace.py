@@ -1,0 +1,63 @@
+"""Namespace lifecycle controller: finalize-and-sweep.
+
+Reference: pkg/controller/namespace — a namespace marked for deletion
+enters Terminating; the controller deletes every namespaced object in
+it via resource discovery, then removes the finalizer so the API server
+can drop the Namespace.  Ours mirrors both halves without finalizer
+machinery:
+
+  * a Namespace whose status.phase is "Terminating" is swept (every
+    kind the store holds, objects in that namespace deleted) and then
+    deleted itself;
+  * a Namespace DELETE event also sweeps — so `kubectl delete ns` (the
+    store-level delete) reaps contents even without the Terminating
+    hand-off.
+"""
+
+from __future__ import annotations
+
+from ..api import store as st
+from ..api import types as api
+from .base import Controller
+
+# kinds that are cluster-scoped: never swept by namespace deletion
+CLUSTER_SCOPED = {"Node", "PersistentVolume", "StorageClass", "Namespace"}
+
+
+class NamespaceController(Controller):
+    KIND = "Namespace"
+
+    def register(self) -> None:
+        self.informers.informer("Namespace").add_handler(self._on_namespace)
+
+    def _on_namespace(self, typ: str, ns: api.Namespace, old) -> None:
+        if typ == st.DELETED or ns.status.phase == "Terminating":
+            self.queue.add(ns.meta.name)
+
+    def sync(self, key: str) -> None:
+        name = key
+        self._sweep(name)
+        try:
+            ns = self.store.get("Namespace", name, namespace="")
+        except KeyError:
+            return  # already deleted; sweep above finished the job
+        if ns.status.phase == "Terminating":
+            try:
+                self.store.delete("Namespace", name, namespace="")
+            except KeyError:
+                pass
+
+    def _sweep(self, namespace: str) -> int:
+        """Delete every namespaced object in `namespace`; returns count."""
+        reaped = 0
+        for kind in self.store.kinds():
+            if kind in CLUSTER_SCOPED:
+                continue
+            objs, _ = self.store.list(kind, namespace=namespace)
+            for obj in objs:
+                try:
+                    self.store.delete(kind, obj.meta.name, namespace)
+                    reaped += 1
+                except KeyError:
+                    pass
+        return reaped

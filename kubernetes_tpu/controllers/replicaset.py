@@ -72,14 +72,10 @@ class ReplicaSetController(Controller):
         try:
             rs = self.store.get("ReplicaSet", name, namespace)
         except st.NotFound:
-            # RS deleted: cascade-delete owned pods (the GC controller's
-            # job in the reference; folded in here — no GC loop yet)
+            # RS deleted: the garbage collector cascades to owned pods
+            # via ownerReferences (controllers/garbagecollector.py) —
+            # deleting here too would bypass the orphan annotation
             self.expectations.forget(key)
-            for pod in self.pods_owned_by(namespace, "ReplicaSet", name):
-                try:
-                    self.store.delete("Pod", pod.meta.name, namespace)
-                except st.NotFound:
-                    pass
             return
         all_owned = self.pods_owned_by(namespace, "ReplicaSet", name)
         pods = [

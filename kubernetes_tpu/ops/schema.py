@@ -345,6 +345,29 @@ class SnapshotBuilder:
         }
         self.scalar_resources: List[str] = []
         self._scalar_index: Dict[str, int] = {}
+        # Optional per-pod requirement hook: (pod) -> (extra required
+        # NodeSelector | None, extra scalar requests).  The VolumeBinding
+        # integration point: volume topology becomes selector terms and
+        # attach limits become scalar resources, so the device kernels
+        # need no volume-specific code (scheduler/volumebinding.py).
+        self.pod_transform = None
+
+    def _transform(self, pod: api.Pod):
+        if self.pod_transform is None:
+            return None, None
+        return self.pod_transform(pod)
+
+    def effective_requests(self, pod: api.Pod) -> Dict[str, int]:
+        """resource_requests plus the transform's extra scalar requests
+        (e.g. attach-limit counts) — the request dict every encode and
+        usage-accounting path must agree on."""
+        req = pod.resource_requests()
+        _sel, extra = self._transform(pod)
+        if extra:
+            req = dict(req)
+            for k, v in extra.items():
+                req[k] = req.get(k, 0) + v
+        return req
 
     # -- resource axis ----------------------------------------------------
 
@@ -599,9 +622,9 @@ class SnapshotBuilder:
         # present in the cluster.
         self._intern_node_strings(nodes)
         for p in bound_pods:
-            self._resource_vector(p.resource_requests(), 0, grow=True)
+            self._resource_vector(self.effective_requests(p), 0, grow=True)
         for p in pending_pods:
-            self._resource_vector(p.resource_requests(), 0, grow=True)
+            self._resource_vector(self.effective_requests(p), 0, grow=True)
 
         r = len(self.resource_names)
         n = vb.pad_dim(max(len(nodes), num_nodes_hint), lim.min_nodes)
@@ -651,7 +674,7 @@ class SnapshotBuilder:
         if state.builder is not self:
             raise ValueError("state was built by a different SnapshotBuilder")
         for p in pending_pods:
-            self._resource_vector(p.resource_requests(), 0, grow=True)
+            self._resource_vector(self.effective_requests(p), 0, grow=True)
         state.ensure_resources()
         r = len(self.resource_names)
         cluster = state.tensors()
@@ -791,7 +814,7 @@ class SnapshotBuilder:
         (framework/types.go AddPodInfo).  Callers intern new scalar
         resources (and widen arrays) before calling; unknown resources
         here would be dropped, so grow=False keeps the axis stable."""
-        req = self._resource_vector(pod.resource_requests(), r, grow=False)
+        req = self._resource_vector(self.effective_requests(pod), r, grow=False)
         req[RESOURCE_PODS] = 1.0
         nz = req.copy()
         nz_cpu, nz_mem = pod.nonzero_requests()
@@ -836,12 +859,12 @@ class SnapshotBuilder:
         # are derived from.
         spec_cache: Dict[tuple, tuple] = {}
 
-        def spec_key(pod: api.Pod) -> tuple:
+        def spec_key(pod: api.Pod, extra_sel, extra_req) -> tuple:
             spec = pod.spec
             aff = spec.affinity
             na = aff.node_affinity if aff else None
             return (
-                tuple(sorted(pod.resource_requests().items())),
+                tuple(sorted(self.effective_requests(pod).items())),
                 tuple(pod.nonzero_requests()),
                 spec.node_name,
                 tuple(sorted(spec.node_selector.items())),
@@ -854,6 +877,9 @@ class SnapshotBuilder:
                     (pt.weight, _term_signature(pt.preference))
                     for pt in (na.preferred if na else ())
                 ),
+                # transform output (e.g. volume topology): pods with the
+                # same spec but different claims must not share a row
+                _selector_signature(extra_sel) if extra_sel else None,
             )
 
         for i, pod in enumerate(pods):
@@ -863,14 +889,17 @@ class SnapshotBuilder:
                 group_id[i] = group_index.setdefault(
                     pod.spec.scheduling_group, len(group_index)
                 )
-            key = spec_key(pod)
+            extra_sel, extra_req = self._transform(pod)
+            key = spec_key(pod, extra_sel, extra_req)
             cached = spec_cache.get(key)
             if cached is not None:
                 (req[i], nonzero[i], name_id[i], sel_idx[i],
                  tol_bits[:, i, :], tol_all[:, i], port_bits[i],
                  pref_idx[i], pref_weight[i]) = cached
                 continue
-            rv = self._resource_vector(pod.resource_requests(), r, grow=False)
+            rv = self._resource_vector(
+                self.effective_requests(pod), r, grow=False
+            )
             rv[RESOURCE_PODS] = 1.0
             req[i] = rv
             nz = rv.copy()
@@ -884,6 +913,8 @@ class SnapshotBuilder:
                 name_id[i] = nid if nid >= 0 else -2
 
             selector = pod.required_node_selector()
+            if extra_sel is not None:
+                selector = api.and_selectors(selector, extra_sel)
             if selector is not None:
                 sig = _selector_signature(selector)
                 idx = sel_index.get(sig)
@@ -1513,7 +1544,9 @@ class ClusterState:
         key = self._pod_key(pod)
         if key in self._pods:
             raise ValueError(f"pod {key} already accounted")
-        self.builder._resource_vector(pod.resource_requests(), 0, grow=True)
+        self.builder._resource_vector(
+            self.builder.effective_requests(pod), 0, grow=True
+        )
         self.ensure_resources()
         req, nz, ports = self.builder.pod_usage(pod, self._r)
         self.requested[i] += req

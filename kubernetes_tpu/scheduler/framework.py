@@ -48,6 +48,10 @@ class Framework:
         self.pre_bind: List[Callable[[api.Pod, str], None]] = []
         self.post_bind: List[Callable[[api.Pod, str], None]] = []
         self.filter_result: List[Callable[[api.Pod, str], Optional[str]]] = []
+        # Reserve's rollback half (interface.go Reserve/Unreserve): runs
+        # when a placement is abandoned after filter_result accepted it
+        # (assume failure, PreBind error, bind conflict)
+        self.unreserve: List[Callable[[api.Pod], None]] = []
 
     @property
     def scheduler_name(self) -> str:
@@ -92,6 +96,13 @@ class Framework:
                 return None
         return node
 
+    def run_unreserve(self, pod: api.Pod) -> None:
+        for fn in self.unreserve:
+            try:
+                fn(pod)
+            except Exception:
+                pass  # rollback must not mask the original failure
+
 
 class FrameworkRegistry:
     """profile.Map: scheduler_name -> Framework, all profiles sharing ONE
@@ -104,6 +115,12 @@ class FrameworkRegistry:
     ):
         config.validate()
         self.config = config
+        self.gate = config.gate()
+        # AuctionSolver gate pins the router to the greedy scan — the
+        # registry build-time consult, like the reference's gate-driven
+        # plugin registry (plugins/registry.go:58-70)
+        mode = "auto" if self.gate.enabled("AuctionSolver") else "greedy"
+        use_mirror = self.gate.enabled("DeviceClusterMirror")
         first: Optional[TPUBatchScheduler] = None
         self.frameworks: Dict[str, Framework] = {}
         for profile in config.profiles:
@@ -111,6 +128,8 @@ class FrameworkRegistry:
                 score_config=profile.effective_score_config(),
                 limits=config.limits if first is None else None,
                 state=first.state if first is not None else state,
+                mode=mode,
+                use_mirror=use_mirror,
             )
             if first is None:
                 first = tpu

@@ -20,7 +20,8 @@ spread/inter-pod families the resource dry-run can't see.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -65,16 +66,14 @@ class PreemptionEvaluator:
         self.metrics = metrics
         # optional client.events.EventRecorder (set by the Scheduler)
         self.events = None
+        # PDBAwarePreemption feature gate (set by the Scheduler): off
+        # means victim ranking ignores disruption budgets
+        self.pdb_aware = True
 
     # -- eligibility (PodEligibleToPreemptOthers) --------------------------
 
     def eligible(self, pod: api.Pod) -> bool:
         if pod.spec.preemption_policy == "Never":
-            return False
-        if pod.spec.scheduling_group:
-            # A gang member preempting alone can evict victims for a gang
-            # that still won't fit whole; gang-aware preemption (evict for
-            # the whole group or not at all) is not implemented.
             return False
         prio = pod.spec.priority
         state = self.tpu.state
@@ -97,12 +96,20 @@ class PreemptionEvaluator:
             return None
         if self.metrics:
             self.metrics.preemption_attempts.inc("attempted")
-        plan = self._plan(pod)
+        if pod.spec.scheduling_group:
+            plan = self._plan_gang(pod)
+        else:
+            single = self._plan(pod)
+            plan = ([(pod, single[0])], single[1]) if single else None
         if plan is None:
             if self.metrics:
                 self.metrics.preemption_attempts.inc("no_candidate")
             return None
-        node_name, victims = plan
+        nominations, victims = plan
+        node_name = next(
+            (n for p, n in nominations if pod_key(p) == pod_key(pod)),
+            nominations[0][1],
+        )
         # Evict: delete through the API *and* unaccount from the cache
         # immediately (remove_pod is idempotent, so the informer's echo of
         # the delete is a no-op).  Without the synchronous unaccount, the
@@ -120,10 +127,13 @@ class PreemptionEvaluator:
                     f"Preempted by {pod.meta.namespace}/{pod.meta.name} on "
                     f"node {node_name}",
                 )
-        self._nominate(pod, node_name)
-        # reserve the freed space for the nominee: other batches see the
-        # reservation; the nominee's own batch excludes it
-        self.cache.nominate(pod, node_name)
+        # reserve the freed space for the nominee(s): other batches see
+        # the reservation; each nominee's own batch excludes it.  Gangs
+        # nominate EVERY member to its verified node so the whole group's
+        # space is held until the gang lands (all-or-nothing).
+        for p, n in nominations:
+            self._nominate(p, n)
+            self.cache.nominate(p, n)
         if self.metrics:
             self.metrics.preemption_attempts.inc("nominated")
             self.metrics.preemption_victims.observe(len(victims))
@@ -160,12 +170,78 @@ class PreemptionEvaluator:
         re-solve against the state with the victims removed.
 
         Lock discipline mirrors schedule_batch's: host-side reads of the
-        shared state and snapshot encodes run under the cache lock; the
-        device dispatches (which can hit tens-of-seconds first-time XLA
-        compiles) run OUTSIDE it, so informer event handling never stalls
-        behind a compile."""
+        shared state and snapshot encodes run under the cache lock
+        (inside _candidates); the device dispatches (which can hit
+        tens-of-seconds first-time XLA compiles) run OUTSIDE it, so
+        informer event handling never stalls behind a compile."""
+        base = self._candidates(pod)
+        if base is None:
+            return None
+        cands, ranked, min_k = base
+        for ci in ranked[:MAX_VERIFY]:
+            row, name, victims, _flags = cands[ci]
+            chosen = victims[: int(min_k[ci])]
+            if self._verify(pod, name, chosen):
+                return name, chosen
+        self._note_budget_exhausted(pod, len(ranked))
+        return None
+
+    def _plan_gang(
+        self, pod: api.Pod
+    ) -> Optional[Tuple[List[Tuple[api.Pod, str]], List[api.Pod]]]:
+        """Gang preemption: victims must admit the WHOLE group, possibly
+        spanning nodes.  Greedy multi-node eviction: walk the ranked
+        single-node candidates accumulating their victim sets; after each
+        addition re-solve ALL pending members with the accumulated
+        victims removed (the solver's gang post-pass enforces
+        all-or-nothing), stopping at the first victim set under which the
+        gang fully places.  Evicting for one member alone could free
+        space a still-partial gang can never use — the failure mode that
+        previously made gang pods preemption-ineligible."""
+        group = pod.spec.scheduling_group
+        pods_all, _ = self.store.list("Pod")
+        members = [
+            p for p in pods_all
+            if p.spec.scheduling_group == group and not p.spec.node_name
+        ]
+        if not members:
+            return None
+        members.sort(key=pod_key)
+        base = self._candidates(pod)
+        if base is None:
+            return None
+        cands, ranked, min_k = base
+        victims_accum: List[api.Pod] = []
+        for ci in ranked[:MAX_VERIFY]:
+            row, name, victims, _flags = cands[ci]
+            victims_accum.extend(victims[: int(min_k[ci])])
+            placements = self._verify_multi(members, victims_accum)
+            if placements and all(n is not None for n in placements):
+                return list(zip(members, placements)), list(victims_accum)
+        self._note_budget_exhausted(pod, len(ranked))
+        return None
+
+    def _note_budget_exhausted(self, pod: api.Pod, n_ranked: int) -> None:
+        """Distinguish 'no candidate' from 'verification budget ran out'
+        — a silent cap here reads as full coverage (review finding r3)."""
+        if n_ranked <= MAX_VERIFY:
+            return
+        if self.metrics:
+            self.metrics.preemption_attempts.inc("verify_budget_exhausted")
+        logging.getLogger(__name__).info(
+            "preemption for %s: %d ranked candidates, verification budget "
+            "%d exhausted without a confirmed placement",
+            pod_key(pod), n_ranked, MAX_VERIFY,
+        )
+
+    def _candidates(self, pod: api.Pod):
+        """Collect + rank candidate (node, victims) sets: the tensorized
+        findCandidates/SelectCandidate half, shared by single-pod and
+        gang planning.  Returns (cands, ranked indices, min_k) with
+        cands entries (row, node_name, victims, pdb_violation_flags)."""
         state = self.tpu.state
         prio = pod.spec.priority
+        pdbs = self._pdbs()
         with self.cache.lock:
             # assumed pods are mid-bind — not evictable (the reference's
             # dry-run also works off the snapshot of *confirmed* state)
@@ -173,7 +249,7 @@ class PreemptionEvaluator:
             static_snap = self._encode_static(pod)
             # candidate victim data is copied out (free vectors, victim
             # usage) so ranking can run lock-free on a consistent view
-            cands: List[Tuple[int, str, List[api.Pod]]] = []
+            cands: List[Tuple[int, str, List[api.Pod], List[bool]]] = []
             free_rows: List[np.ndarray] = []
             usage: Dict[str, np.ndarray] = {}
             r = state._r
@@ -189,7 +265,18 @@ class PreemptionEvaluator:
                 if not victims:
                     continue
                 victims.sort(key=lambda p: (p.spec.priority, pod_key(p)))
-                cands.append((row, name, victims))
+                flags = self._pdb_flags(victims, pdbs)
+                # eviction preference: non-violating victims first
+                # (stably, keeping priority order within each partition)
+                # — the prefix-eviction analogue of the reference's
+                # reprieve pass, which tries hardest to KEEP
+                # PDB-violating victims (preemption.go:198)
+                paired = sorted(
+                    zip(victims, flags), key=lambda vf: vf[1]
+                )
+                victims = [v for v, _ in paired]
+                flags = [f for _, f in paired]
+                cands.append((row, name, victims, flags))
                 free_rows.append(
                     (state.allocatable[row] - state.requested[row]).copy()
                 )
@@ -202,23 +289,49 @@ class PreemptionEvaluator:
             pod_req = state.builder.pod_usage(pod, r)[0]
 
         static_ok = self._static_row_from_snap(static_snap)
-        keep = [i for i, (row, _, _) in enumerate(cands) if static_ok[row]]
+        keep = [i for i, c in enumerate(cands) if static_ok[c[0]]]
         cands = [cands[i] for i in keep]
         free_rows = [free_rows[i] for i in keep]
         if not cands:
             return None
-
         ranked, min_k = self._rank(cands, free_rows, usage, pod_req)
-        for ci in ranked[:MAX_VERIFY]:
-            row, name, victims = cands[ci]
-            chosen = victims[: int(min_k[ci])]
-            if self._verify(pod, name, chosen):
-                return name, chosen
-        return None
+        if not ranked:
+            return None
+        return cands, ranked, min_k
+
+    def _pdbs(self) -> List[api.PodDisruptionBudget]:
+        if not self.pdb_aware:
+            return []
+        try:
+            pdbs, _ = self.store.list("PodDisruptionBudget")
+        except Exception:
+            return []
+        return [p for p in pdbs if p.spec.selector is not None]
+
+    @staticmethod
+    def _pdb_flags(
+        victims: Sequence[api.Pod], pdbs: Sequence[api.PodDisruptionBudget]
+    ) -> List[bool]:
+        """Per-victim PDB-violation flags (filterPodsWithPDBViolation,
+        preemption.go:290): walking the victims in order, each budget's
+        first `disruptions_allowed` matching evictions are tolerated;
+        evictions past that violate it."""
+        if not pdbs:
+            return [False] * len(victims)
+        allow = [p.status.disruptions_allowed for p in pdbs]
+        flags = []
+        for v in victims:
+            matched = [i for i, p in enumerate(pdbs) if p.matches(v)]
+            viol = any(allow[i] <= 0 for i in matched)
+            if not viol:
+                for i in matched:
+                    allow[i] -= 1
+            flags.append(viol)
+        return flags
 
     def _rank(
         self,
-        cands: Sequence[Tuple[int, str, List[api.Pod]]],
+        cands: Sequence[Tuple[int, str, List[api.Pod], List[bool]]],
         free_rows: Sequence[np.ndarray],
         usage: Dict[str, np.ndarray],
         pod_req: np.ndarray,
@@ -229,11 +342,11 @@ class PreemptionEvaluator:
         counts."""
         r = pod_req.shape[0]
         c_dim = pad_dim(len(cands), 8)
-        k_dim = pad_dim(max(len(v) for _, _, v in cands), 4)
+        k_dim = pad_dim(max(len(c[2]) for c in cands), 4)
         free = np.zeros((c_dim, r), dtype=np.float32)
         victim_req = np.zeros((c_dim, k_dim, r), dtype=np.float32)
         victim_valid = np.zeros((c_dim, k_dim), dtype=bool)
-        for ci, (row, _, victims) in enumerate(cands):
+        for ci, (row, _, victims, _flags) in enumerate(cands):
             free[ci] = free_rows[ci]
             for vi, v in enumerate(victims[:k_dim]):
                 victim_req[ci, vi] = usage[pod_key(v)]
@@ -248,17 +361,23 @@ class PreemptionEvaluator:
         feasible = feasible & (min_k > 0)
         # ranking stats with exact integer math (priorities reach ~2e9,
         # past f32's exact envelope) and node-row tie-break — both must
-        # match testing/oracle.preempt for the parity contract
+        # match testing/oracle.preempt for the parity contract.  PDB
+        # violations rank first (fewest preferred —
+        # pickOneNodeForPreemption's minNumPDBViolatingScoreFunc,
+        # preemption.go:463).
         big = np.iinfo(np.int64).max
         max_prio = np.full(len(cands), big, dtype=np.int64)
         sum_prio = np.zeros(len(cands), dtype=np.int64)
-        rows = np.array([row for row, _, _ in cands], dtype=np.int64)
-        for ci, (_, _, victims) in enumerate(cands):
+        n_viol = np.full(len(cands), big, dtype=np.int64)
+        rows = np.array([c[0] for c in cands], dtype=np.int64)
+        for ci, (_, _, victims, flags) in enumerate(cands):
             if feasible[ci]:
-                prios = [v.spec.priority for v in victims[: int(min_k[ci])]]
+                k = int(min_k[ci])
+                prios = [v.spec.priority for v in victims[:k]]
                 max_prio[ci] = max(prios)
                 sum_prio[ci] = sum(prios)
-        order = np.lexsort((rows, min_k, sum_prio, max_prio))
+                n_viol[ci] = sum(flags[:k])
+        order = np.lexsort((rows, min_k, sum_prio, max_prio, n_viol))
         return [int(i) for i in order if feasible[i]], min_k
 
     def _verify(
@@ -269,17 +388,32 @@ class PreemptionEvaluator:
         OUTSIDE the lock.  True iff the pod lands on the expected node.
         This is the all-families check the resource-only kernel can't do
         (the reference re-runs the full filter chain in its dry-run)."""
+        placements = self._verify_multi([pod], victims, node_name)
+        return bool(placements) and placements[0] == node_name
+
+    def _verify_multi(
+        self,
+        pods: List[api.Pod],
+        victims: List[api.Pod],
+        fallback_node: Optional[str] = None,
+    ) -> Optional[List[Optional[str]]]:
+        """Solve `pods` against the state with `victims` removed (state
+        restored before returning); placements list, or None on encode
+        failure.  The gang path feeds all pending members so the solver's
+        all-or-nothing post-pass judges the whole group."""
         state = self.tpu.state
         with self.cache.lock:
-            for v in victims:
-                state.remove_pod(v)
+            removed = []
             try:
-                snap, meta = self.tpu.encode_pending([pod])
-            finally:
                 for v in victims:
-                    state.add_pod(v, v.spec.node_name or node_name)
-        placements = self.tpu.solve_encoded(snap, meta)
-        return bool(placements) and placements[0] == node_name
+                    if state.has_pod(v):
+                        state.remove_pod(v)
+                        removed.append(v)
+                snap, meta = self.tpu.encode_pending(pods)
+            finally:
+                for v in removed:
+                    state.add_pod(v, v.spec.node_name or fallback_node)
+        return self.tpu.solve_encoded(snap, meta)
 
     # -- static feasibility (non-resource filters) --------------------------
 

@@ -28,6 +28,7 @@ import heapq
 import itertools
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -74,6 +75,10 @@ class QueuedPodInfo:
     gated: bool = False
     # assign.REASON_* from the failing solve; -1 = unknown (always woken)
     unschedulable_reason: int = -1
+    # event clock at pop time (in-flight event tracking,
+    # scheduling_queue.go inFlightPods/inFlightEvents): events arriving
+    # while this pod is mid-cycle are replayed when it comes back
+    popped_event_seq: int = 0
 
 
 class SchedulingQueue:
@@ -105,6 +110,14 @@ class SchedulingQueue:
         self._group_keys: Dict[str, set] = {}
         self._group_size: Dict[str, int] = {}
         self._gang_staged: Dict[str, QueuedPodInfo] = {}
+        # In-flight event log (scheduling_queue.go inFlightEvents): each
+        # cluster event gets a sequence number; a pod parked after its
+        # cycle replays events that arrived since it was popped — without
+        # this, an event landing DURING the cycle that just failed the
+        # pod is lost and the pod parks forever (e.g. the PV that makes
+        # it schedulable appearing while the solve runs).
+        self._event_seq = 0
+        self._events_log: deque = deque(maxlen=512)  # (seq, wake-set|None)
         self._closed = False
 
     # -- helpers -----------------------------------------------------------
@@ -349,6 +362,7 @@ class SchedulingQueue:
                     # the tier check on their eventual pop
                     self._tier[key] = "inflight"
                     info.attempts += 1
+                    info.popped_event_seq = self._event_seq
                     batch.append(info)
                     return info
 
@@ -415,8 +429,30 @@ class SchedulingQueue:
                 return  # deleted meanwhile
             info.unschedulable_since = self._clock()
             info.unschedulable_reason = reason
+            if self._missed_event_locked(info, reason):
+                # an event that can fix this failure arrived while the
+                # pod was mid-cycle — retry instead of parking
+                self._push_backoff(info)
+                return
             self._unschedulable[key] = info
             self._tier[key] = "unsched"
+
+    def _missed_event_locked(self, info: QueuedPodInfo, reason: int) -> bool:
+        """True when an event logged after this pod was popped would have
+        woken it (the inFlightEvents replay)."""
+        if reason == assign_ops.REASON_UNENCODABLE:
+            return False
+        since = info.popped_event_seq
+        if self._events_log and self._events_log[0][0] > since + 1:
+            # events between pop and the log's horizon were evicted —
+            # be conservative (only happens past 512 events per cycle)
+            return True
+        for seq, wakes in self._events_log:
+            if seq <= since:
+                continue
+            if wakes is None or reason < 0 or reason in wakes:
+                return True
+        return False
 
     def requeue_backoff(self, info: QueuedPodInfo) -> None:
         """Transient failure (e.g. bind error): retry after backoff."""
@@ -440,6 +476,8 @@ class SchedulingQueue:
         wakes = EVENT_WAKES.get(event) if event is not None else None
         moved = 0
         with self._cond:
+            self._event_seq += 1
+            self._events_log.append((self._event_seq, wakes))
             now = self._clock()
             for key, info in list(self._unschedulable.items()):
                 reason = info.unschedulable_reason

@@ -103,9 +103,26 @@ class Scheduler:
         # cap the per-batch dry-run work so a mass of unschedulable pods
         # can't stall the hot loop.
         self.max_preemptions_per_cycle = self.config.max_preemptions_per_cycle
-        # default PostFilter plugin on every profile: preemption
+        # VolumeBinding: host-side claim/volume state; topology + attach
+        # limits fold into the snapshot encode via the builder transform
+        # (scheduler/volumebinding.py) — PreFilter/Filter cost nothing
+        # extra on device.  Reserve rides filter_result, rollback rides
+        # unreserve, API writes ride pre_bind.
+        from .volumebinding import VolumeBinder
+
+        gate = self.profiles.gate
+        self.preemption.pdb_aware = gate.enabled("PDBAwarePreemption")
+        self.volumes = VolumeBinder(store)
+        if gate.enabled("VolumeBinding"):
+            self.tpu.builder.pod_transform = self.volumes.pod_requirements
+        # default plugins on every profile: preemption (PostFilter) +
+        # volume binding (Reserve/Unreserve/PreBind)
         for fwk in self.profiles:
             fwk.post_filter.append(self._preempt_plugin)
+            if gate.enabled("VolumeBinding"):
+                fwk.filter_result.append(self._volume_reserve_plugin)
+                fwk.unreserve.append(self.volumes.unreserve)
+                fwk.pre_bind.append(self.volumes.prebind)
         self.informers = InformerFactory(store)
         # Optional client.leaderelection.LeaderElector: when set, the hot
         # loop only schedules while leading (app/server.go:170-180 —
@@ -122,6 +139,21 @@ class Scheduler:
     def _wire_handlers(self) -> None:
         self.informers.informer("Node").add_handler(self._on_node)
         self.informers.informer("Pod").add_handler(self._on_pod)
+        self.informers.informer("Node").add_handler(self.volumes.on_node)
+        for kind, handler in (
+            ("PersistentVolume", self.volumes.on_pv),
+            ("PersistentVolumeClaim", self.volumes.on_pvc),
+            ("StorageClass", self.volumes.on_class),
+        ):
+            inf = self.informers.informer(kind)
+            inf.add_handler(handler)
+            inf.add_handler(self._on_volume_event)
+
+    def _on_volume_event(self, typ: str, obj, old) -> None:
+        # a PV/PVC/StorageClass change can lift a volume-topology static
+        # failure (the selector the transform folded in) or free attach
+        # capacity — wake statically-parked and resource-parked pods
+        self.queue.move_for_event("NodeUpdate")
 
     def _on_node(self, typ: str, node: api.Node, old) -> None:
         if typ == st.ADDED:
@@ -185,6 +217,9 @@ class Scheduler:
         """Start informers + the scheduling loop thread."""
         self.informers.informer("Node").start()
         self.informers.informer("Pod").start()
+        self.informers.informer("PersistentVolume").start()
+        self.informers.informer("PersistentVolumeClaim").start()
+        self.informers.informer("StorageClass").start()
         self.informers.wait_for_sync()
         self._thread = threading.Thread(
             target=self._run, name="scheduler", daemon=True
@@ -331,6 +366,11 @@ class Scheduler:
             t_attempt = self._clock()
             if node_name is not None:
                 node_name = fwk.run_filter_result(info.pod, node_name)
+                if node_name is None:
+                    # a later plugin rejected a placement an earlier one
+                    # may have reserved for (e.g. volume Reserve) — roll
+                    # the reservations back before parking
+                    fwk.run_unreserve(info.pod)
             if node_name is None:
                 stats["unschedulable"] += 1
                 self.metrics.schedule_attempts.inc("unschedulable")
@@ -344,6 +384,7 @@ class Scheduler:
             try:
                 self.cache.assume(info.pod, node_name)
             except (KeyError, ValueError):
+                fwk.run_unreserve(info.pod)
                 stats["bind_errors"] += 1
                 self.metrics.schedule_attempts.inc("error")
                 self.queue.requeue_backoff(info)
@@ -353,6 +394,7 @@ class Scheduler:
                 self._bind(info.pod, node_name)
             except Exception:
                 self.cache.forget(info.pod)
+                fwk.run_unreserve(info.pod)
                 stats["bind_errors"] += 1
                 self.metrics.schedule_attempts.inc("error")
                 self.queue.requeue_backoff(info)
@@ -372,6 +414,22 @@ class Scheduler:
             self.metrics.pod_scheduling_sli_duration.observe(
                 self._clock() - info.initial_attempt_timestamp
             )
+
+    def _volume_reserve_plugin(
+        self, pod: api.Pod, node_name: str
+    ) -> Optional[str]:
+        """Reserve (volume_binding.go:369): pick concrete volumes for the
+        pod's unbound claims on the chosen node; rejecting the placement
+        parks the pod for retry (the solve's selector already restricted
+        candidates to topology-feasible nodes, so rejection here means a
+        race on volume capacity)."""
+        if not any(v.persistent_volume_claim for v in pod.spec.volumes):
+            return node_name
+        try:
+            node = self.store.get("Node", node_name, namespace="")
+        except KeyError:
+            return None
+        return node_name if self.volumes.reserve(pod, node) else None
 
     def _preempt_plugin(self, pod: api.Pod) -> Optional[str]:
         """The DefaultPreemption PostFilter plugin (registered on every

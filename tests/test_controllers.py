@@ -118,19 +118,36 @@ def test_deployment_rollout_and_revision_change(manager_store):
     rs_v1 = _deployment_rs(store, "front")
     assert len(rs_v1) == 1
 
-    # template change → new revision RS; old scales to zero
+    # template change → new revision RS; the rollout steps under
+    # maxSurge/maxUnavailable, advancing as pods become ready — pump
+    # readiness (scheduled + Running) like a kubelet would
     fresh = store.get("Deployment", "front")
     fresh.spec.template = _template({"app": "front"}, cpu=200)
     store.update(fresh)
     assert _wait(lambda: len(_deployment_rs(store, "front")) == 2)
-    assert _wait(
-        lambda: sorted(
+
+    def _pump_ready():
+        pods, _ = store.list("Pod")
+        for p in pods:
+            if not p.spec.node_name or p.status.phase != "Running":
+                p.spec.node_name = "n0"
+                p.status.phase = "Running"
+                try:
+                    store.update(p)
+                except (st.Conflict, st.NotFound):
+                    pass
+
+    def _rolled():
+        _pump_ready()
+        return sorted(
             rs.spec.replicas for rs in _deployment_rs(store, "front")
         ) == [0, 2]
-    )
+
+    assert _wait(_rolled, timeout=20)
     # pods converge to the new revision's template
     assert _wait(
-        lambda: len(_owned_pods_by_dep(store, "front")) == 2
+        lambda: (_pump_ready() or True)
+        and len(_owned_pods_by_dep(store, "front")) == 2
         and all(
             p.resource_requests()[api.CPU] == 200
             for p in _owned_pods_by_dep(store, "front")
@@ -432,3 +449,56 @@ def test_nodelifecycle_taint_does_not_flap():
     finally:
         ctrl.stop()
         factory.stop()
+
+
+def test_disruption_controller_maintains_pdb_status():
+    """pkg/controller/disruption: status tracks matching pods' health;
+    disruptionsAllowed = healthy - desired."""
+    from kubernetes_tpu.client.informers import InformerFactory
+    from kubernetes_tpu.controllers.disruption import DisruptionController
+    from kubernetes_tpu.testing.wrappers import make_pod
+
+    store = st.Store()
+    informers = InformerFactory(store)
+    ctrl = DisruptionController(store, informers, workers=1)
+    for kind in ("Pod", "PodDisruptionBudget"):
+        informers.informer(kind).start()
+    assert informers.wait_for_sync(10)
+    ctrl.start()
+    try:
+        pdb = api.PodDisruptionBudget(
+            meta=api.ObjectMeta(name="web-pdb"),
+            spec=api.PodDisruptionBudgetSpec(
+                selector=api.LabelSelector(match_labels={"app": "web"}),
+                min_available=2,
+            ),
+        )
+        store.create(pdb)
+        for i in range(3):
+            p = make_pod(f"w{i}").labels(app="web").node_name("n0").obj()
+            p.status.phase = "Running"
+            store.create(p)
+        deadline = time.time() + 10
+        got = None
+        while time.time() < deadline:
+            got = store.get("PodDisruptionBudget", "web-pdb")
+            if got.status.expected_pods == 3:
+                break
+            time.sleep(0.05)
+        assert got.status.expected_pods == 3
+        assert got.status.current_healthy == 3
+        assert got.status.desired_healthy == 2
+        assert got.status.disruptions_allowed == 1
+        # one pod dies: allowance drops to 0
+        store.delete("Pod", "w0")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            got = store.get("PodDisruptionBudget", "web-pdb")
+            if got.status.disruptions_allowed == 0 and got.status.expected_pods == 2:
+                break
+            time.sleep(0.05)
+        assert got.status.disruptions_allowed == 0
+        assert got.status.current_healthy == 2
+    finally:
+        ctrl.stop()
+        informers.stop()

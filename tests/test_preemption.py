@@ -342,3 +342,101 @@ def test_nominate_survives_conflict_and_notfound():
     ev.store = store
     missing = make_pod("gone").obj()
     ev._nominate(missing, "node-y")  # must not raise
+
+
+# -- PDBs (policy/v1 PodDisruptionBudget; preemption.go:290,463) ----------
+
+
+def _pdb(name, selector, allowed, namespace="default"):
+    pdb = api.PodDisruptionBudget(
+        meta=api.ObjectMeta(name=name, namespace=namespace),
+        spec=api.PodDisruptionBudgetSpec(
+            selector=api.LabelSelector(match_labels=selector)
+        ),
+    )
+    pdb.status.disruptions_allowed = allowed
+    return pdb
+
+
+def test_pdb_flags_partition_victims():
+    from kubernetes_tpu.scheduler.preemption import PreemptionEvaluator
+
+    pdbs = [_pdb("b", {"app": "db"}, 1)]
+    victims = [
+        make_pod(f"v{i}").labels(app="db").priority(i).obj() for i in range(3)
+    ]
+    flags = PreemptionEvaluator._pdb_flags(victims, pdbs)
+    # budget allows ONE disruption: the first eviction tolerated, rest violate
+    assert flags == [False, True, True]
+
+
+def test_pdb_steers_victim_choice_end_to_end():
+    """Two equivalent candidate nodes; the one whose victim violates a
+    PDB must lose (minNumPDBViolatingScoreFunc is the FIRST criterion)."""
+    store = st.Store()
+    store.create(make_node("n0").capacity(cpu_milli=2000, pods=10).obj())
+    store.create(make_node("n1").capacity(cpu_milli=2000, pods=10).obj())
+    for name, node, app in (
+        ("guarded", "n0", "db"),     # protected by a zero-budget PDB
+        ("free", "n1", "web"),
+    ):
+        p = (
+            make_pod(name).labels(app=app).req(cpu_milli=2000)
+            .priority(1).node_name(node).obj()
+        )
+        p.status.phase = "Running"
+        store.create(p)
+    store.create(_pdb("db-pdb", {"app": "db"}, 0))
+    sched = _mk_scheduler(store)
+    try:
+        store.create(make_pod("hi").req(cpu_milli=1500).priority(100).obj())
+        deadline = time.monotonic() + 15
+        placed = None
+        while time.monotonic() < deadline and not placed:
+            sched.schedule_batch(timeout=0.2)
+            placed = store.get("Pod", "hi").spec.node_name
+        assert placed == "n1", placed   # the unprotected victim's node
+        store.get("Pod", "guarded")     # survives
+        with pytest.raises(KeyError):
+            store.get("Pod", "free")    # evicted
+    finally:
+        sched.stop()
+
+
+def test_gang_preemption_evicts_across_nodes():
+    """A whole gang preempts: victims accumulate over multiple nodes
+    until the group fits all-or-nothing (previously gang members were
+    preemption-ineligible)."""
+    store = st.Store()
+    for i in range(2):
+        store.create(make_node(f"n{i}").capacity(cpu_milli=2000, pods=10).obj())
+    for i in range(2):
+        p = (
+            make_pod(f"low-{i}").req(cpu_milli=2000).priority(0)
+            .node_name(f"n{i}").obj()
+        )
+        p.status.phase = "Running"
+        store.create(p)
+    sched = _mk_scheduler(store)
+    try:
+        # gang of 2, each needing a whole node: must evict BOTH low pods
+        for i in range(2):
+            store.create(
+                make_pod(f"g{i}").req(cpu_milli=2000).priority(100)
+                .group("band", size=2).obj()
+            )
+        deadline = time.monotonic() + 20
+        placed = []
+        while time.monotonic() < deadline and len(placed) < 2:
+            sched.schedule_batch(timeout=0.2)
+            placed = [
+                store.get("Pod", f"g{i}").spec.node_name
+                for i in range(2)
+                if store.get("Pod", f"g{i}").spec.node_name
+            ]
+        assert sorted(placed) == ["n0", "n1"], placed
+        for i in range(2):
+            with pytest.raises(KeyError):
+                store.get("Pod", f"low-{i}")
+    finally:
+        sched.stop()
