@@ -1,0 +1,73 @@
+"""Authentication + authorization for the API server.
+
+The reference wires authn/authz into the generic apiserver's handler
+chain (DefaultBuildHandlerChain, apiserver/pkg/server/config.go:983-1028:
+authorization at :987, authentication at :1014) with pluggable token
+authenticators and RBAC/webhook authorizers.  Ours is the minimal
+useful pair:
+
+  * TokenAuthenticator — static bearer-token -> subject map (the
+    --token-auth-file pattern, apiserver/pkg/authentication/token);
+  * RuleAuthorizer — an ordered allow-list evaluated per
+    (subject, verb, kind), "*" wildcards (the ABAC policy-file shape,
+    apiserver/plugin/pkg/authorizer/abac reduced to allow rules).
+
+Semantics: with no authenticator every request is anonymous; with one,
+a missing/unknown bearer token is 401.  With no authorizer everything
+is allowed; with one, any non-matching request is 403.  Reads and
+writes use the reference verb set (get/list/watch/create/update/patch/
+delete).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class Subject:
+    name: str
+    groups: Tuple[str, ...] = ()
+
+
+ANONYMOUS = Subject("system:anonymous", ("system:unauthenticated",))
+
+
+class TokenAuthenticator:
+    def __init__(self, tokens: Dict[str, Subject]):
+        self._tokens = dict(tokens)
+
+    def authenticate(self, authorization: Optional[str]) -> Optional[Subject]:
+        """Subject for an Authorization header value, or None (401)."""
+        if not authorization or not authorization.startswith("Bearer "):
+            return None
+        return self._tokens.get(authorization[len("Bearer "):].strip())
+
+
+@dataclass
+class Rule:
+    """Allow rule: subject name OR group must match, plus verb + kind."""
+
+    subjects: Sequence[str] = ("*",)   # names or group names
+    verbs: Sequence[str] = ("*",)
+    kinds: Sequence[str] = ("*",)
+
+    def matches(self, subject: Subject, verb: str, kind: str) -> bool:
+        who = {subject.name, *subject.groups}
+        return (
+            ("*" in self.subjects or who.intersection(self.subjects))
+            and ("*" in self.verbs or verb in self.verbs)
+            and ("*" in self.kinds or kind in self.kinds)
+        )
+
+
+READ_VERBS = ("get", "list", "watch")
+
+
+class RuleAuthorizer:
+    def __init__(self, rules: Sequence[Rule]):
+        self.rules = list(rules)
+
+    def allowed(self, subject: Subject, verb: str, kind: str) -> bool:
+        return any(r.matches(subject, verb, kind) for r in self.rules)

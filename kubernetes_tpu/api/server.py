@@ -6,18 +6,26 @@ watch.go) over the storage layer.  One process-boundary protocol so
 out-of-process clients — the CLI, remote controllers, a kube shim — use
 the same store the in-process components do:
 
-  GET    /api/v1/{kind}                      list (+ ?namespace=)
+  GET    /api/v1/{kind}                      list (+ ?namespace= and
+                                             ?labelSelector= / ?fieldSelector=)
   GET    /api/v1/{kind}/{ns}/{name}          get
   POST   /api/v1/{kind}                      create (wire-coded body)
   PUT    /api/v1/{kind}/{ns}/{name}          update (optimistic rv;
                                              ?force=1 overrides)
+  PUT    /api/v1/{kind}/{ns}/{name}/status   status subresource: only
+                                             .status from the body lands
+  PATCH  /api/v1/{kind}/{ns}/{name}[/status] RFC 7386 JSON merge patch
   DELETE /api/v1/{kind}/{ns}/{name}          delete
   GET    /api/v1/watch/{kind}?from_rv=N      newline-delimited JSON
                                              event stream (chunked)
 
 Objects travel as api.wire documents (type-tagged dataclass JSON) —
 the codec the journal already uses.  Errors map to the reference's
-status codes: 404 NotFound, 409 AlreadyExists/Conflict, 410 Expired.
+status codes: 401/403 authn/authz, 404 NotFound, 409 AlreadyExists/
+Conflict, 410 Expired.  Authentication/authorization are optional
+constructor hooks (api.auth): bearer tokens -> subjects, allow-list
+rules per (subject, verb, kind) — the DefaultBuildHandlerChain slice
+(apiserver/pkg/server/config.go:983-1028).
 """
 
 from __future__ import annotations
@@ -28,18 +36,138 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from . import auth as authmod
 from . import store as st
 from . import wire
 
 
+def parse_label_selector(expr: str):
+    """`a=b,c!=d,e` -> predicate over an object's labels (the
+    labels.Parse equality subset + bare-key Exists)."""
+    clauses = []
+    for raw in expr.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        if "!=" in raw:
+            k, v = raw.split("!=", 1)
+            clauses.append(("!=", k.strip(), v.strip()))
+        elif "==" in raw:
+            k, v = raw.split("==", 1)
+            clauses.append(("=", k.strip(), v.strip()))
+        elif "=" in raw:
+            k, v = raw.split("=", 1)
+            clauses.append(("=", k.strip(), v.strip()))
+        else:
+            clauses.append(("exists", raw, ""))
+
+    def pred(obj) -> bool:
+        labels = obj.meta.labels
+        for op, k, v in clauses:
+            if op == "=" and labels.get(k) != v:
+                return False
+            if op == "!=" and labels.get(k) == v:
+                return False
+            if op == "exists" and k not in labels:
+                return False
+        return True
+
+    return pred
+
+
+# fieldSelector paths the reference supports for pods (plus the metadata
+# pair every kind has) — dotted wire-field paths resolved on the object
+_FIELD_GETTERS = {
+    "metadata.name": lambda o: o.meta.name,
+    "metadata.namespace": lambda o: o.meta.namespace,
+    "spec.nodeName": lambda o: getattr(o.spec, "node_name", ""),
+    "status.phase": lambda o: getattr(o.status, "phase", ""),
+}
+
+
+def parse_field_selector(expr: str):
+    clauses = []
+    for raw in expr.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        if "!=" in raw:
+            k, v = raw.split("!=", 1)
+            op = "!="
+        else:
+            k, v = raw.split("=", 1)
+            op = "="
+        getter = _FIELD_GETTERS.get(k.strip())
+        if getter is None:
+            raise ValueError(f"unsupported fieldSelector {k.strip()!r}")
+        clauses.append((op, getter, v.strip()))
+
+    def pred(obj) -> bool:
+        for op, getter, v in clauses:
+            try:
+                actual = str(getter(obj))
+            except AttributeError:
+                return False
+            if op == "=" and actual != v:
+                return False
+            if op == "!=" and actual == v:
+                return False
+        return True
+
+    return pred
+
+
+def merge_patch(base, patch):
+    """RFC 7386 JSON merge patch over wire documents: dicts merge
+    recursively, null deletes, everything else replaces (the reference's
+    application/merge-patch+json handler)."""
+    if not isinstance(patch, dict):
+        return patch
+    if not isinstance(base, dict):
+        base = {}
+    out = dict(base)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = merge_patch(out.get(k), v)
+    return out
+
+
 class _Handler(BaseHTTPRequestHandler):
-    store: st.Store  # bound by serve()
+    store: st.Store  # bound by APIServer
+    authn = None     # Optional[auth.TokenAuthenticator]
+    authz = None     # Optional[auth.RuleAuthorizer]
     protocol_version = "HTTP/1.1"
 
     def log_message(self, fmt, *args):
         pass
 
     # -- helpers -----------------------------------------------------------
+
+    def _authorize(self, verb: str, kind: str) -> bool:
+        """authn -> authz gate; replies 401/403 and returns False on
+        rejection.  healthz stays open (the reference exempts health
+        endpoints before the chain)."""
+        subject = authmod.ANONYMOUS
+        if self.authn is not None:
+            subject = self.authn.authenticate(
+                self.headers.get("Authorization")
+            )
+            if subject is None:
+                self._reply({"error": "unauthorized",
+                             "reason": "Unauthorized"}, 401)
+                return False
+        if self.authz is not None and not self.authz.allowed(
+            subject, verb, kind
+        ):
+            self._reply(
+                {"error": f"{subject.name} cannot {verb} {kind}",
+                 "reason": "Forbidden"},
+                403,
+            )
+            return False
+        return True
 
     def _reply(self, obj, code: int = 200) -> None:
         data = json.dumps(obj).encode()
@@ -70,10 +198,29 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if len(parts) >= 3 and parts[:2] == ["api", "v1"]:
                 if parts[2] == "watch" and len(parts) == 4:
+                    if not self._authorize("watch", parts[3]):
+                        return
                     return self._watch(parts[3], q)
                 if len(parts) == 3:
+                    if not self._authorize("list", parts[2]):
+                        return
                     namespace = q.get("namespace", [None])[0]
-                    items, rv = self.store.list(parts[2], namespace=namespace)
+                    preds = []
+                    if q.get("labelSelector"):
+                        preds.append(
+                            parse_label_selector(q["labelSelector"][0])
+                        )
+                    if q.get("fieldSelector"):
+                        preds.append(
+                            parse_field_selector(q["fieldSelector"][0])
+                        )
+                    selector = (
+                        (lambda o: all(p(o) for p in preds)) if preds
+                        else None
+                    )
+                    items, rv = self.store.list(
+                        parts[2], namespace=namespace, selector=selector
+                    )
                     return self._reply(
                         {
                             "items": [wire.to_wire(o) for o in items],
@@ -81,6 +228,8 @@ class _Handler(BaseHTTPRequestHandler):
                         }
                     )
                 if len(parts) == 5:
+                    if not self._authorize("get", parts[2]):
+                        return
                     ns = "" if parts[3] == "-" else parts[3]
                     obj = self.store.get(parts[2], parts[4], ns)
                     return self._reply(wire.to_wire(obj))
@@ -94,6 +243,8 @@ class _Handler(BaseHTTPRequestHandler):
         parts, _ = self._parts()
         try:
             if len(parts) == 3 and parts[:2] == ["api", "v1"]:
+                if not self._authorize("create", parts[2]):
+                    return
                 obj = wire.from_wire(self._body())
                 created = self.store.create(obj)
                 return self._reply(wire.to_wire(created), 201)
@@ -104,10 +255,60 @@ class _Handler(BaseHTTPRequestHandler):
     def do_PUT(self) -> None:
         parts, q = self._parts()
         try:
+            if (
+                len(parts) == 6
+                and parts[:2] == ["api", "v1"]
+                and parts[5] == "status"
+            ):
+                # status subresource: only .status from the body lands —
+                # spec edits through this path are dropped (the
+                # StatusStrategy PrepareForUpdate contract,
+                # registry/core/pod/strategy.go podStatusStrategy)
+                if not self._authorize("update", parts[2]):
+                    return
+                incoming = wire.from_wire(self._body())
+                ns = "" if parts[3] == "-" else parts[3]
+                current = self.store.get(parts[2], parts[4], ns)
+                current.status = incoming.status
+                updated = self.store.update(current)
+                return self._reply(wire.to_wire(updated))
             if len(parts) == 5 and parts[:2] == ["api", "v1"]:
+                if not self._authorize("update", parts[2]):
+                    return
                 obj = wire.from_wire(self._body())
                 force = q.get("force", ["0"])[0] == "1"
                 updated = self.store.update(obj, force=force)
+                return self._reply(wire.to_wire(updated))
+            self._reply({"error": f"unknown path {self.path}"}, 404)
+        except Exception as e:
+            self._error(e)
+
+    def do_PATCH(self) -> None:
+        """RFC 7386 merge patch on the object's wire document (or its
+        status subresource) — endpoints/handlers/patch.go reduced to the
+        merge-patch content type."""
+        parts, _ = self._parts()
+        try:
+            is_status = (
+                len(parts) == 6
+                and parts[:2] == ["api", "v1"]
+                and parts[5] == "status"
+            )
+            if (len(parts) == 5 or is_status) and parts[:2] == ["api", "v1"]:
+                if not self._authorize("patch", parts[2]):
+                    return
+                ns = "" if parts[3] == "-" else parts[3]
+                current = self.store.get(parts[2], parts[4], ns)
+                doc = wire.to_wire(current)
+                patch = self._body()
+                if is_status:
+                    patch = {"status": patch.get("status", patch)}
+                merged = merge_patch(doc, patch)
+                obj = wire.from_wire(merged)
+                # the patch applies to what was READ: keep its rv so a
+                # concurrent writer surfaces as 409, not silent clobber
+                obj.meta.resource_version = current.meta.resource_version
+                updated = self.store.update(obj)
                 return self._reply(wire.to_wire(updated))
             self._reply({"error": f"unknown path {self.path}"}, 404)
         except Exception as e:
@@ -117,6 +318,8 @@ class _Handler(BaseHTTPRequestHandler):
         parts, _ = self._parts()
         try:
             if len(parts) == 5 and parts[:2] == ["api", "v1"]:
+                if not self._authorize("delete", parts[2]):
+                    return
                 ns = "" if parts[3] == "-" else parts[3]
                 self.store.delete(parts[2], parts[4], ns)
                 return self._reply({"deleted": True})
@@ -182,10 +385,24 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class APIServer:
-    """Threaded HTTP server exposing one Store."""
+    """Threaded HTTP server exposing one Store.
 
-    def __init__(self, store: st.Store, host: str = "127.0.0.1", port: int = 0):
-        handler = type("BoundHandler", (_Handler,), {"store": store})
+    authn/authz: optional api.auth.TokenAuthenticator /
+    api.auth.RuleAuthorizer — None keeps the surface open (the
+    --anonymous-auth development posture)."""
+
+    def __init__(
+        self,
+        store: st.Store,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        authn=None,
+        authz=None,
+    ):
+        handler = type(
+            "BoundHandler", (_Handler,),
+            {"store": store, "authn": authn, "authz": authz},
+        )
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self._thread: Optional[threading.Thread] = None
 

@@ -185,7 +185,18 @@ class Store:
                     continue
                 try:
                     rec = json.loads(line)
-                except json.JSONDecodeError:
+                    if not isinstance(rec, dict):
+                        raise ValueError("journal record is not an object")
+                    op, rv, kind = rec["op"], rec["rv"], rec["kind"]
+                    key = rec["key"]
+                    obj = (
+                        None if op == DELETED else wire.from_wire(rec["obj"])
+                    )
+                except (json.JSONDecodeError, ValueError, KeyError, TypeError):
+                    # undecodable OR structurally-corrupt record (a line
+                    # that parses as JSON but lost its fields or its
+                    # object payload aborts replay just as hard as a
+                    # torn one)
                     if good_offset + len(raw) >= size:
                         # torn TAIL: the process died mid-append; the
                         # record was never acknowledged durable — stop
@@ -204,15 +215,12 @@ class Store:
                     )
                     good_offset += len(raw)
                     continue
-                op, rv, kind = rec["op"], rec["rv"], rec["kind"]
-                key = rec["key"]
                 objs = self._objects.setdefault(kind, {})
                 vers = self._versions.setdefault(kind, {})
                 if op == DELETED:
                     objs.pop(key, None)
                     vers.pop(key, None)
                 else:
-                    obj = wire.from_wire(rec["obj"])
                     objs[key] = obj
                     vers[key] = rv
                 self._rv = max(self._rv, rv)
@@ -382,6 +390,13 @@ class Store:
                 and (selector is None or selector(o))
             ]
             return items, self._rv
+
+    def kinds(self) -> List[str]:
+        """Object kinds the store currently holds (the GC/namespace
+        controllers sweep every kind, like the reference's
+        RESTMapper-driven resource discovery)."""
+        with self._lock:
+            return [k for k, objs in self._objects.items() if objs]
 
     # -- watch -------------------------------------------------------------
 

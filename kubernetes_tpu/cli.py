@@ -85,7 +85,12 @@ def cmd_get(client: RestClient, args) -> None:
         if kind == "Node" or getattr(args, "all_namespaces", False)
         else args.namespace
     )
-    items, rv = client.list(kind, namespace=namespace)
+    items, rv = client.list(
+        kind,
+        namespace=namespace,
+        label_selector=getattr(args, "selector", None),
+        field_selector=getattr(args, "field_selector", None),
+    )
     for o in items:
         print("  ".join(_fmt_any(o)))
     print(f"# {len(items)} {kind}(s) at rv {rv}", file=sys.stderr)
@@ -144,9 +149,19 @@ def cmd_scale(client: RestClient, args) -> None:
     print(f"{args.resource.lower()}/{args.name} scaled to {args.replicas}")
 
 
+def cmd_patch(client: RestClient, args) -> None:
+    kind = _kind(args.resource)
+    client.patch(
+        kind, args.name, json.loads(args.patch),
+        namespace=_ns_for(kind, args), subresource=args.subresource,
+    )
+    print(f"{args.resource.lower()}/{args.name} patched")
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(prog="kubernetes_tpu.cli", description=__doc__)
     ap.add_argument("--server", default="http://127.0.0.1:8080")
+    ap.add_argument("--token", default=None, help="bearer token")
     ap.add_argument("-n", "--namespace", default="default")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
@@ -154,6 +169,10 @@ def main(argv=None) -> None:
     g.add_argument("resource")
     g.add_argument("name", nargs="?")
     g.add_argument("-A", "--all-namespaces", action="store_true")
+    g.add_argument("-l", "--selector", default=None,
+                   help="label selector, e.g. app=web,tier!=cache")
+    g.add_argument("--field-selector", default=None,
+                   help="field selector, e.g. spec.nodeName=n0")
     g.set_defaults(fn=cmd_get)
 
     d = sub.add_parser("describe")
@@ -176,8 +195,16 @@ def main(argv=None) -> None:
     s.add_argument("--replicas", type=int, required=True)
     s.set_defaults(fn=cmd_scale)
 
+    p = sub.add_parser("patch")
+    p.add_argument("resource")
+    p.add_argument("name")
+    p.add_argument("-p", "--patch", required=True,
+                   help="RFC 7386 merge patch as JSON")
+    p.add_argument("--subresource", default=None, choices=[None, "status"])
+    p.set_defaults(fn=cmd_patch)
+
     args = ap.parse_args(argv)
-    client = RestClient(args.server)
+    client = RestClient(args.server, token=args.token)
     args.fn(client, args)
 
 

@@ -22,9 +22,21 @@ def _ns_seg(namespace: str) -> str:
 
 
 class RestClient:
-    def __init__(self, base_url: str, timeout: float = 10.0):
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = 10.0,
+        token: Optional[str] = None,
+    ):
         self.base = base_url.rstrip("/")
         self.timeout = timeout
+        self.token = token
+
+    def _headers(self) -> dict:
+        h = {"Content-Type": "application/json"}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        return h
 
     @staticmethod
     def _map_http_error(e: urllib.error.HTTPError):
@@ -46,7 +58,7 @@ class RestClient:
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(
             self.base + path, data=data, method=method,
-            headers={"Content-Type": "application/json"},
+            headers=self._headers(),
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
@@ -57,11 +69,24 @@ class RestClient:
     # -- typed verbs -------------------------------------------------------
 
     def list(
-        self, kind: str, namespace: Optional[str] = None
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[str] = None,
+        field_selector: Optional[str] = None,
     ) -> Tuple[List[Any], int]:
-        path = f"/api/v1/{kind}"
+        from urllib.parse import urlencode
+
+        params = {}
         if namespace is not None:
-            path += f"?namespace={namespace}"
+            params["namespace"] = namespace
+        if label_selector:
+            params["labelSelector"] = label_selector
+        if field_selector:
+            params["fieldSelector"] = field_selector
+        path = f"/api/v1/{kind}"
+        if params:
+            path += "?" + urlencode(params)
         doc = self._call("GET", path)
         return [wire.from_wire(d) for d in doc["items"]], doc["resourceVersion"]
 
@@ -86,6 +111,30 @@ class RestClient:
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
         self._call("DELETE", f"/api/v1/{kind}/{_ns_seg(namespace)}/{name}")
 
+    def patch(
+        self,
+        kind: str,
+        name: str,
+        patch: Any,
+        namespace: str = "default",
+        subresource: Optional[str] = None,
+    ):
+        """RFC 7386 merge patch; subresource="status" patches only
+        .status (the PATCH pods/{name}/status controllers use)."""
+        path = f"/api/v1/{kind}/{_ns_seg(namespace)}/{name}"
+        if subresource:
+            path += f"/{subresource}"
+        return wire.from_wire(self._call("PATCH", path, patch))
+
+    def update_status(self, obj: Any):
+        """PUT the status subresource: only .status from obj lands."""
+        kind = obj.KIND
+        path = (
+            f"/api/v1/{kind}/{_ns_seg(obj.meta.namespace)}"
+            f"/{obj.meta.name}/status"
+        )
+        return wire.from_wire(self._call("PUT", path, wire.to_wire(obj)))
+
     def watch(self, kind: str, from_rv: Optional[int] = None):
         """Generator of (type, obj, rv) from the chunked watch stream.
 
@@ -98,7 +147,7 @@ class RestClient:
         path = f"/api/v1/watch/{kind}"
         if from_rv is not None:
             path += f"?from_rv={from_rv}"
-        req = urllib.request.Request(self.base + path)
+        req = urllib.request.Request(self.base + path, headers=self._headers())
         try:
             stream = urllib.request.urlopen(
                 req, timeout=max(self.timeout, 5.0)

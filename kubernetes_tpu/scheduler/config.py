@@ -19,10 +19,11 @@ and percentageOfNodesToScore — with defaulting and validation
 from __future__ import annotations
 
 from dataclasses import dataclass, field, replace
-from typing import List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..ops.schema import SnapshotLimits
 from ..ops.scores import DEFAULT_SCORE_CONFIG, ScoreConfig
+from ..utils.featuregate import FeatureGate
 
 # Score plugins that map onto ScoreConfig weights (names/names.go:20-43).
 SCORE_PLUGIN_WEIGHTS = {
@@ -66,6 +67,13 @@ class SchedulerConfiguration:
     parallelism: int = 16
     percentage_of_nodes_to_score: int = 100
     limits: Optional[SnapshotLimits] = None
+    # feature-gate overrides (utils.featuregate.DEFAULT_FEATURES),
+    # consulted at registry/router build time — e.g. AuctionSolver=false
+    # pins every profile's solver to the greedy scan
+    feature_gates: Dict[str, bool] = field(default_factory=dict)
+
+    def gate(self) -> FeatureGate:
+        return FeatureGate(overrides=self.feature_gates)
 
     def validate(self) -> "SchedulerConfiguration":
         """Raise ValueError on an invalid configuration (the
@@ -119,4 +127,116 @@ class SchedulerConfiguration:
             raise ValueError("percentage_of_nodes_to_score must be 0..100")
         if self.max_preemptions_per_cycle < 0:
             raise ValueError("max_preemptions_per_cycle must be >= 0")
+        self.gate()  # unknown/locked gate overrides raise here
         return self
+
+
+# ---------------------------------------------------------------------------
+# Versioned config-file loading: KubeSchedulerConfiguration-shaped YAML
+# -> defaults -> validation -> SchedulerConfiguration (the
+# apis/config/{v1,validation} pipeline; scheduler.go:268-276 wires it).
+# ---------------------------------------------------------------------------
+
+_API_VERSIONS = (
+    "kubescheduler.config.k8s.io/v1",
+    "kubescheduler.config.tpu/v1",
+)
+_TOP_KEYS = {
+    "apiVersion", "kind", "parallelism", "percentageOfNodesToScore",
+    "podInitialBackoffSeconds", "podMaxBackoffSeconds", "profiles",
+    "featureGates", "batchSize", "assumeTTLSeconds",
+    "unschedulableFlushSeconds", "maxPreemptionsPerCycle",
+}
+
+
+def load_config(source: Any) -> SchedulerConfiguration:
+    """Load a KubeSchedulerConfiguration-shaped document: a YAML file
+    path, a YAML string, or an already-parsed dict.  Unknown top-level
+    fields are rejected (the strict-decoding posture); the result is
+    defaulted and validated."""
+    import os
+
+    if isinstance(source, dict):
+        doc = source
+    else:
+        import yaml
+
+        text = source
+        if isinstance(source, str) and os.path.exists(source):
+            with open(source) as f:
+                text = f.read()
+        doc = yaml.safe_load(text) or {}
+    if doc.get("kind", "KubeSchedulerConfiguration") != "KubeSchedulerConfiguration":
+        raise ValueError(f"unexpected kind {doc.get('kind')!r}")
+    api_version = doc.get("apiVersion", _API_VERSIONS[0])
+    if api_version not in _API_VERSIONS:
+        raise ValueError(
+            f"unsupported apiVersion {api_version!r}; known: {_API_VERSIONS}"
+        )
+    unknown = set(doc) - _TOP_KEYS
+    if unknown:
+        raise ValueError(f"unknown configuration fields: {sorted(unknown)}")
+
+    cfg = SchedulerConfiguration()
+    if "parallelism" in doc:
+        cfg.parallelism = int(doc["parallelism"])
+    if "percentageOfNodesToScore" in doc:
+        cfg.percentage_of_nodes_to_score = int(doc["percentageOfNodesToScore"])
+    if "podInitialBackoffSeconds" in doc:
+        cfg.pod_initial_backoff_seconds = float(doc["podInitialBackoffSeconds"])
+    if "podMaxBackoffSeconds" in doc:
+        cfg.pod_max_backoff_seconds = float(doc["podMaxBackoffSeconds"])
+    if "batchSize" in doc:
+        cfg.batch_size = int(doc["batchSize"])
+    if "assumeTTLSeconds" in doc:
+        cfg.assume_ttl_seconds = float(doc["assumeTTLSeconds"])
+    if "unschedulableFlushSeconds" in doc:
+        cfg.unschedulable_flush_seconds = float(doc["unschedulableFlushSeconds"])
+    if "maxPreemptionsPerCycle" in doc:
+        cfg.max_preemptions_per_cycle = int(doc["maxPreemptionsPerCycle"])
+    if "featureGates" in doc:
+        cfg.feature_gates = {
+            str(k): bool(v) for k, v in (doc["featureGates"] or {}).items()
+        }
+    if "profiles" in doc:
+        cfg.profiles = [_load_profile(p) for p in doc["profiles"] or []]
+    return cfg.validate()
+
+
+def _load_profile(doc: Dict[str, Any]) -> ProfileConfig:
+    unknown = set(doc) - {"schedulerName", "plugins", "pluginConfig"}
+    if unknown:
+        raise ValueError(f"unknown profile fields: {sorted(unknown)}")
+    profile = ProfileConfig(
+        scheduler_name=doc.get("schedulerName", "default-scheduler")
+    )
+    score_kwargs: Dict[str, Any] = {}
+    plugins = doc.get("plugins") or {}
+    score = plugins.get("score") or {}
+    disabled = tuple(
+        d["name"] for d in score.get("disabled") or [] if d.get("name") != "*"
+    )
+    profile.disabled_score_plugins = disabled
+    for e in score.get("enabled") or []:
+        name, weight = e.get("name"), e.get("weight")
+        if name not in SCORE_PLUGIN_WEIGHTS:
+            raise ValueError(
+                f"unknown score plugin {name!r}; known: "
+                f"{sorted(SCORE_PLUGIN_WEIGHTS)}"
+            )
+        if weight is not None:
+            score_kwargs[SCORE_PLUGIN_WEIGHTS[name]] = float(weight)
+    for pc in doc.get("pluginConfig") or []:
+        if pc.get("name") == "NodeResourcesFit":
+            strat = (pc.get("args") or {}).get("scoringStrategy") or {}
+            if "type" in strat:
+                score_kwargs["fit_strategy"] = strat["type"]
+            shape = strat.get("requestedToCapacityRatio", {}).get("shape")
+            if shape:
+                score_kwargs["rtcr_shape"] = tuple(
+                    (float(p["utilization"]), float(p["score"]))
+                    for p in shape
+                )
+    if score_kwargs:
+        profile.score_config = replace(DEFAULT_SCORE_CONFIG, **score_kwargs)
+    return profile

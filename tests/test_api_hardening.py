@@ -1,0 +1,178 @@
+"""API-server hardening: PATCH (merge semantics + conflict), the status
+subresource, list selectors over REST, and authn/authz.
+
+References: apiserver endpoints/handlers/patch.go (merge patch),
+registry/core/pod/strategy.go (status strategy), apiserver/pkg/server/
+config.go:983-1028 (the authn/authz chain slice).
+"""
+
+import pytest
+
+from kubernetes_tpu.api import auth, store as st, types as api
+from kubernetes_tpu.api.server import (
+    APIServer,
+    merge_patch,
+    parse_field_selector,
+    parse_label_selector,
+)
+from kubernetes_tpu.client.rest import RestClient
+from kubernetes_tpu.testing.wrappers import MI, make_node, make_pod
+
+
+@pytest.fixture
+def server():
+    store = st.Store()
+    srv = APIServer(store).start()
+    yield srv, store, RestClient(srv.url)
+    srv.stop()
+
+
+def test_merge_patch_semantics():
+    base = {"a": {"b": 1, "c": 2}, "d": [1, 2], "e": "x"}
+    patch = {"a": {"b": 9, "c": None}, "d": [3]}
+    assert merge_patch(base, patch) == {"a": {"b": 9}, "d": [3], "e": "x"}
+
+
+def test_patch_updates_labels(server):
+    srv, store, client = server
+    store.create(make_pod("p").labels(app="web").obj())
+    got = client.patch(
+        "Pod", "p", {"meta": {"labels": {"tier": "front"}}}
+    )
+    assert got.meta.labels == {"app": "web", "tier": "front"}
+    assert store.get("Pod", "p").meta.labels["tier"] == "front"
+
+
+def test_patch_status_subresource_ignores_spec(server):
+    srv, store, client = server
+    store.create(make_pod("p").req(cpu_milli=100).obj())
+    client.patch(
+        "Pod", "p",
+        {"status": {"phase": "Running"}, "spec": {"node_name": "sneaky"}},
+        subresource="status",
+    )
+    got = store.get("Pod", "p")
+    assert got.status.phase == "Running"
+    assert got.spec.node_name == ""  # spec write dropped
+
+
+def test_put_status_subresource_ignores_spec(server):
+    srv, store, client = server
+    store.create(make_pod("p").obj())
+    obj = client.get("Pod", "p")
+    obj.status.phase = "Failed"
+    obj.spec.node_name = "sneaky"
+    got = client.update_status(obj)
+    assert got.status.phase == "Failed"
+    assert store.get("Pod", "p").spec.node_name == ""
+
+
+def test_patch_conflict_on_concurrent_write(server):
+    srv, store, client = server
+    store.create(make_pod("p").obj())
+
+    # patch applies against what it read; simulate a lost race by
+    # patching with a stale rv via direct handler behavior: two patches
+    # in a row both succeed (each reads fresh), so force staleness by
+    # updating between read and write is internal — instead verify rv
+    # advances and a stale PUT conflicts
+    obj = client.get("Pod", "p")
+    obj2 = client.get("Pod", "p")
+    obj.meta.labels["a"] = "1"
+    client.update(obj)
+    obj2.meta.labels["b"] = "2"
+    with pytest.raises(st.Conflict):
+        client.update(obj2)
+
+
+def test_list_selectors_over_rest(server):
+    srv, store, client = server
+    store.create(make_pod("w1").labels(app="web").obj())
+    store.create(make_pod("w2").labels(app="web", tier="cache").obj())
+    store.create(make_pod("d1").labels(app="db").obj())
+    p = make_pod("bound").labels(app="web").obj()
+    p.spec.node_name = "n7"
+    store.create(p)
+
+    items, _ = client.list("Pod", label_selector="app=web")
+    assert {o.meta.name for o in items} == {"w1", "w2", "bound"}
+    items, _ = client.list("Pod", label_selector="app=web,tier!=cache")
+    assert {o.meta.name for o in items} == {"w1", "bound"}
+    items, _ = client.list("Pod", label_selector="tier")
+    assert {o.meta.name for o in items} == {"w2"}
+    items, _ = client.list("Pod", field_selector="spec.nodeName=n7")
+    assert {o.meta.name for o in items} == {"bound"}
+    items, _ = client.list(
+        "Pod", label_selector="app=web", field_selector="spec.nodeName="
+    )
+    assert {o.meta.name for o in items} == {"w1", "w2"}
+
+
+def test_selector_parsers_direct():
+    pod = make_pod("x").labels(app="web").obj()
+    assert parse_label_selector("app=web")(pod)
+    assert not parse_label_selector("app!=web")(pod)
+    assert parse_field_selector("metadata.name=x")(pod)
+    with pytest.raises(ValueError):
+        parse_field_selector("spec.bogus=1")
+
+
+def test_authn_authz_enforced():
+    store = st.Store()
+    authn = auth.TokenAuthenticator({
+        "admin-token": auth.Subject("admin", ("system:masters",)),
+        "viewer-token": auth.Subject("viewer", ("readers",)),
+    })
+    authz = auth.RuleAuthorizer([
+        auth.Rule(subjects=("system:masters",)),               # full access
+        auth.Rule(subjects=("readers",), verbs=auth.READ_VERBS),
+    ])
+    srv = APIServer(store, authn=authn, authz=authz).start()
+    try:
+        admin = RestClient(srv.url, token="admin-token")
+        viewer = RestClient(srv.url, token="viewer-token")
+        anon = RestClient(srv.url)
+        bad = RestClient(srv.url, token="wrong")
+
+        admin.create(make_pod("p").obj())
+
+        # viewer: reads OK, writes 403
+        assert viewer.get("Pod", "p").meta.name == "p"
+        assert len(viewer.list("Pod")[0]) == 1
+        with pytest.raises(RuntimeError):
+            viewer.delete("Pod", "p")
+        with pytest.raises(RuntimeError):
+            viewer.create(make_pod("q").obj())
+        with pytest.raises(RuntimeError):
+            viewer.patch("Pod", "p", {"meta": {"labels": {"a": "b"}}})
+
+        # no/unknown token: 401 on everything
+        with pytest.raises(RuntimeError):
+            anon.get("Pod", "p")
+        with pytest.raises(RuntimeError):
+            bad.list("Pod")
+
+        # the store is untouched by rejected writes
+        assert store.get("Pod", "p").meta.labels == {}
+    finally:
+        srv.stop()
+
+
+def test_cli_patch_and_selector(server):
+    srv, store, client = server
+    from kubernetes_tpu import cli
+
+    store.create(make_pod("p").labels(app="web").obj())
+    cli.main([
+        "--server", srv.url, "patch", "pod", "p",
+        "-p", '{"status": {"phase": "Running"}}', "--subresource", "status",
+    ])
+    assert store.get("Pod", "p").status.phase == "Running"
+    cli.main(["--server", srv.url, "get", "pods", "-l", "app=web"])
+
+
+def test_label_selector_double_equals(server):
+    srv, store, client = server
+    store.create(make_pod("p").labels(app="web").obj())
+    items, _ = client.list("Pod", label_selector="app==web")
+    assert {o.meta.name for o in items} == {"p"}

@@ -235,3 +235,19 @@ def test_journal_compaction_bounds_growth(tmp_path):
     # state survives compaction
     s2 = st.Store(journal_path=path)
     assert s2.get("Lease", "l", "kube-system").spec.renew_time >= 2999
+
+
+def test_journal_structurally_corrupt_line_skipped(tmp_path):
+    """A mid-file line that parses as JSON but lost its record shape
+    must be skipped like byte corruption, not crash Store startup
+    (review finding r4)."""
+    path = str(tmp_path / "j.jsonl")
+    s1 = st.Store(journal_path=path)
+    s1.create(make_pod("a").obj())
+    s1.create(make_pod("b").obj())
+    lines = open(path, "rb").read().splitlines(keepends=True)
+    lines[0] = b"42\n"  # valid JSON, not a record
+    with open(path, "wb") as f:
+        f.writelines(lines)
+    s2 = st.Store(journal_path=path)  # must not raise
+    assert {p.meta.name for p in s2.list("Pod")[0]} == {"b"}
