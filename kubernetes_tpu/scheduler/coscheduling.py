@@ -1,0 +1,75 @@
+"""Coscheduling at Permit — gangs held in the waiting-pods map.
+
+The out-of-tree coscheduling plugin's real mechanism: each gang member
+returns Wait at Permit; when the last member arrives, the plugin walks
+the waiting map and Allows the whole group; a timeout rejects the
+stragglers and the group retries.  Our queue already stages gangs
+pre-solve (SchedulingQueue gang staging) — this plugin is the
+alternative hold point for groups whose size is declared out-of-band
+(no scheduling_group_size on the pods), and the proof that the Permit
+seam carries the protocol real plugins need.
+
+Usage:
+    cos = CoschedulingPermit(scheduler.waiting, sizes={"my-gang": 4})
+    for fwk in scheduler.profiles:
+        fwk.register("permit", cos.permit)
+
+Release is quorum-of-currently-waiting: a member that times out and
+requeues re-enters Permit on its retry, so stale arrivals can never
+release a partial gang.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from ..api import types as api
+from .waitingpods import WaitingPodsMap
+
+DEFAULT_PERMIT_TIMEOUT = 30.0
+
+
+class CoschedulingPermit:
+    def __init__(
+        self,
+        waiting: WaitingPodsMap,
+        sizes: Optional[Dict[str, int]] = None,
+        timeout: float = DEFAULT_PERMIT_TIMEOUT,
+    ):
+        self.waiting = waiting
+        self.sizes = dict(sizes or {})
+        self.timeout = timeout
+        self._lock = threading.Lock()
+
+    def group_of(self, pod: api.Pod) -> Optional[str]:
+        g = pod.spec.scheduling_group
+        return g if g in self.sizes else None
+
+    def _waiting_members(self, namespace: str, group: str):
+        """Members of (namespace, group) CURRENTLY parked at Permit.
+        Release decisions read the live waiting map, never an arrival
+        history — a member that timed out and was requeued must not
+        count toward the quorum (it will re-enter Permit on retry), and
+        same-named gangs in different namespaces must not pool."""
+        return [
+            wp for wp in self.waiting.iterate()
+            if wp.pod.spec.scheduling_group == group
+            and wp.pod.meta.namespace == namespace
+        ]
+
+    def permit(self, pod: api.Pod, node: str):
+        """The Permit plugin callable: Wait until the declared member
+        count is simultaneously parked at Permit, then Allow the whole
+        group (this pod itself returns allow — it never enters the
+        map)."""
+        group = self.group_of(pod)
+        if group is None:
+            return "allow", 0.0
+        with self._lock:
+            parked = self._waiting_members(pod.meta.namespace, group)
+            if len(parked) + 1 < self.sizes[group]:
+                return "wait", self.timeout
+            for wp in parked:
+                wp.allow()
+            return "allow", 0.0

@@ -52,6 +52,11 @@ class Framework:
         # when a placement is abandoned after filter_result accepted it
         # (assume failure, PreBind error, bind conflict)
         self.unreserve: List[Callable[[api.Pod], None]] = []
+        # Permit (interface.go:330-666): each plugin returns
+        # ("allow" | "reject" | "wait", timeout_seconds); any reject
+        # wins, any wait parks the pod in the waiting map and its
+        # binding thread blocks in WaitOnPermit (schedule_one.go:278)
+        self.permit: List[Callable[[api.Pod, str], tuple]] = []
 
     @property
     def scheduler_name(self) -> str:
@@ -102,6 +107,32 @@ class Framework:
                 fn(pod)
             except Exception:
                 pass  # rollback must not mask the original failure
+
+    def run_permit(self, pod: api.Pod, node: str) -> tuple:
+        """Combined Permit verdict: ("allow"|"reject"|"wait", timeout).
+        Reject short-circuits; wait accumulates the LONGEST requested
+        timeout (RunPermitPlugins, runtime/framework.go).  A plugin
+        exception is a reject (the reference turns plugin errors into a
+        non-success Status) — letting it propagate after cache.assume
+        would leak the assumed capacity forever."""
+        import logging
+
+        verdict, timeout = "allow", 0.0
+        for fn in self.permit:
+            try:
+                v, t = fn(pod, node)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "permit plugin %r failed for %s/%s; rejecting",
+                    fn, pod.meta.namespace, pod.meta.name,
+                )
+                return "reject", 0.0
+            if v == "reject":
+                return "reject", 0.0
+            if v == "wait":
+                verdict = "wait"
+                timeout = max(timeout, float(t))
+        return verdict, timeout
 
 
 class FrameworkRegistry:

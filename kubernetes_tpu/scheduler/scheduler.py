@@ -43,6 +43,7 @@ from .framework import Framework, FrameworkRegistry
 from .metrics import Registry
 from .preemption import PreemptionEvaluator
 from .queue import QueuedPodInfo, SchedulingQueue, pod_key
+from .waitingpods import WaitingPod, WaitingPodsMap
 
 
 _REASON_TEXT = {
@@ -94,6 +95,9 @@ class Scheduler:
             clock=clock,
         )
         self.metrics = Registry()
+        # pods parked at Permit (waiting_pods_map.go); coscheduling-style
+        # plugins Allow/Reject through this map
+        self.waiting = WaitingPodsMap()
         self.events = EventRecorder(store, component="default-scheduler")
         self.preemption = PreemptionEvaluator(
             self.tpu, self.cache, store, self.metrics
@@ -389,31 +393,92 @@ class Scheduler:
                 self.metrics.schedule_attempts.inc("error")
                 self.queue.requeue_backoff(info)
                 continue
-            try:
-                fwk.run_pre_bind(info.pod, node_name)
-                self._bind(info.pod, node_name)
-            except Exception:
+            # Permit (schedule_one.go:231): reject aborts; wait parks
+            # the pod in the waiting map and the binding runs on its own
+            # thread blocking in WaitOnPermit (:278) — the scheduling
+            # loop moves on, like the reference's async bindingCycle
+            verdict, timeout = fwk.run_permit(info.pod, node_name)
+            if verdict == "reject":
                 self.cache.forget(info.pod)
                 fwk.run_unreserve(info.pod)
-                stats["bind_errors"] += 1
-                self.metrics.schedule_attempts.inc("error")
+                stats["unschedulable"] += 1
+                self.metrics.schedule_attempts.inc("unschedulable")
+                self.events.eventf(
+                    info.pod, "Warning", "FailedScheduling",
+                    f"permit rejected on node {node_name}",
+                )
                 self.queue.requeue_backoff(info)
                 continue
-            fwk.run_post_bind(info.pod, node_name)
+            if verdict == "wait":
+                wp = WaitingPod(info.pod, node_name, timeout)
+                self.waiting.add(wp)
+                t = threading.Thread(
+                    target=self._binding_cycle_async,
+                    args=(fwk, info, node_name, wp, t_attempt),
+                    name=f"bind-{info.pod.meta.name}",
+                    daemon=True,
+                )
+                t.start()
+                stats["waiting"] = stats.get("waiting", 0) + 1
+                continue
+            if not self._bind_tail(fwk, info, node_name, t_attempt, stats):
+                continue
+
+    def _bind_tail(
+        self, fwk, info, node_name, t_attempt, stats
+    ) -> bool:
+        """PreBind -> bind -> PostBind with failure containment; the
+        synchronous tail of the binding cycle."""
+        try:
+            fwk.run_pre_bind(info.pod, node_name)
+            self._bind(info.pod, node_name)
+        except Exception:
+            self.cache.forget(info.pod)
+            fwk.run_unreserve(info.pod)
+            stats["bind_errors"] += 1
+            self.metrics.schedule_attempts.inc("error")
+            self.queue.requeue_backoff(info)
+            return False
+        fwk.run_post_bind(info.pod, node_name)
+        self.events.eventf(
+            info.pod, "Normal", "Scheduled",
+            f"Successfully assigned {pod_key(info.pod)} to {node_name}",
+        )
+        self.cache.finish_binding(info.pod)
+        self.queue.done(info.pod)
+        stats["scheduled"] += 1
+        self.metrics.schedule_attempts.inc("scheduled")
+        self.metrics.scheduling_attempt_duration.observe(
+            self._clock() - t_attempt
+        )
+        self.metrics.pod_scheduling_sli_duration.observe(
+            self._clock() - info.initial_attempt_timestamp
+        )
+        return True
+
+    def _binding_cycle_async(
+        self, fwk, info, node_name, wp, t_attempt
+    ) -> None:
+        """WaitOnPermit then the bind tail, on a binding thread
+        (schedule_one.go:118's goroutine).  Rejection/timeout forgets the
+        assume, rolls back reservations, and requeues with backoff."""
+        try:
+            verdict = wp.wait()
+        finally:
+            self.waiting.remove(info.pod)
+        if verdict != "allow":
+            self.cache.forget(info.pod)
+            fwk.run_unreserve(info.pod)
+            self.metrics.schedule_attempts.inc("unschedulable")
             self.events.eventf(
-                info.pod, "Normal", "Scheduled",
-                f"Successfully assigned {pod_key(info.pod)} to {node_name}",
+                info.pod, "Warning", "FailedScheduling",
+                f"permit {verdict} on node {node_name}",
             )
-            self.cache.finish_binding(info.pod)
-            self.queue.done(info.pod)
-            stats["scheduled"] += 1
-            self.metrics.schedule_attempts.inc("scheduled")
-            self.metrics.scheduling_attempt_duration.observe(
-                self._clock() - t_attempt
-            )
-            self.metrics.pod_scheduling_sli_duration.observe(
-                self._clock() - info.initial_attempt_timestamp
-            )
+            self.queue.requeue_backoff(info)
+            return
+        self._bind_tail(fwk, info, node_name, t_attempt, {
+            "bind_errors": 0, "scheduled": 0,
+        })
 
     def _volume_reserve_plugin(
         self, pod: api.Pod, node_name: str

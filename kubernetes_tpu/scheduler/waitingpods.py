@@ -1,0 +1,86 @@
+"""The waiting-pods map — Permit's asynchronous half.
+
+Reference: pkg/scheduler/framework/runtime/waiting_pods_map.go + the
+Permit extension point (framework/interface.go:330-666): a Permit
+plugin may return Wait with a timeout; the pod parks in the waiting map
+while its binding goroutine blocks in WaitOnPermit
+(schedule_one.go:278).  Any plugin may later Allow or Reject it; the
+timeout rejects.  This is the extension point real coscheduling
+plugins are built on (scheduler/coscheduling.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..api import types as api
+from .queue import pod_key
+
+
+class WaitingPod:
+    """One pod parked at Permit (waitingPod, waiting_pods_map.go:52)."""
+
+    def __init__(self, pod: api.Pod, node: str, timeout: float):
+        self.pod = pod
+        self.node = node
+        self.deadline = time.monotonic() + timeout
+        self._done = threading.Event()
+        self._verdict: Optional[str] = None  # "allow" | reason string
+
+    def allow(self) -> None:
+        self._verdict = "allow"
+        self._done.set()
+
+    def reject(self, reason: str = "rejected") -> None:
+        if self._verdict is None:
+            self._verdict = reason
+        self._done.set()
+
+    def wait(self) -> str:
+        """Block until Allow/Reject/timeout (WaitOnPermit); returns
+        "allow" or the rejection reason ("timeout" when the permit
+        window lapsed)."""
+        remaining = self.deadline - time.monotonic()
+        if not self._done.wait(timeout=max(remaining, 0)):
+            self.reject("timeout")
+        return self._verdict or "rejected"
+
+
+class WaitingPodsMap:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pods: Dict[str, WaitingPod] = {}
+
+    def add(self, wp: WaitingPod) -> None:
+        with self._lock:
+            self._pods[pod_key(wp.pod)] = wp
+
+    def remove(self, pod: api.Pod) -> None:
+        with self._lock:
+            self._pods.pop(pod_key(pod), None)
+
+    def get(self, pod: api.Pod) -> Optional[WaitingPod]:
+        with self._lock:
+            return self._pods.get(pod_key(pod))
+
+    def iterate(self) -> List[WaitingPod]:
+        """Snapshot of the currently-waiting pods (IterateOverWaitingPods
+        — what coscheduling plugins walk to release a whole group)."""
+        with self._lock:
+            return list(self._pods.values())
+
+    def allow(self, pod: api.Pod) -> bool:
+        wp = self.get(pod)
+        if wp is None:
+            return False
+        wp.allow()
+        return True
+
+    def reject(self, pod: api.Pod, reason: str = "rejected") -> bool:
+        wp = self.get(pod)
+        if wp is None:
+            return False
+        wp.reject(reason)
+        return True

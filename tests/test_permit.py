@@ -1,0 +1,184 @@
+"""Permit / WaitOnPermit + the waiting-pods map, and coscheduling held
+at Permit.
+
+References: framework/runtime/waiting_pods_map.go, the Permit extension
+point (framework/interface.go:330-666), schedule_one.go:231 (RunPermit)
+and :278 (WaitOnPermit in the async binding cycle).
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import store as st
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.scheduler.coscheduling import CoschedulingPermit
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.scheduler.waitingpods import WaitingPod, WaitingPodsMap
+from kubernetes_tpu.testing.wrappers import GI, MI, make_node, make_pod
+
+
+def _mk_scheduler(store):
+    s = Scheduler(store)
+    s.start()  # informers + the scheduling loop (Permit needs the loop)
+    return s
+
+
+def _wait(cond, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_waiting_pod_allow_reject_timeout():
+    wp = WaitingPod(make_pod("p").obj(), "n0", timeout=5)
+    wp.allow()
+    assert wp.wait() == "allow"
+    wp2 = WaitingPod(make_pod("q").obj(), "n0", timeout=5)
+    wp2.reject("custom")
+    assert wp2.wait() == "custom"
+    wp3 = WaitingPod(make_pod("r").obj(), "n0", timeout=0.05)
+    assert wp3.wait() == "timeout"
+
+
+def test_permit_wait_blocks_bind_until_allow():
+    store = st.Store()
+    store.create(make_node("n0").capacity(cpu_milli=4000, pods=10).obj())
+    sched = _mk_scheduler(store)
+    sched.profiles.default.register(
+        "permit", lambda pod, node: ("wait", 10.0)
+    )
+    try:
+        store.create(make_pod("p").req(cpu_milli=100).obj())
+        assert _wait(lambda: sched.waiting.get(
+            store.get("Pod", "p")
+        ) is not None, timeout=30)
+        # parked at Permit: bind has NOT happened
+        time.sleep(0.3)
+        assert not store.get("Pod", "p").spec.node_name
+        assert sched.waiting.allow(store.get("Pod", "p"))
+        assert _wait(lambda: store.get("Pod", "p").spec.node_name == "n0")
+    finally:
+        sched.stop()
+
+
+def test_permit_reject_requeues():
+    store = st.Store()
+    store.create(make_node("n0").capacity(cpu_milli=4000, pods=10).obj())
+    sched = _mk_scheduler(store)
+    verdicts = iter([("reject", 0.0)])
+    sched.profiles.default.register(
+        "permit",
+        lambda pod, node: next(verdicts, ("allow", 0.0)),
+    )
+    try:
+        store.create(make_pod("p").req(cpu_milli=100).obj())
+        # first attempt rejected; the retry (permit now allows) binds
+        assert _wait(lambda: store.get("Pod", "p").spec.node_name == "n0",
+                     timeout=30)
+    finally:
+        sched.stop()
+
+
+def test_permit_timeout_requeues_and_retries():
+    store = st.Store()
+    store.create(make_node("n0").capacity(cpu_milli=4000, pods=10).obj())
+    sched = _mk_scheduler(store)
+    calls = {"n": 0}
+
+    def permit(pod, node):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return "wait", 0.2  # nobody allows: times out
+        return "allow", 0.0
+
+    sched.profiles.default.register("permit", permit)
+    try:
+        store.create(make_pod("p").req(cpu_milli=100).obj())
+        assert _wait(lambda: store.get("Pod", "p").spec.node_name == "n0",
+                     timeout=30)
+        assert calls["n"] >= 2
+    finally:
+        sched.stop()
+
+
+def test_coscheduling_gang_holds_at_permit():
+    """Members of an out-of-band-declared gang wait at Permit; the last
+    arrival releases the whole group atomically."""
+    store = st.Store()
+    for i in range(4):
+        store.create(
+            make_node(f"n{i}").capacity(cpu_milli=2000, pods=10).obj()
+        )
+    sched = _mk_scheduler(store)
+    cos = CoschedulingPermit(sched.waiting, sizes={"band": 3}, timeout=30)
+    for fwk in sched.profiles:
+        fwk.register("permit", cos.permit)
+    try:
+        # two members arrive: both park at Permit, neither binds
+        for i in range(2):
+            p = make_pod(f"g{i}").req(cpu_milli=500).obj()
+            p.spec.scheduling_group = "band"  # no size: queue won't stage
+            store.create(p)
+        assert _wait(
+            lambda: len([
+                wp for wp in sched.waiting.iterate()
+                if wp.pod.spec.scheduling_group == "band"
+            ]) == 2,
+            timeout=30,
+        )
+        time.sleep(0.3)
+        assert all(
+            not store.get("Pod", f"g{i}").spec.node_name for i in range(2)
+        )
+        # the third member completes the gang: everyone binds
+        p = make_pod("g2").req(cpu_milli=500).obj()
+        p.spec.scheduling_group = "band"
+        store.create(p)
+        assert _wait(
+            lambda: all(
+                store.get("Pod", f"g{i}").spec.node_name for i in range(3)
+            ),
+            timeout=30,
+        )
+    finally:
+        sched.stop()
+
+
+def test_coscheduling_gangs_namespaced():
+    """Same-named gangs in different namespaces must not pool toward one
+    quorum (review finding r4)."""
+    store = st.Store()
+    for i in range(6):
+        store.create(
+            make_node(f"n{i}").capacity(cpu_milli=2000, pods=10).obj()
+        )
+    sched = _mk_scheduler(store)
+    cos = CoschedulingPermit(sched.waiting, sizes={"workers": 2}, timeout=30)
+    for fwk in sched.profiles:
+        fwk.register("permit", cos.permit)
+    try:
+        # one member in each namespace: two half-gangs, no quorum
+        for ns in ("team-a", "team-b"):
+            p = make_pod("w0", namespace=ns).req(cpu_milli=500).obj()
+            p.spec.scheduling_group = "workers"
+            store.create(p)
+        assert _wait(lambda: len(sched.waiting.iterate()) == 2, timeout=30)
+        time.sleep(0.3)
+        for ns in ("team-a", "team-b"):
+            assert not store.get("Pod", "w0", ns).spec.node_name
+        # team-a's second member completes ONLY team-a's gang
+        p = make_pod("w1", namespace="team-a").req(cpu_milli=500).obj()
+        p.spec.scheduling_group = "workers"
+        store.create(p)
+        assert _wait(
+            lambda: store.get("Pod", "w0", "team-a").spec.node_name
+            and store.get("Pod", "w1", "team-a").spec.node_name,
+            timeout=30,
+        )
+        assert not store.get("Pod", "w0", "team-b").spec.node_name
+    finally:
+        sched.stop()
