@@ -256,3 +256,168 @@ def job_from_dict(d: Dict[str, Any]) -> api.Job:
             backoff_limit=int(spec.get("backoffLimit", 6)),
         ),
     )
+
+
+def _meta_from_dict(d: Dict[str, Any], namespace="default") -> api.ObjectMeta:
+    meta = d.get("metadata") or {}
+    return api.ObjectMeta(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", namespace),
+        labels=dict(meta.get("labels") or {}),
+        annotations=dict(meta.get("annotations") or {}),
+    )
+
+
+def statefulset_from_dict(d: Dict[str, Any]) -> api.StatefulSet:
+    spec = d.get("spec") or {}
+    return api.StatefulSet(
+        meta=_meta_from_dict(d),
+        spec=api.StatefulSetSpec(
+            replicas=int(spec.get("replicas", 1)),
+            selector=_label_selector(spec.get("selector")) or api.LabelSelector(),
+            template=_pod_template_from_dict(spec.get("template") or {}),
+            service_name=spec.get("serviceName", ""),
+            pod_management_policy=spec.get("podManagementPolicy", "OrderedReady"),
+            volume_claim_templates=[
+                pvc_from_dict(t) for t in spec.get("volumeClaimTemplates") or []
+            ],
+        ),
+    )
+
+
+def daemonset_from_dict(d: Dict[str, Any]) -> api.DaemonSet:
+    spec = d.get("spec") or {}
+    return api.DaemonSet(
+        meta=_meta_from_dict(d),
+        spec=api.DaemonSetSpec(
+            selector=_label_selector(spec.get("selector")) or api.LabelSelector(),
+            template=_pod_template_from_dict(spec.get("template") or {}),
+        ),
+    )
+
+
+def cronjob_from_dict(d: Dict[str, Any]) -> api.CronJob:
+    spec = d.get("spec") or {}
+    job_tpl = (spec.get("jobTemplate") or {}).get("spec") or {}
+    return api.CronJob(
+        meta=_meta_from_dict(d),
+        spec=api.CronJobSpec(
+            schedule=spec.get("schedule", "* * * * *"),
+            suspend=bool(spec.get("suspend", False)),
+            concurrency_policy=spec.get("concurrencyPolicy", "Allow"),
+            starting_deadline_seconds=(
+                float(spec["startingDeadlineSeconds"])
+                if "startingDeadlineSeconds" in spec else None
+            ),
+            job_template=api.JobSpec(
+                parallelism=int(job_tpl.get("parallelism", 1)),
+                completions=(
+                    int(job_tpl["completions"])
+                    if "completions" in job_tpl else 1
+                ),
+                template=_pod_template_from_dict(job_tpl.get("template") or {}),
+                backoff_limit=int(job_tpl.get("backoffLimit", 6)),
+            ),
+        ),
+    )
+
+
+def pvc_from_dict(d: Dict[str, Any]) -> api.PersistentVolumeClaim:
+    spec = d.get("spec") or {}
+    storage = parse_quantity(
+        ((spec.get("resources") or {}).get("requests") or {}).get("storage", 0)
+    )
+    return api.PersistentVolumeClaim(
+        meta=_meta_from_dict(d),
+        spec=api.PersistentVolumeClaimSpec(
+            access_modes=list(spec.get("accessModes") or []),
+            storage_class_name=spec.get("storageClassName", ""),
+            resources={api.STORAGE: storage} if storage else {},
+            volume_name=spec.get("volumeName", ""),
+        ),
+    )
+
+
+def pv_from_dict(d: Dict[str, Any]) -> api.PersistentVolume:
+    spec = d.get("spec") or {}
+    affinity = None
+    na = (spec.get("nodeAffinity") or {}).get("required")
+    if na:
+        affinity = api.NodeSelector(
+            terms=[
+                _node_selector_term(t)
+                for t in na.get("nodeSelectorTerms") or []
+            ]
+        )
+    storage = parse_quantity((spec.get("capacity") or {}).get("storage", 0))
+    csi = spec.get("csi") or {}
+    return api.PersistentVolume(
+        meta=_meta_from_dict(d, namespace=""),
+        spec=api.PersistentVolumeSpec(
+            capacity={api.STORAGE: storage} if storage else {},
+            access_modes=list(spec.get("accessModes") or []),
+            storage_class_name=spec.get("storageClassName", ""),
+            node_affinity=affinity,
+            driver=csi.get("driver", ""),
+        ),
+    )
+
+
+def storageclass_from_dict(d: Dict[str, Any]) -> api.StorageClass:
+    topo = None
+    allowed = d.get("allowedTopologies")
+    if allowed:
+        terms = []
+        for entry in allowed:
+            exprs = [
+                api.Requirement(
+                    e.get("key", ""), api.OP_IN, list(e.get("values") or [])
+                )
+                for e in entry.get("matchLabelExpressions") or []
+            ]
+            terms.append(api.NodeSelectorTerm(match_expressions=exprs))
+        topo = api.NodeSelector(terms=terms)
+    return api.StorageClass(
+        meta=_meta_from_dict(d, namespace=""),
+        provisioner=d.get("provisioner", ""),
+        volume_binding_mode=d.get("volumeBindingMode", api.VOLUME_BINDING_IMMEDIATE),
+        allowed_topologies=topo,
+    )
+
+
+def pdb_from_dict(d: Dict[str, Any]) -> api.PodDisruptionBudget:
+    spec = d.get("spec") or {}
+    return api.PodDisruptionBudget(
+        meta=_meta_from_dict(d),
+        spec=api.PodDisruptionBudgetSpec(
+            selector=_label_selector(spec.get("selector")),
+            min_available=(
+                int(spec["minAvailable"]) if "minAvailable" in spec else None
+            ),
+            max_unavailable=(
+                int(spec["maxUnavailable"])
+                if "maxUnavailable" in spec else None
+            ),
+        ),
+    )
+
+
+def namespace_from_dict(d: Dict[str, Any]) -> api.Namespace:
+    return api.Namespace(meta=_meta_from_dict(d, namespace=""))
+
+
+# kind -> converter, the CLI's `create -f` dispatch table
+CONVERTERS = {
+    "Node": node_from_dict,
+    "Pod": pod_from_dict,
+    "Deployment": deployment_from_dict,
+    "Job": job_from_dict,
+    "StatefulSet": statefulset_from_dict,
+    "DaemonSet": daemonset_from_dict,
+    "CronJob": cronjob_from_dict,
+    "PersistentVolume": pv_from_dict,
+    "PersistentVolumeClaim": pvc_from_dict,
+    "StorageClass": storageclass_from_dict,
+    "PodDisruptionBudget": pdb_from_dict,
+    "Namespace": namespace_from_dict,
+}

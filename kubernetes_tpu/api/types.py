@@ -799,6 +799,84 @@ class Job:
     KIND = "Job"
 
 
+@dataclass
+class StatefulSetSpec:
+    """apps/v1 StatefulSetSpec: ordered, identity-stable replicas with
+    per-replica volume claims."""
+
+    replicas: int = 1
+    selector: LabelSelector = field(default_factory=LabelSelector)
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    service_name: str = ""
+    # one PVC per (template, ordinal): claim "<tpl>-<set>-<i>"
+    volume_claim_templates: List["PersistentVolumeClaim"] = field(
+        default_factory=list
+    )
+    pod_management_policy: str = "OrderedReady"  # or "Parallel"
+
+
+@dataclass
+class StatefulSetStatus:
+    replicas: int = 0
+    ready_replicas: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class StatefulSet:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: StatefulSetSpec = field(default_factory=StatefulSetSpec)
+    status: StatefulSetStatus = field(default_factory=StatefulSetStatus)
+
+    KIND = "StatefulSet"
+
+
+@dataclass
+class DaemonSetSpec:
+    selector: LabelSelector = field(default_factory=LabelSelector)
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class DaemonSetStatus:
+    desired_number_scheduled: int = 0
+    current_number_scheduled: int = 0
+    number_ready: int = 0
+
+
+@dataclass
+class DaemonSet:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: DaemonSetSpec = field(default_factory=DaemonSetSpec)
+    status: DaemonSetStatus = field(default_factory=DaemonSetStatus)
+
+    KIND = "DaemonSet"
+
+
+@dataclass
+class CronJobSpec:
+    schedule: str = "* * * * *"       # standard 5-field cron
+    job_template: JobSpec = field(default_factory=JobSpec)
+    suspend: bool = False
+    concurrency_policy: str = "Allow"  # Allow | Forbid | Replace
+    starting_deadline_seconds: Optional[float] = None
+
+
+@dataclass
+class CronJobStatus:
+    last_schedule_time: Optional[float] = None
+    active: List[str] = field(default_factory=list)  # job names
+
+
+@dataclass
+class CronJob:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: CronJobSpec = field(default_factory=CronJobSpec)
+    status: CronJobStatus = field(default_factory=CronJobStatus)
+
+    KIND = "CronJob"
+
+
 def clone(obj):
     """Deep copy an API object (the reference's generated DeepCopy)."""
     return dataclasses.replace(

@@ -115,16 +115,10 @@ def cmd_create(client: RestClient, args) -> None:
         if not d:
             continue
         kind = d.get("kind", "Pod")
-        converters = {
-            "Node": kubeyaml.node_from_dict,
-            "Pod": kubeyaml.pod_from_dict,
-            "Deployment": kubeyaml.deployment_from_dict,
-            "Job": kubeyaml.job_from_dict,
-        }
-        conv = converters.get(kind)
+        conv = kubeyaml.CONVERTERS.get(kind)
         if conv is None:
             raise SystemExit(
-                f"create -f supports {sorted(converters)}; got {kind}"
+                f"create -f supports {sorted(kubeyaml.CONVERTERS)}; got {kind}"
             )
         created = client.create(conv(d))
         print(f"{kind.lower()}/{created.meta.name} created")

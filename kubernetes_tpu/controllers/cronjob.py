@@ -1,0 +1,211 @@
+"""CronJob controller: Jobs on a cron schedule.
+
+Reference: pkg/controller/cronjob/cronjob_controllerv2.go — each sync
+computes the schedule's most recent fire time since lastScheduleTime;
+if one is due, a Job named <cron>-<unix-minute> is created subject to
+the concurrency policy (Allow runs overlap, Forbid skips while one is
+active, Replace deletes the running one first).  startingDeadlineSeconds
+bounds how stale a missed fire may be and still run.  The cron grammar
+is the standard 5-field subset: `*`, `*/step`, lists, ranges.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, NamedTuple, Optional
+
+from ..api import store as st
+from ..api import types as api
+from .base import Controller, split_key
+
+_FIELDS = (  # (min, max) per cron field
+    (0, 59),   # minute
+    (0, 23),   # hour
+    (1, 31),   # day of month
+    (1, 12),   # month
+    (0, 6),    # day of week (0 = Sunday)
+)
+
+
+class CronSchedule(NamedTuple):
+    fields: List[set]
+    dom_any: bool  # day-of-month field was "*"
+    dow_any: bool  # day-of-week field was "*"
+
+
+def parse_cron(expr: str) -> CronSchedule:
+    parts = expr.split()
+    if len(parts) != 5:
+        raise ValueError(f"cron {expr!r}: want 5 fields, got {len(parts)}")
+    out = []
+    for raw, (lo, hi) in zip(parts, _FIELDS):
+        allowed = set()
+        for piece in raw.split(","):
+            body, _, step_s = piece.partition("/")
+            step = int(step_s) if step_s else 1
+            if step <= 0:
+                raise ValueError(f"cron {expr!r}: step must be positive")
+            if body in ("*", ""):
+                start, end = lo, hi
+            elif "-" in body:
+                a, b = body.split("-", 1)
+                start, end = int(a), int(b)
+            else:
+                start = end = int(body)
+            if not (lo <= start <= end <= hi):
+                raise ValueError(f"cron {expr!r}: {piece!r} out of range")
+            allowed.update(range(start, end + 1, step))
+        out.append(allowed)
+    return CronSchedule(
+        out, dom_any=parts[2] == "*", dow_any=parts[4] == "*"
+    )
+
+
+def matches(sched: CronSchedule, t: float) -> bool:
+    fields = sched.fields
+    lt = time.localtime(t)
+    dow = (lt.tm_wday + 1) % 7  # tm_wday: Monday=0; cron: Sunday=0
+    dom_ok = lt.tm_mday in fields[2]
+    dow_ok = dow in fields[4]
+    # standard cron: when BOTH day fields are restricted, they OR
+    # (vixie-cron semantics — '0 0 13 * 5' fires the 13th OR Fridays)
+    if sched.dom_any or sched.dow_any:
+        day_ok = dom_ok and dow_ok
+    else:
+        day_ok = dom_ok or dow_ok
+    return (
+        lt.tm_min in fields[0]
+        and lt.tm_hour in fields[1]
+        and lt.tm_mon in fields[3]
+        and day_ok
+    )
+
+
+def most_recent_fire(
+    fields: CronSchedule, since: float, now: float
+) -> Optional[float]:
+    """The latest minute boundary in (since, now] matching the schedule
+    (getMostRecentScheduleTime).  Scans minute-by-minute, capped to a
+    day — a gap wider than that reports the newest match only, like the
+    reference's 'too many missed start times' clamp."""
+    start_min = int(since // 60) + 1
+    now_min = int(now // 60)
+    start_min = max(start_min, now_min - 24 * 60)
+    for m in range(now_min, start_min - 1, -1):
+        t = m * 60.0
+        if matches(fields, t):
+            return t
+    return None
+
+
+class CronJobController(Controller):
+    KIND = "CronJob"
+    RESYNC_SECONDS = 10.0
+
+    def __init__(self, store, informers, workers: int = 2, clock=time.time):
+        super().__init__(store, informers, workers=workers)
+        self.clock = clock
+
+    def register(self) -> None:
+        self.informers.informer("CronJob").add_handler(self._on_cron)
+        self.informers.informer("Job").add_handler(self._on_job)
+        self._tick_stop = threading.Event()
+        self._ticker = threading.Thread(
+            target=self._tick_loop, name="cronjob-ticker", daemon=True
+        )
+        self._ticker.start()
+
+    def stop(self) -> None:
+        if hasattr(self, "_tick_stop"):
+            self._tick_stop.set()
+        super().stop()
+
+    def _tick_loop(self) -> None:
+        # time-driven requeue: cron fires without object events
+        while not self._tick_stop.wait(self.RESYNC_SECONDS):
+            for cj in self.informers.informer("CronJob").list():
+                self.enqueue(cj)
+
+    def _on_cron(self, typ: str, obj, old) -> None:
+        if typ != st.DELETED:
+            self.enqueue(obj)
+
+    def _on_job(self, typ: str, job, old) -> None:
+        self.enqueue_owner(job, "CronJob")
+
+    def sync(self, key: str) -> None:
+        namespace, name = split_key(key)
+        try:
+            cj = self.store.get("CronJob", name, namespace)
+        except st.NotFound:
+            return
+        self._reap_finished_actives(cj)
+        if cj.spec.suspend:
+            return
+        fields = parse_cron(cj.spec.schedule)
+        now = self.clock()
+        since = cj.status.last_schedule_time or (now - 60)
+        fire = most_recent_fire(fields, since, now)
+        if fire is None:
+            return
+        deadline = cj.spec.starting_deadline_seconds
+        if deadline is not None and now - fire > deadline:
+            return  # missed too long ago (startingDeadlineSeconds)
+        active = self._active_jobs(cj)
+        if active:
+            if cj.spec.concurrency_policy == "Forbid":
+                return
+            if cj.spec.concurrency_policy == "Replace":
+                for j in active:
+                    try:
+                        self.store.delete("Job", j.meta.name, namespace)
+                    except st.NotFound:
+                        pass
+        job_name = f"{name}-{int(fire // 60)}"
+        job = api.Job(
+            meta=api.ObjectMeta(
+                name=job_name,
+                namespace=namespace,
+                owner_references=[
+                    api.OwnerReference(
+                        kind="CronJob", name=name,
+                        uid=cj.meta.uid, controller=True,
+                    )
+                ],
+            ),
+            spec=api.clone(cj.spec.job_template),
+        )
+        try:
+            self.store.create(job)
+        except st.AlreadyExists:
+            pass  # this fire time already ran
+        fresh = self.store.get("CronJob", name, namespace)
+        fresh.status.last_schedule_time = fire
+        if job_name not in fresh.status.active:
+            fresh.status.active.append(job_name)
+        self.store.update(fresh)
+
+    def _active_jobs(self, cj: api.CronJob) -> List[api.Job]:
+        out = []
+        for j in self.informers.informer("Job").list():
+            if j.meta.namespace != cj.meta.namespace:
+                continue
+            refs = [
+                r for r in j.meta.owner_references
+                if r.kind == "CronJob" and r.name == cj.meta.name
+            ]
+            if refs and j.status.completion_time is None:
+                out.append(j)
+        return out
+
+    def _reap_finished_actives(self, cj: api.CronJob) -> None:
+        still = [j.meta.name for j in self._active_jobs(cj)]
+        if set(cj.status.active) == set(still):
+            return
+        try:
+            fresh = self.store.get("CronJob", cj.meta.name, cj.meta.namespace)
+        except st.NotFound:
+            return
+        fresh.status.active = still
+        self.store.update(fresh)

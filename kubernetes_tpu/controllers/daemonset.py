@@ -1,0 +1,131 @@
+"""DaemonSet controller: one pod per eligible node.
+
+Reference: pkg/controller/daemon/daemon_controller.go — for every node
+passing the template's node selector and tolerating the node's
+NoSchedule taints, ensure exactly one daemon pod; nodes joining get a
+pod, nodes leaving lose theirs via the GC cascade.  Modern kubernetes
+routes daemon pods through the default scheduler with a per-node
+nodeAffinity; ours pins spec.node_name directly (the pre-1.12 behavior)
+— daemon pods are per-node by definition, so the placement decision is
+the eligibility check itself."""
+
+from __future__ import annotations
+
+from ..api import store as st
+from ..api import types as api
+from .base import Controller, split_key
+
+
+class DaemonSetController(Controller):
+    KIND = "DaemonSet"
+
+    def register(self) -> None:
+        self.informers.informer("DaemonSet").add_handler(self._on_ds)
+        self.informers.informer("Pod").add_handler(self._on_pod)
+        self.informers.informer("Node").add_handler(self._on_node)
+
+    def _on_ds(self, typ: str, obj, old) -> None:
+        if typ != st.DELETED:
+            self.enqueue(obj)
+
+    def _on_pod(self, typ: str, pod, old) -> None:
+        self.enqueue_owner(pod, "DaemonSet")
+
+    def _on_node(self, typ: str, node, old) -> None:
+        # only eligibility-relevant changes fan out — heartbeat status
+        # updates would otherwise enqueue every DaemonSet per node per
+        # interval (O(nodes x daemonsets) steady-state churn)
+        if typ == st.MODIFIED and old is not None:
+            if (
+                old.meta.labels == node.meta.labels
+                and old.spec.taints == node.spec.taints
+                and old.spec.unschedulable == node.spec.unschedulable
+            ):
+                return
+        for ds in self.informers.informer("DaemonSet").list():
+            self.enqueue(ds)
+
+    def _eligible(self, ds: api.DaemonSet, node: api.Node) -> bool:
+        tmpl = ds.spec.template.spec
+        for k, v in tmpl.node_selector.items():
+            if node.meta.labels.get(k) != v:
+                return False
+        tolerated = {
+            (t.key, t.value) for t in tmpl.tolerations
+        } | {(t.key, "") for t in tmpl.tolerations if t.op == api.OP_EXISTS}
+        for taint in node.effective_taints():
+            if taint.effect != api.NO_SCHEDULE:
+                continue
+            if (taint.key, taint.value) in tolerated:
+                continue
+            if any(
+                t.key == taint.key and t.op == api.OP_EXISTS
+                for t in tmpl.tolerations
+            ):
+                continue
+            return False
+        return True
+
+    def sync(self, key: str) -> None:
+        namespace, name = split_key(key)
+        try:
+            ds = self.store.get("DaemonSet", name, namespace)
+        except st.NotFound:
+            return  # GC cascades the pods
+        nodes = self.informers.informer("Node").list()
+        eligible = {n.meta.name for n in nodes if self._eligible(ds, n)}
+        pods = self.pods_owned_by(namespace, "DaemonSet", name)
+        by_node = {}
+        for p in pods:
+            by_node.setdefault(p.spec.node_name, []).append(p)
+
+        # delete pods on ineligible/vanished nodes + duplicates
+        for node_name, plist in by_node.items():
+            doomed = plist[1:] if node_name in eligible else plist
+            for p in doomed:
+                try:
+                    self.store.delete("Pod", p.meta.name, namespace)
+                except st.NotFound:
+                    pass
+        # create missing daemon pods
+        for node_name in sorted(eligible - set(by_node)):
+            template = api.clone(ds.spec.template)
+            pod = api.Pod(
+                meta=api.ObjectMeta(
+                    name=f"{name}-{node_name}",
+                    namespace=namespace,
+                    labels=dict(template.meta.labels),
+                    owner_references=[
+                        api.OwnerReference(
+                            kind="DaemonSet", name=name,
+                            uid=ds.meta.uid, controller=True,
+                        )
+                    ],
+                ),
+                spec=api.clone(template.spec),
+            )
+            pod.spec.node_name = node_name
+            try:
+                self.store.create(pod)
+            except st.AlreadyExists:
+                pass
+        self._write_status(ds, namespace, name, len(eligible))
+
+    def _write_status(self, ds, namespace, name, desired) -> None:
+        pods = self.pods_owned_by(namespace, "DaemonSet", name)
+        current = len(pods)
+        ready = sum(1 for p in pods if p.status.phase == "Running")
+        if (
+            ds.status.desired_number_scheduled == desired
+            and ds.status.current_number_scheduled == current
+            and ds.status.number_ready == ready
+        ):
+            return
+        try:
+            fresh = self.store.get("DaemonSet", name, namespace)
+        except st.NotFound:
+            return
+        fresh.status.desired_number_scheduled = desired
+        fresh.status.current_number_scheduled = current
+        fresh.status.number_ready = ready
+        self.store.update(fresh)

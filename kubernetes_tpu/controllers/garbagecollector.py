@@ -26,9 +26,11 @@ from .base import Controller, obj_key, split_key
 
 # kinds that can OWN dependents (watching these for deletes drives the
 # cascade; the orphan scan covers everything else)
-OWNER_KINDS = ("Deployment", "ReplicaSet", "Job")
+OWNER_KINDS = (
+    "Deployment", "ReplicaSet", "Job", "StatefulSet", "DaemonSet", "CronJob",
+)
 # kinds swept for dependents
-DEPENDENT_KINDS = ("ReplicaSet", "Pod")
+DEPENDENT_KINDS = ("ReplicaSet", "Job", "Pod")
 
 ORPHAN_ANNOTATION = "kubernetes.io/orphan"
 

@@ -13,12 +13,15 @@ from typing import Dict, List, Optional, Type
 from ..api import store as st
 from ..client.informers import InformerFactory
 from .base import Controller
+from .cronjob import CronJobController
+from .daemonset import DaemonSetController
 from .deployment import DeploymentController
 from .disruption import DisruptionController
 from .garbagecollector import GarbageCollector
 from .job import JobController
 from .namespace import NamespaceController
 from .replicaset import ReplicaSetController
+from .statefulset import StatefulSetController
 
 DEFAULT_CONTROLLERS: List[Type[Controller]] = [
     ReplicaSetController,
@@ -27,6 +30,9 @@ DEFAULT_CONTROLLERS: List[Type[Controller]] = [
     DisruptionController,
     GarbageCollector,
     NamespaceController,
+    StatefulSetController,
+    DaemonSetController,
+    CronJobController,
 ]
 
 
@@ -48,7 +54,7 @@ class ControllerManager:
         # informers for every kind any controller watches
         for kind in (
             "Pod", "ReplicaSet", "Deployment", "Job", "PodDisruptionBudget",
-            "Namespace",
+            "Namespace", "StatefulSet", "DaemonSet", "CronJob", "Node",
         ):
             self.informers.informer(kind).start()
         self.informers.wait_for_sync()

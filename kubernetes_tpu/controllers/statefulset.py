@@ -1,0 +1,183 @@
+"""StatefulSet controller: ordered, identity-stable replicas.
+
+Reference: pkg/controller/statefulset/stateful_set_control.go —
+replicas are named <set>-<ordinal>; OrderedReady creates ordinal i only
+once 0..i-1 are ready and scales down from the highest ordinal;
+volumeClaimTemplates materialize one PVC per (template, ordinal) that
+survives pod deletion (stable storage identity).  Parallel skips the
+ordering gate.  Rolling template updates are delete-and-recreate per
+ordinal, highest first, which preserves identity (our simplification of
+the partitioned RollingUpdate)."""
+
+from __future__ import annotations
+
+from ..api import store as st
+from ..api import types as api
+from .base import Controller, controller_owner, split_key
+from .deployment import template_hash
+
+
+class StatefulSetController(Controller):
+    KIND = "StatefulSet"
+
+    def register(self) -> None:
+        self.informers.informer("StatefulSet").add_handler(self._on_set)
+        self.informers.informer("Pod").add_handler(self._on_pod)
+
+    def _on_set(self, typ: str, obj, old) -> None:
+        if typ != st.DELETED:
+            self.enqueue(obj)
+
+    def _on_pod(self, typ: str, pod, old) -> None:
+        self.enqueue_owner(pod, "StatefulSet")
+
+    def _pod_name(self, set_name: str, i: int) -> str:
+        return f"{set_name}-{i}"
+
+    def sync(self, key: str) -> None:
+        namespace, name = split_key(key)
+        try:
+            sts = self.store.get("StatefulSet", name, namespace)
+        except st.NotFound:
+            return  # GC cascades the pods via ownerReferences
+        pods = {
+            p.meta.name: p
+            for p in self.pods_owned_by(namespace, "StatefulSet", name)
+        }
+        desired = sts.spec.replicas
+        rev = template_hash(sts.spec.template)
+        ordered = sts.spec.pod_management_policy != "Parallel"
+
+        # scale down: highest ordinal first, one at a time when ordered
+        extra = [
+            p for n, p in pods.items()
+            if self._ordinal(name, n) is not None
+            and self._ordinal(name, n) >= desired
+        ]
+        if extra:
+            victim = max(extra, key=lambda p: self._ordinal(name, p.meta.name))
+            self._delete_pod(victim)
+            return
+
+        # scale up / recreate missing ordinals FIRST; OrderedReady waits
+        # for predecessors before creating the next
+        complete = True
+        for i in range(desired):
+            pod_name = self._pod_name(name, i)
+            existing = pods.get(pod_name)
+            if existing is not None:
+                if ordered and not self._ready(existing):
+                    complete = False
+                    break  # wait for this ordinal before creating i+1
+                continue
+            self._create_claims(sts, i)
+            self._create_pod(sts, i, rev)
+            complete = False
+            if ordered:
+                break  # one ordinal per reconcile; readiness re-enqueues
+        # rolling update: only when every desired ordinal exists (and is
+        # ready, when ordered) delete ONE out-of-revision pod, highest
+        # ordinal first — each deletion is recreated and readied before
+        # the next ordinal is touched, so the set never loses more than
+        # one replica to the rollout (stateful_set_control.go's
+        # one-at-a-time update walk)
+        if complete:
+            stale = [
+                p for p in pods.values()
+                if p.meta.labels.get("statefulset-revision") != rev
+            ]
+            if stale:
+                victim = max(
+                    stale,
+                    key=lambda p: self._ordinal(name, p.meta.name) or 0,
+                )
+                self._delete_pod(victim)
+        self._write_status(sts, namespace, name)
+
+    @staticmethod
+    def _ordinal(set_name: str, pod_name: str):
+        prefix = f"{set_name}-"
+        if not pod_name.startswith(prefix):
+            return None
+        try:
+            return int(pod_name[len(prefix):])
+        except ValueError:
+            return None
+
+    @staticmethod
+    def _ready(pod: api.Pod) -> bool:
+        return bool(pod.spec.node_name) and pod.status.phase == "Running"
+
+    def _delete_pod(self, pod: api.Pod) -> None:
+        try:
+            self.store.delete("Pod", pod.meta.name, pod.meta.namespace)
+        except st.NotFound:
+            pass
+
+    def _create_claims(self, sts: api.StatefulSet, i: int) -> None:
+        """Per-ordinal PVCs ("<tpl>-<set>-<i>"): created once, NEVER
+        deleted with the pod — the stable-storage contract."""
+        for tpl in sts.spec.volume_claim_templates:
+            claim_name = f"{tpl.meta.name}-{sts.meta.name}-{i}"
+            pvc = api.clone(tpl)
+            pvc.meta.name = claim_name
+            pvc.meta.namespace = sts.meta.namespace
+            try:
+                self.store.create(pvc)
+            except st.AlreadyExists:
+                pass  # survives pod churn by design
+
+    def _create_pod(self, sts: api.StatefulSet, i: int, rev: str) -> None:
+        template = api.clone(sts.spec.template)
+        labels = dict(template.meta.labels)
+        labels["statefulset-revision"] = rev
+        pod = api.Pod(
+            meta=api.ObjectMeta(
+                name=self._pod_name(sts.meta.name, i),
+                namespace=sts.meta.namespace,
+                labels=labels,
+                owner_references=[
+                    api.OwnerReference(
+                        kind="StatefulSet", name=sts.meta.name,
+                        uid=sts.meta.uid, controller=True,
+                    )
+                ],
+            ),
+            spec=api.clone(template.spec),
+        )
+        # mount the per-ordinal claims
+        for tpl in sts.spec.volume_claim_templates:
+            pod.spec.volumes.append(
+                api.Volume(
+                    name=tpl.meta.name,
+                    persistent_volume_claim=(
+                        f"{tpl.meta.name}-{sts.meta.name}-{i}"
+                    ),
+                )
+            )
+        try:
+            self.store.create(pod)
+        except st.AlreadyExists:
+            pass
+
+    def _write_status(self, sts, namespace, name) -> None:
+        pods = self.pods_owned_by(namespace, "StatefulSet", name)
+        replicas = len(pods)
+        ready = sum(1 for p in pods if self._ready(p))
+        if (
+            sts.status.replicas == replicas
+            and sts.status.ready_replicas == ready
+            and sts.status.observed_generation == sts.meta.generation
+        ):
+            return
+        try:
+            fresh = self.store.get("StatefulSet", name, namespace)
+        except st.NotFound:
+            return
+        fresh.status.replicas = replicas
+        fresh.status.ready_replicas = ready
+        fresh.status.observed_generation = fresh.meta.generation
+        self.store.update(fresh)
+
+
+_ = controller_owner  # imported for parity with sibling controllers

@@ -62,7 +62,10 @@ class CoschedulingPermit:
         """The Permit plugin callable: Wait until the declared member
         count is simultaneously parked at Permit, then Allow the whole
         group (this pod itself returns allow — it never enters the
-        map)."""
+        map).  Release is two-phase (WaitingPod.try_claim then allow):
+        a member timing out between the quorum snapshot and the release
+        makes its claim fail, the claims roll back, and this pod waits —
+        a partial gang can never be allowed."""
         group = self.group_of(pod)
         if group is None:
             return "allow", 0.0
@@ -70,6 +73,11 @@ class CoschedulingPermit:
             parked = self._waiting_members(pod.meta.namespace, group)
             if len(parked) + 1 < self.sizes[group]:
                 return "wait", self.timeout
-            for wp in parked:
+            claimed = [wp for wp in parked if wp.try_claim()]
+            if len(claimed) + 1 < self.sizes[group]:
+                for wp in claimed:
+                    wp.release_claim()
+                return "wait", self.timeout
+            for wp in claimed:
                 wp.allow()
             return "allow", 0.0

@@ -29,6 +29,7 @@ pod.spec.scheduler_name -> Framework (frameworkForPod, scheduler.go:358
 
 from __future__ import annotations
 
+import logging
 from typing import Callable, Dict, List, Optional
 
 from ..api import types as api
@@ -115,8 +116,6 @@ class Framework:
         exception is a reject (the reference turns plugin errors into a
         non-success Status) — letting it propagate after cache.assume
         would leak the assumed capacity forever."""
-        import logging
-
         verdict, timeout = "allow", 0.0
         for fn in self.permit:
             try:

@@ -425,17 +425,21 @@ class Scheduler:
                 continue
 
     def _bind_tail(
-        self, fwk, info, node_name, t_attempt, stats
+        self, fwk, info, node_name, t_attempt, stats=None
     ) -> bool:
         """PreBind -> bind -> PostBind with failure containment; the
-        synchronous tail of the binding cycle."""
+        synchronous tail of the binding cycle.  stats is the calling
+        cycle's counter dict — None from binding threads, whose pods
+        completed after their cycle returned (the global metrics
+        Registry still records them)."""
         try:
             fwk.run_pre_bind(info.pod, node_name)
             self._bind(info.pod, node_name)
         except Exception:
             self.cache.forget(info.pod)
             fwk.run_unreserve(info.pod)
-            stats["bind_errors"] += 1
+            if stats is not None:
+                stats["bind_errors"] += 1
             self.metrics.schedule_attempts.inc("error")
             self.queue.requeue_backoff(info)
             return False
@@ -446,7 +450,8 @@ class Scheduler:
         )
         self.cache.finish_binding(info.pod)
         self.queue.done(info.pod)
-        stats["scheduled"] += 1
+        if stats is not None:
+            stats["scheduled"] += 1
         self.metrics.schedule_attempts.inc("scheduled")
         self.metrics.scheduling_attempt_duration.observe(
             self._clock() - t_attempt
@@ -476,9 +481,7 @@ class Scheduler:
             )
             self.queue.requeue_backoff(info)
             return
-        self._bind_tail(fwk, info, node_name, t_attempt, {
-            "bind_errors": 0, "scheduled": 0,
-        })
+        self._bind_tail(fwk, info, node_name, t_attempt)
 
     def _volume_reserve_plugin(
         self, pod: api.Pod, node_name: str

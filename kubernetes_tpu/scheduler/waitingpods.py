@@ -20,32 +20,72 @@ from .queue import pod_key
 
 
 class WaitingPod:
-    """One pod parked at Permit (waitingPod, waiting_pods_map.go:52)."""
+    """One pod parked at Permit (waitingPod, waiting_pods_map.go:52).
+
+    Decisions LATCH: the first of allow/reject/timeout wins and later
+    calls report whether they prevailed — the reference's
+    compare-and-swap on the waiting pod's status.  try_claim/allow/
+    release_claim give group releasers (coscheduling) a two-phase
+    commit: claim every member atomically, then finalize — so a member
+    timing out mid-release can never yield a partially-allowed gang."""
 
     def __init__(self, pod: api.Pod, node: str, timeout: float):
         self.pod = pod
         self.node = node
         self.deadline = time.monotonic() + timeout
         self._done = threading.Event()
+        self._mu = threading.Lock()
+        self._claimed = False
         self._verdict: Optional[str] = None  # "allow" | reason string
 
-    def allow(self) -> None:
-        self._verdict = "allow"
-        self._done.set()
+    def try_claim(self) -> bool:
+        """Atomically reserve the decision (phase 1 of a group release);
+        False when already decided or claimed."""
+        with self._mu:
+            if self._verdict is not None or self._claimed:
+                return False
+            self._claimed = True
+            return True
 
-    def reject(self, reason: str = "rejected") -> None:
-        if self._verdict is None:
-            self._verdict = reason
-        self._done.set()
+    def release_claim(self) -> None:
+        """Abort phase 1 — the pod returns to plain waiting."""
+        with self._mu:
+            self._claimed = False
+
+    def allow(self) -> bool:
+        """Finalize allow; True iff the pod ends allowed."""
+        with self._mu:
+            if self._verdict is None:
+                self._verdict = "allow"
+                self._claimed = False
+                self._done.set()
+            return self._verdict == "allow"
+
+    def reject(self, reason: str = "rejected") -> bool:
+        """Latch a rejection; False when already decided or a group
+        release holds the claim (the claimer's decision wins)."""
+        with self._mu:
+            if self._claimed:
+                return False
+            if self._verdict is None:
+                self._verdict = reason
+                self._done.set()
+            return self._verdict == reason
 
     def wait(self) -> str:
         """Block until Allow/Reject/timeout (WaitOnPermit); returns
         "allow" or the rejection reason ("timeout" when the permit
-        window lapsed)."""
-        remaining = self.deadline - time.monotonic()
-        if not self._done.wait(timeout=max(remaining, 0)):
-            self.reject("timeout")
-        return self._verdict or "rejected"
+        window lapsed).  A timeout racing an in-flight group claim
+        defers to the claimer's decision."""
+        while True:
+            remaining = self.deadline - time.monotonic()
+            if self._done.wait(timeout=max(remaining, 0)):
+                return self._verdict or "rejected"
+            if self.reject("timeout"):
+                return "timeout"
+            # claimed: the group release is deciding — wait it out
+            if self._done.wait(timeout=0.05):
+                return self._verdict or "rejected"
 
 
 class WaitingPodsMap:

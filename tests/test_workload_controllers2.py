@@ -1,0 +1,282 @@
+"""StatefulSet, DaemonSet, and CronJob controllers.
+
+References: pkg/controller/statefulset/stateful_set_control.go,
+pkg/controller/daemon/daemon_controller.go,
+pkg/controller/cronjob/cronjob_controllerv2.go.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import store as st
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.controllers.cronjob import (
+    matches,
+    most_recent_fire,
+    parse_cron,
+)
+from kubernetes_tpu.testing.wrappers import GI, MI, make_node, make_pod
+
+
+def _template(labels, cpu=100):
+    return api.PodTemplateSpec(
+        meta=api.ObjectMeta(name="", labels=dict(labels)),
+        spec=api.PodSpec(
+            containers=[
+                api.Container(
+                    name="c0", requests={api.CPU: cpu, api.MEMORY: 64 * MI}
+                )
+            ]
+        ),
+    )
+
+
+def _wait(cond, timeout=20.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _mark_running(store, names=None):
+    pods, _ = store.list("Pod")
+    for p in pods:
+        if names is not None and p.meta.name not in names:
+            continue
+        if not p.spec.node_name or p.status.phase != "Running":
+            p.spec.node_name = p.spec.node_name or "n0"
+            p.status.phase = "Running"
+            try:
+                store.update(p)
+            except (st.Conflict, st.NotFound):
+                pass
+
+
+@pytest.fixture
+def cm_store():
+    store = st.Store()
+    cm = ControllerManager(store).start()
+    yield cm, store
+    cm.stop()
+
+
+def test_statefulset_ordered_creation_and_identity(cm_store):
+    cm, store = cm_store
+    sts = api.StatefulSet(
+        meta=api.ObjectMeta(name="db"),
+        spec=api.StatefulSetSpec(
+            replicas=3,
+            selector=api.LabelSelector(match_labels={"app": "db"}),
+            template=_template({"app": "db"}),
+            volume_claim_templates=[
+                api.PersistentVolumeClaim(
+                    meta=api.ObjectMeta(name="data"),
+                    spec=api.PersistentVolumeClaimSpec(
+                        storage_class_name="fast",
+                        resources={api.STORAGE: GI},
+                    ),
+                )
+            ],
+        ),
+    )
+    store.create(sts)
+    # OrderedReady: db-0 first; db-1 must NOT appear until db-0 is ready
+    assert _wait(lambda: any(
+        p.meta.name == "db-0" for p in store.list("Pod")[0]
+    ))
+    time.sleep(0.5)
+    names = {p.meta.name for p in store.list("Pod")[0]}
+    assert "db-1" not in names, names
+    _mark_running(store, {"db-0"})
+    assert _wait(lambda: any(
+        p.meta.name == "db-1" for p in store.list("Pod")[0]
+    ))
+    _mark_running(store)
+    assert _wait(lambda: {"db-0", "db-1", "db-2"} <= {
+        p.meta.name for p in store.list("Pod")[0]
+    })
+    # one PVC per ordinal, named <tpl>-<set>-<i>
+    pvcs = {c.meta.name for c in store.list("PersistentVolumeClaim")[0]}
+    assert {"data-db-0", "data-db-1", "data-db-2"} <= pvcs
+    # pod identity: db-1 deleted -> recreated under the SAME name with
+    # the SAME claim
+    store.delete("Pod", "db-1")
+    assert _wait(lambda: any(
+        p.meta.name == "db-1" for p in store.list("Pod")[0]
+    ))
+    pod = store.get("Pod", "db-1")
+    assert pod.spec.volumes[0].persistent_volume_claim == "data-db-1"
+    # scale down removes the HIGHEST ordinal, claims survive
+    sts = store.get("StatefulSet", "db")
+    sts.spec.replicas = 2
+    store.update(sts)
+    assert _wait(lambda: {"db-0", "db-1"} == {
+        p.meta.name for p in store.list("Pod")[0]
+        if p.meta.name.startswith("db-")
+    })
+    assert "data-db-2" in {
+        c.meta.name for c in store.list("PersistentVolumeClaim")[0]
+    }
+
+
+def test_daemonset_one_pod_per_eligible_node(cm_store):
+    cm, store = cm_store
+    for i in range(3):
+        store.create(make_node(f"n{i}").capacity(cpu_milli=4000, pods=10).obj())
+    tainted = make_node("n-tainted").capacity(cpu_milli=4000, pods=10) \
+        .taint("dedicated", "x", api.NO_SCHEDULE).obj()
+    store.create(tainted)
+    ds = api.DaemonSet(
+        meta=api.ObjectMeta(name="agent"),
+        spec=api.DaemonSetSpec(
+            selector=api.LabelSelector(match_labels={"app": "agent"}),
+            template=_template({"app": "agent"}),
+        ),
+    )
+    store.create(ds)
+    assert _wait(lambda: len(store.list("Pod")[0]) == 3)
+    nodes = {p.spec.node_name for p in store.list("Pod")[0]}
+    assert nodes == {"n0", "n1", "n2"}  # tainted node excluded
+    # a new node joining gets a daemon pod
+    store.create(make_node("n9").capacity(cpu_milli=4000, pods=10).obj())
+    assert _wait(lambda: "n9" in {
+        p.spec.node_name for p in store.list("Pod")[0]
+    })
+    # node leaving: its pod is reaped (nodelifecycle/GC semantics are
+    # store-side here — the controller deletes pods on vanished nodes)
+    store.delete("Node", "n1", namespace="")
+    assert _wait(lambda: "n1" not in {
+        p.spec.node_name for p in store.list("Pod")[0]
+    })
+    got = store.get("DaemonSet", "agent")
+    assert got.status.desired_number_scheduled == 3
+
+
+def test_daemonset_toleration_allows_tainted_node(cm_store):
+    cm, store = cm_store
+    store.create(
+        make_node("gpu").capacity(cpu_milli=4000, pods=10)
+        .taint("dedicated", "gpu", api.NO_SCHEDULE).obj()
+    )
+    tmpl = _template({"app": "gpu-agent"})
+    tmpl.spec.tolerations.append(
+        api.Toleration(key="dedicated", op=api.OP_EQUAL, value="gpu",
+                       effect=api.NO_SCHEDULE)
+    )
+    ds = api.DaemonSet(
+        meta=api.ObjectMeta(name="gpu-agent"),
+        spec=api.DaemonSetSpec(
+            selector=api.LabelSelector(match_labels={"app": "gpu-agent"}),
+            template=tmpl,
+        ),
+    )
+    store.create(ds)
+    assert _wait(lambda: {
+        p.spec.node_name for p in store.list("Pod")[0]
+    } == {"gpu"})
+
+
+def test_cron_parser_and_fire_times():
+    fields = parse_cron("*/15 2 * * *")
+    t = time.mktime((2026, 7, 30, 2, 45, 0, 0, 0, -1))
+    assert matches(fields, t)
+    assert not matches(fields, t + 60)
+    assert not matches(fields, time.mktime((2026, 7, 30, 3, 0, 0, 0, 0, -1)))
+    # most recent fire within a window
+    now = time.mktime((2026, 7, 30, 2, 50, 0, 0, 0, -1))
+    since = now - 3600
+    fire = most_recent_fire(fields, since, now)
+    assert fire == time.mktime((2026, 7, 30, 2, 45, 0, 0, 0, -1))
+    with pytest.raises(ValueError):
+        parse_cron("* * * *")
+    with pytest.raises(ValueError):
+        parse_cron("99 * * * *")
+
+
+def test_cronjob_fires_and_respects_forbid(cm_store):
+    cm, store = cm_store
+    ctrl = cm.controllers["CronJob"]
+    # a fake clock the test advances minute by minute
+    now = {"t": time.time()}
+    ctrl.clock = lambda: now["t"]
+    cj = api.CronJob(
+        meta=api.ObjectMeta(name="tick"),
+        spec=api.CronJobSpec(
+            schedule="* * * * *",  # every minute
+            concurrency_policy="Forbid",
+            job_template=api.JobSpec(
+                parallelism=1, completions=1,
+                template=_template({"app": "tick"}),
+            ),
+        ),
+    )
+    store.create(cj)
+    assert _wait(lambda: len(store.list("Job")[0]) == 1, timeout=15)
+    # Forbid: while the job is active, the next minute must NOT fire
+    now["t"] += 60
+    time.sleep(0.5)
+    ctrl.enqueue(store.get("CronJob", "tick"))
+    time.sleep(1.0)
+    assert len(store.list("Job")[0]) == 1
+    # complete the job: the next minute fires a second one
+    job = store.list("Job")[0][0]
+    job.status.completion_time = now["t"]
+    store.update(job)
+    now["t"] += 60
+    assert _wait(lambda: len(store.list("Job")[0]) == 2, timeout=15)
+
+
+def test_cron_dom_dow_or_rule():
+    """Vixie-cron: both day fields restricted -> OR; one starred -> AND."""
+    both = parse_cron("0 0 13 * 5")
+    # Fri 2026-07-17 (a Friday, not the 13th)
+    assert matches(both, time.mktime((2026, 7, 17, 0, 0, 0, 0, 0, -1)))
+    # Mon 2026-07-13 (the 13th, not a Friday)
+    assert matches(both, time.mktime((2026, 7, 13, 0, 0, 0, 0, 0, -1)))
+    assert not matches(both, time.mktime((2026, 7, 14, 0, 0, 0, 0, 0, -1)))
+    dow_only = parse_cron("0 0 * * 5")
+    assert matches(dow_only, time.mktime((2026, 7, 17, 0, 0, 0, 0, 0, -1)))
+    assert not matches(dow_only, time.mktime((2026, 7, 13, 0, 0, 0, 0, 0, -1)))
+
+
+def test_statefulset_rolling_update_one_at_a_time(cm_store):
+    """Template change: at most ONE replica down at a time; each ordinal
+    is recreated and readied before the next is touched (review finding:
+    the stale sweep must not drain the whole set)."""
+    cm, store = cm_store
+    sts = api.StatefulSet(
+        meta=api.ObjectMeta(name="kv"),
+        spec=api.StatefulSetSpec(
+            replicas=3,
+            selector=api.LabelSelector(match_labels={"app": "kv"}),
+            template=_template({"app": "kv"}),
+        ),
+    )
+    store.create(sts)
+
+    def pump():
+        _mark_running(store)
+        pods = [p for p in store.list("Pod")[0]
+                if p.meta.name.startswith("kv-")]
+        return pods
+
+    assert _wait(lambda: len(pump()) == 3, timeout=20)
+    sts = store.get("StatefulSet", "kv")
+    sts.spec.template = _template({"app": "kv"}, cpu=200)
+    store.update(sts)
+    low_water = 3
+    deadline = time.time() + 30
+    done = False
+    while time.time() < deadline and not done:
+        pods = pump()
+        low_water = min(low_water, len(pods))
+        done = len(pods) == 3 and all(
+            p.resource_requests()[api.CPU] == 200 for p in pods
+        )
+        time.sleep(0.05)
+    assert done, [(p.meta.name, p.resource_requests()) for p in pump()]
+    assert low_water >= 2, f"rollout drained to {low_water} replicas"
