@@ -238,23 +238,26 @@ def deployment_from_dict(d: Dict[str, Any]) -> api.Deployment:
     )
 
 
+def _job_spec_from_dict(spec: Dict[str, Any]) -> api.JobSpec:
+    return api.JobSpec(
+        parallelism=int(spec.get("parallelism", 1)),
+        completions=(
+            int(spec["completions"]) if "completions" in spec else 1
+        ),
+        template=_pod_template_from_dict(spec.get("template") or {}),
+        backoff_limit=int(spec.get("backoffLimit", 6)),
+    )
+
+
 def job_from_dict(d: Dict[str, Any]) -> api.Job:
     meta = d.get("metadata") or {}
-    spec = d.get("spec") or {}
     return api.Job(
         meta=api.ObjectMeta(
             name=meta.get("name", ""),
             namespace=meta.get("namespace", "default"),
             labels=dict(meta.get("labels") or {}),
         ),
-        spec=api.JobSpec(
-            parallelism=int(spec.get("parallelism", 1)),
-            completions=(
-                int(spec["completions"]) if "completions" in spec else 1
-            ),
-            template=_pod_template_from_dict(spec.get("template") or {}),
-            backoff_limit=int(spec.get("backoffLimit", 6)),
-        ),
+        spec=_job_spec_from_dict(d.get("spec") or {}),
     )
 
 
@@ -309,15 +312,7 @@ def cronjob_from_dict(d: Dict[str, Any]) -> api.CronJob:
                 float(spec["startingDeadlineSeconds"])
                 if "startingDeadlineSeconds" in spec else None
             ),
-            job_template=api.JobSpec(
-                parallelism=int(job_tpl.get("parallelism", 1)),
-                completions=(
-                    int(job_tpl["completions"])
-                    if "completions" in job_tpl else 1
-                ),
-                template=_pod_template_from_dict(job_tpl.get("template") or {}),
-                backoff_limit=int(job_tpl.get("backoffLimit", 6)),
-            ),
+            job_template=_job_spec_from_dict(job_tpl),
         ),
     )
 

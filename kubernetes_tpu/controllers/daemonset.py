@@ -50,21 +50,32 @@ class DaemonSetController(Controller):
         for k, v in tmpl.node_selector.items():
             if node.meta.labels.get(k) != v:
                 return False
-        tolerated = {
-            (t.key, t.value) for t in tmpl.tolerations
-        } | {(t.key, "") for t in tmpl.tolerations if t.op == api.OP_EXISTS}
         for taint in node.effective_taints():
             if taint.effect != api.NO_SCHEDULE:
                 continue
-            if (taint.key, taint.value) in tolerated:
+            # daemon pods implicitly tolerate cordoning — the controller
+            # adds node.kubernetes.io/unschedulable automatically
+            # (daemon_controller.go AddOrUpdateDaemonPodTolerations), so
+            # cordon must not evict running agents
+            if taint.key == api.TAINT_NODE_UNSCHEDULABLE:
                 continue
-            if any(
-                t.key == taint.key and t.op == api.OP_EXISTS
-                for t in tmpl.tolerations
+            if not any(
+                self._tolerates(t, taint) for t in tmpl.tolerations
             ):
-                continue
-            return False
+                return False
         return True
+
+    @staticmethod
+    def _tolerates(tol: api.Toleration, taint: api.Taint) -> bool:
+        """Toleration-vs-taint match incl. the EFFECT dimension (a
+        NoExecute-only toleration must not cover a NoSchedule taint)."""
+        if tol.effect and tol.effect != taint.effect:
+            return False
+        if tol.key != taint.key:
+            return False
+        if tol.op == api.OP_EXISTS:
+            return True
+        return tol.value == taint.value
 
     def sync(self, key: str) -> None:
         namespace, name = split_key(key)

@@ -69,7 +69,11 @@ class JobController(Controller):
             if job.spec.completions is not None
             else job.spec.parallelism
         )
-        done = succeeded >= completions
+        # terminal either way: success (completions reached) OR failure
+        # (backoffLimit exceeded — the job_controller.go Failed
+        # condition); a failed job must still record completion_time so
+        # consumers (CronJob's Forbid policy) see it as finished
+        done = succeeded >= completions or failed > job.spec.backoff_limit
         if (
             not done
             and failed <= job.spec.backoff_limit

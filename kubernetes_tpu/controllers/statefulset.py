@@ -13,7 +13,7 @@ from __future__ import annotations
 
 from ..api import store as st
 from ..api import types as api
-from .base import Controller, controller_owner, split_key
+from .base import Controller, split_key
 from .deployment import template_hash
 
 
@@ -178,6 +178,3 @@ class StatefulSetController(Controller):
         fresh.status.ready_replicas = ready
         fresh.status.observed_generation = fresh.meta.generation
         self.store.update(fresh)
-
-
-_ = controller_owner  # imported for parity with sibling controllers

@@ -52,6 +52,7 @@ class ThroughputCollector:
         interval: float = 0.1,
         labels: Optional[Dict[str, str]] = None,
         pod_names: Optional[set] = None,
+        lister=None,
     ):
         self.store = store
         self.namespaces = namespaces
@@ -61,12 +62,16 @@ class ThroughputCollector:
         # bound victims, so counting every scheduled pod in the namespace
         # would produce negative deltas.
         self.pod_names = pod_names
+        # cheap pod source (e.g. an informer cache's list): store.list
+        # deep-copies every object per call, and a 100ms sampling loop
+        # over thousands of pods GIL-starves the scheduler it measures
+        self.lister = lister or (lambda: store.list("Pod")[0])
         self.samples: List[float] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def _scheduled_count(self) -> int:
-        pods, _ = self.store.list("Pod")
+        pods = self.lister()
         return sum(
             1
             for p in pods

@@ -133,6 +133,10 @@ class WorkloadRunner:
                 interval=self.sample_interval,
                 labels={"Name": workload.full_name},
                 pod_names=measured,
+                lister=(
+                    sched.informers.informer("Pod").list
+                    if sched is not None else None
+                ),
             ).start()
         t0 = time.monotonic()
         for i in range(op.count):
@@ -148,7 +152,7 @@ class WorkloadRunner:
             wall = time.monotonic() - t0
             collector.stop()
             items.extend(collector.collect())
-            scheduled = self._scheduled(store, namespace)
+            scheduled = self._scheduled(store, namespace, sched=sched)
             items.append(
                 DataItem(
                     {"Average": scheduled / wall if wall > 0 else 0.0},
@@ -158,11 +162,29 @@ class WorkloadRunner:
             )
 
     @staticmethod
-    def _scheduled(store: st.Store, namespace: Optional[str]) -> int:
-        pods, _ = store.list("Pod")
+    def _pods_snapshot(
+        store: st.Store, sched: Optional[Scheduler]
+    ) -> List[api.Pod]:
+        """Pods for polling loops.  The scheduler's informer cache is
+        the cheap source: store.list deep-copies every object per call,
+        and a 50ms poll over thousands of pods becomes a GIL-saturating
+        copy storm that starves the commit loop it is waiting on
+        (observed: 15 pods/s in TopologySpreading until the barrier
+        stopped hammering store.list)."""
+        if sched is not None:
+            return sched.informers.informer("Pod").list()
+        return store.list("Pod")[0]
+
+    @classmethod
+    def _scheduled(
+        cls,
+        store: st.Store,
+        namespace: Optional[str],
+        sched: Optional[Scheduler] = None,
+    ) -> int:
         return sum(
             1
-            for p in pods
+            for p in cls._pods_snapshot(store, sched)
             if p.spec.node_name
             and (namespace is None or p.meta.namespace == namespace)
         )
@@ -184,7 +206,7 @@ class WorkloadRunner:
         stable = 0
         last_sig = None
         while time.monotonic() < deadline:
-            pods, _ = store.list("Pod")
+            pods = self._pods_snapshot(store, sched)
             pending = [
                 p
                 for p in pods

@@ -280,3 +280,87 @@ def test_statefulset_rolling_update_one_at_a_time(cm_store):
         time.sleep(0.05)
     assert done, [(p.meta.name, p.resource_requests()) for p in pump()]
     assert low_water >= 2, f"rollout drained to {low_water} replicas"
+
+
+def test_daemonset_survives_cordon(cm_store):
+    """Cordoning a node must NOT evict its daemon pod — the controller
+    implicitly tolerates node.kubernetes.io/unschedulable (review
+    finding; daemon_controller.go AddOrUpdateDaemonPodTolerations)."""
+    cm, store = cm_store
+    store.create(make_node("n0").capacity(cpu_milli=4000, pods=10).obj())
+    ds = api.DaemonSet(
+        meta=api.ObjectMeta(name="agent"),
+        spec=api.DaemonSetSpec(
+            selector=api.LabelSelector(match_labels={"app": "agent"}),
+            template=_template({"app": "agent"}),
+        ),
+    )
+    store.create(ds)
+    assert _wait(lambda: len(store.list("Pod")[0]) == 1)
+    node = store.get("Node", "n0", namespace="")
+    node.spec.unschedulable = True
+    store.update(node)
+    time.sleep(1.0)
+    assert len(store.list("Pod")[0]) == 1, "cordon evicted the daemon pod"
+
+
+def test_daemonset_toleration_effect_must_match(cm_store):
+    """A NoExecute-only toleration must not cover a NoSchedule taint
+    (review finding)."""
+    cm, store = cm_store
+    store.create(
+        make_node("t").capacity(cpu_milli=4000, pods=10)
+        .taint("dedicated", "x", api.NO_SCHEDULE).obj()
+    )
+    tmpl = _template({"app": "a"})
+    tmpl.spec.tolerations.append(
+        api.Toleration(key="dedicated", op=api.OP_EQUAL, value="x",
+                       effect=api.NO_EXECUTE)
+    )
+    store.create(api.DaemonSet(
+        meta=api.ObjectMeta(name="a"),
+        spec=api.DaemonSetSpec(
+            selector=api.LabelSelector(match_labels={"app": "a"}),
+            template=tmpl,
+        ),
+    ))
+    time.sleep(1.0)
+    assert len(store.list("Pod")[0]) == 0
+
+
+def test_failed_job_unblocks_forbid_cronjob(cm_store):
+    """A job whose pods exceed backoffLimit gets completion_time, so a
+    Forbid CronJob keeps firing (review finding)."""
+    cm, store = cm_store
+    ctrl = cm.controllers["CronJob"]
+    now = {"t": time.time()}
+    ctrl.clock = lambda: now["t"]
+    cj = api.CronJob(
+        meta=api.ObjectMeta(name="flaky"),
+        spec=api.CronJobSpec(
+            schedule="* * * * *",
+            concurrency_policy="Forbid",
+            job_template=api.JobSpec(
+                parallelism=1, completions=1,
+                template=_template({"app": "flaky"}),
+            ),
+        ),
+    )
+    cj.spec.job_template.backoff_limit = 0
+    store.create(cj)
+    assert _wait(lambda: len(store.list("Job")[0]) == 1, timeout=15)
+    # all its pods fail -> the job must become terminal
+    def fail_pods():
+        for p in store.list("Pod")[0]:
+            if p.status.phase != "Failed":
+                p.status.phase = "Failed"
+                try:
+                    store.update(p)
+                except (st.Conflict, st.NotFound):
+                    pass
+        jobs = store.list("Job")[0]
+        return jobs and jobs[0].status.completion_time is not None
+    assert _wait(fail_pods, timeout=15), "failed job never became terminal"
+    now["t"] += 60
+    assert _wait(lambda: len(store.list("Job")[0]) == 2, timeout=15), \
+        "Forbid cron stuck behind a failed job"
