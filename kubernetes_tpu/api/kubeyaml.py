@@ -180,6 +180,16 @@ def pod_from_dict(d: Dict[str, Any]) -> api.Pod:
                 match_label_keys=list(c.get("matchLabelKeys") or []),
             )
         )
+    for v in spec.get("volumes") or []:
+        pvc = (v.get("persistentVolumeClaim") or {}).get("claimName")
+        if pvc:
+            pod.spec.volumes.append(
+                api.Volume(name=v.get("name", ""), persistent_volume_claim=pvc)
+            )
+    pod.spec.resource_claims = [
+        rc.get("resourceClaimName") or rc.get("name", "")
+        for rc in spec.get("resourceClaims") or []
+    ]
     return pod
 
 
@@ -401,6 +411,24 @@ def namespace_from_dict(d: Dict[str, Any]) -> api.Namespace:
     return api.Namespace(meta=_meta_from_dict(d, namespace=""))
 
 
+def resourceclaim_from_dict(d: Dict[str, Any]) -> api.ResourceClaim:
+    spec = d.get("spec") or {}
+    return api.ResourceClaim(
+        meta=_meta_from_dict(d),
+        spec=api.ResourceClaimSpec(
+            device_class_name=spec.get("deviceClassName", ""),
+            count=int(spec.get("count", 1)),
+        ),
+    )
+
+
+def deviceclass_from_dict(d: Dict[str, Any]) -> api.DeviceClass:
+    return api.DeviceClass(
+        meta=_meta_from_dict(d, namespace=""),
+        driver=(d.get("spec") or {}).get("driver", d.get("driver", "")),
+    )
+
+
 # kind -> converter, the CLI's `create -f` dispatch table
 CONVERTERS = {
     "Node": node_from_dict,
@@ -415,4 +443,6 @@ CONVERTERS = {
     "StorageClass": storageclass_from_dict,
     "PodDisruptionBudget": pdb_from_dict,
     "Namespace": namespace_from_dict,
+    "ResourceClaim": resourceclaim_from_dict,
+    "DeviceClass": deviceclass_from_dict,
 }

@@ -365,6 +365,9 @@ class PodSpec:
     restart_policy: str = "Always"
     termination_grace_period_seconds: int = 30
     volumes: List["Volume"] = field(default_factory=list)
+    # ResourceClaim names (pod namespace) this pod consumes — the
+    # pod.spec.resourceClaims reference (DRA)
+    resource_claims: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -649,6 +652,53 @@ class StorageClass:
     allowed_topologies: Optional[NodeSelector] = None
 
     KIND = "StorageClass"
+
+
+# ---------------------------------------------------------------------------
+# Dynamic resource allocation (reference: resource.k8s.io ResourceClaim /
+# DeviceClass, scheduled by plugins/dynamicresources/dynamicresources.go)
+# — device claims as first-class objects with allocation lifecycle.
+# ---------------------------------------------------------------------------
+
+
+def device_resource(class_name: str) -> str:
+    """The node-allocatable resource name carrying a device class's
+    per-node capacity (the devicemanager-published countable-resource
+    convention)."""
+    return f"devices/{class_name}"
+
+
+@dataclass
+class DeviceClass:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    driver: str = ""
+
+    KIND = "DeviceClass"
+
+
+@dataclass
+class ResourceClaimSpec:
+    device_class_name: str = ""
+    count: int = 1                 # devices requested from the class
+
+
+@dataclass
+class ResourceClaimStatus:
+    phase: str = "Pending"         # Pending | Allocated
+    allocated_node: str = ""       # set at allocation (Reserve/PreBind)
+    # the consumer pod (ns/name) whose resource accounting carries the
+    # claim's device count — keeps usage stable across the pod's
+    # lifetime while sharers add only the co-location pin
+    carrier: str = ""
+
+
+@dataclass
+class ResourceClaim:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceClaimSpec = field(default_factory=ResourceClaimSpec)
+    status: ResourceClaimStatus = field(default_factory=ResourceClaimStatus)
+
+    KIND = "ResourceClaim"
 
 
 # ---------------------------------------------------------------------------

@@ -68,9 +68,13 @@ class DaemonSetController(Controller):
     @staticmethod
     def _tolerates(tol: api.Toleration, taint: api.Taint) -> bool:
         """Toleration-vs-taint match incl. the EFFECT dimension (a
-        NoExecute-only toleration must not cover a NoSchedule taint)."""
+        NoExecute-only toleration must not cover a NoSchedule taint).
+        An empty key with operator Exists tolerates EVERYTHING (the
+        node-agent tolerate-all pattern, core/v1 Toleration docs)."""
         if tol.effect and tol.effect != taint.effect:
             return False
+        if tol.op == api.OP_EXISTS and not tol.key:
+            return True
         if tol.key != taint.key:
             return False
         if tol.op == api.OP_EXISTS:

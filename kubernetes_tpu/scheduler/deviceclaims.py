@@ -1,0 +1,231 @@
+"""Dynamic resource allocation — device claims, TPU-first.
+
+The reference's DynamicResources plugin (plugins/dynamicresources/
+dynamicresources.go:275,1145 — 2,161 LoC of PreEnqueue/PreFilter/
+Filter/Reserve/Unreserve/PreBind over ResourceClaim objects) walks
+nodes matching claim allocations.  The TPU-native design reuses the two
+primitives the rest of scheduling already rides:
+
+  * device CAPACITY is a node-published countable resource
+    (`devices/<class>`, api.device_resource) — an UNALLOCATED claim's
+    device count folds into the consuming pod's effective requests and
+    the NodeResourcesFit kernel does the filtering;
+  * an ALLOCATED claim pins its consumers to the allocation's node via
+    a hostname selector term riding the static-feasibility bitsets —
+    which is how claim SHARING co-locates pods (the DRA property device
+    plugins can't express).
+
+Host side (this module): claim/class indexes fed by informers, the
+Reserve/Unreserve assume cache, and PreBind allocation writes — the
+same protocol shape as scheduler/volumebinding.py.
+
+Accounting model: device usage rides the consuming pods' effective
+requests, with one CARRIER per claim (recorded on the claim at
+allocation): the carrier's requests include the device count for the
+claim's whole lifetime — from its own solve (claim unallocated then)
+through cache add and remove — so the node's usage vector stays exact
+and symmetric; sharers contribute only the co-location pin.  Reserve
+rejects a placement whose node disagrees with an existing allocation
+(two sharers solved in one batch re-solve under the pin).  Documented
+simplification: if the carrier terminates while sharers remain, the
+devices read as free until the claim deallocates.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..api import store as st
+from ..api import types as api
+
+_IMPOSSIBLE = api.NodeSelector(
+    terms=[
+        api.NodeSelectorTerm(
+            match_expressions=[
+                api.Requirement(
+                    "resource.kubernetes.io/unsatisfiable", api.OP_IN,
+                    ["true"],
+                )
+            ]
+        )
+    ]
+)
+
+
+def _pin(node_name: str) -> api.NodeSelector:
+    return api.NodeSelector(
+        terms=[
+            api.NodeSelectorTerm(
+                match_expressions=[
+                    api.Requirement(
+                        api.LABEL_HOSTNAME, api.OP_IN, [node_name]
+                    )
+                ]
+            )
+        ]
+    )
+
+
+class DeviceClaimBinder:
+    """Host-side DRA state + the Reserve/PreBind protocol."""
+
+    def __init__(self, store: st.Store):
+        self.store = store
+        self._mu = threading.RLock()
+        self._claims: Dict[str, api.ResourceClaim] = {}   # ns/name
+        self._classes: Dict[str, api.DeviceClass] = {}
+        # assume cache: claim key -> (node, carrier pod key) at Reserve
+        self._assumed: Dict[str, Tuple[str, str]] = {}
+
+    # -- informer handlers -------------------------------------------------
+
+    def on_claim(self, typ: str, claim: api.ResourceClaim, old) -> None:
+        key = f"{claim.meta.namespace}/{claim.meta.name}"
+        with self._mu:
+            if typ == st.DELETED:
+                self._claims.pop(key, None)
+                self._assumed.pop(key, None)
+            else:
+                self._claims[key] = claim
+                if claim.status.allocated_node:
+                    # the written allocation supersedes the assume
+                    self._assumed.pop(key, None)
+
+    def on_class(self, typ: str, dc: api.DeviceClass, old) -> None:
+        with self._mu:
+            if typ == st.DELETED:
+                self._classes.pop(dc.meta.name, None)
+            else:
+                self._classes[dc.meta.name] = dc
+
+    # -- the pod_transform hook --------------------------------------------
+
+    def _allocation(self, key: str, claim) -> Tuple[str, str]:
+        """(node, carrier) for a claim — from written status or the
+        assume cache.  Callers hold self._mu."""
+        if claim.status.allocated_node:
+            return claim.status.allocated_node, claim.status.carrier
+        return self._assumed.get(key, ("", ""))
+
+    def pod_requirements(
+        self, pod: api.Pod
+    ) -> Tuple[Optional[api.NodeSelector], Dict[str, int]]:
+        pkey = f"{pod.meta.namespace}/{pod.meta.name}"
+        selector: Optional[api.NodeSelector] = None
+        requests: Dict[str, int] = {}
+        with self._mu:
+            for claim_name in pod.spec.resource_claims:
+                key = f"{pod.meta.namespace}/{claim_name}"
+                claim = self._claims.get(key)
+                if claim is None:
+                    return _IMPOSSIBLE, {}
+                if claim.spec.device_class_name not in self._classes:
+                    return _IMPOSSIBLE, {}
+                node, carrier = self._allocation(key, claim)
+                res = api.device_resource(claim.spec.device_class_name)
+                if node:
+                    # allocated: every consumer co-locates; the CARRIER
+                    # keeps carrying the device count so the node's
+                    # usage stays accounted for the claim's lifetime
+                    selector = api.and_selectors(selector, _pin(node))
+                    if carrier == pkey:
+                        requests[res] = (
+                            requests.get(res, 0) + claim.spec.count
+                        )
+                    continue
+                requests[res] = requests.get(res, 0) + claim.spec.count
+        return selector, requests
+
+    # -- Reserve / Unreserve / PreBind ------------------------------------
+
+    def reserve(self, pod: api.Pod, node: api.Node) -> bool:
+        """Assume allocations for the pod's unallocated claims on the
+        chosen node (capacity was already enforced by the fit kernel via
+        the synthetic requests).  A claim already allocated/assumed to a
+        DIFFERENT node rejects the placement — two sharers solved in one
+        batch (both seeing the claim unallocated) would otherwise bind
+        to different nodes; the loser re-solves under the pin."""
+        pkey = f"{pod.meta.namespace}/{pod.meta.name}"
+        with self._mu:
+            picked = []
+
+            def rollback():
+                for k in picked:
+                    self._assumed.pop(k, None)
+
+            for claim_name in pod.spec.resource_claims:
+                key = f"{pod.meta.namespace}/{claim_name}"
+                claim = self._claims.get(key)
+                if claim is None:
+                    rollback()
+                    return False
+                alloc_node, _carrier = self._allocation(key, claim)
+                if alloc_node:
+                    if alloc_node != node.meta.name:
+                        rollback()
+                        return False
+                    continue
+                self._assumed[key] = (node.meta.name, pkey)
+                picked.append(key)
+            return True
+
+    def unreserve(self, pod: api.Pod) -> None:
+        pkey = f"{pod.meta.namespace}/{pod.meta.name}"
+        with self._mu:
+            for claim_name in pod.spec.resource_claims:
+                key = f"{pod.meta.namespace}/{claim_name}"
+                if self._assumed.get(key, ("", ""))[1] == pkey:
+                    self._assumed.pop(key, None)
+
+    def prebind(self, pod: api.Pod, node_name: str) -> None:
+        """Write assumed allocations through the API (the PreBind claim
+        status update, dynamicresources.go:1145)."""
+        for claim_name in pod.spec.resource_claims:
+            key = f"{pod.meta.namespace}/{claim_name}"
+            with self._mu:
+                assumed = self._assumed.get(key)
+            if assumed is None:
+                continue
+            node, carrier = assumed
+            claim = self.store.get(
+                "ResourceClaim", claim_name, pod.meta.namespace
+            )
+            if not claim.status.allocated_node:
+                claim.status.allocated_node = node
+                claim.status.carrier = carrier
+                claim.status.phase = "Allocated"
+                self.store.update(claim)
+            # the assume stays until the informer echoes the write back
+            # into _claims — dropping it earlier would briefly account
+            # the carrier's devices as unallocated again
+            with self._mu:
+                cached = self._claims.get(key)
+                if cached is not None and cached.status.allocated_node:
+                    self._assumed.pop(key, None)
+
+    # -- deallocation (the resourceclaim controller's half) ----------------
+
+    def maybe_deallocate(self, claim_key: str) -> None:
+        """Deallocate a claim no pod consumes any more (the
+        resourceclaim controller's cleanup; called from the scheduler's
+        pod-delete path)."""
+        with self._mu:
+            claim = self._claims.get(claim_key)
+        if claim is None or not claim.status.allocated_node:
+            return
+        pods, _ = self.store.list("Pod", namespace=claim.meta.namespace)
+        if any(
+            claim.meta.name in p.spec.resource_claims for p in pods
+        ):
+            return
+        try:
+            fresh = self.store.get(
+                "ResourceClaim", claim.meta.name, claim.meta.namespace
+            )
+            fresh.status.allocated_node = ""
+            fresh.status.carrier = ""
+            fresh.status.phase = "Pending"
+            self.store.update(fresh)
+        except (st.NotFound, st.Conflict):
+            pass

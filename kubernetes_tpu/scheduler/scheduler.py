@@ -46,6 +46,22 @@ from .queue import QueuedPodInfo, SchedulingQueue, pod_key
 from .waitingpods import WaitingPod, WaitingPodsMap
 
 
+def _combine_transforms(transforms):
+    """Compose pod_transform hooks: selectors AND together, extra
+    requests sum (VolumeBinding + DRA both fold into the encode)."""
+
+    def combined(pod):
+        selector, requests = None, {}
+        for fn in transforms:
+            sel, extra = fn(pod)
+            selector = api.and_selectors(selector, sel)
+            for k, v in (extra or {}).items():
+                requests[k] = requests.get(k, 0) + v
+        return selector, requests
+
+    return combined
+
+
 _REASON_TEXT = {
     assign_ops.REASON_STATIC: "node affinity/taints/name mismatch",
     assign_ops.REASON_RESOURCES: "insufficient resources",
@@ -112,21 +128,32 @@ class Scheduler:
         # (scheduler/volumebinding.py) — PreFilter/Filter cost nothing
         # extra on device.  Reserve rides filter_result, rollback rides
         # unreserve, API writes ride pre_bind.
+        from .deviceclaims import DeviceClaimBinder
         from .volumebinding import VolumeBinder
 
         gate = self.profiles.gate
         self.preemption.pdb_aware = gate.enabled("PDBAwarePreemption")
         self.volumes = VolumeBinder(store)
+        self.devices = DeviceClaimBinder(store)
+        transforms = []
         if gate.enabled("VolumeBinding"):
-            self.tpu.builder.pod_transform = self.volumes.pod_requirements
+            transforms.append(self.volumes.pod_requirements)
+        if gate.enabled("DynamicResourceAllocation"):
+            transforms.append(self.devices.pod_requirements)
+        if transforms:
+            self.tpu.builder.pod_transform = _combine_transforms(transforms)
         # default plugins on every profile: preemption (PostFilter) +
-        # volume binding (Reserve/Unreserve/PreBind)
+        # volume binding + device claims (Reserve/Unreserve/PreBind)
         for fwk in self.profiles:
             fwk.post_filter.append(self._preempt_plugin)
             if gate.enabled("VolumeBinding"):
                 fwk.filter_result.append(self._volume_reserve_plugin)
                 fwk.unreserve.append(self.volumes.unreserve)
                 fwk.pre_bind.append(self.volumes.prebind)
+            if gate.enabled("DynamicResourceAllocation"):
+                fwk.filter_result.append(self._device_reserve_plugin)
+                fwk.unreserve.append(self.devices.unreserve)
+                fwk.pre_bind.append(self.devices.prebind)
         self.informers = InformerFactory(store)
         # Optional client.leaderelection.LeaderElector: when set, the hot
         # loop only schedules while leading (app/server.go:170-180 —
@@ -148,6 +175,8 @@ class Scheduler:
             ("PersistentVolume", self.volumes.on_pv),
             ("PersistentVolumeClaim", self.volumes.on_pvc),
             ("StorageClass", self.volumes.on_class),
+            ("ResourceClaim", self.devices.on_claim),
+            ("DeviceClass", self.devices.on_class),
         ):
             inf = self.informers.informer(kind)
             inf.add_handler(handler)
@@ -173,6 +202,10 @@ class Scheduler:
         assigned = bool(pod.spec.node_name)
         if typ == st.DELETED:
             if assigned:
+                # the cache removal must see the claim state the pod was
+                # ACCOUNTED under — deallocating first would make
+                # remove_pod subtract device counts that were never
+                # added (unaccounting symmetry)
                 self.cache.remove_pod(pod)
                 # a terminated pod frees resources: unschedulable pods
                 # may fit now — but only resource/port/spread/interpod
@@ -181,6 +214,12 @@ class Scheduler:
             else:
                 self.queue.delete(pod)
                 self.cache.remove_nomination(pod)
+            for claim_name in pod.spec.resource_claims:
+                # last-consumer-gone deallocation (the resourceclaim
+                # controller's cleanup half) — AFTER unaccounting
+                self.devices.maybe_deallocate(
+                    f"{pod.meta.namespace}/{claim_name}"
+                )
             return
         if assigned:
             # bound (or our own bind echoing back): confirm in cache
@@ -224,6 +263,8 @@ class Scheduler:
         self.informers.informer("PersistentVolume").start()
         self.informers.informer("PersistentVolumeClaim").start()
         self.informers.informer("StorageClass").start()
+        self.informers.informer("ResourceClaim").start()
+        self.informers.informer("DeviceClass").start()
         self.informers.wait_for_sync()
         self._thread = threading.Thread(
             target=self._run, name="scheduler", daemon=True
@@ -498,6 +539,18 @@ class Scheduler:
         except KeyError:
             return None
         return node_name if self.volumes.reserve(pod, node) else None
+
+    def _device_reserve_plugin(
+        self, pod: api.Pod, node_name: str
+    ) -> Optional[str]:
+        """DRA Reserve: assume claim allocations on the chosen node."""
+        if not pod.spec.resource_claims:
+            return node_name
+        try:
+            node = self.store.get("Node", node_name, namespace="")
+        except KeyError:
+            return None
+        return node_name if self.devices.reserve(pod, node) else None
 
     def _preempt_plugin(self, pod: api.Pod) -> Optional[str]:
         """The DefaultPreemption PostFilter plugin (registered on every

@@ -37,6 +37,8 @@ DEFAULT_FEATURES: Dict[str, FeatureSpec] = {
     "VolumeBinding": FeatureSpec(True, BETA),
     # PodDisruptionBudget-aware victim ranking (scheduler/preemption.py)
     "PDBAwarePreemption": FeatureSpec(True, BETA),
+    # ResourceClaim/DeviceClass scheduling (scheduler/deviceclaims.py)
+    "DynamicResourceAllocation": FeatureSpec(True, BETA),
     # gang staging in the queue + all-or-nothing post-pass; GA and
     # locked — the north-star workload depends on it
     "GangScheduling": FeatureSpec(True, GA, lock_to_default=True),

@@ -1,0 +1,167 @@
+"""Dynamic resource allocation: ResourceClaim/DeviceClass scheduling.
+
+Reference: pkg/scheduler/framework/plugins/dynamicresources/
+dynamicresources.go:275 (the claim-driven Filter/Reserve/PreBind
+protocol) — re-designed so capacity rides the resource-fit kernel and
+allocation pins ride hostname selector terms
+(kubernetes_tpu/scheduler/deviceclaims.py).
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import store as st
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import GI, MI, make_node, make_pod
+
+
+def _claim(name, device_class, count=1):
+    return api.ResourceClaim(
+        meta=api.ObjectMeta(name=name),
+        spec=api.ResourceClaimSpec(
+            device_class_name=device_class, count=count
+        ),
+    )
+
+
+def _gpu_nodes(store, n, per_node=1):
+    for i in range(n):
+        store.create(
+            make_node(f"n{i}")
+            .capacity(
+                cpu_milli=8000, mem=16 * GI, pods=32,
+                **{api.device_resource("gpu"): per_node},
+            )
+            .obj()
+        )
+
+
+def _wait(cond, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.fixture
+def sched_store():
+    store = st.Store()
+    sched = Scheduler(store, batch_size=32)
+    sched.start()
+    yield sched, store
+    sched.stop()
+
+
+def test_claims_consume_device_capacity(sched_store):
+    sched, store = sched_store
+    _gpu_nodes(store, 2, per_node=1)
+    store.create(api.DeviceClass(meta=api.ObjectMeta(name="gpu")))
+    for i in range(3):
+        store.create(_claim(f"c{i}", "gpu"))
+        p = make_pod(f"p{i}").req(cpu_milli=100, mem=MI).obj()
+        p.spec.resource_claims = [f"c{i}"]
+        store.create(p)
+    # two claims fit (one device per node); the third parks
+    assert _wait(lambda: sum(
+        1 for p in store.list("Pod")[0] if p.spec.node_name
+    ) == 2)
+    time.sleep(1.0)
+    bound = {
+        p.meta.name: p.spec.node_name
+        for p in store.list("Pod")[0] if p.spec.node_name
+    }
+    assert len(set(bound.values())) == 2, bound  # one per node
+    # allocations written through the API at PreBind
+    allocated = [
+        c for c in store.list("ResourceClaim")[0]
+        if c.status.phase == "Allocated"
+    ]
+    assert len(allocated) == 2
+    # the parked pod's claim frees up when a consumer dies
+    victim = next(iter(bound))
+    store.delete("Pod", victim)
+    assert _wait(lambda: sum(
+        1 for p in store.list("Pod")[0] if p.spec.node_name
+    ) == 2, timeout=30)
+
+
+def test_shared_claim_colocates_pods(sched_store):
+    sched, store = sched_store
+    _gpu_nodes(store, 3, per_node=2)
+    store.create(api.DeviceClass(meta=api.ObjectMeta(name="gpu")))
+    store.create(_claim("shared", "gpu", count=2))
+    a = make_pod("a").req(cpu_milli=100, mem=MI).obj()
+    a.spec.resource_claims = ["shared"]
+    store.create(a)
+    assert _wait(lambda: store.get("Pod", "a").spec.node_name)
+    node = store.get("Pod", "a").spec.node_name
+    # a second consumer of the SAME claim must land on the SAME node
+    b = make_pod("b").req(cpu_milli=100, mem=MI).obj()
+    b.spec.resource_claims = ["shared"]
+    store.create(b)
+    assert _wait(lambda: store.get("Pod", "b").spec.node_name)
+    assert store.get("Pod", "b").spec.node_name == node
+
+
+def test_missing_device_class_parks_until_created(sched_store):
+    sched, store = sched_store
+    _gpu_nodes(store, 1)
+    store.create(_claim("c", "gpu"))
+    p = make_pod("p").req(cpu_milli=100, mem=MI).obj()
+    p.spec.resource_claims = ["c"]
+    store.create(p)
+    time.sleep(2.0)
+    assert not store.get("Pod", "p").spec.node_name
+    store.create(api.DeviceClass(meta=api.ObjectMeta(name="gpu")))
+    assert _wait(lambda: store.get("Pod", "p").spec.node_name)
+    claim = store.get("ResourceClaim", "c")
+    assert claim.status.allocated_node == store.get("Pod", "p").spec.node_name
+
+
+def test_allocated_devices_stay_accounted(sched_store):
+    """Review repro 1: after a claim allocates, its devices must remain
+    accounted on the node — a later claim must NOT overcommit."""
+    sched, store = sched_store
+    _gpu_nodes(store, 1, per_node=1)
+    store.create(api.DeviceClass(meta=api.ObjectMeta(name="gpu")))
+    store.create(_claim("c0", "gpu"))
+    p0 = make_pod("p0").req(cpu_milli=100, mem=MI).obj()
+    p0.spec.resource_claims = ["c0"]
+    store.create(p0)
+    assert _wait(lambda: store.get("Pod", "p0").spec.node_name)
+    assert _wait(lambda: store.get(
+        "ResourceClaim", "c0"
+    ).status.phase == "Allocated")
+    # second claim on the SAME (only) node: device is taken -> must park
+    store.create(_claim("c1", "gpu"))
+    p1 = make_pod("p1").req(cpu_milli=100, mem=MI).obj()
+    p1.spec.resource_claims = ["c1"]
+    store.create(p1)
+    time.sleep(2.5)
+    assert not store.get("Pod", "p1").spec.node_name, \
+        "device overcommit: allocated claim's capacity was not accounted"
+
+
+def test_batch_sharers_end_on_one_node(sched_store):
+    """Review repro 2: two sharers of one claim solved in the SAME batch
+    must both land on the allocation's node (the loser re-solves under
+    the pin instead of binding elsewhere)."""
+    sched, store = sched_store
+    _gpu_nodes(store, 4, per_node=1)
+    store.create(api.DeviceClass(meta=api.ObjectMeta(name="gpu")))
+    store.create(_claim("shared", "gpu"))
+    pods = []
+    for name in ("a", "b"):
+        p = make_pod(name).req(cpu_milli=100, mem=MI).obj()
+        p.spec.resource_claims = ["shared"]
+        pods.append(p)
+        store.create(p)
+    assert _wait(lambda: all(
+        store.get("Pod", n).spec.node_name for n in ("a", "b")
+    ), timeout=45)
+    nodes = {store.get("Pod", n).spec.node_name for n in ("a", "b")}
+    assert len(nodes) == 1, f"shared-claim consumers split: {nodes}"
