@@ -18,12 +18,19 @@ def main() -> None:
     ap.add_argument("--name", default=None, help="substring of Case/Workload")
     ap.add_argument("--out", default=None, help="write JSON here")
     ap.add_argument("--batch-size", type=int, default=4096)
+    ap.add_argument(
+        "--no-warmup", action="store_true",
+        help="skip the pre-window compile warmup (fully cold numbers)",
+    )
     args = ap.parse_args()
     wls = select(load_config(args.config), label=args.label, name=args.name)
     if not wls:
         raise SystemExit("no workloads selected")
     print(f"running {len(wls)} workloads: {[w.full_name for w in wls]}")
-    result = run_workloads(wls, out_path=args.out, batch_size=args.batch_size)
+    result = run_workloads(
+        wls, out_path=args.out, batch_size=args.batch_size,
+        warmup=not args.no_warmup,
+    )
     print(json.dumps(result, indent=1))
 
 

@@ -122,6 +122,20 @@ class ThroughputCollector:
         return [DataItem(_percentiles(vals), "pods/s", labels)]
 
 
+def histogram_baseline(registry: Registry) -> Dict[str, tuple]:
+    """Snapshot histogram counters so a later MetricsCollector can report
+    the measured WINDOW only — the reference's metricsCollector inits at
+    the collectMetrics op's start and diffs at collect
+    (scheduler_perf.go:100-112); without the diff the summary mixes the
+    init-phase and warmup attempts into the measured percentiles."""
+    out: Dict[str, tuple] = {}
+    for name, m in registry.snapshot().items():
+        if isinstance(m, Histogram):
+            with m._lock:
+                out[name] = (list(m.counts), m.total, m.n)
+    return out
+
+
 class MetricsCollector:
     """Extracts percentile summaries from the scheduler's histograms by
     reference metric name (scheduler_perf.go:100-112)."""
@@ -129,19 +143,42 @@ class MetricsCollector:
     DEFAULT_METRICS = (
         "scheduler_scheduling_attempt_duration_seconds",
         "scheduler_scheduling_algorithm_duration_seconds",
+        "scheduler_batch_solve_duration_seconds",
         "scheduler_pod_scheduling_sli_duration_seconds",
     )
 
-    def __init__(self, registry: Registry, labels: Optional[Dict[str, str]] = None):
+    def __init__(
+        self,
+        registry: Registry,
+        labels: Optional[Dict[str, str]] = None,
+        baseline: Optional[Dict[str, tuple]] = None,
+    ):
         self.registry = registry
         self.labels = dict(labels or {})
+        self.baseline = baseline or {}
+
+    def _windowed(self, name: str, h: Histogram) -> Histogram:
+        base = self.baseline.get(name)
+        if base is None:
+            return h
+        counts0, total0, n0 = base
+        with h._lock:
+            d = Histogram(name, tuple(h.buckets))
+            d.counts = [c - c0 for c, c0 in zip(h.counts, counts0)]
+            d.total = h.total - total0
+            d.n = h.n - n0
+            d.max = h.max  # upper bound; per-window max isn't tracked
+        return d
 
     def collect(self) -> List[DataItem]:
         out: List[DataItem] = []
         snap = self.registry.snapshot()
         for name in self.DEFAULT_METRICS:
             h = snap.get(name)
-            if not isinstance(h, Histogram) or h.n == 0:
+            if not isinstance(h, Histogram):
+                continue
+            h = self._windowed(name, h)
+            if h.n == 0:
                 continue
             labels = dict(self.labels)
             labels["Metric"] = name

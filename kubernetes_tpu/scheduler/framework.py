@@ -30,6 +30,7 @@ pod.spec.scheduler_name -> Framework (frameworkForPod, scheduler.go:358
 from __future__ import annotations
 
 import logging
+import time
 from typing import Callable, Dict, List, Optional
 
 from ..api import types as api
@@ -58,6 +59,16 @@ class Framework:
         # wins, any wait parks the pod in the waiting map and its
         # binding thread blocks in WaitOnPermit (schedule_one.go:278)
         self.permit: List[Callable[[api.Pod, str], tuple]] = []
+        # set by the Scheduler: the metrics Registry whose
+        # framework_extension_point_duration vec the runners observe
+        # (frameworkImpl.metricsRecorder, runtime/framework.go)
+        self.metrics = None
+
+    def _observe(self, point: str, t0: float) -> None:
+        if self.metrics is not None:
+            self.metrics.framework_extension_point_duration.labels(
+                point
+            ).observe(time.monotonic() - t0)
 
     @property
     def scheduler_name(self) -> str:
@@ -71,43 +82,63 @@ class Framework:
     # -- runners -----------------------------------------------------------
 
     def run_pre_enqueue(self, pod: api.Pod) -> Optional[str]:
-        for fn in self.pre_enqueue:
-            reason = fn(pod)
-            if reason:
-                return reason
-        return None
+        t0 = time.monotonic()
+        try:
+            for fn in self.pre_enqueue:
+                reason = fn(pod)
+                if reason:
+                    return reason
+            return None
+        finally:
+            self._observe("PreEnqueue", t0)
 
     def run_post_filter(self, pod: api.Pod) -> Optional[str]:
-        for fn in self.post_filter:
-            nominated = fn(pod)
-            if nominated:
-                return nominated
-        return None
+        t0 = time.monotonic()
+        try:
+            for fn in self.post_filter:
+                nominated = fn(pod)
+                if nominated:
+                    return nominated
+            return None
+        finally:
+            self._observe("PostFilter", t0)
 
     def run_pre_bind(self, pod: api.Pod, node: str) -> None:
-        for fn in self.pre_bind:
-            fn(pod, node)  # raising aborts the bind (reference semantics)
+        t0 = time.monotonic()
+        try:
+            for fn in self.pre_bind:
+                fn(pod, node)  # raising aborts the bind (reference semantics)
+        finally:
+            self._observe("PreBind", t0)
 
     def run_post_bind(self, pod: api.Pod, node: str) -> None:
+        t0 = time.monotonic()
         for fn in self.post_bind:
             try:
                 fn(pod, node)
             except Exception:
                 pass  # PostBind is informational (interface.go:624)
+        self._observe("PostBind", t0)
 
     def run_filter_result(self, pod: api.Pod, node: str) -> Optional[str]:
-        for fn in self.filter_result:
-            node = fn(pod, node)
-            if node is None:
-                return None
-        return node
+        t0 = time.monotonic()
+        try:
+            for fn in self.filter_result:
+                node = fn(pod, node)
+                if node is None:
+                    return None
+            return node
+        finally:
+            self._observe("Reserve", t0)
 
     def run_unreserve(self, pod: api.Pod) -> None:
+        t0 = time.monotonic()
         for fn in self.unreserve:
             try:
                 fn(pod)
             except Exception:
                 pass  # rollback must not mask the original failure
+        self._observe("Unreserve", t0)
 
     def run_permit(self, pod: api.Pod, node: str) -> tuple:
         """Combined Permit verdict: ("allow"|"reject"|"wait", timeout).
@@ -116,22 +147,26 @@ class Framework:
         exception is a reject (the reference turns plugin errors into a
         non-success Status) — letting it propagate after cache.assume
         would leak the assumed capacity forever."""
-        verdict, timeout = "allow", 0.0
-        for fn in self.permit:
-            try:
-                v, t = fn(pod, node)
-            except Exception:
-                logging.getLogger(__name__).exception(
-                    "permit plugin %r failed for %s/%s; rejecting",
-                    fn, pod.meta.namespace, pod.meta.name,
-                )
-                return "reject", 0.0
-            if v == "reject":
-                return "reject", 0.0
-            if v == "wait":
-                verdict = "wait"
-                timeout = max(timeout, float(t))
-        return verdict, timeout
+        t0 = time.monotonic()
+        try:
+            verdict, timeout = "allow", 0.0
+            for fn in self.permit:
+                try:
+                    v, t = fn(pod, node)
+                except Exception:
+                    logging.getLogger(__name__).exception(
+                        "permit plugin %r failed for %s/%s; rejecting",
+                        fn, pod.meta.namespace, pod.meta.name,
+                    )
+                    return "reject", 0.0
+                if v == "reject":
+                    return "reject", 0.0
+                if v == "wait":
+                    verdict = "wait"
+                    timeout = max(timeout, float(t))
+            return verdict, timeout
+        finally:
+            self._observe("Permit", t0)
 
 
 class FrameworkRegistry:

@@ -19,16 +19,27 @@ from .metrics import Counter, Gauge, Histogram, Registry
 def render_prometheus(registry: Registry) -> str:
     """Text exposition of every metric in the registry."""
     lines = []
+    typed = set()  # one TYPE line per metric family (expfmt requirement)
     for name, metric in sorted(registry.snapshot().items()):
         if isinstance(metric, Histogram):
-            lines.append(f"# TYPE {name} histogram")
+            # HistogramVec children carry labels in their name
+            # (`base{extension_point="..."}`): fold them into each series
+            # so the exposition stays valid Prometheus text format.
+            base, extra = name, ""
+            if "{" in name:
+                base, extra = name.split("{", 1)
+                extra = extra.rstrip("}") + ","
+            if base not in typed:
+                typed.add(base)
+                lines.append(f"# TYPE {base} histogram")
             acc = 0
             for bound, c in zip(metric.buckets, metric.counts):
                 acc += c
-                lines.append(f'{name}_bucket{{le="{bound}"}} {acc}')
-            lines.append(f'{name}_bucket{{le="+Inf"}} {metric.n}')
-            lines.append(f"{name}_sum {metric.total}")
-            lines.append(f"{name}_count {metric.n}")
+                lines.append(f'{base}_bucket{{{extra}le="{bound}"}} {acc}')
+            lines.append(f'{base}_bucket{{{extra}le="+Inf"}} {metric.n}')
+            suffix = "{" + extra.rstrip(",") + "}" if extra else ""
+            lines.append(f"{base}_sum{suffix} {metric.total}")
+            lines.append(f"{base}_count{suffix} {metric.n}")
         elif isinstance(metric, (Counter, Gauge)):
             kind = "counter" if isinstance(metric, Counter) else "gauge"
             lines.append(f"# TYPE {name} {kind}")

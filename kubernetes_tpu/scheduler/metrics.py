@@ -25,11 +25,15 @@ class Histogram:
         self.max = 0.0  # true upper bound for the +Inf bucket
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, count: int = 1) -> None:
+        """Record `value`, `count` times.  count>1 is the batched-solve
+        fan-out: one device dispatch schedules P pods, so the per-pod
+        algorithm cost (solve/P) is observed once per pod without P
+        bisect calls."""
         with self._lock:
-            self.counts[bisect.bisect_left(self.buckets, value)] += 1
-            self.total += value
-            self.n += 1
+            self.counts[bisect.bisect_left(self.buckets, value)] += count
+            self.total += value * count
+            self.n += count
             if value > self.max:
                 self.max = value
 
@@ -62,6 +66,37 @@ class Histogram:
     def average(self) -> float:
         with self._lock:
             return self.total / self.n if self.n else 0.0
+
+
+class HistogramVec:
+    """A labeled histogram family (component-base metrics HistogramVec):
+    one child Histogram per label tuple, created lazily.  snapshot()
+    flattens children under `name{label}` so /metrics and collectors see
+    plain histograms."""
+
+    def __init__(self, name: str, buckets: Tuple[float, ...] = _DEF_BUCKETS):
+        self.name = name
+        self.buckets = buckets
+        self._children: Dict[Tuple[str, ...], Histogram] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *labels: str) -> Histogram:
+        with self._lock:
+            h = self._children.get(labels)
+            if h is None:
+                child_name = (
+                    f'{self.name}{{extension_point="{"/".join(labels)}"}}'
+                    if labels
+                    else self.name
+                )
+                h = self._children[labels] = Histogram(
+                    child_name, self.buckets
+                )
+            return h
+
+    def children(self) -> Dict[Tuple[str, ...], Histogram]:
+        with self._lock:
+            return dict(self._children)
 
 
 class Counter:
@@ -107,15 +142,25 @@ class Registry:
         self.scheduling_attempt_duration = Histogram(
             "scheduler_scheduling_attempt_duration_seconds"
         )
-        # metrics.go SchedulingAlgorithmLatency
+        # metrics.go SchedulingAlgorithmLatency — PER POD: one device
+        # dispatch solves a whole batch, so each pod is observed at
+        # solve_duration / batch_size (the comparable per-attempt cost;
+        # the whole-batch number lives in batch_solve_duration below)
         self.scheduling_algorithm_duration = Histogram(
             "scheduler_scheduling_algorithm_duration_seconds"
+        )
+        # OUR batch-level metric (no reference analogue): one observation
+        # per device solve, including any first-shape XLA compile
+        self.batch_solve_duration = Histogram(
+            "scheduler_batch_solve_duration_seconds"
         )
         # pod_scheduling_sli_duration_seconds (end-to-end incl. requeues)
         self.pod_scheduling_sli_duration = Histogram(
             "scheduler_pod_scheduling_sli_duration_seconds"
         )
-        self.framework_extension_point_duration = Histogram(
+        # labeled per extension point (PreEnqueue/Permit/PreBind/...),
+        # observed by the Framework runners (framework.py)
+        self.framework_extension_point_duration = HistogramVec(
             "scheduler_framework_extension_point_duration_seconds"
         )
         # schedule_attempts_total{result="scheduled|unschedulable|error"}
@@ -126,9 +171,13 @@ class Registry:
         self.preemption_attempts = Counter("scheduler_preemption_attempts_total")
 
     def snapshot(self) -> Dict[str, object]:
-        """Name → metric, for collectors."""
-        return {
-            m.name: m
-            for m in vars(self).values()
-            if isinstance(m, (Histogram, Counter, Gauge))
-        }
+        """Name → metric, for collectors.  HistogramVec children appear
+        under their labeled names (`name{extension_point="..."}`)."""
+        out: Dict[str, object] = {}
+        for m in vars(self).values():
+            if isinstance(m, (Histogram, Counter, Gauge)):
+                out[m.name] = m
+            elif isinstance(m, HistogramVec):
+                for child in m.children().values():
+                    out[child.name] = child
+        return out

@@ -114,7 +114,11 @@ class Scheduler:
         # pods parked at Permit (waiting_pods_map.go); coscheduling-style
         # plugins Allow/Reject through this map
         self.waiting = WaitingPodsMap()
-        self.events = EventRecorder(store, component="default-scheduler")
+        # async: a bind wave must not pay per-pod synchronous Event
+        # writes on the scheduling thread (the broadcaster channel)
+        self.events = EventRecorder(
+            store, component="default-scheduler", async_mode=True
+        )
         self.preemption = PreemptionEvaluator(
             self.tpu, self.cache, store, self.metrics
         )
@@ -145,6 +149,7 @@ class Scheduler:
         # default plugins on every profile: preemption (PostFilter) +
         # volume binding + device claims (Reserve/Unreserve/PreBind)
         for fwk in self.profiles:
+            fwk.metrics = self.metrics
             fwk.post_filter.append(self._preempt_plugin)
             if gate.enabled("VolumeBinding"):
                 fwk.filter_result.append(self._volume_reserve_plugin)
@@ -280,6 +285,7 @@ class Scheduler:
             # so wait the compile out
             self._thread.join(timeout=120)
         self.informers.stop()
+        self.events.stop()
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -310,7 +316,6 @@ class Scheduler:
                  "bind_errors": 0}
         if not batch:
             return stats
-        t0 = self._clock()
         # Encode under the cache lock (informer threads mutate the same
         # ClusterState/vocabularies); solve outside it.  A pod whose spec
         # can't be encoded (cap overflow, unsupported field) must only
@@ -323,11 +328,9 @@ class Scheduler:
         # LogIfLong, schedule_one.go:391-431); threshold is generous
         # because first-shape compiles legitimately run tens of seconds
         with Trace("schedule_batch", threshold=1.0, pods=len(batch)) as trace:
-            return self._schedule_groups(
-                batch, reservations, stats, t0, trace
-            )
+            return self._schedule_groups(batch, reservations, stats, trace)
 
-    def _schedule_groups(self, batch, reservations, stats, t0, trace):
+    def _schedule_groups(self, batch, reservations, stats, trace):
         # Group the popped batch by profile.  Each group runs its FULL
         # cycle (solve -> assume -> bind) before the next group solves:
         # assume lands the placements in the shared state, so a later
@@ -342,6 +345,7 @@ class Scheduler:
             fwk = self.profiles.frameworks.get(sched_name)
             if fwk is None:
                 continue  # another scheduler's pod slipped in; drop
+            t_solve = self._clock()
             try:
                 names = fwk.tpu.schedule_pending(
                     [info.pod for info in group], lock=self.cache.lock,
@@ -367,6 +371,16 @@ class Scheduler:
                         )
                     continue
             solved_any = True
+            # one device dispatch solved len(group) pods: the batch gets
+            # one batch_solve observation (incl. any first-shape compile);
+            # the reference-named per-pod algorithm metric gets the
+            # per-pod share so harness percentiles stay comparable with
+            # the reference's per-ScheduleOne numbers
+            dt_solve = self._clock() - t_solve
+            self.metrics.batch_solve_duration.observe(dt_solve)
+            self.metrics.scheduling_algorithm_duration.observe(
+                dt_solve / max(len(group), 1), count=len(group)
+            )
             result = fwk.tpu.last_result
             if result is not None and result.reasons is not None:
                 reasons = [int(r) for r in np.asarray(result.reasons)[: len(group)]]
@@ -377,7 +391,6 @@ class Scheduler:
             trace.step(f"commit[{sched_name}]")
         if not solved_any:
             return stats
-        self.metrics.scheduling_algorithm_duration.observe(self._clock() - t0)
 
         # PostFilter: preemption for unschedulable pods, highest priority
         # first (handleSchedulingFailure -> Evaluator.Preempt,
@@ -588,7 +601,97 @@ class Scheduler:
         current = self.store.get("Pod", pod.meta.name, pod.meta.namespace)
         current.spec.node_name = node_name
         current.status.phase = "Running"
-        self.store.update(current)
+        self.store.update(current, copy_result=False)
+
+    # -- warmup ------------------------------------------------------------
+
+    def warmup(self, pods: List[api.Pod], max_batch: Optional[int] = None) -> float:
+        """Pre-compile the solver executables a coming workload will hit.
+
+        The reference needs nothing like this (Go compiles ahead of
+        time); here first-shape XLA compiles are 10-40 s each, and a
+        measured scheduling window that includes them loses the wall
+        clock at small scale.  Warmup runs the REAL scheduling path —
+        encode + solve, placements discarded, nothing assumed or bound —
+        over every power-of-two pod bucket up to the first full batch,
+        using caller-supplied template pods so the compiled feature set
+        (spread/interpod/ports/...) and constraint-table shapes match
+        the workload's.  Combined with the persistent compilation cache
+        (utils/compilecache.py) later processes warm in milliseconds.
+
+        Two rounds per bucket: round A against the current (typically
+        bound-pod-free) cluster, round B with one template pod assumed —
+        the bound_* FeatureFlags flip once the first batch binds, which
+        is a NEW executable; without round B the second measured batch
+        of a constraint workload would compile mid-window.  For
+        constraint-free workloads round B is a jit-cache hit and costs
+        an encode (~ms).
+
+        Returns seconds spent.  Never raises: a bucket that fails to
+        encode (cap overflow) is skipped — the real cycle handles those
+        pods through its own rejection path."""
+        t0 = self._clock()
+        if not pods or not self.tpu.state._rows:
+            return 0.0
+        fwk = self.profiles.for_pod(pods[0]) or self.profiles.default
+        cap = min(len(pods), max_batch or self.batch_size)
+        from ..utils import vocab as vb
+
+        buckets, b = [], self.tpu.builder.limits.min_pods
+        top = vb.pad_dim(cap, self.tpu.builder.limits.min_pods)
+        while b <= top:
+            buckets.append(b)
+            b *= 2
+        log = logging.getLogger(__name__)
+
+        def warm_bucket(bucket: int) -> None:
+            try:
+                fwk.tpu.schedule_pending(
+                    pods[:bucket], num_pods_hint=bucket, lock=self.cache.lock,
+                )
+            except Exception:
+                log.exception("warmup bucket %d skipped", bucket)
+
+        def warm_all() -> None:
+            # buckets in parallel: encode serializes under the cache
+            # lock, but XLA compiles release the GIL and overlap —
+            # cold warmup is compile-dominated
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=4) as ex:
+                list(ex.map(warm_bucket, reversed(buckets)))
+
+        # constraint-free pods can never flip the bound_* feature flags
+        # (their count tables have no rows), so one round suffices
+        needs_bound_round = any(
+            p.spec.topology_spread_constraints
+            or (p.spec.affinity and (p.spec.affinity.pod_affinity
+                                     or p.spec.affinity.pod_anti_affinity))
+            for p in pods
+        )
+        warm_all()
+        if needs_bound_round:
+            # round B: one template pod assumed on a live node flips
+            # bound_spread/bound_terms/bound_pref — a NEW executable the
+            # second measured batch would otherwise compile mid-window
+            import copy
+
+            clone = copy.deepcopy(pods[0])
+            clone.meta.name = "warmup-bound-pod"
+            clone.meta.namespace = pods[0].meta.namespace or "default"
+            node0 = next(iter(self.tpu.state._rows))
+            try:
+                self.cache.assume(clone, node0)
+            except Exception:
+                return self._clock() - t0  # no usable node; round A ran
+            try:
+                warm_all()
+            finally:
+                try:
+                    self.cache.forget(clone)
+                except Exception:
+                    log.exception("warmup: forgetting the bound clone failed")
+        return self._clock() - t0
 
     # -- test/bench convenience -------------------------------------------
 

@@ -43,8 +43,14 @@ def test_scheduler_emits_events():
             sched.schedule_batch(timeout=0.2)
             if store.get("Pod", "fits").spec.node_name:
                 break
-        events, _ = store.list("Event")
-        by_reason = {e.reason: e for e in events}
+        # the recorder is async (broadcaster thread): poll for the drain
+        by_reason = {}
+        while time.monotonic() < deadline:
+            events, _ = store.list("Event")
+            by_reason = {e.reason: e for e in events}
+            if "Scheduled" in by_reason and "FailedScheduling" in by_reason:
+                break
+            time.sleep(0.02)
         assert "Scheduled" in by_reason
         assert "FailedScheduling" in by_reason
         assert "insufficient resources" in by_reason["FailedScheduling"].message
