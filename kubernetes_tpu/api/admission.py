@@ -28,6 +28,11 @@ class AdmissionChain:
     def __init__(self):
         self.mutators: List[Mutator] = []
         self.validators: List[Validator] = []
+        # set by the Store that owns this chain: plugins marked
+        # `wants_store` receive it (the reference's admission plugins
+        # get informers/clients via plugin initializers —
+        # apiserver/pkg/admission/initializer)
+        self.store = None
 
     def register_mutator(self, fn: Mutator) -> None:
         self.mutators.append(fn)
@@ -39,9 +44,15 @@ class AdmissionChain:
         """Run the chain (mutate, then validate).  Raises AdmissionError
         on rejection; returns the (mutated) object."""
         for m in self.mutators:
-            m(obj, operation)
+            if getattr(m, "wants_store", False):
+                m(obj, operation, self.store)
+            else:
+                m(obj, operation)
         for v in self.validators:
-            v(obj, operation)
+            if getattr(v, "wants_store", False):
+                v(obj, operation, self.store)
+            else:
+                v(obj, operation)
         return obj
 
 
@@ -95,6 +106,60 @@ def validate_pod(obj: Any, operation: str) -> None:
             )
 
 
+def default_service(obj: Any, operation: str, store=None) -> None:
+    """ClusterIP allocation (the apiserver Service REST strategy's
+    allocator, pkg/registry/core/service/ipallocator): a deterministic
+    hash into 10.96.0.0/12, linear-probed against the Services already
+    stored so two names hashing together never share a VIP (the bitmap
+    allocator's uniqueness guarantee).  "None" (headless) and explicit
+    IPs pass through."""
+    if not isinstance(obj, api.Service):
+        return
+    if obj.spec.type == "ExternalName" or obj.spec.cluster_ip:
+        return
+    if operation == "CREATE":
+        import zlib
+
+        used = set()
+        if store is not None:
+            services, _ = store.list("Service")
+            used = {s.spec.cluster_ip for s in services if s.spec.cluster_ip}
+        space = (1 << 20) - 2  # /12 host space, avoiding .0.0.0
+        h = zlib.crc32(
+            f"{obj.meta.namespace}/{obj.meta.name}".encode()
+        ) % space + 1
+        for _ in range(space):
+            ip = f"10.{96 + (h >> 16)}.{(h >> 8) & 0xFF}.{h & 0xFF}"
+            if ip not in used:
+                obj.spec.cluster_ip = ip
+                return
+            h = h % space + 1
+        raise AdmissionError("cluster IP space exhausted")
+
+
+default_service.wants_store = True
+
+
+def validate_service(obj: Any, operation: str) -> None:
+    if not isinstance(obj, api.Service):
+        return
+    if obj.spec.type == "ExternalName":
+        if not obj.spec.external_name:
+            raise AdmissionError("externalName required for ExternalName type")
+        return
+    if not obj.spec.ports:
+        raise AdmissionError("service must declare at least one port")
+    seen = set()
+    for p in obj.spec.ports:
+        if not (0 < p.port < 65536):
+            raise AdmissionError(f"invalid service port {p.port}")
+        if (p.name, p.protocol, p.port) in seen:
+            raise AdmissionError(f"duplicate service port {p.port}")
+        seen.add((p.name, p.protocol, p.port))
+    if len(obj.spec.ports) > 1 and any(not p.name for p in obj.spec.ports):
+        raise AdmissionError("multi-port services require port names")
+
+
 def validate_node(obj: Any, operation: str) -> None:
     if not isinstance(obj, api.Node):
         return
@@ -109,7 +174,9 @@ def validate_node(obj: Any, operation: str) -> None:
 def default_chain() -> AdmissionChain:
     chain = AdmissionChain()
     chain.register_mutator(default_pod)
+    chain.register_mutator(default_service)
     chain.register_validator(validate_meta)
     chain.register_validator(validate_pod)
     chain.register_validator(validate_node)
+    chain.register_validator(validate_service)
     return chain

@@ -141,6 +141,7 @@ def pod_from_dict(d: Dict[str, Any]) -> api.Pod:
         for p in c.get("ports") or []:
             cont.ports.append(
                 api.ContainerPort(
+                    name=p.get("name", ""),
                     container_port=int(p.get("containerPort", 0)),
                     host_port=int(p.get("hostPort", 0)),
                     protocol=p.get("protocol", "TCP"),
@@ -430,7 +431,40 @@ def deviceclass_from_dict(d: Dict[str, Any]) -> api.DeviceClass:
 
 
 # kind -> converter, the CLI's `create -f` dispatch table
+def service_from_dict(d: Dict[str, Any]) -> api.Service:
+    """core/v1 Service (types.go:5517): selector + ports + clusterIP."""
+    spec = d.get("spec") or {}
+    ports = []
+    for p in spec.get("ports") or []:
+        tp = p.get("targetPort", 0)
+        ports.append(
+            api.ServicePort(
+                name=p.get("name", ""),
+                protocol=p.get("protocol", "TCP"),
+                port=int(p.get("port", 0)),
+                target_port=int(tp) if isinstance(tp, int) else 0,
+                target_port_name=tp if isinstance(tp, str) else "",
+                node_port=int(p.get("nodePort", 0)),
+            )
+        )
+    return api.Service(
+        meta=_meta_from_dict(d),
+        spec=api.ServiceSpec(
+            selector=dict(spec.get("selector") or {}),
+            ports=ports,
+            cluster_ip=spec.get("clusterIP", ""),
+            type=spec.get("type", "ClusterIP"),
+            external_name=spec.get("externalName", ""),
+            session_affinity=spec.get("sessionAffinity", "None"),
+            publish_not_ready_addresses=bool(
+                spec.get("publishNotReadyAddresses", False)
+            ),
+        ),
+    )
+
+
 CONVERTERS = {
+    "Service": service_from_dict,
     "Node": node_from_dict,
     "Pod": pod_from_dict,
     "Deployment": deployment_from_dict,

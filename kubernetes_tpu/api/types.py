@@ -324,10 +324,25 @@ def tolerations_tolerate_taint(tols: List[Toleration], taint: Taint) -> bool:
 
 @dataclass
 class ContainerPort:
+    name: str = ""                # named port (Service targetPort refs)
     container_port: int = 0
     host_port: int = 0            # 0 => no host port claim
     protocol: str = "TCP"
     host_ip: str = ""             # "" or "0.0.0.0" => wildcard
+
+
+@dataclass
+class Probe:
+    """core/v1 Probe timing envelope (types.go Probe).  The probe
+    ACTION (exec/http/tcp) is carried out by the node agent's runtime;
+    the hollow runtime resolves outcomes from agent annotations so
+    tests and kubemark can script failures (agent.py)."""
+
+    initial_delay_seconds: float = 0.0
+    period_seconds: float = 1.0
+    failure_threshold: int = 3
+    success_threshold: int = 1
+    timeout_seconds: float = 1.0
 
 
 @dataclass
@@ -337,6 +352,9 @@ class Container:
     requests: Dict[str, int] = field(default_factory=dict)
     limits: Dict[str, int] = field(default_factory=dict)
     ports: List[ContainerPort] = field(default_factory=list)
+    readiness_probe: Optional[Probe] = None
+    liveness_probe: Optional[Probe] = None
+    startup_probe: Optional[Probe] = None
 
 
 @dataclass
@@ -375,6 +393,11 @@ class PodStatus:
     phase: str = "Pending"        # Pending | Running | Succeeded | Failed
     conditions: List[Dict[str, Any]] = field(default_factory=list)
     nominated_node_name: str = ""
+    pod_ip: str = ""              # set by the node agent once running
+    host_ip: str = ""
+    # per-container restart counts, by container name (node agent v1);
+    # the containerStatuses[].restartCount aggregate
+    restart_counts: Dict[str, int] = field(default_factory=dict)
 
 
 @dataclass
@@ -925,6 +948,140 @@ class CronJob:
     status: CronJobStatus = field(default_factory=CronJobStatus)
 
     KIND = "CronJob"
+
+
+# ---------------------------------------------------------------------------
+# Services & endpoints (reference: staging/src/k8s.io/api/core/v1/types.go:5517
+# Service, :6088 Endpoints; staging/src/k8s.io/api/discovery/v1/types.go
+# EndpointSlice).  A Service names a virtual IP + port set; the
+# endpointslice controller materialises "what backs this VIP" from the
+# ready pods matching the selector.
+# ---------------------------------------------------------------------------
+
+
+LABEL_SERVICE_NAME = "kubernetes.io/service-name"  # discovery/v1 well-known
+
+
+@dataclass
+class ServicePort:
+    name: str = ""
+    protocol: str = "TCP"
+    port: int = 0
+    # target port on the backend pods; 0 means same as `port`.  Named
+    # targetPorts (string form) resolve against container port names at
+    # slice-build time, like the reference's findPort
+    # (pkg/api/v1/pod/util.go FindPort).
+    target_port: int = 0
+    target_port_name: str = ""
+    node_port: int = 0
+
+
+@dataclass
+class ServiceSpec:
+    selector: Dict[str, str] = field(default_factory=dict)
+    ports: List[ServicePort] = field(default_factory=list)
+    cluster_ip: str = ""   # allocated at admission ("" = allocate; "None" = headless)
+    type: str = "ClusterIP"  # ClusterIP | NodePort | LoadBalancer | ExternalName
+    external_name: str = ""
+    session_affinity: str = "None"  # None | ClientIP
+    publish_not_ready_addresses: bool = False
+
+
+@dataclass
+class LoadBalancerIngress:
+    ip: str = ""
+    hostname: str = ""
+
+
+@dataclass
+class ServiceStatus:
+    load_balancer: List[LoadBalancerIngress] = field(default_factory=list)
+
+
+@dataclass
+class Service:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+    status: ServiceStatus = field(default_factory=ServiceStatus)
+
+    KIND = "Service"
+
+
+@dataclass
+class EndpointConditions:
+    ready: bool = True
+    serving: bool = True
+    terminating: bool = False
+
+
+@dataclass
+class Endpoint:
+    """discovery/v1 Endpoint: one backend of a slice."""
+
+    addresses: List[str] = field(default_factory=list)
+    conditions: EndpointConditions = field(default_factory=EndpointConditions)
+    node_name: str = ""
+    target_ref_kind: str = "Pod"
+    target_ref_name: str = ""
+    zone: str = ""
+
+
+@dataclass
+class EndpointPort:
+    name: str = ""
+    protocol: str = "TCP"
+    port: int = 0
+
+
+@dataclass
+class EndpointSlice:
+    """discovery/v1 EndpointSlice: a bounded chunk (<=100 endpoints by
+    default) of a Service's backends, labeled kubernetes.io/service-name.
+    Slicing bounds the write amplification of large services: one pod's
+    readiness flip rewrites one slice, not the whole endpoint set."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    address_type: str = "IPv4"
+    endpoints: List[Endpoint] = field(default_factory=list)
+    ports: List[EndpointPort] = field(default_factory=list)
+
+    KIND = "EndpointSlice"
+
+
+@dataclass
+class EndpointAddress:
+    ip: str = ""
+    node_name: str = ""
+    target_ref_name: str = ""
+
+
+@dataclass
+class EndpointSubset:
+    addresses: List[EndpointAddress] = field(default_factory=list)
+    not_ready_addresses: List[EndpointAddress] = field(default_factory=list)
+    ports: List[EndpointPort] = field(default_factory=list)
+
+
+@dataclass
+class Endpoints:
+    """core/v1 Endpoints (legacy aggregate view; kubectl get endpoints).
+    Maintained alongside slices by the endpoints controller
+    (pkg/controller/endpoint/endpoints_controller.go)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    subsets: List[EndpointSubset] = field(default_factory=list)
+
+    KIND = "Endpoints"
+
+
+def pod_is_ready(pod: "Pod") -> bool:
+    """The Ready condition when the node agent reports one, else the
+    Running-phase fallback (hollow kubelets flip phase without
+    conditions) — podutil.IsPodReady."""
+    for c in pod.status.conditions:
+        if c.get("type") == "Ready":
+            return c.get("status") in (True, "True")
+    return pod.status.phase == "Running"
 
 
 def clone(obj):

@@ -32,6 +32,10 @@ KINDS = {
     "job": "Job", "jobs": "Job",
     "event": "Event", "events": "Event", "ev": "Event",
     "lease": "Lease", "leases": "Lease",
+    "service": "Service", "services": "Service", "svc": "Service",
+    "endpoints": "Endpoints", "ep": "Endpoints",
+    "endpointslice": "EndpointSlice", "endpointslices": "EndpointSlice",
+    "eps": "EndpointSlice",
 }
 
 
@@ -66,6 +70,16 @@ def _fmt_any(o) -> List[str]:
         return [name, f"succeeded={o.status.succeeded}", f"active={o.status.active}"]
     if isinstance(o, api.Event):
         return [name, o.type, o.reason, f"x{o.count}", o.message[:60]]
+    if isinstance(o, api.Service):
+        ports = ",".join(f"{p.port}/{p.protocol}" for p in o.spec.ports)
+        return [name, o.spec.type, o.spec.cluster_ip or "<none>", ports]
+    if isinstance(o, api.Endpoints):
+        addrs = [a.ip for s in o.subsets for a in s.addresses]
+        shown = ",".join(addrs[:3]) + ("..." if len(addrs) > 3 else "")
+        return [name, shown or "<none>"]
+    if isinstance(o, api.EndpointSlice):
+        ready = sum(1 for e in o.endpoints if e.conditions.ready)
+        return [name, o.address_type, f"{ready}/{len(o.endpoints)} ready"]
     return [name]
 
 

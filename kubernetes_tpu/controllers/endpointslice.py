@@ -1,0 +1,305 @@
+"""Endpoint controllers: materialise "what backs this Service".
+
+Reference: pkg/controller/endpointslice (reconciler.go, the slice
+packing + minimal-write logic) and pkg/controller/endpoint
+(endpoints_controller.go, the legacy aggregate object).  One controller
+here maintains BOTH outputs from one computed backend set — the two
+reference controllers independently recompute identical pod→service
+matches; folding them halves the informer work at kubemark scale.
+
+Shape of the reconcile:
+  pod event  -> match the ONE changed pod against the namespace's
+                services (O(services-in-ns), the reference's
+                getPodServiceMemberships) -> enqueue those services
+  svc event  -> enqueue
+  sync(svc)  -> desired backends = ready/serving pods matching the
+                selector, sorted -> packed into EndpointSlices of
+                <=100 endpoints -> diffed against owned slices with
+                create/update/delete keeping unchanged slices
+                untouched (one pod's readiness flip rewrites one
+                slice, not the whole set) -> legacy Endpoints object
+                rewritten only when its content changed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..api import store as st
+from ..api import types as api
+from .base import Controller, split_key
+
+MAX_ENDPOINTS_PER_SLICE = 100  # discovery.k8s.io default
+
+
+def _slice_index(name: str) -> int:
+    tail = name.rsplit("-", 1)[-1]
+    return int(tail) if tail.isdigit() else 0
+
+
+def _service_key_of_slice(s: api.EndpointSlice) -> Optional[str]:
+    name = s.meta.labels.get(api.LABEL_SERVICE_NAME)
+    if not name:
+        return None
+    return f"{s.meta.namespace}/{name}"
+
+
+def _resolve_target_port(port: api.ServicePort, pods: List[api.Pod]) -> int:
+    """Numeric backend port for a ServicePort (FindPort,
+    pkg/api/v1/pod/util.go): named targetPorts resolve against the
+    first matching container port; numeric pass through; 0 falls back
+    to the front port."""
+    if port.target_port:
+        return port.target_port
+    if port.target_port_name:
+        for pod in pods:
+            for c in pod.spec.containers:
+                for cp in c.ports:
+                    if cp.name == port.target_port_name:
+                        return cp.container_port
+        return 0
+    return port.port
+
+
+def _endpoint_of(pod: api.Pod) -> api.Endpoint:
+    return api.Endpoint(
+        addresses=[pod.status.pod_ip] if pod.status.pod_ip else [],
+        conditions=api.EndpointConditions(
+            ready=api.pod_is_ready(pod),
+            serving=api.pod_is_ready(pod),
+            terminating=bool(pod.meta.deletion_timestamp),
+        ),
+        node_name=pod.spec.node_name,
+        target_ref_name=pod.meta.name,
+    )
+
+
+def _endpoints_equal(a: api.Endpoint, b: api.Endpoint) -> bool:
+    return (
+        a.addresses == b.addresses
+        and a.conditions == b.conditions
+        and a.node_name == b.node_name
+        and a.target_ref_name == b.target_ref_name
+    )
+
+
+class EndpointSliceController(Controller):
+    KIND = "Service"
+
+    def register(self) -> None:
+        self.informers.informer("Service").add_handler(self._on_service)
+        self.informers.informer("Pod").add_handler(self._on_pod)
+        self.informers.informer("EndpointSlice").add_handler(self._on_slice)
+
+    # -- event routing -----------------------------------------------------
+
+    def _on_service(self, typ: str, svc: api.Service, old) -> None:
+        self.enqueue(svc)
+
+    def _on_pod(self, typ: str, pod: api.Pod, old) -> None:
+        """Route the changed pod to the services it matches (and, on
+        label change, the ones it STOPPED matching)."""
+        for svc in self.informers.informer("Service").list():
+            if svc.meta.namespace != pod.meta.namespace:
+                continue
+            sel = svc.spec.selector
+            if not sel:
+                continue
+            labels = pod.meta.labels
+            matches = all(labels.get(k) == v for k, v in sel.items())
+            matched_old = (
+                old is not None
+                and all(old.meta.labels.get(k) == v for k, v in sel.items())
+            )
+            if matches or matched_old:
+                self.enqueue(svc)
+
+    def _on_slice(self, typ: str, s: api.EndpointSlice, old) -> None:
+        # repair: a hand-deleted/mutated slice re-syncs its service
+        key = _service_key_of_slice(s)
+        if key:
+            self.queue.add(key)
+
+    # -- reconcile ---------------------------------------------------------
+
+    def sync(self, key: str) -> None:
+        namespace, name = split_key(key)
+        owned = [
+            s
+            for s in self.informers.informer("EndpointSlice").list()
+            if s.meta.namespace == namespace
+            and s.meta.labels.get(api.LABEL_SERVICE_NAME) == name
+        ]
+        try:
+            svc = self.store.get("Service", name, namespace)
+        except st.NotFound:
+            # service gone: reap its slices + legacy object
+            for s in owned:
+                self._delete_slice(s)
+            try:
+                self.store.delete("Endpoints", name, namespace)
+            except st.NotFound:
+                pass
+            return
+        if not svc.spec.selector or svc.spec.type == "ExternalName":
+            return  # selector-less services are managed by their owner
+        backends = self._backends(svc)
+        ports = [
+            api.EndpointPort(
+                name=p.name,
+                protocol=p.protocol,
+                port=_resolve_target_port(p, backends),
+            )
+            for p in svc.spec.ports
+        ]
+        desired = [_endpoint_of(p) for p in backends]
+        if not svc.spec.publish_not_ready_addresses:
+            desired = [e for e in desired if e.addresses]
+        self._reconcile_slices(svc, desired, ports, owned)
+        self._reconcile_legacy(svc, backends, ports)
+
+    def _backends(self, svc: api.Service) -> List[api.Pod]:
+        sel = svc.spec.selector
+        out = []
+        for p in self.informers.informer("Pod").list():
+            if p.meta.namespace != svc.meta.namespace:
+                continue
+            if p.status.phase in ("Succeeded", "Failed"):
+                continue
+            if all(p.meta.labels.get(k) == v for k, v in sel.items()):
+                out.append(p)
+        out.sort(key=lambda p: p.meta.name)
+        return out
+
+    # -- slice packing/diffing (reconciler.go) ------------------------------
+
+    def _reconcile_slices(
+        self,
+        svc: api.Service,
+        desired: List[api.Endpoint],
+        ports: List[api.EndpointPort],
+        owned: List[api.EndpointSlice],
+    ) -> None:
+        chunks: List[List[api.Endpoint]] = [
+            desired[i : i + MAX_ENDPOINTS_PER_SLICE]
+            for i in range(0, len(desired), MAX_ENDPOINTS_PER_SLICE)
+        ] or [[]]
+        # numeric suffix order (zero-padded names keep lexicographic ==
+        # numeric, but sort numerically anyway for robustness): chunk i
+        # must pair with slice i or >10-slice services rewrite most
+        # slices per change
+        owned.sort(key=lambda s: _slice_index(s.meta.name))
+        # pair chunks with existing slices positionally (stable sort on
+        # both sides keeps an unchanged prefix byte-identical); update
+        # only pairs whose content differs
+        for i, chunk in enumerate(chunks):
+            if i < len(owned):
+                s = owned[i]
+                same = (
+                    len(s.endpoints) == len(chunk)
+                    and all(
+                        _endpoints_equal(a, b)
+                        for a, b in zip(s.endpoints, chunk)
+                    )
+                    and s.ports == ports
+                )
+                if not same:
+                    # mutate a COPY: `s` is the shared informer-cache
+                    # object; editing it in place would make a failed
+                    # update look already-converged on retry
+                    s = api.clone(s)
+                    s.endpoints = chunk
+                    s.ports = ports
+                    self.store.update(s)
+            else:
+                fresh = api.EndpointSlice(
+                    meta=api.ObjectMeta(
+                        name=f"{svc.meta.name}-{i:04d}",
+                        namespace=svc.meta.namespace,
+                        labels={api.LABEL_SERVICE_NAME: svc.meta.name},
+                        owner_references=[
+                            api.OwnerReference(
+                                kind="Service",
+                                name=svc.meta.name,
+                                uid=svc.meta.uid,
+                                controller=True,
+                            )
+                        ],
+                    ),
+                    endpoints=chunk,
+                    ports=ports,
+                )
+                try:
+                    self.store.create(fresh)
+                except st.AlreadyExists:
+                    # informer cache lag: the slice exists but wasn't in
+                    # `owned` yet — converge by overwriting its content
+                    cur = self.store.get(
+                        "EndpointSlice", fresh.meta.name, fresh.meta.namespace
+                    )
+                    cur.endpoints = chunk
+                    cur.ports = ports
+                    cur.meta.labels[api.LABEL_SERVICE_NAME] = svc.meta.name
+                    self.store.update(cur, force=True)
+        for s in owned[len(chunks):]:
+            self._delete_slice(s)
+
+    def _delete_slice(self, s: api.EndpointSlice) -> None:
+        try:
+            self.store.delete("EndpointSlice", s.meta.name, s.meta.namespace)
+        except st.NotFound:
+            pass
+
+    # -- legacy Endpoints (endpoints_controller.go) -------------------------
+
+    def _reconcile_legacy(
+        self,
+        svc: api.Service,
+        backends: List[api.Pod],
+        ports: List[api.EndpointPort],
+    ) -> None:
+        ready: List[api.EndpointAddress] = []
+        not_ready: List[api.EndpointAddress] = []
+        for p in backends:
+            if not p.status.pod_ip:
+                continue
+            addr = api.EndpointAddress(
+                ip=p.status.pod_ip,
+                node_name=p.spec.node_name,
+                target_ref_name=p.meta.name,
+            )
+            (ready if api.pod_is_ready(p) else not_ready).append(addr)
+        subsets = (
+            [
+                api.EndpointSubset(
+                    addresses=ready,
+                    not_ready_addresses=not_ready,
+                    ports=ports,
+                )
+            ]
+            if (ready or not_ready)
+            else []
+        )
+        try:
+            cur = self.store.get("Endpoints", svc.meta.name, svc.meta.namespace)
+            if cur.subsets != subsets:
+                cur.subsets = subsets
+                self.store.update(cur)
+        except st.NotFound:
+            self.store.create(
+                api.Endpoints(
+                    meta=api.ObjectMeta(
+                        name=svc.meta.name,
+                        namespace=svc.meta.namespace,
+                        owner_references=[
+                            api.OwnerReference(
+                                kind="Service",
+                                name=svc.meta.name,
+                                uid=svc.meta.uid,
+                                controller=True,
+                            )
+                        ],
+                    ),
+                    subsets=subsets,
+                )
+            )

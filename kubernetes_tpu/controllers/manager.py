@@ -17,6 +17,7 @@ from .cronjob import CronJobController
 from .daemonset import DaemonSetController
 from .deployment import DeploymentController
 from .disruption import DisruptionController
+from .endpointslice import EndpointSliceController
 from .garbagecollector import GarbageCollector
 from .job import JobController
 from .namespace import NamespaceController
@@ -33,6 +34,7 @@ DEFAULT_CONTROLLERS: List[Type[Controller]] = [
     StatefulSetController,
     DaemonSetController,
     CronJobController,
+    EndpointSliceController,
 ]
 
 
@@ -55,6 +57,7 @@ class ControllerManager:
         for kind in (
             "Pod", "ReplicaSet", "Deployment", "Job", "PodDisruptionBudget",
             "Namespace", "StatefulSet", "DaemonSet", "CronJob", "Node",
+            "Service", "EndpointSlice",
         ):
             self.informers.informer(kind).start()
         self.informers.wait_for_sync()
