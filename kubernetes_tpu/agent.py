@@ -1,0 +1,454 @@
+"""Node agent v1: the kubelet's pod-lifecycle half as a per-pod FSM.
+
+Reference: pkg/kubelet — syncLoop (kubelet.go:2338) feeding per-pod
+workers (pod_workers.go), probe workers (prober/worker.go) gating the
+Ready condition, restart policy enforcement in syncPod, graceful
+deletion (kubelet.go HandlePodRemoves + the apiserver's two-phase
+delete), and the checkpoint manager (checkpointmanager/
+checkpoint_manager.go:36) that lets an agent restart without losing
+container state.
+
+The runtime is hollow (kubemark's fake runtime): containers don't run,
+but the CONTROL surface is real — probe outcomes, restarts, exits, and
+termination are scripted through pod annotations so tests and kubemark
+churn can drive every path:
+
+  agent.kubernetes.io/fail-readiness: "true"   readiness probe fails
+  agent.kubernetes.io/fail-liveness:  "true"   liveness probe fails
+                                               (restart per policy)
+  agent.kubernetes.io/exit-after: "1.5"        container exits after
+                                               1.5s of running
+  agent.kubernetes.io/exit-code:  "1"          ... with this exit code
+
+Annotations are re-read each tick, so a test can flip readiness at
+runtime exactly like a real probe starting to fail.
+
+State machine per pod (pod_workers.go's SyncPod/TerminatingPod):
+
+  observed bound ─→ starting ──(startup window)──→ running
+        ▲               │                             │ liveness fail /
+        │               │◀────── restart ─────────────┘ scripted exit
+        │               │ (policy allows; restartCount++)
+        │               └──(policy forbids)→ terminal (Succeeded/Failed)
+  deletionTimestamp at any point → terminating ──(grace)──→ finalizer
+  dropped → object removed (two-phase delete, api/store.py delete()).
+
+Checkpoint: restart counts, start times, and the pod-IP counter are
+journaled to a JSON file on every change (atomic replace); a restarted
+agent resumes its pods with state intact (kill-and-resume).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional
+
+from .api import store as st
+from .api import types as api
+
+FINALIZER = "agent.kubernetes.io/running"
+
+ANN_FAIL_READINESS = "agent.kubernetes.io/fail-readiness"
+ANN_FAIL_LIVENESS = "agent.kubernetes.io/fail-liveness"
+ANN_EXIT_AFTER = "agent.kubernetes.io/exit-after"
+ANN_EXIT_CODE = "agent.kubernetes.io/exit-code"
+
+
+class _PodWorker:
+    """One pod's FSM state (pod_workers.go podSyncStatus)."""
+
+    def __init__(self, pod: api.Pod, now: float):
+        self.pod = pod
+        self.state = "starting"          # starting | running | terminating | terminal
+        self.started_at = now            # current container start (wall)
+        self.terminating_since: Optional[float] = None
+        self.restart_counts: Dict[str, int] = {}
+        self.ready = False
+        self.live_fails = 0              # consecutive liveness failures
+        self.ready_successes = 0         # consecutive readiness successes
+        self.phase = ""                  # terminal phase once decided
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "state": self.state,
+            "started_at": self.started_at,
+            "restart_counts": self.restart_counts,
+            "ready": self.ready,
+            "phase": self.phase,
+        }
+
+    def load(self, d: Dict[str, Any]) -> None:
+        self.state = d.get("state", "starting")
+        self.started_at = d.get("started_at", self.started_at)
+        self.restart_counts = dict(d.get("restart_counts", {}))
+        self.ready = bool(d.get("ready", False))
+        self.phase = d.get("phase", "")
+
+
+class NodeAgent:
+    """One node's kubelet: watches its pods, runs their FSMs, reports
+    status through the API, heartbeats the Node object."""
+
+    def __init__(
+        self,
+        store: st.Store,
+        node_name: str,
+        checkpoint_path: Optional[str] = None,
+        tick: float = 0.05,
+        heartbeat_interval: float = 10.0,
+        register: bool = False,
+        cpu_milli: int = 32000,
+        mem: int = 64 * (1 << 30),
+        pods_cap: int = 110,
+    ):
+        self.store = store
+        self.node_name = node_name
+        self.tick = tick
+        self.heartbeat_interval = heartbeat_interval
+        self.checkpoint_path = checkpoint_path
+        self._workers: Dict[str, _PodWorker] = {}
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._ip_counter = 0
+        self._register = register
+        self._caps = (cpu_milli, mem, pods_cap)
+        if checkpoint_path and os.path.exists(checkpoint_path):
+            self._load_checkpoint()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "NodeAgent":
+        if self._register:
+            self._register_node()
+        t = threading.Thread(
+            target=self._sync_loop, name=f"agent-{self.node_name}", daemon=True
+        )
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(
+            target=self._heartbeat_loop,
+            name=f"agent-hb-{self.node_name}",
+            daemon=True,
+        )
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def _register_node(self) -> None:
+        cpu, mem, pods = self._caps
+        node = api.Node(
+            meta=api.ObjectMeta(
+                name=self.node_name,
+                namespace="",
+                labels={api.LABEL_HOSTNAME: self.node_name},
+            ),
+            status=api.NodeStatus(
+                allocatable={api.CPU: cpu, api.MEMORY: mem, api.PODS: pods},
+                capacity={api.CPU: cpu, api.MEMORY: mem, api.PODS: pods},
+            ),
+        )
+        try:
+            self.store.create(node)
+        except st.AlreadyExists:
+            pass
+
+    # -- the sync loop (kubelet.go:2338) -------------------------------------
+
+    def _sync_loop(self) -> None:
+        pods, rv = self.store.list("Pod")
+        for p in pods:
+            self._observe(p)
+        w = self.store.watch("Pod", from_rv=rv)
+        try:
+            while not self._stop.is_set():
+                if w.stopped:
+                    # terminated as a slow watcher: relist + rewatch
+                    # (reflector contract), reconciling the worker set
+                    w.stop()
+                    pods, rv = self.store.list("Pod")
+                    mine = set()
+                    for p in pods:
+                        self._observe(p)
+                        if p.spec.node_name == self.node_name:
+                            mine.add(_key(p))
+                    for key in list(self._workers):
+                        if key not in mine:
+                            self._workers.pop(key, None)
+                    w = self.store.watch("Pod", from_rv=rv)
+                # drain config events, then advance every worker one step
+                while True:
+                    ev = w.get(timeout=0.0)
+                    if ev is None:
+                        break
+                    if ev.type == st.DELETED:
+                        self._workers.pop(_key(ev.obj), None)
+                    else:
+                        self._observe(ev.obj)
+                now = time.time()
+                for key in list(self._workers):
+                    try:
+                        self._advance(key, now)
+                    except st.NotFound:
+                        self._workers.pop(key, None)
+                    except st.Conflict:
+                        pass  # re-read next tick
+                self._checkpoint()
+                self._stop.wait(self.tick)
+        finally:
+            w.stop()
+
+    def _observe(self, pod: api.Pod) -> None:
+        if pod.spec.node_name != self.node_name:
+            return
+        key = _key(pod)
+        worker = self._workers.get(key)
+        if worker is None:
+            worker = _PodWorker(pod, time.time())
+            # a checkpointed restart resumes counts for pods we had
+            saved = getattr(self, "_saved", {}).pop(key, None)
+            if saved:
+                worker.load(saved)
+            self._workers[key] = worker
+        worker.pod = pod
+        if pod.meta.deletion_timestamp is not None and worker.state not in (
+            "terminating",
+            "terminal",
+        ):
+            worker.state = "terminating"
+            worker.terminating_since = time.time()
+
+    # -- FSM ----------------------------------------------------------------
+
+    def _advance(self, key: str, now: float) -> None:
+        worker = self._workers[key]
+        pod = worker.pod
+        ann = pod.meta.annotations
+        if worker.state == "terminal":
+            return
+        if worker.state == "terminating":
+            grace = min(
+                float(pod.spec.termination_grace_period_seconds),
+                _grace_override(ann),
+            )
+            if now - (worker.terminating_since or now) >= grace:
+                self._finish_termination(worker)
+            return
+        if worker.state == "starting":
+            # add our finalizer once so deletion becomes two-phase
+            if FINALIZER not in pod.meta.finalizers:
+                self._mutate(worker, add_finalizer=True)
+                return
+            delay = max(
+                (c.startup_probe.initial_delay_seconds
+                 for c in pod.spec.containers if c.startup_probe),
+                default=0.0,
+            )
+            if now - worker.started_at >= delay:
+                worker.state = "running"
+                self._mutate(worker, running=True)
+            return
+        # running: scripted exit?
+        exit_after = ann.get(ANN_EXIT_AFTER)
+        if exit_after is not None and now - worker.started_at >= float(exit_after):
+            self._container_exit(worker, int(ann.get(ANN_EXIT_CODE, "0")))
+            return
+        # liveness (prober/worker.go): scripted failure accrues toward
+        # failureThreshold, then restarts per policy
+        probe = next(
+            (c.liveness_probe for c in pod.spec.containers if c.liveness_probe),
+            None,
+        )
+        threshold = probe.failure_threshold if probe else 3
+        if ann.get(ANN_FAIL_LIVENESS) == "true":
+            worker.live_fails += 1
+            if worker.live_fails >= threshold:
+                worker.live_fails = 0
+                self._restart_or_fail(worker, exit_code=137)
+                return
+        else:
+            worker.live_fails = 0
+        # readiness gates the Ready condition
+        desired_ready = ann.get(ANN_FAIL_READINESS) != "true"
+        if desired_ready != worker.ready:
+            worker.ready = desired_ready
+            self._mutate(worker)
+
+    def _restart_or_fail(self, worker: _PodWorker, exit_code: int) -> None:
+        pod = worker.pod
+        policy = pod.spec.restart_policy
+        if policy == "Always" or (policy == "OnFailure" and exit_code != 0):
+            # a spec with no containers (hollow pods created without the
+            # admission defaulter) still has one implicit container
+            for c in pod.spec.containers or [api.Container()]:
+                worker.restart_counts[c.name] = (
+                    worker.restart_counts.get(c.name, 0) + 1
+                )
+            worker.state = "starting"
+            worker.started_at = time.time()
+            worker.ready = False
+            self._mutate(worker)
+        else:
+            self._terminal(worker, "Failed" if exit_code else "Succeeded")
+
+    def _container_exit(self, worker: _PodWorker, exit_code: int) -> None:
+        # policy arbitration lives in _restart_or_fail: Always restarts
+        # any exit, OnFailure restarts non-zero, otherwise terminal phase
+        self._restart_or_fail(worker, exit_code)
+
+    def _terminal(self, worker: _PodWorker, phase: str) -> None:
+        worker.state = "terminal"
+        worker.phase = phase
+        worker.ready = False
+        # terminal pods must not block deletion: drop our finalizer now
+        self._mutate(worker, drop_finalizer=True)
+
+    def _finish_termination(self, worker: _PodWorker) -> None:
+        """Grace elapsed: release the finalizer; the store completes the
+        two-phase delete and the DELETED event untracks the worker."""
+        self._mutate(worker, drop_finalizer=True)
+
+    # -- status writes ------------------------------------------------------
+
+    def _mutate(
+        self,
+        worker: _PodWorker,
+        add_finalizer: bool = False,
+        drop_finalizer: bool = False,
+        running: bool = False,
+    ) -> None:
+        pod = self.store.get(
+            "Pod", worker.pod.meta.name, worker.pod.meta.namespace
+        )
+        if add_finalizer and FINALIZER not in pod.meta.finalizers:
+            pod.meta.finalizers.append(FINALIZER)
+        if drop_finalizer and FINALIZER in pod.meta.finalizers:
+            pod.meta.finalizers.remove(FINALIZER)
+        if running:
+            pod.status.phase = "Running"
+            if not pod.status.pod_ip:
+                pod.status.pod_ip = self._alloc_ip(worker)
+            pod.status.host_ip = self._node_ip()
+        if worker.phase:
+            pod.status.phase = worker.phase
+        pod.status.restart_counts = dict(worker.restart_counts)
+        conds = [c for c in pod.status.conditions if c.get("type") != "Ready"]
+        conds.append(
+            {
+                "type": "Ready",
+                "status": "True" if worker.ready else "False",
+                "lastTransitionTime": time.time(),
+            }
+        )
+        pod.status.conditions = conds
+        updated = self.store.update(pod, force=True)
+        worker.pod = updated
+
+    def _alloc_ip(self, worker: _PodWorker) -> str:
+        self._ip_counter += 1
+        h = zlib.crc32(self.node_name.encode()) % 250
+        return f"10.88.{h}.{(self._ip_counter % 253) + 1}"
+
+    def _node_ip(self) -> str:
+        h = zlib.crc32(self.node_name.encode())
+        return f"10.64.{(h >> 8) % 256}.{h % 256}"
+
+    # -- heartbeats ----------------------------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                node = self.store.get("Node", self.node_name, namespace="")
+                node.meta.annotations["agent/heartbeat"] = str(time.time())
+                conds = [
+                    c for c in node.status.conditions
+                    if c.get("type") != "Ready"
+                ]
+                conds.append({"type": "Ready", "status": "True"})
+                node.status.conditions = conds
+                self.store.update(node, force=True, copy_result=False)
+            except st.NotFound:
+                pass
+            self._publish_metrics()
+
+    def _publish_metrics(self) -> None:
+        """PodMetrics for each running pod (the metrics-server pipeline
+        the HPA consumes).  Usage comes from the cpu-usage annotation
+        (scriptable load) or defaults to ~60% of the pod's request."""
+        for worker in list(self._workers.values()):
+            pod = worker.pod
+            if worker.state != "running":
+                continue
+            ann = pod.meta.annotations
+            if "agent.kubernetes.io/cpu-usage" in ann:
+                cpu = int(float(ann["agent.kubernetes.io/cpu-usage"]))
+            else:
+                req = pod.resource_requests().get(api.CPU, 100)
+                cpu = int(req * 0.6)
+            m = api.PodMetrics(
+                meta=api.ObjectMeta(
+                    name=pod.meta.name, namespace=pod.meta.namespace
+                ),
+                usage={api.CPU: cpu},
+                timestamp=time.time(),
+            )
+            try:
+                self.store.create(m)
+            except st.AlreadyExists:
+                try:
+                    cur = self.store.get(
+                        "PodMetrics", pod.meta.name, pod.meta.namespace
+                    )
+                    cur.usage = m.usage
+                    cur.timestamp = m.timestamp
+                    self.store.update(cur, force=True, copy_result=False)
+                except st.NotFound:
+                    pass
+
+    # -- checkpoint (checkpoint_manager.go:36) --------------------------------
+
+    def _checkpoint(self) -> None:
+        if not self.checkpoint_path:
+            return
+        doc = {
+            "node": self.node_name,
+            "ip_counter": self._ip_counter,
+            "pods": {k: w.to_dict() for k, w in self._workers.items()},
+        }
+        blob = json.dumps(doc)
+        if blob == getattr(self, "_last_checkpoint", None):
+            return
+        tmp = self.checkpoint_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(blob)
+        os.replace(tmp, self.checkpoint_path)
+        self._last_checkpoint = blob
+
+    def _load_checkpoint(self) -> None:
+        try:
+            with open(self.checkpoint_path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return
+        if doc.get("node") != self.node_name:
+            return
+        self._ip_counter = int(doc.get("ip_counter", 0))
+        # pods re-adopt their saved worker state on first observation
+        self._saved: Dict[str, Dict[str, Any]] = dict(doc.get("pods", {}))
+
+
+def _key(pod: api.Pod) -> str:
+    return f"{pod.meta.namespace}/{pod.meta.name}"
+
+
+def _grace_override(ann: Dict[str, str]) -> float:
+    v = ann.get("agent.kubernetes.io/grace-seconds")
+    return float(v) if v else float("inf")

@@ -120,6 +120,20 @@ def _affinity(d: Optional[Dict[str, Any]]) -> Optional[api.Affinity]:
     return aff
 
 
+def _probe(d: Optional[Dict[str, Any]]) -> Optional[api.Probe]:
+    """core/v1 Probe timing fields (the action — exec/httpGet/tcpSocket —
+    is carried out by the node agent's hollow runtime)."""
+    if not d:
+        return None
+    return api.Probe(
+        initial_delay_seconds=float(d.get("initialDelaySeconds", 0)),
+        period_seconds=float(d.get("periodSeconds", 1)),
+        failure_threshold=int(d.get("failureThreshold", 3)),
+        success_threshold=int(d.get("successThreshold", 1)),
+        timeout_seconds=float(d.get("timeoutSeconds", 1)),
+    )
+
+
 def pod_from_dict(d: Dict[str, Any]) -> api.Pod:
     meta = d.get("metadata") or {}
     spec = d.get("spec") or {}
@@ -138,6 +152,9 @@ def pod_from_dict(d: Dict[str, Any]) -> api.Pod:
             requests=_requests((c.get("resources") or {}).get("requests")),
             limits=_requests((c.get("resources") or {}).get("limits")),
         )
+        cont.readiness_probe = _probe(c.get("readinessProbe"))
+        cont.liveness_probe = _probe(c.get("livenessProbe"))
+        cont.startup_probe = _probe(c.get("startupProbe"))
         for p in c.get("ports") or []:
             cont.ports.append(
                 api.ContainerPort(

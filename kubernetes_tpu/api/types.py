@@ -382,6 +382,7 @@ class PodSpec:
     scheduling_gates: List[str] = field(default_factory=list)
     restart_policy: str = "Always"
     termination_grace_period_seconds: int = 30
+    service_account: str = ""  # defaulted to "default" at admission
     volumes: List["Volume"] = field(default_factory=list)
     # ResourceClaim names (pod namespace) this pod consumes — the
     # pod.spec.resourceClaims reference (DRA)
@@ -853,6 +854,9 @@ class JobSpec:
     completions: Optional[int] = 1
     template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
     backoff_limit: int = 6
+    # ttlafterfinished controller: delete the Job (and its pods via GC)
+    # this many seconds after it finishes (batch/v1 TTLSecondsAfterFinished)
+    ttl_seconds_after_finished: Optional[float] = None
 
 
 @dataclass
@@ -933,6 +937,10 @@ class CronJobSpec:
     suspend: bool = False
     concurrency_policy: str = "Allow"  # Allow | Forbid | Replace
     starting_deadline_seconds: Optional[float] = None
+    # batch/v1 spec.timeZone: None = the controller's local time (the
+    # reference's default, DST caveats included); "UTC"/"Etc/UTC" pins
+    # evaluation to UTC, immune to DST double-fire/skip
+    time_zone: Optional[str] = None
 
 
 @dataclass
@@ -1072,6 +1080,154 @@ class Endpoints:
     subsets: List[EndpointSubset] = field(default_factory=list)
 
     KIND = "Endpoints"
+
+
+# ---------------------------------------------------------------------------
+# Autoscaling + quota + identity (reference: autoscaling/v1 types.go
+# HorizontalPodAutoscaler; core/v1 ResourceQuota :6392, ServiceAccount
+# :5190; metrics.k8s.io PodMetrics).
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ScaleTargetRef:
+    kind: str = "Deployment"
+    name: str = ""
+
+
+@dataclass
+class HorizontalPodAutoscalerSpec:
+    scale_target_ref: ScaleTargetRef = field(default_factory=ScaleTargetRef)
+    min_replicas: int = 1
+    max_replicas: int = 10
+    # autoscaling/v1 shape: average CPU utilization across pods as a
+    # percentage of their requests
+    target_cpu_utilization_percentage: int = 80
+
+
+@dataclass
+class HorizontalPodAutoscalerStatus:
+    current_replicas: int = 0
+    desired_replicas: int = 0
+    current_cpu_utilization_percentage: Optional[int] = None
+    last_scale_time: Optional[float] = None
+
+
+@dataclass
+class HorizontalPodAutoscaler:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: HorizontalPodAutoscalerSpec = field(
+        default_factory=HorizontalPodAutoscalerSpec
+    )
+    status: HorizontalPodAutoscalerStatus = field(
+        default_factory=HorizontalPodAutoscalerStatus
+    )
+
+    KIND = "HorizontalPodAutoscaler"
+
+
+@dataclass
+class PodMetrics:
+    """metrics.k8s.io PodMetrics reduced: the node agent reports each
+    running pod's usage (hollow runtime: scripted via the
+    agent.kubernetes.io/cpu-usage annotation, else ~60% of request)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    usage: Dict[str, int] = field(default_factory=dict)  # {CPU: millicores}
+    window_seconds: float = 10.0
+    timestamp: float = 0.0
+
+    KIND = "PodMetrics"
+
+
+@dataclass
+class ResourceQuotaSpec:
+    # hard limits by resource name: "pods", CPU ("cpu"), MEMORY
+    hard: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceQuotaStatus:
+    hard: Dict[str, int] = field(default_factory=dict)
+    used: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceQuota:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceQuotaSpec = field(default_factory=ResourceQuotaSpec)
+    status: ResourceQuotaStatus = field(default_factory=ResourceQuotaStatus)
+
+    KIND = "ResourceQuota"
+
+
+@dataclass
+class ServiceAccount:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    secrets: List[str] = field(default_factory=list)
+
+    KIND = "ServiceAccount"
+
+
+# ---------------------------------------------------------------------------
+# RBAC (reference: staging/src/k8s.io/api/rbac/v1/types.go; evaluated by
+# plugin/pkg/auth/authorizer/rbac/rbac.go:75).  Role/RoleBinding are
+# namespace-scoped grants; ClusterRole/ClusterRoleBinding are
+# cluster-wide.  A RoleBinding may reference a ClusterRole to grant its
+# rules within the binding's namespace only.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PolicyRule:
+    verbs: List[str] = field(default_factory=lambda: ["*"])
+    resources: List[str] = field(default_factory=lambda: ["*"])  # kinds
+
+
+@dataclass
+class RoleRef:
+    kind: str = "Role"  # Role | ClusterRole
+    name: str = ""
+
+
+@dataclass
+class RbacSubject:
+    kind: str = "User"  # User | Group
+    name: str = ""
+
+
+@dataclass
+class Role:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    rules: List[PolicyRule] = field(default_factory=list)
+
+    KIND = "Role"
+
+
+@dataclass
+class ClusterRole:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    rules: List[PolicyRule] = field(default_factory=list)
+
+    KIND = "ClusterRole"
+
+
+@dataclass
+class RoleBinding:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    subjects: List[RbacSubject] = field(default_factory=list)
+    role_ref: RoleRef = field(default_factory=RoleRef)
+
+    KIND = "RoleBinding"
+
+
+@dataclass
+class ClusterRoleBinding:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    subjects: List[RbacSubject] = field(default_factory=list)
+    role_ref: RoleRef = field(default_factory=RoleRef)
+
+    KIND = "ClusterRoleBinding"
 
 
 def pod_is_ready(pod: "Pod") -> bool:

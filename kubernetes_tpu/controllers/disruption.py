@@ -6,8 +6,9 @@ disruptionsAllowed on every relevant event.  Preemption consults
 status.disruptions_allowed when ranking victims
 (framework/preemption/preemption.go:290 filterPodsWithPDBViolation).
 
-Healthy = Running phase (the reference checks the Ready condition; our
-node agent surface reports phase).  desiredHealthy:
+Healthy = the Ready condition when a node agent reports one (matching
+the reference's IsPodReady check, disruption.go:910), falling back to
+Running phase for hollow nodes with no agent.  desiredHealthy:
   minAvailable set   -> minAvailable
   maxUnavailable set -> expectedPods - maxUnavailable
 """
@@ -50,7 +51,11 @@ class DisruptionController(Controller):
             if pdb.matches(p)
         ]
         expected = len(pods)
-        healthy = sum(1 for p in pods if p.status.phase == "Running")
+        healthy = sum(
+            1
+            for p in pods
+            if p.status.phase == "Running" and api.pod_is_ready(p)
+        )
         if pdb.spec.min_available is not None:
             desired = min(pdb.spec.min_available, expected)
         elif pdb.spec.max_unavailable is not None:

@@ -98,28 +98,43 @@ class HollowCluster:
 
     def _pod_runner(self) -> None:
         """The kubelet status half: bound Pending pods become Running
-        (status written through the API, like status manager PATCHes)."""
+        (status written through the API, like status manager PATCHes).
+        A watch the store terminated for falling behind is re-established
+        with a catch-up list (the reflector contract) — churn benches
+        kill slow watchers by design."""
         w = self.store.watch("Pod")
         try:
             while not self._stop.is_set():
+                if w.stopped:
+                    w.stop()
+                    pods, rv = self.store.list("Pod")
+                    for pod in pods:
+                        self._maybe_run(pod)  # catch up on missed binds
+                    # resume FROM the list's rv: binds landing between
+                    # the snapshot and the new watch must not vanish
+                    w = self.store.watch("Pod", from_rv=rv)
+                    continue
                 ev = w.get(timeout=0.2)
                 if ev is None:
                     continue
                 pod = ev.obj
-                if (
-                    ev.type in (st.ADDED, st.MODIFIED)
-                    and pod.spec.node_name
-                    and pod.spec.node_name.startswith("hollow-")
-                    and pod.status.phase == "Pending"
-                ):
-                    try:
-                        fresh = self.store.get(
-                            "Pod", pod.meta.name, pod.meta.namespace
-                        )
-                        if fresh.status.phase == "Pending" and fresh.spec.node_name:
-                            fresh.status.phase = "Running"
-                            self.store.update(fresh, force=True)
-                    except st.NotFound:
-                        pass
+                if ev.type in (st.ADDED, st.MODIFIED):
+                    self._maybe_run(pod)
         finally:
             w.stop()
+
+    def _maybe_run(self, pod) -> None:
+        if (
+            pod.spec.node_name
+            and pod.spec.node_name.startswith("hollow-")
+            and pod.status.phase == "Pending"
+        ):
+            try:
+                fresh = self.store.get(
+                    "Pod", pod.meta.name, pod.meta.namespace
+                )
+                if fresh.status.phase == "Pending" and fresh.spec.node_name:
+                    fresh.status.phase = "Running"
+                    self.store.update(fresh, force=True)
+            except st.NotFound:
+                pass
