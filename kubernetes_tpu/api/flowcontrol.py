@@ -1,0 +1,184 @@
+"""API Priority & Fairness, reduced to its load-bearing core.
+
+Reference: staging/src/k8s.io/apiserver/pkg/util/flowcontrol
+(apf_controller.go, apf_filter.go; wired into the handler chain at
+server/config.go:990-996).  The reference implementation is a
+config-driven controller reconciling FlowSchema/PriorityLevel objects
+into fair-queuing dispatchers (1,128 LoC of shuffle-sharding).  What
+that machinery BUYS an apiserver is: (1) requests are classified into
+priority levels, (2) each level has its own concurrency seats and a
+bounded FIFO queue, (3) when a level's queue is full new arrivals are
+shed with 429 + Retry-After, so (4) a flood in one level cannot starve
+another level's traffic.  This module provides exactly those four
+properties with static levels — the config-object dance is not what
+protects the store.
+
+  exempt         healthz/readyz + system:masters      (never queued)
+  system         system:* users/groups (schedulers, controllers, nodes)
+  workload-high  authenticated non-system users
+  catch-all      anonymous + everything else
+
+Watches hold a seat for their (long) lifetime in the reference too;
+here they are classified but acquire with a short timeout so a full
+level sheds them quickly instead of hanging the handler thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from . import auth as authmod
+
+
+class PriorityLevel:
+    """One level's seats + bounded waiting room (apf_filter.go's
+    queueSet reduced to a single FIFO-ish queue per level)."""
+
+    def __init__(self, name: str, seats: int, queue_limit: int):
+        self.name = name
+        self.seats = seats
+        self.queue_limit = queue_limit
+        self.in_flight = 0
+        self.queued = 0
+        self.rejected_total = 0
+        self.dispatched_total = 0
+        self._cond = threading.Condition()
+
+    def acquire(self, timeout: float) -> bool:
+        """Take a seat, waiting up to `timeout` in the queue; False =
+        shed (queue full or wait expired) — reply 429."""
+        with self._cond:
+            if self.in_flight < self.seats:
+                self.in_flight += 1
+                self.dispatched_total += 1
+                return True
+            if self.queued >= self.queue_limit:
+                self.rejected_total += 1
+                return False
+            self.queued += 1
+            deadline = time.monotonic() + timeout
+            try:
+                while self.in_flight >= self.seats:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        self.rejected_total += 1
+                        return False
+                    self._cond.wait(remaining)
+                self.in_flight += 1
+                self.dispatched_total += 1
+                return True
+            finally:
+                self.queued -= 1
+
+    def release(self) -> None:
+        with self._cond:
+            self.in_flight -= 1
+            self._cond.notify()
+
+
+@dataclass
+class FlowSchema:
+    """Classification rule: first match wins (FlowSchema precedence)."""
+
+    name: str
+    level: str
+    users: Tuple[str, ...] = ()     # exact names; () = any
+    groups: Tuple[str, ...] = ()    # any-of; () = any
+    verbs: Tuple[str, ...] = ()     # () = any
+
+    def matches(self, subject: authmod.Subject, verb: str) -> bool:
+        if self.users and subject.name not in self.users:
+            return False
+        if self.groups and not set(self.groups) & set(subject.groups):
+            return False
+        if self.verbs and verb not in self.verbs:
+            return False
+        return True
+
+
+DEFAULT_LEVELS = {
+    # seats sized like the reference defaults' spirit: system traffic
+    # gets guaranteed headroom, the catch-all gets a small slice
+    "system": (16, 128),
+    "workload-high": (16, 128),
+    "catch-all": (4, 16),
+}
+
+DEFAULT_SCHEMAS = [
+    FlowSchema("system-leader-election", "system", groups=("system:masters",)),
+    FlowSchema("system-components", "system",
+               groups=("system:schedulers", "system:controllers",
+                       "system:nodes")),
+    FlowSchema("workload-high", "workload-high",
+               groups=("system:authenticated",)),
+    FlowSchema("catch-all", "catch-all"),
+]
+
+
+class APFGate:
+    """The filter the server calls around every request
+    (apf_filter.go Handle): classify -> acquire -> handle -> release."""
+
+    def __init__(
+        self,
+        levels: Optional[Dict[str, Tuple[int, int]]] = None,
+        schemas: Optional[List[FlowSchema]] = None,
+        queue_wait_s: float = 5.0,
+    ):
+        self.levels = {
+            name: PriorityLevel(name, seats, qlen)
+            for name, (seats, qlen) in (levels or DEFAULT_LEVELS).items()
+        }
+        self.schemas = list(schemas or DEFAULT_SCHEMAS)
+        self.queue_wait_s = queue_wait_s
+
+    def classify(self, subject: authmod.Subject, verb: str) -> PriorityLevel:
+        for schema in self.schemas:
+            if schema.matches(subject, verb) and schema.level in self.levels:
+                return self.levels[schema.level]
+        return self.levels["catch-all"]
+
+    def acquire(
+        self, subject: authmod.Subject, verb: str
+    ) -> Optional[PriorityLevel]:
+        """Seat for this request, or None → reply 429."""
+        level = self.classify(subject, verb)
+        if level.acquire(self.queue_wait_s):
+            return level
+        return None
+
+    def metrics(self) -> str:
+        """Prometheus text exposition of per-level state (the reference's
+        apiserver_flowcontrol_* series reduced)."""
+        lines = [
+            "# TYPE apiserver_flowcontrol_current_inqueue_requests gauge",
+        ]
+        for lv in self.levels.values():
+            lines.append(
+                "apiserver_flowcontrol_current_inqueue_requests"
+                f'{{priority_level="{lv.name}"}} {lv.queued}'
+            )
+        lines.append(
+            "# TYPE apiserver_flowcontrol_current_executing_requests gauge"
+        )
+        for lv in self.levels.values():
+            lines.append(
+                "apiserver_flowcontrol_current_executing_requests"
+                f'{{priority_level="{lv.name}"}} {lv.in_flight}'
+            )
+        lines.append("# TYPE apiserver_flowcontrol_rejected_requests_total counter")
+        for lv in self.levels.values():
+            lines.append(
+                "apiserver_flowcontrol_rejected_requests_total"
+                f'{{priority_level="{lv.name}"}} {lv.rejected_total}'
+            )
+        lines.append("# TYPE apiserver_flowcontrol_dispatched_requests_total counter")
+        for lv in self.levels.values():
+            lines.append(
+                "apiserver_flowcontrol_dispatched_requests_total"
+                f'{{priority_level="{lv.name}"}} {lv.dispatched_total}'
+            )
+        return "\n".join(lines) + "\n"

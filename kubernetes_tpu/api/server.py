@@ -137,7 +137,8 @@ def merge_patch(base, patch):
 class _Handler(BaseHTTPRequestHandler):
     store: st.Store  # bound by APIServer
     authn = None     # Optional[auth.TokenAuthenticator]
-    authz = None     # Optional[auth.RuleAuthorizer]
+    authz = None     # Optional[auth.RuleAuthorizer | auth.RBACAuthorizer]
+    apf = None       # Optional[flowcontrol.APFGate]
     protocol_version = "HTTP/1.1"
 
     def log_message(self, fmt, *args):
@@ -145,10 +146,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- helpers -----------------------------------------------------------
 
-    def _authorize(self, verb: str, kind: str) -> bool:
-        """authn -> authz gate; replies 401/403 and returns False on
-        rejection.  healthz stays open (the reference exempts health
-        endpoints before the chain)."""
+    def _authorize(self, verb: str, kind: str, namespace: str = "") -> bool:
+        """authn -> flow-control -> authz gate; replies 401/429/403 and
+        returns False on rejection.  healthz stays open (the reference
+        exempts health endpoints before the chain).  The APF seat, once
+        acquired, is released by the do_* wrapper's finally."""
         subject = authmod.ANONYMOUS
         if self.authn is not None:
             subject = self.authn.authenticate(
@@ -158,16 +160,42 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply({"error": "unauthorized",
                              "reason": "Unauthorized"}, 401)
                 return False
+        if self.apf is not None and self._apf_level is None:
+            level = self.apf.acquire(subject, verb)
+            if level is None:
+                data = json.dumps(
+                    {"error": "too many requests", "reason": "TooManyRequests"}
+                ).encode()
+                self.send_response(429)
+                self.send_header("Retry-After", "1")
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return False
+            self._apf_level = level
         if self.authz is not None and not self.authz.allowed(
-            subject, verb, kind
+            subject, verb, kind, namespace
         ):
             self._reply(
-                {"error": f"{subject.name} cannot {verb} {kind}",
+                {"error": f"{subject.name} cannot {verb} {kind}"
+                 + (f" in {namespace!r}" if namespace else ""),
                  "reason": "Forbidden"},
                 403,
             )
             return False
         return True
+
+    # every request handler runs inside this wrapper so an acquired APF
+    # seat is always released, whatever path the verb takes
+    def handle_one_request(self):  # noqa: N802 (stdlib name)
+        self._apf_level = None
+        try:
+            super().handle_one_request()
+        finally:
+            if self._apf_level is not None:
+                self._apf_level.release()
+                self._apf_level = None
 
     def _reply(self, obj, code: int = 200) -> None:
         data = json.dumps(obj).encode()
@@ -202,9 +230,9 @@ class _Handler(BaseHTTPRequestHandler):
                         return
                     return self._watch(parts[3], q)
                 if len(parts) == 3:
-                    if not self._authorize("list", parts[2]):
-                        return
                     namespace = q.get("namespace", [None])[0]
+                    if not self._authorize("list", parts[2], namespace or ""):
+                        return
                     preds = []
                     if q.get("labelSelector"):
                         preds.append(
@@ -228,13 +256,27 @@ class _Handler(BaseHTTPRequestHandler):
                         }
                     )
                 if len(parts) == 5:
-                    if not self._authorize("get", parts[2]):
-                        return
                     ns = "" if parts[3] == "-" else parts[3]
+                    if not self._authorize("get", parts[2], ns):
+                        return
                     obj = self.store.get(parts[2], parts[4], ns)
                     return self._reply(wire.to_wire(obj))
             if parts == ["healthz"] or parts == ["readyz"]:
                 return self._reply({"ok": True})
+            if parts == ["metrics"]:
+                # metrics go through the full chain like any resource
+                # (the reference grants system:monitoring via authz —
+                # only healthz/readyz are exempt)
+                if not self._authorize("get", "metrics"):
+                    return
+                body = self.apf.metrics() if self.apf is not None else ""
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
             self._reply({"error": f"unknown path {self.path}"}, 404)
         except Exception as e:
             self._error(e)
@@ -243,9 +285,10 @@ class _Handler(BaseHTTPRequestHandler):
         parts, _ = self._parts()
         try:
             if len(parts) == 3 and parts[:2] == ["api", "v1"]:
-                if not self._authorize("create", parts[2]):
-                    return
                 obj = wire.from_wire(self._body())
+                ns = getattr(obj.meta, "namespace", "") or ""
+                if not self._authorize("create", parts[2], ns):
+                    return
                 created = self.store.create(obj)
                 return self._reply(wire.to_wire(created), 201)
             self._reply({"error": f"unknown path {self.path}"}, 404)
@@ -264,16 +307,17 @@ class _Handler(BaseHTTPRequestHandler):
                 # spec edits through this path are dropped (the
                 # StatusStrategy PrepareForUpdate contract,
                 # registry/core/pod/strategy.go podStatusStrategy)
-                if not self._authorize("update", parts[2]):
+                ns = "" if parts[3] == "-" else parts[3]
+                if not self._authorize("update", parts[2], ns):
                     return
                 incoming = wire.from_wire(self._body())
-                ns = "" if parts[3] == "-" else parts[3]
                 current = self.store.get(parts[2], parts[4], ns)
                 current.status = incoming.status
                 updated = self.store.update(current)
                 return self._reply(wire.to_wire(updated))
             if len(parts) == 5 and parts[:2] == ["api", "v1"]:
-                if not self._authorize("update", parts[2]):
+                ns = "" if parts[3] == "-" else parts[3]
+                if not self._authorize("update", parts[2], ns):
                     return
                 obj = wire.from_wire(self._body())
                 force = q.get("force", ["0"])[0] == "1"
@@ -295,12 +339,18 @@ class _Handler(BaseHTTPRequestHandler):
                 and parts[5] == "status"
             )
             if (len(parts) == 5 or is_status) and parts[:2] == ["api", "v1"]:
-                if not self._authorize("patch", parts[2]):
-                    return
                 ns = "" if parts[3] == "-" else parts[3]
+                if not self._authorize("patch", parts[2], ns):
+                    return
+                patch = self._body()
+                if not isinstance(patch, dict):
+                    return self._reply(
+                        {"error": "merge patch body must be a JSON object",
+                         "reason": "BadRequest"},
+                        400,
+                    )
                 current = self.store.get(parts[2], parts[4], ns)
                 doc = wire.to_wire(current)
-                patch = self._body()
                 if is_status:
                     patch = {"status": patch.get("status", patch)}
                 merged = merge_patch(doc, patch)
@@ -318,9 +368,9 @@ class _Handler(BaseHTTPRequestHandler):
         parts, _ = self._parts()
         try:
             if len(parts) == 5 and parts[:2] == ["api", "v1"]:
-                if not self._authorize("delete", parts[2]):
-                    return
                 ns = "" if parts[3] == "-" else parts[3]
+                if not self._authorize("delete", parts[2], ns):
+                    return
                 self.store.delete(parts[2], parts[4], ns)
                 return self._reply({"deleted": True})
             self._reply({"error": f"unknown path {self.path}"}, 404)
@@ -398,10 +448,11 @@ class APIServer:
         port: int = 0,
         authn=None,
         authz=None,
+        apf=None,  # Optional[flowcontrol.APFGate]; classify→queue→shed
     ):
         handler = type(
             "BoundHandler", (_Handler,),
-            {"store": store, "authn": authn, "authz": authz},
+            {"store": store, "authn": authn, "authz": authz, "apf": apf},
         )
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self._thread: Optional[threading.Thread] = None
