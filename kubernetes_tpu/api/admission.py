@@ -175,8 +175,21 @@ def default_chain() -> AdmissionChain:
     chain = AdmissionChain()
     chain.register_mutator(default_pod)
     chain.register_mutator(default_service)
+    # serviceaccount admission (plugin/pkg/admission/serviceaccount)
+    from ..controllers.serviceaccount import default_service_account
+
+    chain.register_mutator(default_service_account)
     chain.register_validator(validate_meta)
     chain.register_validator(validate_pod)
     chain.register_validator(validate_node)
     chain.register_validator(validate_service)
+    # quota enforcement (plugin/pkg/admission/resourcequota)
+    from ..controllers.resourcequota import quota_validator
+
+    chain.register_validator(quota_validator)
+    # CRD schema validation (apiextensions structural schemas)
+    from .crd import validate_crd, validate_custom_resource
+
+    chain.register_validator(validate_crd)
+    chain.register_validator(validate_custom_resource)
     return chain

@@ -21,7 +21,10 @@ from .endpointslice import EndpointSliceController
 from .garbagecollector import GarbageCollector
 from .job import JobController
 from .namespace import NamespaceController
+from .podautoscaler import HorizontalPodAutoscalerController
 from .replicaset import ReplicaSetController
+from .resourcequota import ResourceQuotaController
+from .serviceaccount import ServiceAccountController, TTLAfterFinishedController
 from .statefulset import StatefulSetController
 
 DEFAULT_CONTROLLERS: List[Type[Controller]] = [
@@ -35,6 +38,10 @@ DEFAULT_CONTROLLERS: List[Type[Controller]] = [
     DaemonSetController,
     CronJobController,
     EndpointSliceController,
+    HorizontalPodAutoscalerController,
+    ResourceQuotaController,
+    ServiceAccountController,
+    TTLAfterFinishedController,
 ]
 
 
@@ -47,8 +54,12 @@ class ControllerManager:
     ):
         self.store = store
         self.informers = InformerFactory(store)
+        # keyed by NAME when a controller shares its primary KIND with
+        # another (TTLAfterFinished also reconciles Jobs)
         self.controllers: Dict[str, Controller] = {
-            cls.KIND: cls(store, self.informers, workers=workers)
+            getattr(cls, "NAME", cls.KIND): cls(
+                store, self.informers, workers=workers
+            )
             for cls in (controllers or DEFAULT_CONTROLLERS)
         }
 
@@ -57,7 +68,8 @@ class ControllerManager:
         for kind in (
             "Pod", "ReplicaSet", "Deployment", "Job", "PodDisruptionBudget",
             "Namespace", "StatefulSet", "DaemonSet", "CronJob", "Node",
-            "Service", "EndpointSlice",
+            "Service", "EndpointSlice", "HorizontalPodAutoscaler",
+            "PodMetrics", "ResourceQuota", "ServiceAccount",
         ):
             self.informers.informer(kind).start()
         self.informers.wait_for_sync()

@@ -1,0 +1,168 @@
+"""Horizontal pod autoscaler.
+
+Reference: pkg/controller/podautoscaler/horizontal.go:125
+(reconcileAutoscaler) + replica_calculator.go (GetResourceReplicas):
+desired = ceil(current * avgUtilization / target), with a ±10%
+tolerance band so tiny drift doesn't flap, clamped to
+[minReplicas, maxReplicas], and a downscale stabilization window so a
+momentary dip doesn't shrink the fleet (the
+--horizontal-pod-autoscaler-downscale-stabilization default is 300 s;
+tests tune `downscale_stabilization_s`).
+
+The metrics pipeline is the node agents' PodMetrics objects
+(metrics.k8s.io shape) — utilization = usage / request per pod,
+averaged over the target's pods that have both.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import List, Optional
+
+from ..api import store as st
+from ..api import types as api
+from .base import Controller, split_key
+
+TOLERANCE = 0.1  # horizontal.go tolerance
+
+
+class HorizontalPodAutoscalerController(Controller):
+    KIND = "HorizontalPodAutoscaler"
+
+    # resync cadence: metrics change without object events, so HPAs are
+    # re-queued periodically (the reference's 15 s resync)
+    RESYNC_S = 1.0
+
+    def __init__(self, *args, downscale_stabilization_s: float = 300.0, **kw):
+        super().__init__(*args, **kw)
+        self.downscale_stabilization_s = downscale_stabilization_s
+        self.clock = time.monotonic
+        self._recommendations: dict = {}  # key -> [(t, desired), ...]
+
+    def register(self) -> None:
+        self.informers.informer("HorizontalPodAutoscaler").add_handler(
+            self._on_hpa
+        )
+        self.informers.informer("PodMetrics").add_handler(self._on_metrics)
+
+    def _on_hpa(self, typ: str, hpa, old) -> None:
+        self.enqueue(hpa)
+
+    def _on_metrics(self, typ: str, m, old) -> None:
+        # fresh samples re-evaluate every HPA in that namespace
+        for hpa in self.informers.informer("HorizontalPodAutoscaler").list():
+            if hpa.meta.namespace == m.meta.namespace:
+                self.enqueue(hpa)
+
+    def sync(self, key: str) -> None:
+        namespace, name = split_key(key)
+        try:
+            hpa = self.store.get("HorizontalPodAutoscaler", name, namespace)
+        except st.NotFound:
+            self._recommendations.pop(key, None)
+            return
+        ref = hpa.spec.scale_target_ref
+        try:
+            target = self.store.get(ref.kind, ref.name, namespace)
+        except st.NotFound:
+            return
+        current = target.spec.replicas
+        pods = self._target_pods(namespace, target)
+        utilization, desired = self._desired_replicas(hpa, current, pods)
+        desired = max(hpa.spec.min_replicas, min(hpa.spec.max_replicas, desired))
+
+        # downscale stabilization: recommend the MAX over the window
+        now = self.clock()
+        recs = self._recommendations.setdefault(key, [])
+        recs.append((now, desired))
+        cutoff = now - self.downscale_stabilization_s
+        recs[:] = [(t, d) for t, d in recs if t >= cutoff]
+        if desired < current:
+            desired = max(d for _, d in recs)
+        if desired != current:
+            target.spec.replicas = desired
+            self.store.update(target, force=True)
+            hpa.status.last_scale_time = now
+        hpa.status.current_replicas = current
+        hpa.status.desired_replicas = desired
+        hpa.status.current_cpu_utilization_percentage = (
+            int(utilization) if utilization is not None else None
+        )
+        self.store.update(hpa, force=True)
+
+    # -- metrics math (replica_calculator.go) --------------------------------
+
+    def _target_pods(self, namespace: str, target) -> List[api.Pod]:
+        # ALL active pods, not just Running: a just-created Pending pod
+        # must participate as a missing-metrics pod (conservatively 0%
+        # on scale-up) or the calculator compounds fresh scale-ups into
+        # overshoot (replica_calculator.go's ignored-pods set)
+        sel = target.spec.selector
+        return [
+            p
+            for p in self.informers.informer("Pod").list()
+            if p.meta.namespace == namespace
+            and p.status.phase not in ("Succeeded", "Failed")
+            and (sel is None or sel.matches(p.meta.labels))
+        ]
+
+    def _desired_replicas(self, hpa, current: int, pods: List[api.Pod]):
+        """(utilization%, desired) — GetResourceReplicas: sum-based
+        utilization, and pods MISSING metrics are assumed conservative
+        (0% when scaling up, 100% when scaling down) so a fresh scale-up
+        whose new pods haven't reported yet doesn't compound into an
+        overshoot."""
+        target_pct = hpa.spec.target_cpu_utilization_percentage
+        usages, reqs, missing_req, missing_count = [], [], 0, 0
+        for p in pods:
+            req = p.resource_requests().get(api.CPU, 0)
+            if not req:
+                continue
+            usage = None
+            if p.status.phase == "Running":
+                try:
+                    m = self.store.get(
+                        "PodMetrics", p.meta.name, p.meta.namespace
+                    )
+                    usage = m.usage.get(api.CPU)
+                except st.NotFound:
+                    usage = None
+            if usage is None:
+                missing_req += req  # unstarted or unreported
+                missing_count += 1
+            else:
+                usages.append(usage)
+                reqs.append(req)
+        if not reqs:
+            return None, current
+        # sum-based utilization over the pods that reported; the desired
+        # count scales the READY pod count, not spec.replicas — a scale-up
+        # the informers haven't materialized yet must not compound
+        # (replica_calculator.go GetResourceReplicas)
+        ready = len(reqs)
+        utilization = 100.0 * sum(usages) / sum(reqs)
+        ratio = utilization / target_pct
+        if not missing_req:
+            if abs(ratio - 1.0) <= TOLERANCE:
+                return utilization, current
+            return utilization, math.ceil(ready * ratio)
+        if ratio > 1.0:
+            # rebalance with missing pods at 0 usage
+            new_ratio = (
+                100.0 * sum(usages) / (sum(reqs) + missing_req)
+            ) / target_pct
+            if new_ratio <= 1.0 + TOLERANCE:
+                return utilization, current
+        elif ratio < 1.0:
+            # rebalance with missing pods at full usage
+            new_ratio = (
+                100.0
+                * (sum(usages) + missing_req)
+                / (sum(reqs) + missing_req)
+            ) / target_pct
+            if new_ratio >= 1.0 - TOLERANCE:
+                return utilization, current
+        else:
+            return utilization, current
+        return utilization, math.ceil(new_ratio * (ready + missing_count))
