@@ -19,6 +19,18 @@ _TYPE_KEY = "__t"
 
 
 def to_wire(obj: Any) -> Any:
+    # dynamic kinds (CRD instances): kind travels in the document since
+    # there is no dataclass to recover it from
+    from .crd import DynamicObject
+
+    if isinstance(obj, DynamicObject):
+        return {
+            _TYPE_KEY: "DynamicObject",
+            "kind": obj.KIND,
+            "meta": to_wire(obj.meta),
+            "spec": to_wire(obj.spec),
+            "status": to_wire(obj.status),
+        }
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         out = {_TYPE_KEY: type(obj).__name__}
         for f in dataclasses.fields(obj):
@@ -35,7 +47,21 @@ def from_wire(doc: Any) -> Any:
     if isinstance(doc, dict):
         if _TYPE_KEY in doc:
             name = doc[_TYPE_KEY]
+            if name == "DynamicObject":
+                from .crd import DynamicObject
+
+                return DynamicObject(
+                    doc.get("kind", ""),
+                    meta=from_wire(doc.get("meta")),
+                    spec=from_wire(doc.get("spec") or {}),
+                    status=from_wire(doc.get("status") or {}),
+                )
             cls = getattr(api, name, None)
+            if cls is None:
+                # apiextensions dataclasses live beside, not in, types
+                from . import crd as crdmod
+
+                cls = getattr(crdmod, name, None)
             if cls is None or not dataclasses.is_dataclass(cls):
                 raise ValueError(f"unknown wire type {name!r}")
             kwargs = {

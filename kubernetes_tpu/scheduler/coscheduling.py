@@ -9,10 +9,18 @@ alternative hold point for groups whose size is declared out-of-band
 (no scheduling_group_size on the pods), and the proof that the Permit
 seam carries the protocol real plugins need.
 
-Usage:
-    cos = CoschedulingPermit(scheduler.waiting, sizes={"my-gang": 4})
+Usage (sizes from PodGroup API objects — the real plugin's shape):
+    from ..api import crd
+    crd.install_podgroup_crd(store)
+    store.create(crd.pod_group("my-gang", min_member=4))
+    cos = CoschedulingPermit(
+        scheduler.waiting, directory=crd.PodGroupDirectory(store)
+    )
     for fwk in scheduler.profiles:
         fwk.register("permit", cos.permit)
+
+Usage (out-of-band dict, kept for tests/embedding):
+    cos = CoschedulingPermit(scheduler.waiting, sizes={"my-gang": 4})
 
 Release is quorum-of-currently-waiting: a member that times out and
 requeues re-enters Permit on its retry, so stale arrivals can never
@@ -36,15 +44,36 @@ class CoschedulingPermit:
         waiting: WaitingPodsMap,
         sizes: Optional[Dict[str, int]] = None,
         timeout: float = DEFAULT_PERMIT_TIMEOUT,
+        directory=None,  # api.crd.PodGroupDirectory: sizes from PodGroups
     ):
         self.waiting = waiting
         self.sizes = dict(sizes or {})
         self.timeout = timeout
+        self.directory = directory
         self._lock = threading.Lock()
+
+    def _size_of(self, pod: api.Pod) -> Optional[int]:
+        g = pod.spec.scheduling_group
+        if g is None:
+            return None
+        if g in self.sizes:
+            return self.sizes[g]
+        if self.directory is not None:
+            return self.directory.size_for(pod.meta.namespace, g)
+        return None
+
+    def _timeout_of(self, pod: api.Pod) -> float:
+        if self.directory is not None:
+            t = self.directory.timeout_for(
+                pod.meta.namespace, pod.spec.scheduling_group
+            )
+            if t:
+                return float(t)
+        return self.timeout
 
     def group_of(self, pod: api.Pod) -> Optional[str]:
         g = pod.spec.scheduling_group
-        return g if g in self.sizes else None
+        return g if self._size_of(pod) is not None else None
 
     def _waiting_members(self, namespace: str, group: str):
         """Members of (namespace, group) CURRENTLY parked at Permit.
@@ -66,18 +95,25 @@ class CoschedulingPermit:
         a member timing out between the quorum snapshot and the release
         makes its claim fail, the claims roll back, and this pod waits —
         a partial gang can never be allowed."""
-        group = self.group_of(pod)
+        group = pod.spec.scheduling_group
         if group is None:
             return "allow", 0.0
+        # ONE size lookup: the directory reads live API objects, and a
+        # PodGroup deleted between two lookups must not surface as a
+        # TypeError mid-Permit
+        size = self._size_of(pod)
+        if size is None:
+            return "allow", 0.0
+        timeout = self._timeout_of(pod)
         with self._lock:
             parked = self._waiting_members(pod.meta.namespace, group)
-            if len(parked) + 1 < self.sizes[group]:
-                return "wait", self.timeout
+            if len(parked) + 1 < size:
+                return "wait", timeout
             claimed = [wp for wp in parked if wp.try_claim()]
-            if len(claimed) + 1 < self.sizes[group]:
+            if len(claimed) + 1 < size:
                 for wp in claimed:
                     wp.release_claim()
-                return "wait", self.timeout
+                return "wait", timeout
             for wp in claimed:
                 wp.allow()
             return "allow", 0.0
