@@ -3,11 +3,14 @@
 Reference: pkg/controller/daemon/daemon_controller.go — for every node
 passing the template's node selector and tolerating the node's
 NoSchedule taints, ensure exactly one daemon pod; nodes joining get a
-pod, nodes leaving lose theirs via the GC cascade.  Modern kubernetes
-routes daemon pods through the default scheduler with a per-node
-nodeAffinity; ours pins spec.node_name directly (the pre-1.12 behavior)
-— daemon pods are per-node by definition, so the placement decision is
-the eligibility check itself."""
+pod, nodes leaving lose theirs via the GC cascade.  Like the modern
+reference (post-1.12), daemon pods route THROUGH the default scheduler:
+the controller stamps a per-node required nodeAffinity on
+kubernetes.io/hostname (replaceDaemonSetPodNodeNameNodeAffinity,
+pkg/controller/daemon/util/daemonset_util.go) plus the implicit daemon
+tolerations (unschedulable/not-ready/unreachable), and the scheduler's
+fit/ports/volume kernels decide — a FULL node rejects its daemon pod
+with a FailedScheduling event instead of silently overcommitting."""
 
 from __future__ import annotations
 
@@ -81,6 +84,44 @@ class DaemonSetController(Controller):
             return True
         return tol.value == taint.value
 
+    @staticmethod
+    def _pinned_node(pod: api.Pod) -> str:
+        aff = pod.spec.affinity
+        na = aff.node_affinity if aff else None
+        if na is None or na.required is None:
+            return ""
+        for term in na.required.terms:
+            for req in term.match_expressions:
+                if req.key == api.LABEL_HOSTNAME and req.op == api.OP_IN:
+                    return req.values[0] if req.values else ""
+        return ""
+
+    @staticmethod
+    def _pin_to_node(pod: api.Pod, node_name: str) -> None:
+        """Per-node pin via required nodeAffinity on the hostname label
+        (daemonset_util.go ReplaceDaemonSetPodNodeNameNodeAffinity) plus
+        the implicit daemon tolerations
+        (AddOrUpdateDaemonPodTolerations): daemon pods survive cordons
+        and node-pressure taints but still face resource/port fit."""
+        pin = api.NodeSelector(terms=[
+            api.NodeSelectorTerm(match_expressions=[
+                api.Requirement(api.LABEL_HOSTNAME, api.OP_IN, [node_name])
+            ])
+        ])
+        aff = pod.spec.affinity or api.Affinity()
+        na = aff.node_affinity or api.NodeAffinity()
+        na.required = pin  # replace: the per-node pin owns placement
+        aff.node_affinity = na
+        pod.spec.affinity = aff
+        for key_, effect in (
+            (api.TAINT_NODE_UNSCHEDULABLE, api.NO_SCHEDULE),
+            (api.TAINT_NODE_NOT_READY, api.NO_EXECUTE),
+            (api.TAINT_NODE_UNREACHABLE, api.NO_EXECUTE),
+        ):
+            tol = api.Toleration(key=key_, op=api.OP_EXISTS, effect=effect)
+            if tol not in pod.spec.tolerations:
+                pod.spec.tolerations.append(tol)
+
     def sync(self, key: str) -> None:
         namespace, name = split_key(key)
         try:
@@ -92,7 +133,11 @@ class DaemonSetController(Controller):
         pods = self.pods_owned_by(namespace, "DaemonSet", name)
         by_node = {}
         for p in pods:
-            by_node.setdefault(p.spec.node_name, []).append(p)
+            # a daemon pod belongs to its PIN target even before the
+            # scheduler binds it — keying pending pods on "" would make
+            # the next sync double-create and reap them
+            node = p.spec.node_name or self._pinned_node(p) or ""
+            by_node.setdefault(node, []).append(p)
 
         # delete pods on ineligible/vanished nodes + duplicates
         for node_name, plist in by_node.items():
@@ -102,7 +147,9 @@ class DaemonSetController(Controller):
                     self.store.delete("Pod", p.meta.name, namespace)
                 except st.NotFound:
                     pass
-        # create missing daemon pods
+        # create missing daemon pods — scheduled by the default
+        # scheduler via a per-node hostname affinity, so they pass the
+        # fit/ports/volume kernels like any other pod
         for node_name in sorted(eligible - set(by_node)):
             template = api.clone(ds.spec.template)
             pod = api.Pod(
@@ -119,7 +166,7 @@ class DaemonSetController(Controller):
                 ),
                 spec=api.clone(template.spec),
             )
-            pod.spec.node_name = node_name
+            self._pin_to_node(pod, node_name)
             try:
                 self.store.create(pod)
             except st.AlreadyExists:

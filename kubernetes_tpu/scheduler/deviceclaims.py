@@ -26,9 +26,15 @@ claim's whole lifetime — from its own solve (claim unallocated then)
 through cache add and remove — so the node's usage vector stays exact
 and symmetric; sharers contribute only the co-location pin.  Reserve
 rejects a placement whose node disagrees with an existing allocation
-(two sharers solved in one batch re-solve under the pin).  Documented
-simplification: if the carrier terminates while sharers remain, the
-devices read as free until the claim deallocates.
+(two sharers solved in one batch re-solve under the pin).
+
+Carrier death with surviving sharers HANDS OFF: on_consumer_delete
+promotes a surviving consumer to carrier (claim status write + cache
+re-account under the cache lock), so the allocation's devices stay
+charged to the node until the LAST consumer is gone — the reference's
+allocation-holds-until-deallocate semantics (dynamicresources.go:275).
+Consumers are tracked in an O(1) index fed by the scheduler's pod
+events, so the delete path no longer lists every pod.
 """
 
 from __future__ import annotations
@@ -77,6 +83,9 @@ class DeviceClaimBinder:
         self._classes: Dict[str, api.DeviceClass] = {}
         # assume cache: claim key -> (node, carrier pod key) at Reserve
         self._assumed: Dict[str, Tuple[str, str]] = {}
+        # consumer index: claim key -> live consumer pod keys (fed by
+        # the scheduler's pod events; replaces O(pods) delete scans)
+        self._consumers: Dict[str, set] = {}
 
     # -- informer handlers -------------------------------------------------
 
@@ -204,20 +213,114 @@ class DeviceClaimBinder:
                 if cached is not None and cached.status.allocated_node:
                     self._assumed.pop(key, None)
 
-    # -- deallocation (the resourceclaim controller's half) ----------------
+    # -- consumer tracking + deallocation ----------------------------------
+
+    def track_pod(self, typ: str, pod: api.Pod) -> None:
+        """Maintain the claim→consumers index from the scheduler's pod
+        informer events (pods without claims never reach here)."""
+        pkey = f"{pod.meta.namespace}/{pod.meta.name}"
+        with self._mu:
+            for claim_name in pod.spec.resource_claims:
+                key = f"{pod.meta.namespace}/{claim_name}"
+                if typ == st.DELETED:
+                    self._consumers.get(key, set()).discard(pkey)
+                else:
+                    self._consumers.setdefault(key, set()).add(pkey)
+
+    def on_consumer_delete(self, claim_key: str, deleted_pkey: str,
+                           cache=None) -> None:
+        """A consumer died.  Last one out deallocates the claim; a dead
+        CARRIER with surviving sharers hands its accounting to a
+        survivor (claim-status write + cache re-account) so the devices
+        stay charged until deallocation (dynamicresources.go:275)."""
+        with self._mu:
+            claim = self._claims.get(claim_key)
+            survivors = set(self._consumers.get(claim_key, ()))
+        survivors.discard(deleted_pkey)
+        if claim is None or not claim.status.allocated_node:
+            return
+        if not survivors:
+            self._consumers.pop(claim_key, None)
+            try:
+                fresh = self.store.get(
+                    "ResourceClaim", claim.meta.name, claim.meta.namespace
+                )
+                fresh.status.allocated_node = ""
+                fresh.status.carrier = ""
+                fresh.status.phase = "Pending"
+                self.store.update(fresh)
+            except (st.NotFound, st.Conflict):
+                pass
+            return
+        if claim.status.carrier != deleted_pkey:
+            return  # a sharer died; the carrier still accounts
+        self._transfer_carrier(claim, survivors, cache)
+
+    def _transfer_carrier(self, claim, survivors, cache) -> None:
+        """Promote a survivor (preferring one bound to the allocation's
+        node) to carrier.  Order matters for accounting symmetry: the
+        survivor is UN-accounted under the old carrier identity, the
+        carrier flips, then it is re-accounted — its usage now includes
+        the devices.  An unbound survivor needs no re-account; it will
+        account as carrier when it binds."""
+        alloc_node = claim.status.allocated_node
+        chosen, chosen_pod = None, None
+        for pkey in sorted(survivors):
+            ns, _, name = pkey.partition("/")
+            try:
+                p = self.store.get("Pod", name, ns)
+            except st.NotFound:
+                continue
+            if p.spec.node_name == alloc_node:
+                chosen, chosen_pod = pkey, p
+                break
+            if chosen is None:
+                chosen, chosen_pod = pkey, p
+        if chosen is None:
+            return
+        key = f"{claim.meta.namespace}/{claim.meta.name}"
+        lock = cache.lock if cache is not None else threading.RLock()
+        with lock:
+            bound_here = (
+                cache is not None
+                and chosen_pod.spec.node_name == alloc_node
+                and cache.state.has_pod(chosen_pod)
+            )
+            if bound_here:
+                cache.state.remove_pod(chosen_pod)  # usage sans devices
+            with self._mu:
+                cached = self._claims.get(key)
+                if cached is not None:
+                    cached.status.carrier = chosen
+            if bound_here:
+                cache.state.add_pod(chosen_pod)     # usage with devices
+        try:
+            fresh = self.store.get(
+                "ResourceClaim", claim.meta.name, claim.meta.namespace
+            )
+            fresh.status.carrier = chosen
+            self.store.update(fresh)
+        except (st.NotFound, st.Conflict):
+            pass
 
     def maybe_deallocate(self, claim_key: str) -> None:
-        """Deallocate a claim no pod consumes any more (the
-        resourceclaim controller's cleanup; called from the scheduler's
-        pod-delete path)."""
+        """Back-compat shim for direct callers: consult the consumer
+        index (falling back to a store list when the index never saw
+        this claim) and deallocate when empty."""
         with self._mu:
+            known = claim_key in self._consumers
+            survivors = set(self._consumers.get(claim_key, ()))
             claim = self._claims.get(claim_key)
         if claim is None or not claim.status.allocated_node:
             return
-        pods, _ = self.store.list("Pod", namespace=claim.meta.namespace)
-        if any(
-            claim.meta.name in p.spec.resource_claims for p in pods
-        ):
+        if not known:
+            pods, _ = self.store.list("Pod", namespace=claim.meta.namespace)
+            survivors = {
+                f"{p.meta.namespace}/{p.meta.name}"
+                for p in pods
+                if claim.meta.name in p.spec.resource_claims
+            }
+        if survivors:
             return
         try:
             fresh = self.store.get(

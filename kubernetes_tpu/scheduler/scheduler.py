@@ -205,6 +205,8 @@ class Scheduler:
 
     def _on_pod(self, typ: str, pod: api.Pod, old) -> None:
         assigned = bool(pod.spec.node_name)
+        if pod.spec.resource_claims and typ != st.DELETED:
+            self.devices.track_pod(typ, pod)
         if typ == st.DELETED:
             if assigned:
                 # the cache removal must see the claim state the pod was
@@ -219,12 +221,18 @@ class Scheduler:
             else:
                 self.queue.delete(pod)
                 self.cache.remove_nomination(pod)
-            for claim_name in pod.spec.resource_claims:
-                # last-consumer-gone deallocation (the resourceclaim
-                # controller's cleanup half) — AFTER unaccounting
-                self.devices.maybe_deallocate(
-                    f"{pod.meta.namespace}/{claim_name}"
-                )
+            if pod.spec.resource_claims:
+                self.devices.track_pod(typ, pod)
+                pkey = pod_key(pod)
+                for claim_name in pod.spec.resource_claims:
+                    # last consumer gone -> deallocate; dead CARRIER with
+                    # sharers -> hand accounting to a survivor — AFTER
+                    # unaccounting (dynamicresources.go:275 semantics)
+                    self.devices.on_consumer_delete(
+                        f"{pod.meta.namespace}/{claim_name}",
+                        pkey,
+                        cache=self.cache,
+                    )
             return
         if assigned:
             # bound (or our own bind echoing back): confirm in cache

@@ -165,3 +165,53 @@ def test_batch_sharers_end_on_one_node(sched_store):
     ), timeout=45)
     nodes = {store.get("Pod", n).spec.node_name for n in ("a", "b")}
     assert len(nodes) == 1, f"shared-claim consumers split: {nodes}"
+
+
+def test_carrier_death_hands_off_to_sharer(sched_store):
+    """dynamicresources.go:275 semantics: the allocation's devices stay
+    charged while ANY consumer lives.  The carrier dies; a sharer
+    inherits the accounting; a competing claim still can't take the
+    device until the last sharer is gone."""
+    sched, store = sched_store
+    _gpu_nodes(store, 1, per_node=1)
+    store.create(api.DeviceClass(meta=api.ObjectMeta(name="gpu")))
+    store.create(_claim("shared", "gpu"))
+    for name in ("carrier", "sharer"):
+        p = make_pod(name).req(cpu_milli=100, mem=MI).obj()
+        p.spec.resource_claims = ["shared"]
+        store.create(p)
+    assert _wait(lambda: sum(
+        1 for p in store.list("Pod")[0] if p.spec.node_name
+    ) == 2)
+    claim = store.get("ResourceClaim", "shared")
+    assert claim.status.allocated_node == "n0"
+    carrier_key = f"default/{claim.status.carrier.split('/', 1)[1]}"
+    dead, surviving = (
+        ("carrier", "sharer")
+        if claim.status.carrier.endswith("carrier")
+        else ("sharer", "carrier")
+    )
+    # a competitor wants the only device
+    store.create(_claim("rival", "gpu"))
+    rp = make_pod("rival-pod").req(cpu_milli=100, mem=MI).obj()
+    rp.spec.resource_claims = ["rival"]
+    store.create(rp)
+    time.sleep(0.5)
+
+    # kill the CARRIER: accounting must hand off to the survivor
+    store.delete("Pod", dead)
+    assert _wait(
+        lambda: store.get("ResourceClaim", "shared").status.carrier
+        == f"default/{surviving}"
+    )
+    # the device is still held: the rival stays pending
+    time.sleep(1.0)
+    assert not store.get("Pod", "rival-pod").spec.node_name
+    assert store.get("ResourceClaim", "shared").status.allocated_node == "n0"
+
+    # last consumer gone -> deallocate -> rival finally lands
+    store.delete("Pod", surviving)
+    assert _wait(
+        lambda: store.get("Pod", "rival-pod").spec.node_name == "n0",
+        timeout=60,
+    )

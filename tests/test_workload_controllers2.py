@@ -124,7 +124,13 @@ def test_statefulset_ordered_creation_and_identity(cm_store):
 
 
 def test_daemonset_one_pod_per_eligible_node(cm_store):
+    # daemon pods now route THROUGH the scheduler (per-node affinity
+    # pin, daemonset_util.go semantics) — a real Scheduler binds them
+    from kubernetes_tpu.scheduler import Scheduler
+
     cm, store = cm_store
+    sched = Scheduler(store)
+    sched.start()
     for i in range(3):
         store.create(make_node(f"n{i}").capacity(cpu_milli=4000, pods=10).obj())
     tainted = make_node("n-tainted").capacity(cpu_milli=4000, pods=10) \
@@ -138,26 +144,36 @@ def test_daemonset_one_pod_per_eligible_node(cm_store):
         ),
     )
     store.create(ds)
-    assert _wait(lambda: len(store.list("Pod")[0]) == 3)
-    nodes = {p.spec.node_name for p in store.list("Pod")[0]}
-    assert nodes == {"n0", "n1", "n2"}  # tainted node excluded
-    # a new node joining gets a daemon pod
-    store.create(make_node("n9").capacity(cpu_milli=4000, pods=10).obj())
-    assert _wait(lambda: "n9" in {
-        p.spec.node_name for p in store.list("Pod")[0]
-    })
-    # node leaving: its pod is reaped (nodelifecycle/GC semantics are
-    # store-side here — the controller deletes pods on vanished nodes)
-    store.delete("Node", "n1", namespace="")
-    assert _wait(lambda: "n1" not in {
-        p.spec.node_name for p in store.list("Pod")[0]
-    })
-    got = store.get("DaemonSet", "agent")
-    assert got.status.desired_number_scheduled == 3
+    try:
+        # the scheduler binds each daemon pod onto its pinned node
+        assert _wait(
+            lambda: {p.spec.node_name for p in store.list("Pod")[0]}
+            == {"n0", "n1", "n2"},
+            timeout=60,
+        )
+        # a new node joining gets a daemon pod
+        store.create(make_node("n9").capacity(cpu_milli=4000, pods=10).obj())
+        assert _wait(lambda: "n9" in {
+            p.spec.node_name for p in store.list("Pod")[0]
+        }, timeout=60)
+        # node leaving: its pod is reaped (nodelifecycle/GC semantics are
+        # store-side here — the controller deletes pods on vanished nodes)
+        store.delete("Node", "n1", namespace="")
+        assert _wait(lambda: "n1" not in {
+            p.spec.node_name for p in store.list("Pod")[0]
+        })
+        got = store.get("DaemonSet", "agent")
+        assert got.status.desired_number_scheduled == 3
+    finally:
+        sched.stop()
 
 
 def test_daemonset_toleration_allows_tainted_node(cm_store):
+    from kubernetes_tpu.scheduler import Scheduler
+
     cm, store = cm_store
+    sched = Scheduler(store)
+    sched.start()
     store.create(
         make_node("gpu").capacity(cpu_milli=4000, pods=10)
         .taint("dedicated", "gpu", api.NO_SCHEDULE).obj()
@@ -175,9 +191,53 @@ def test_daemonset_toleration_allows_tainted_node(cm_store):
         ),
     )
     store.create(ds)
-    assert _wait(lambda: {
-        p.spec.node_name for p in store.list("Pod")[0]
-    } == {"gpu"})
+    try:
+        assert _wait(lambda: {
+            p.spec.node_name for p in store.list("Pod")[0]
+        } == {"gpu"}, timeout=60)
+    finally:
+        sched.stop()
+
+
+def test_daemonset_full_node_rejects_daemon_pod(cm_store):
+    """VERDICT r4 #9 acceptance: a full node REJECTS its daemon pod
+    (fit kernels apply) instead of silently overcommitting."""
+    from kubernetes_tpu.scheduler import Scheduler
+
+    cm, store = cm_store
+    sched = Scheduler(store)
+    sched.start()
+    store.create(make_node("full").capacity(cpu_milli=200, pods=10).obj())
+    store.create(make_node("roomy").capacity(cpu_milli=4000, pods=10).obj())
+    ds = api.DaemonSet(
+        meta=api.ObjectMeta(name="heavy"),
+        spec=api.DaemonSetSpec(
+            selector=api.LabelSelector(match_labels={"app": "heavy"}),
+            template=_template({"app": "heavy"}, cpu=500),
+        ),
+    )
+    store.create(ds)
+    try:
+        # the roomy node binds; the full node's pod stays Pending with a
+        # FailedScheduling event
+        assert _wait(lambda: any(
+            p.spec.node_name == "roomy" for p in store.list("Pod")[0]
+        ), timeout=60)
+        full_pod = next(
+            p for p in store.list("Pod")[0]
+            if p.meta.name == "heavy-full"
+        )
+        assert not full_pod.spec.node_name
+
+        def rejected():
+            return any(
+                e.reason == "FailedScheduling"
+                and "heavy-full" in e.meta.name
+                for e in store.list("Event")[0]
+            )
+        assert _wait(rejected, timeout=30)
+    finally:
+        sched.stop()
 
 
 def test_cron_parser_and_fire_times():
