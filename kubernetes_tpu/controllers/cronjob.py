@@ -62,8 +62,31 @@ def parse_cron(expr: str) -> CronSchedule:
     )
 
 
-def matches(sched: CronSchedule, t: float) -> bool:
+def matches(sched: CronSchedule, t: float, tz: str = None) -> bool:
+    """tz None = controller-local wall time (the reference's default —
+    with its documented DST double-fire/skip caveat); otherwise any IANA
+    zone name resolved via zoneinfo (batch/v1 spec.timeZone — a named
+    zone silently falling back to local time would fire hours wrong,
+    the one failure the field exists to prevent; unknown names raise)."""
     fields = sched.fields
+    if tz:
+        from datetime import datetime, timezone
+        from zoneinfo import ZoneInfo
+
+        dt = datetime.fromtimestamp(t, timezone.utc).astimezone(ZoneInfo(tz))
+        dow = (dt.weekday() + 1) % 7
+        dom_ok = dt.day in fields[2]
+        dow_ok = dow in fields[4]
+        if sched.dom_any or sched.dow_any:
+            day_ok = dom_ok and dow_ok
+        else:
+            day_ok = dom_ok or dow_ok
+        return (
+            dt.minute in fields[0]
+            and dt.hour in fields[1]
+            and dt.month in fields[3]
+            and day_ok
+        )
     lt = time.localtime(t)
     dow = (lt.tm_wday + 1) % 7  # tm_wday: Monday=0; cron: Sunday=0
     dom_ok = lt.tm_mday in fields[2]
@@ -83,7 +106,7 @@ def matches(sched: CronSchedule, t: float) -> bool:
 
 
 def most_recent_fire(
-    fields: CronSchedule, since: float, now: float
+    fields: CronSchedule, since: float, now: float, tz: str = None
 ) -> Optional[float]:
     """The latest minute boundary in (since, now] matching the schedule
     (getMostRecentScheduleTime).  Scans minute-by-minute, capped to a
@@ -94,7 +117,7 @@ def most_recent_fire(
     start_min = max(start_min, now_min - 24 * 60)
     for m in range(now_min, start_min - 1, -1):
         t = m * 60.0
-        if matches(fields, t):
+        if matches(fields, t, tz):
             return t
     return None
 
@@ -146,7 +169,7 @@ class CronJobController(Controller):
         fields = parse_cron(cj.spec.schedule)
         now = self.clock()
         since = cj.status.last_schedule_time or (now - 60)
-        fire = most_recent_fire(fields, since, now)
+        fire = most_recent_fire(fields, since, now, cj.spec.time_zone)
         if fire is None:
             return
         deadline = cj.spec.starting_deadline_seconds

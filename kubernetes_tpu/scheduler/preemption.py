@@ -212,14 +212,40 @@ class PreemptionEvaluator:
             return None
         cands, ranked, min_k = base
         victims_accum: List[api.Pod] = []
+        chunks: List[List[api.Pod]] = []  # per-candidate contributions
         for ci in ranked[:MAX_VERIFY]:
             row, name, victims, _flags = cands[ci]
-            victims_accum.extend(victims[: int(min_k[ci])])
+            chunk = victims[: int(min_k[ci])]
+            victims_accum.extend(chunk)
+            chunks.append(chunk)
             placements = self._verify_multi(members, victims_accum)
             if placements and all(n is not None for n in placements):
-                return list(zip(members, placements)), list(victims_accum)
+                return self._shrink_gang_plan(members, chunks, placements)
         self._note_budget_exhausted(pod, len(ranked))
         return None
+
+    def _shrink_gang_plan(self, members, chunks, placements):
+        """Shrink pass: an early candidate's victims may be unnecessary
+        once later candidates joined the accumulation (the gang fit
+        thanks to them alone).  Try dropping each contribution —
+        earliest first, since later ones completed the fit — re-verifying
+        the remainder; keep any drop that still fully places.  Bounded:
+        one re-solve per contributing candidate (<= MAX_VERIFY extra
+        dry-runs, only on the success path)."""
+        kept = list(chunks)
+        best = placements
+        for i in range(len(kept) - 1):  # the last chunk completed the fit
+            if not kept[i]:
+                continue
+            trial_victims = [
+                v for j, c in enumerate(kept) if j != i for v in c
+            ]
+            p = self._verify_multi(members, trial_victims)
+            if p and all(n is not None for n in p):
+                kept[i] = []
+                best = p
+        victims = [v for c in kept for v in c]
+        return list(zip(members, best)), victims
 
     def _note_budget_exhausted(self, pod: api.Pod, n_ranked: int) -> None:
         """Distinguish 'no candidate' from 'verification budget ran out'

@@ -68,6 +68,8 @@ class VolumeBinder:
         self.store = store
         self._mu = threading.RLock()
         self._pvs: Dict[str, api.PersistentVolume] = {}
+        # claimRef -> pv name, for O(1) half-bound crash repair
+        self._claimref_index: Dict[str, str] = {}
         self._pvcs: Dict[str, api.PersistentVolumeClaim] = {}  # ns/name
         self._classes: Dict[str, api.StorageClass] = {}
         # assume cache (util/assumecache): pv name -> claim key it is
@@ -85,9 +87,20 @@ class VolumeBinder:
     def on_pv(self, typ: str, pv: api.PersistentVolume, old) -> None:
         with self._mu:
             if typ == st.DELETED:
-                self._pvs.pop(pv.meta.name, None)
+                gone = self._pvs.pop(pv.meta.name, None)
+                if gone is not None and gone.spec.claim_ref:
+                    self._claimref_index.pop(gone.spec.claim_ref, None)
             else:
+                prev = self._pvs.get(pv.meta.name)
+                if (
+                    prev is not None
+                    and prev.spec.claim_ref
+                    and prev.spec.claim_ref != pv.spec.claim_ref
+                ):
+                    self._claimref_index.pop(prev.spec.claim_ref, None)
                 self._pvs[pv.meta.name] = pv
+                if pv.spec.claim_ref:
+                    self._claimref_index[pv.spec.claim_ref] = pv.meta.name
 
     def on_pvc(self, typ: str, pvc: api.PersistentVolumeClaim, old) -> None:
         key = f"{pvc.meta.namespace}/{pvc.meta.name}"
@@ -144,7 +157,16 @@ class VolumeBinder:
     def _claim_constraint(
         self, key: str, pvc: api.PersistentVolumeClaim
     ) -> Tuple[Optional[api.NodeSelector], str]:
-        """One claim's node constraint + its attach-limit driver."""
+        """One claim's node constraint + its attach-limit driver.
+
+        Driver note: for an UNBOUND claim the attach-limit driver is
+        taken from the first eligible PV (falling back to the class
+        provisioner), assuming one driver per storage class — the
+        overwhelmingly common deployment shape, and what the class's
+        provisioner field implies.  Mixed-driver PVs under one class
+        could charge the attach count to the wrong
+        `attachable-volumes-<driver>` scalar until Reserve picks the
+        concrete PV (documented divergence)."""
         bound_pv = pvc.spec.volume_name or self._assumed_claim.get(key)
         if bound_pv:
             pv = self._pvs.get(bound_pv)
@@ -153,6 +175,19 @@ class VolumeBinder:
             return pv.spec.node_affinity, pv.spec.driver
         if key in self._assumed_claim:  # assumed for provisioning
             return None, ""
+        # Crash repair (the PV controller's syncVolume half,
+        # pkg/controller/volume/persistentvolume/pv_controller.go): a
+        # PV whose claimRef already points at this PVC means a prebind
+        # wrote the PV side and died before the PVC write — finish the
+        # PVC side and treat the pair as bound, instead of skipping the
+        # PV (claimRef set) and resolving the claim IMPOSSIBLE forever.
+        # O(1): the claimRef index is maintained by the PV informer.
+        ref_pv = self._claimref_index.get(key)
+        if ref_pv is not None:
+            pv = self._pvs.get(ref_pv)
+            if pv is not None:
+                self._finish_half_bound(key, pvc, pv.meta.name)
+                return pv.spec.node_affinity, pv.spec.driver
         # unbound: OR over eligible PVs' affinities; a PV without a node
         # affinity is mountable anywhere -> the claim is unconstrained
         candidates = self._eligible_pvs(pvc)
@@ -177,6 +212,25 @@ class VolumeBinder:
         if not terms:
             return _IMPOSSIBLE, ""  # no PV fits and nothing can provision
         return api.NodeSelector(terms=terms), driver
+
+    def _finish_half_bound(
+        self, key: str, pvc: api.PersistentVolumeClaim, pv_name: str
+    ) -> None:
+        """Complete the PVC side of a half-written binding (journal
+        replay after a crash between prebind's two writes)."""
+        try:
+            fresh = self.store.get(
+                "PersistentVolumeClaim", pvc.meta.name, pvc.meta.namespace
+            )
+            if not fresh.spec.volume_name:
+                fresh.spec.volume_name = pv_name
+                fresh.status.phase = api.PVC_BOUND
+                self.store.update(fresh)
+            # local cache: don't wait for the informer echo
+            pvc.spec.volume_name = pv_name
+        except Exception:
+            # best-effort; the informer-driven next pass retries
+            pvc.spec.volume_name = pv_name
 
     def _eligible_pvs(
         self, pvc: api.PersistentVolumeClaim
