@@ -439,7 +439,105 @@ class TPUBatchScheduler:
             pending, num_pods_hint=num_pods_hint, lock=lock,
             reservations=reservations,
         )
+        names = self.solve_encoded(snap, meta)
+        return self._gang_admission_retry(
+            pending, names,
+            lambda subset: self.schedule_pending_no_retry(
+                subset, lock=lock, reservations=reservations
+            ),
+        )
+
+    def schedule_pending_no_retry(
+        self, pending, lock=None, reservations=()
+    ) -> List[Optional[str]]:
+        snap, meta = self.encode_pending(
+            pending, lock=lock, reservations=reservations
+        )
         return self.solve_encoded(snap, meta)
+
+    def _gang_admission_retry(
+        self,
+        pending: Sequence[api.Pod],
+        names: List[Optional[str]],
+        solve_subset,
+    ) -> List[Optional[str]]:
+        """Gang scarcity packing: when gangs are present and NONE placed
+        completely (each went partial and all-or-nothing released all of
+        them), admit gangs by priority until capacity runs out.
+
+        The joint solve has no gang-knapsack stage — under scarcity,
+        members of every gang interleave onto the same nodes and every
+        gang comes back incomplete.  The live scheduler eventually
+        self-heals through staggered backoff retries; one-shot callers
+        (proto service, extender, bench bursts) would return zero.  The
+        fix exploits monotonicity — if the k highest-priority gangs
+        don't fit, k+1 don't either — so a binary search over the
+        priority-ordered gang prefix finds the maximal admissible set in
+        O(log G) extra solves, only on the everything-parked path."""
+        groups: Dict[str, List[int]] = {}
+        for i, p in enumerate(pending):
+            g = p.spec.scheduling_group
+            if g:
+                groups.setdefault(g, []).append(i)
+        if not groups:
+            return names
+        complete = [
+            g for g, idx in groups.items()
+            if all(names[i] is not None for i in idx)
+        ]
+        if complete:
+            return names  # scarcity handled: some gang(s) landed
+        # `names` belongs to the FULL solve; subset attempts below will
+        # overwrite last_result, so keep the aligned one to restore on
+        # the no-prefix-fits path (callers read reasons positionally)
+        full_result = self.last_result
+        # admission order: priority desc, then smaller gangs first
+        order = sorted(
+            groups,
+            key=lambda g: (
+                -max(pending[i].spec.priority for i in groups[g]),
+                len(groups[g]),
+                g,
+            ),
+        )
+        nongang = [
+            i for i, p in enumerate(pending) if not p.spec.scheduling_group
+        ]
+
+        def attempt(k: int) -> Optional[List[Optional[str]]]:
+            idx = list(nongang)
+            for g in order[:k]:
+                idx.extend(groups[g])
+            idx.sort()
+            sub = [pending[i] for i in idx]
+            sub_names = solve_subset(sub)
+            admitted = {
+                i for g in order[:k] for i in groups[g]
+            }
+            pos = {orig: j for j, orig in enumerate(idx)}
+            if any(sub_names[pos[i]] is None for i in admitted):
+                return None  # an admitted gang still doesn't fit
+            out: List[Optional[str]] = [None] * len(pending)
+            for orig, j in pos.items():
+                out[orig] = sub_names[j]
+            return out
+
+        lo, hi, best = 0, len(order), None
+        while lo < hi:
+            mid = (lo + hi + 1) // 2
+            got = attempt(mid)
+            if got is not None:
+                best, lo = got, mid
+            else:
+                hi = mid - 1
+        if best is None:
+            self.last_result = full_result  # re-align reasons with names
+            return names
+        # last_result belongs to the final SUBSET solve — its reasons no
+        # longer align with the merged name list; unplaced pods here are
+        # unadmitted gang members (REASON_GANG by construction)
+        self.last_result = None
+        return best
 
     # -- stateless (one-shot) ---------------------------------------------
 
@@ -459,8 +557,12 @@ class TPUBatchScheduler:
     ) -> List[Optional[str]]:
         if not pending:
             return []
-        snap, meta = self.snapshot(nodes, pending, bound)
-        result = self._dispatch(snap)
-        self.last_result = result
-        idx = np.asarray(result.assignment)[: meta.num_pods]
-        return [meta.node_name(int(i)) for i in idx]
+
+        def solve(pods):
+            snap, meta = self.snapshot(nodes, pods, bound)
+            result = self._dispatch(snap)
+            self.last_result = result
+            idx = np.asarray(result.assignment)[: meta.num_pods]
+            return [meta.node_name(int(i)) for i in idx]
+
+        return self._gang_admission_retry(pending, solve(pending), solve)

@@ -1,0 +1,140 @@
+// Stock-C++ proof of the dense-snapshot scheduling boundary: build a
+// SolveRequest with protoc-generated code, ship it to the TPU solver
+// service over the length-framed TCP transport, and read back
+// assignments — no Python, no JSON, tensors on the wire.
+//
+// This is the SURVEY §2.6 north-star shim exercised from the native
+// side (the role a Go scheduler core would play; the image has no Go
+// toolchain, and C++ is the same proof).  Reference analogue: a CRI
+// client driving the runtime over its proto contract
+// (staging/src/k8s.io/cri-api/pkg/apis/runtime/v1/api.proto).
+//
+// Build (tests/test_protoserver.py does this automatically):
+//   protoc --cpp_out=build/ kubernetes_tpu/proto/snapshot.proto
+//   g++ -O2 -o proto_client native/proto_client.cpp \
+//       build/kubernetes_tpu/proto/snapshot.pb.cc \
+//       -Ibuild/kubernetes_tpu/proto $(pkg-config --cflags --libs protobuf)
+//
+// Usage: proto_client <port> <n_nodes> <n_pods>
+// Prints: "placed <k>/<n> pods in <secs>s" and exits 0 on full placement.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "snapshot.pb.h"
+
+namespace pb = kubernetes_tpu::v1;
+
+static bool write_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = write(fd, p, n);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+static bool read_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::fprintf(stderr, "usage: %s <port> <n_nodes> <n_pods>\n", argv[0]);
+    return 2;
+  }
+  const int port = std::atoi(argv[1]);
+  const int n_nodes = std::atoi(argv[2]);
+  const int n_pods = std::atoi(argv[3]);
+
+  pb::SolveRequest req;
+  auto* cluster = req.mutable_cluster();
+  auto* vocab = cluster->mutable_resources();
+  vocab->add_names("cpu");     // milli
+  vocab->add_names("memory");  // bytes (fits float32 at test scale)
+  vocab->add_names("pods");
+
+  auto* alloc = cluster->mutable_allocatable();
+  alloc->set_rows(n_nodes);
+  alloc->set_cols(3);
+  for (int i = 0; i < n_nodes; ++i) {
+    cluster->add_node_names("node-" + std::to_string(i));
+    alloc->add_data(32000.0f);              // 32 cores
+    alloc->add_data(64.0f * (1u << 20));    // 64 Mi-as-bytes scale-down
+    alloc->add_data(110.0f);
+  }
+
+  auto* pods = req.mutable_pods();
+  auto* preq = pods->mutable_requests();
+  preq->set_rows(n_pods);
+  preq->set_cols(3);
+  for (int i = 0; i < n_pods; ++i) {
+    pods->add_pod_names("pod-" + std::to_string(i));
+    preq->add_data(500.0f);
+    preq->add_data(0.5f * (1u << 20));
+    preq->add_data(1.0f);
+  }
+
+  std::string payload;
+  if (!req.SerializeToString(&payload)) {
+    std::fprintf(stderr, "serialize failed\n");
+    return 1;
+  }
+
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    std::perror("connect");
+    return 1;
+  }
+
+  uint32_t len = htonl(static_cast<uint32_t>(payload.size()));
+  if (!write_all(fd, &len, 4) ||
+      !write_all(fd, payload.data(), payload.size())) {
+    std::fprintf(stderr, "send failed\n");
+    return 1;
+  }
+  if (!read_all(fd, &len, 4)) {
+    std::fprintf(stderr, "recv header failed\n");
+    return 1;
+  }
+  std::string in(ntohl(len), '\0');
+  if (!read_all(fd, in.data(), in.size())) {
+    std::fprintf(stderr, "recv body failed\n");
+    return 1;
+  }
+  close(fd);
+
+  pb::SolveResponse resp;
+  if (!resp.ParseFromString(in)) {
+    std::fprintf(stderr, "parse failed\n");
+    return 1;
+  }
+  int placed = 0;
+  for (const auto& a : resp.assignments()) {
+    if (!a.node_name().empty()) ++placed;
+  }
+  std::printf("placed %d/%d pods in %.3fs\n", placed, n_pods,
+              resp.solve_seconds());
+  return placed == n_pods ? 0 : 3;
+}
