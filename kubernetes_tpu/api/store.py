@@ -91,7 +91,19 @@ class Watch:
             try:
                 self._q.put_nowait(self._SENTINEL)
             except queue.Full:
-                pass
+                # the overflow-kill path closes a FULL queue: evict one
+                # buffered event to guarantee the sentinel lands — the
+                # stream is already lossy (that's why it's being killed)
+                # and a consumer blocked on get() with no sentinel would
+                # hang its reflector FOREVER instead of relisting
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    pass
+                try:
+                    self._q.put_nowait(self._SENTINEL)
+                except queue.Full:
+                    pass  # __next__'s stopped check is the backstop
 
     def _offer(self, ev: Event) -> bool:
         try:
@@ -104,10 +116,18 @@ class Watch:
         return self
 
     def __next__(self) -> Event:
-        ev = self._q.get()
-        if ev is self._SENTINEL:
-            raise StopIteration
-        return ev
+        while True:
+            try:
+                # bounded wait so a lost sentinel can never park the
+                # consumer forever (belt to _close()'s braces)
+                ev = self._q.get(timeout=0.5)
+            except queue.Empty:
+                if self.stopped:
+                    raise StopIteration from None
+                continue
+            if ev is self._SENTINEL:
+                raise StopIteration
+            return ev
 
     def get(self, timeout: Optional[float] = None) -> Optional[Event]:
         """One event, or None on timeout / stream end."""
@@ -133,7 +153,10 @@ class Store:
     def __init__(
         self,
         buffer_size: int = 4096,
-        watch_capacity: int = 1024,
+        # per-watcher queue matches the event buffer: a watcher that
+        # can't hold buffer_size events couldn't relist-recover either,
+        # and a 4k bind wave must not kill the scheduler's own informer
+        watch_capacity: int = 4096,
         journal_path: Optional[str] = None,
         admission=None,
         journal_sync: str = "write",  # "write" | "interval"
@@ -146,6 +169,8 @@ class Store:
         self._buffer_size = buffer_size
         self._watch_capacity = watch_capacity
         self._watchers: Dict[str, List[Watch]] = {}     # kind -> watches
+        self.watchers_terminated = 0                    # slow-watcher kills
+        self.terminated_kinds: List[str] = []           # ... by kind
         # optional api.admission.AdmissionChain: mutate-then-validate on
         # every create/update before the commit (the apiserver admission
         # chain's position in the write path, server/config.go:983)
@@ -344,6 +369,11 @@ class Store:
         for w in dead:
             self._watchers[ev.kind].remove(w)
             w._close()
+            # observability: churn benches assert no watcher was too
+            # slow for the event rate (cacher terminations == data loss
+            # for that consumer until it relists)
+            self.watchers_terminated += 1
+            self.terminated_kinds.append(ev.kind)
 
     # -- CRUD --------------------------------------------------------------
 

@@ -120,7 +120,10 @@ def test_gc_orphan_scan_reaps_stale_dependents(cm_store):
     ]
     store.create(p)
     gc = cm.controllers["GarbageCollection"]
-    assert gc.scan_orphans() >= 1
+    # the scan reads the informer cache (not store.list — the r4
+    # verdict's Weak #6 copy-storm fix), so wait for the cache to
+    # observe the pod before expecting a reap
+    assert _wait(lambda: gc.scan_orphans() >= 1)
     with pytest.raises(KeyError):
         store.get("Pod", "stale")
 
