@@ -192,4 +192,19 @@ def default_chain() -> AdmissionChain:
 
     chain.register_validator(validate_crd)
     chain.register_validator(validate_custom_resource)
+    # dynamic admission: webhook callouts + expression policies
+    # (admissionregistration.k8s.io; mutating hooks run LAST among
+    # mutators, validating hooks/policies last among validators — the
+    # reference's chain position, server/config.go:983)
+    from .webhooks import (
+        mutating_webhooks,
+        validate_policy_object,
+        validating_policies,
+        validating_webhooks,
+    )
+
+    chain.register_mutator(mutating_webhooks)
+    chain.register_validator(validate_policy_object)
+    chain.register_validator(validating_webhooks)
+    chain.register_validator(validating_policies)
     return chain

@@ -615,7 +615,13 @@ class PersistentVolumeSpec:
     # (core/v1 VolumeNodeAffinity.required)
     node_affinity: Optional[NodeSelector] = None
     claim_ref: Optional[str] = None       # "namespace/name" of bound claim
+    claim_uid: str = ""                   # that claim's uid: a deleted-and-
+    # recreated same-name PVC must NOT silently inherit the volume
+    # (pv_controller.go checks claimRef.UID for exactly this)
     driver: str = ""                      # CSI driver (attach-limit bucket)
+    # Retain | Delete | Recycle (core/v1 PersistentVolumeReclaimPolicy;
+    # acted on by the PV controller when the claim goes away)
+    reclaim_policy: str = "Retain"
 
 
 @dataclass
@@ -1167,6 +1173,71 @@ class ServiceAccount:
     secrets: List[str] = field(default_factory=list)
 
     KIND = "ServiceAccount"
+
+
+# ---------------------------------------------------------------------------
+# Dynamic admission (reference: admissionregistration.k8s.io/v1 —
+# Mutating/ValidatingWebhookConfiguration, ValidatingAdmissionPolicy).
+# Webhooks are HTTP callouts on the write path; policies are in-process
+# expression checks (the CEL ValidatingAdmissionPolicy family).
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class WebhookRule:
+    operations: List[str] = field(default_factory=lambda: ["*"])  # CREATE/UPDATE
+    kinds: List[str] = field(default_factory=lambda: ["*"])
+
+
+@dataclass
+class Webhook:
+    name: str = ""
+    url: str = ""                      # clientConfig.url
+    rules: List[WebhookRule] = field(default_factory=list)
+    failure_policy: str = "Fail"       # Fail | Ignore
+    timeout_seconds: float = 10.0
+
+
+@dataclass
+class MutatingWebhookConfiguration:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    webhooks: List[Webhook] = field(default_factory=list)
+
+    KIND = "MutatingWebhookConfiguration"
+
+
+@dataclass
+class ValidatingWebhookConfiguration:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    webhooks: List[Webhook] = field(default_factory=list)
+
+    KIND = "ValidatingWebhookConfiguration"
+
+
+@dataclass
+class PolicyValidation:
+    expression: str = ""   # CEL-style over `object` / `oldObject`
+    message: str = ""
+
+
+@dataclass
+class ValidatingAdmissionPolicySpec:
+    match: WebhookRule = field(default_factory=WebhookRule)
+    validations: List[PolicyValidation] = field(default_factory=list)
+
+
+@dataclass
+class ValidatingAdmissionPolicy:
+    """ValidatingAdmissionPolicy folded with its binding (our policies
+    apply cluster-wide to their match rule — the binding indirection is
+    a multi-tenancy refinement this control plane doesn't need yet)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ValidatingAdmissionPolicySpec = field(
+        default_factory=ValidatingAdmissionPolicySpec
+    )
+
+    KIND = "ValidatingAdmissionPolicy"
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,182 @@
+"""Dynamic admission: webhook callouts + expression policies.
+
+VERDICT r4 missing #8.  Reference:
+apiserver/pkg/admission/plugin/webhook (AdmissionReview POSTs,
+failurePolicy) and admission/plugin/policy/validating/plugin.go (CEL
+over object fields).
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubernetes_tpu.api import admission as adm
+from kubernetes_tpu.api import store as st
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.webhooks import Expression
+from kubernetes_tpu.testing.wrappers import make_pod
+
+
+class _Hook:
+    """In-process webhook endpoint returning a scripted response."""
+
+    def __init__(self, respond):
+        hooks = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                review = json.loads(self.rfile.read(n))
+                hooks.reviews.append(review)
+                body = json.dumps(respond(review)).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.reviews = []
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        ).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_mutating_webhook_applies_patch():
+    hook = _Hook(lambda review: {
+        "allowed": True,
+        "patch": {"meta": {"labels": {"injected": "yes"}}},
+    })
+    try:
+        store = st.Store(admission=adm.default_chain())
+        store.create(api.MutatingWebhookConfiguration(
+            meta=api.ObjectMeta(name="labeler", namespace=""),
+            webhooks=[api.Webhook(
+                name="labeler.example.com", url=hook.url,
+                rules=[api.WebhookRule(kinds=["Pod"])],
+            )],
+        ))
+        created = store.create(make_pod("p").obj())
+        assert created.meta.labels.get("injected") == "yes"
+        assert hook.reviews and hook.reviews[0]["kind"] == "Pod"
+        # non-matching kind is untouched
+        store.create(api.Namespace(meta=api.ObjectMeta(name="ns", namespace="")))
+        assert all(r["kind"] == "Pod" for r in hook.reviews)
+    finally:
+        hook.stop()
+
+
+def test_validating_webhook_denies():
+    hook = _Hook(lambda review: {
+        "allowed": False,
+        "status": {"message": "pods named bad are bad"},
+    } if review["object"]["meta"]["name"] == "bad" else {"allowed": True})
+    try:
+        store = st.Store(admission=adm.default_chain())
+        store.create(api.ValidatingWebhookConfiguration(
+            meta=api.ObjectMeta(name="gate", namespace=""),
+            webhooks=[api.Webhook(
+                name="gate.example.com", url=hook.url,
+                rules=[api.WebhookRule(kinds=["Pod"], operations=["CREATE"])],
+            )],
+        ))
+        store.create(make_pod("good").obj())
+        with pytest.raises(adm.AdmissionError, match="bad are bad"):
+            store.create(make_pod("bad").obj())
+    finally:
+        hook.stop()
+
+
+def test_failure_policy():
+    store = st.Store(admission=adm.default_chain())
+    # unreachable endpoint, failurePolicy=Ignore: writes pass
+    store.create(api.ValidatingWebhookConfiguration(
+        meta=api.ObjectMeta(name="down-ignore", namespace=""),
+        webhooks=[api.Webhook(
+            name="down", url="http://127.0.0.1:1/nope",
+            rules=[api.WebhookRule(kinds=["Pod"])],
+            failure_policy="Ignore", timeout_seconds=0.2,
+        )],
+    ))
+    store.create(make_pod("p1").obj())
+    # failurePolicy=Fail: writes reject
+    import time
+    store.create(api.ValidatingWebhookConfiguration(
+        meta=api.ObjectMeta(name="down-fail", namespace=""),
+        webhooks=[api.Webhook(
+            name="down", url="http://127.0.0.1:1/nope",
+            rules=[api.WebhookRule(kinds=["Pod"])],
+            failure_policy="Fail", timeout_seconds=0.2,
+        )],
+    ))
+    time.sleep(0.6)  # config cache TTL
+    with pytest.raises(adm.AdmissionError, match="webhook down"):
+        store.create(make_pod("p2").obj())
+
+
+def test_validating_policy_expressions():
+    store = st.Store(admission=adm.default_chain())
+    store.create(api.ValidatingAdmissionPolicy(
+        meta=api.ObjectMeta(name="naming", namespace=""),
+        spec=api.ValidatingAdmissionPolicySpec(
+            match=api.WebhookRule(kinds=["Pod"]),
+            validations=[
+                api.PolicyValidation(
+                    expression="object.meta.name.startsWith('web-') || "
+                               "object.meta.name.startsWith('sys-')",
+                    message="pod names must start with web- or sys-",
+                ),
+                api.PolicyValidation(
+                    expression="object.spec.priority <= 100 && "
+                               "object.spec.priority >= 0",
+                    message="priority out of range",
+                ),
+            ],
+        ),
+    ))
+    store.create(make_pod("web-1").obj())
+    with pytest.raises(adm.AdmissionError, match="must start with"):
+        store.create(make_pod("db-1").obj())
+    over = make_pod("sys-1").obj()
+    over.spec.priority = 5000
+    with pytest.raises(adm.AdmissionError, match="priority out of range"):
+        store.create(over)
+
+
+def test_policy_compile_time_rejection_and_sandbox():
+    store = st.Store(admission=adm.default_chain())
+    # a bad expression rejects the POLICY write itself
+    with pytest.raises(adm.AdmissionError, match="not allowed"):
+        store.create(api.ValidatingAdmissionPolicy(
+            meta=api.ObjectMeta(name="evil", namespace=""),
+            spec=api.ValidatingAdmissionPolicySpec(
+                validations=[api.PolicyValidation(
+                    expression="__import__('os').system('true')")],
+            ),
+        ))
+    # the evaluator cannot escape the wire document
+    e = Expression("object.meta.name == 'x'")
+    with pytest.raises(adm.AdmissionError):
+        Expression("object.__class__")
+    # CEL-isms: has(), size(), negation, membership
+    env_obj = {"meta": {"name": "x", "labels": {"a": "1"}}, "spec": {}}
+    from kubernetes_tpu.api.webhooks import _Doc
+
+    env = {"object": _Doc(env_obj), "true": True, "false": False}
+    assert Expression("has(object.meta, 'labels')").evaluate(env)
+    assert not Expression("has(object.spec, 'priority')").evaluate(env)
+    assert Expression("size(object.meta.labels) == 1").evaluate(env)
+    assert Expression("!(object.meta.name == 'y')").evaluate(env)
+    assert Expression("object.meta.labels['a'] == '1'").evaluate(env)
