@@ -382,6 +382,9 @@ def pv_from_dict(d: Dict[str, Any]) -> api.PersistentVolume:
             storage_class_name=spec.get("storageClassName", ""),
             node_affinity=affinity,
             driver=csi.get("driver", ""),
+            reclaim_policy=spec.get(
+                "persistentVolumeReclaimPolicy", "Retain"
+            ),
         ),
     )
 

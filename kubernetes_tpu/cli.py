@@ -138,6 +138,137 @@ def cmd_create(client: RestClient, args) -> None:
         print(f"{kind.lower()}/{created.meta.name} created")
 
 
+def _manifest_patch(obj):
+    """Merge patch carrying only the fields the manifest SET: the
+    object's wire doc diffed against a default-constructed one, so
+    server-owned fields (node_name, finalizers, timestamps, status)
+    never ride along and stomp live state.  kubectl's three-way apply
+    gets the same effect via the last-applied annotation; diff-vs-default
+    is the stateless equivalent for our wire model (a field explicitly
+    set to its default is treated as unset — documented divergence)."""
+    from .api import wire
+
+    def diff(doc, base):
+        if isinstance(doc, dict) and isinstance(base, dict):
+            out = {}
+            for k, v in doc.items():
+                if k == "__t":
+                    continue
+                if k not in base:
+                    out[k] = v
+                else:
+                    sub = diff(v, base[k])
+                    if sub is not None:
+                        out[k] = sub
+            return out or None
+        return doc if doc != base else None
+
+    doc = wire.to_wire(obj)
+    base = wire.to_wire(type(obj)())
+    patch = diff(doc, base) or {}
+    patch.pop("status", None)
+    meta = patch.get("meta")
+    if meta:
+        for managed in (
+            "resource_version", "uid", "deletion_timestamp", "finalizers",
+            "creation_timestamp",
+        ):
+            meta.pop(managed, None)
+    return patch
+
+
+def cmd_apply(client: RestClient, args) -> None:
+    """create-or-patch from a manifest (kubectl apply's effective
+    behavior for our wire model: absent objects are created; existing
+    objects receive the manifest's fields as an RFC 7386 merge patch —
+    the reference's three-way server-side apply reduces to this when no
+    other field manager contests ownership)."""
+    import yaml
+
+    from .api import kubeyaml, wire
+
+    with open(args.filename) as f:
+        docs = list(yaml.safe_load_all(f))
+    for d in docs:
+        if not d:
+            continue
+        kind = d.get("kind", "Pod")
+        conv = kubeyaml.CONVERTERS.get(kind)
+        if conv is None:
+            raise SystemExit(
+                f"apply -f supports {sorted(kubeyaml.CONVERTERS)}; got {kind}"
+            )
+        obj = conv(d)
+        ns = "" if kind == "Node" else obj.meta.namespace
+        try:
+            client.get(kind, obj.meta.name, ns)
+        except Exception:
+            client.create(obj)
+            print(f"{kind.lower()}/{obj.meta.name} created")
+            continue
+        patch = _manifest_patch(obj)
+        if patch:
+            client.patch(kind, obj.meta.name, patch, namespace=ns)
+        print(f"{kind.lower()}/{obj.meta.name} configured")
+
+
+def cmd_edit(client: RestClient, args) -> None:
+    """fetch -> $EDITOR -> update (kubectl edit): the object's wire JSON
+    round-trips through the editor; an unchanged buffer is a no-op."""
+    import os
+    import subprocess
+    import tempfile
+
+    from .api import wire
+
+    kind = _kind(args.resource)
+    obj = client.get(kind, args.name, _ns_for(kind, args))
+    doc = json.dumps(wire.to_wire(obj), indent=2, default=str)
+    import shlex
+
+    editor = shlex.split(os.environ.get("EDITOR", "vi"))
+    with tempfile.NamedTemporaryFile(
+        "w+", suffix=".json", delete=False
+    ) as f:
+        f.write(doc)
+        path = f.name
+    try:
+        subprocess.run(editor + [path], check=True)
+        with open(path) as f:
+            edited = f.read()
+        if edited == doc:
+            print("Edit cancelled, no changes made.")
+            return
+        client.update(wire.from_wire(json.loads(edited)))
+        print(f"{args.resource.lower()}/{args.name} edited")
+    finally:
+        os.unlink(path)
+
+
+def cmd_logs(client: RestClient, args) -> None:
+    """Lifecycle log for a pod (kubectl logs): the hollow runtime has
+    no container stdout, so the log surface is the pod's recorded
+    lifecycle — its Events plus agent-reported restart counts — which
+    is what the reference's events+logs pair carries for a pod that
+    never wrote output."""
+    pod = client.get("Pod", args.name, args.namespace)
+    events, _ = client.list("Event", namespace=args.namespace)
+    mine = sorted(
+        (e for e in events if e.involved_object.name == args.name),
+        key=lambda e: e.last_timestamp,
+    )
+    for e in mine:
+        print(f"{e.type}\t{e.reason}\tx{e.count}\t{e.message}")
+    rc = pod.status.restart_counts
+    if rc:
+        print(f"-- restarts: {dict(rc)}")
+    print(
+        f"-- phase: {pod.status.phase}"
+        + (f" on {pod.spec.node_name}" if pod.spec.node_name else "")
+        + (f" ip {pod.status.pod_ip}" if pod.status.pod_ip else "")
+    )
+
+
 def cmd_delete(client: RestClient, args) -> None:
     kind = _kind(args.resource)
     client.delete(kind, args.name, _ns_for(kind, args))
@@ -191,6 +322,19 @@ def main(argv=None) -> None:
     c = sub.add_parser("create")
     c.add_argument("-f", "--filename", required=True)
     c.set_defaults(fn=cmd_create)
+
+    ap_ = sub.add_parser("apply")
+    ap_.add_argument("-f", "--filename", required=True)
+    ap_.set_defaults(fn=cmd_apply)
+
+    ed = sub.add_parser("edit")
+    ed.add_argument("resource")
+    ed.add_argument("name")
+    ed.set_defaults(fn=cmd_edit)
+
+    lg = sub.add_parser("logs")
+    lg.add_argument("name")
+    lg.set_defaults(fn=cmd_logs)
 
     rm = sub.add_parser("delete")
     rm.add_argument("resource")

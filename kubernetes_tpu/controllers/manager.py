@@ -22,6 +22,7 @@ from .garbagecollector import GarbageCollector
 from .job import JobController
 from .namespace import NamespaceController
 from .podautoscaler import HorizontalPodAutoscalerController
+from .pvcontroller import PersistentVolumeController
 from .replicaset import ReplicaSetController
 from .resourcequota import ResourceQuotaController
 from .serviceaccount import ServiceAccountController, TTLAfterFinishedController
@@ -42,6 +43,7 @@ DEFAULT_CONTROLLERS: List[Type[Controller]] = [
     ResourceQuotaController,
     ServiceAccountController,
     TTLAfterFinishedController,
+    PersistentVolumeController,
 ]
 
 
@@ -70,6 +72,7 @@ class ControllerManager:
             "Namespace", "StatefulSet", "DaemonSet", "CronJob", "Node",
             "Service", "EndpointSlice", "HorizontalPodAutoscaler",
             "PodMetrics", "ResourceQuota", "ServiceAccount",
+            "PersistentVolume", "PersistentVolumeClaim", "StorageClass",
         ):
             self.informers.informer(kind).start()
         self.informers.wait_for_sync()

@@ -354,6 +354,7 @@ class VolumeBinder:
                 pv_name = pv.meta.name
             pv = self.store.get("PersistentVolume", pv_name)
             pv.spec.claim_ref = key
+            pv.spec.claim_uid = pvc.meta.uid
             pv.status.phase = api.PV_BOUND
             self.store.update(pv)
             pvc.spec.volume_name = pv_name

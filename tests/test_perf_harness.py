@@ -166,13 +166,15 @@ def test_basic_workload_end_to_end(tmp_path):
     wls = load_config(cfg)
     result = run_workloads(wls, sample_interval=0.02)
     metrics = {i["labels"]["Metric"] for i in result["dataItems"]}
-    assert "WallClockThroughput" in metrics
-    assert "scheduler_scheduling_algorithm_duration_seconds" in metrics
+    assert "WallClockThroughput" in metrics, result["dataItems"]
+    assert (
+        "scheduler_scheduling_algorithm_duration_seconds" in metrics
+    ), result["dataItems"]
     wall = [
         i for i in result["dataItems"]
         if i["labels"]["Metric"] == "WallClockThroughput"
     ][0]
-    assert wall["data"]["Average"] > 0
+    assert wall["data"]["Average"] > 0, result["dataItems"]
 
 
 def test_churn_and_barrier_end_to_end(tmp_path):
