@@ -111,6 +111,9 @@ class NodeAgent:
         self.heartbeat_interval = heartbeat_interval
         self.checkpoint_path = checkpoint_path
         self._workers: Dict[str, _PodWorker] = {}
+        # pod keys the heartbeat thread asked the sync loop to evict
+        # (pressure eviction); consumed by _advance on the tick thread
+        self._evict_requests: set = set()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._ip_counter = 0
@@ -234,6 +237,12 @@ class NodeAgent:
         ann = pod.meta.annotations
         if worker.state == "terminal":
             return
+        if key in self._evict_requests and worker.state in (
+            "starting", "running"
+        ):
+            self._evict_requests.discard(key)
+            self._evict(worker)
+            return
         if worker.state == "terminating":
             grace = min(
                 float(pod.spec.termination_grace_period_seconds),
@@ -303,6 +312,34 @@ class NodeAgent:
         # policy arbitration lives in _restart_or_fail: Always restarts
         # any exit, OnFailure restarts non-zero, otherwise terminal phase
         self._restart_or_fail(worker, exit_code)
+
+    def _evict(self, worker: _PodWorker) -> None:
+        """Pressure eviction on the sync-loop thread: Failed phase +
+        DisruptionTarget condition (the signal controllers recreate
+        from), finalizer released so deletion is not blocked."""
+        worker.phase = "Failed"
+        worker.state = "terminal"
+        worker.ready = False
+        try:
+            pod = self.store.get(
+                "Pod", worker.pod.meta.name, worker.pod.meta.namespace
+            )
+            pod.status.phase = "Failed"
+            pod.status.conditions = [
+                c for c in pod.status.conditions
+                if c.get("type") != "DisruptionTarget"
+            ] + [{
+                "type": "DisruptionTarget",
+                "status": "True",
+                "reason": "TerminationByKubelet",
+                "message": "memory pressure eviction",
+            }]
+            if FINALIZER in pod.meta.finalizers:
+                pod.meta.finalizers.remove(FINALIZER)
+            self.store.update(pod, force=True, copy_result=False)
+            worker.pod = pod
+        except (st.NotFound, st.Conflict):
+            pass
 
     def _terminal(self, worker: _PodWorker, phase: str) -> None:
         worker.state = "terminal"
@@ -375,9 +412,36 @@ class NodeAgent:
                 conds.append({"type": "Ready", "status": "True"})
                 node.status.conditions = conds
                 self.store.update(node, force=True, copy_result=False)
+                self._check_pressure(node)
             except st.NotFound:
                 pass
             self._publish_metrics()
+
+    def _check_pressure(self, node: api.Node) -> None:
+        """Eviction manager (pkg/kubelet/eviction): under node pressure
+        (hollow signal: the memory-pressure annotation) evict the
+        lowest-priority running pod per sync — phase Failed with the
+        Evicted reason, exactly what controllers react to by
+        recreating elsewhere.  One victim per pass (the reference's
+        single-eviction cadence) so pressure relief is observable
+        between kills."""
+        if node.meta.annotations.get(
+            "agent.kubernetes.io/memory-pressure"
+        ) != "true":
+            return
+        # only REQUEST the eviction here: worker state and pod status
+        # belong to the sync-loop thread — a concurrent _mutate would
+        # otherwise race this write and resurrect the pod as Running
+        # with the terminal worker stranded
+        victims = sorted(
+            (
+                w for w in self._workers.values()
+                if w.state in ("starting", "running")
+            ),
+            key=lambda w: (w.pod.spec.priority, w.pod.meta.name),
+        )
+        if victims:
+            self._evict_requests.add(_key(victims[0].pod))
 
     def _publish_metrics(self) -> None:
         """PodMetrics for each running pod (the metrics-server pipeline

@@ -261,6 +261,29 @@ class _Handler(BaseHTTPRequestHandler):
                         return
                     obj = self.store.get(parts[2], parts[4], ns)
                     return self._reply(wire.to_wire(obj))
+            if parts == ["api", "v1"]:
+                # discovery (the APIResourceList kubectl uses to map
+                # names) rides the full chain like any read — only
+                # healthz/readyz are exempt
+                if not self._authorize("get", "APIResourceList"):
+                    return
+                from . import kubeyaml
+
+                kinds = sorted(
+                    set(self.store.kinds()) | set(kubeyaml.CONVERTERS)
+                )
+                return self._reply({
+                    "kind": "APIResourceList",
+                    "groupVersion": "v1",
+                    "resources": [
+                        {
+                            "kind": k,
+                            "verbs": ["get", "list", "watch", "create",
+                                      "update", "patch", "delete"],
+                        }
+                        for k in kinds
+                    ],
+                })
             if parts == ["healthz"] or parts == ["readyz"]:
                 return self._reply({"ok": True})
             if parts == ["metrics"]:

@@ -1,0 +1,164 @@
+"""Service proxy: the VIP -> backend dataplane table.
+
+Reference: pkg/proxy/iptables/proxier.go:142,796 — kube-proxy watches
+Services + EndpointSlices and compiles them into kernel rules that
+rewrite VIP:port to a backend pod.  An in-process control plane has no
+kernel to program, but the load-bearing artifact is the RULE TABLE and
+its maintenance: this module keeps a versioned, atomically-swapped
+resolution table from the same inputs (the syncProxyRules analogue) and
+answers "what backs this VIP" — round-robin across ready endpoints,
+ClientIP session affinity when the Service asks for it, and node-local
+preference for (the semantics of) internalTrafficPolicy=Local.
+
+`resolve()` is the dataplane lookup a connection would hit; `rules()`
+dumps the whole table (the iptables-save analogue) for inspection and
+tests.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from .api import store as st
+from .api import types as api
+from .client.informers import InformerFactory
+
+
+class _ServiceRules:
+    """One service's compiled rules: VIP:port -> backend list."""
+
+    def __init__(self, svc: api.Service):
+        self.cluster_ip = svc.spec.cluster_ip
+        self.session_affinity = svc.spec.session_affinity
+        # port -> [(pod_ip, target_port, node_name)], ready only
+        self.by_port: Dict[int, List[Tuple[str, int, str]]] = {}
+
+
+class ServiceProxy:
+    """Watches Services + EndpointSlices; maintains the swap-on-write
+    rule table (proxier.go syncProxyRules: full recompute per change,
+    readers never see a partial table)."""
+
+    def __init__(self, store: st.Store, node_name: str = ""):
+        self.store = store
+        self.node_name = node_name  # for Local traffic preference
+        self.informers = InformerFactory(store)
+        self._table: Dict[Tuple[str, int], _ServiceRules] = {}
+        self._rr: Dict[Tuple[str, int], int] = {}
+        self._affinity: Dict[Tuple[str, str, int], Tuple[str, int]] = {}
+        self._lock = threading.Lock()
+        # serializes whole syncs (list + compile + swap): the Service and
+        # EndpointSlice informers run handlers on separate threads, and
+        # an older snapshot must never be swapped in after a newer one
+        # (the reference funnels syncProxyRules through one runner)
+        self._sync_lock = threading.Lock()
+        self.syncs = 0
+
+    def start(self) -> "ServiceProxy":
+        for kind in ("Service", "EndpointSlice"):
+            inf = self.informers.informer(kind)
+            inf.add_handler(lambda *_a: self._sync())
+            inf.start()
+        self.informers.wait_for_sync()
+        self._sync()
+        return self
+
+    def stop(self) -> None:
+        self.informers.stop()
+
+    # -- rule compilation (syncProxyRules) ----------------------------------
+
+    def _sync(self) -> None:
+        with self._sync_lock:
+            self._sync_locked()
+
+    def _sync_locked(self) -> None:
+        services = self.informers.informer("Service").list()
+        slices = self.informers.informer("EndpointSlice").list()
+        by_service: Dict[Tuple[str, str], List[api.EndpointSlice]] = {}
+        for s in slices:
+            name = s.meta.labels.get(api.LABEL_SERVICE_NAME)
+            if name:
+                by_service.setdefault((s.meta.namespace, name), []).append(s)
+        table: Dict[Tuple[str, int], _ServiceRules] = {}
+        for svc in services:
+            vip = svc.spec.cluster_ip
+            if not vip or vip == "None":
+                continue  # headless: DNS answers, the proxy doesn't
+            rules = _ServiceRules(svc)
+            eps = by_service.get((svc.meta.namespace, svc.meta.name), [])
+            for port in svc.spec.ports:
+                backends: List[Tuple[str, int, str]] = []
+                for s in eps:
+                    target = next(
+                        (p.port for p in s.ports if p.name == port.name),
+                        port.target_port or port.port,
+                    )
+                    for e in s.endpoints:
+                        if not e.conditions.ready or not e.addresses:
+                            continue
+                        backends.append(
+                            (e.addresses[0], target, e.node_name)
+                        )
+                backends.sort()
+                rules.by_port[port.port] = backends
+                table[(vip, port.port)] = rules
+        valid = {
+            (ip, tp)
+            for r in table.values()
+            for bs in r.by_port.values()
+            for ip, tp, _n in bs
+        }
+        with self._lock:
+            self._table = table  # atomic swap; prune dead affinities
+            self._affinity = {
+                k: v for k, v in self._affinity.items() if v in valid
+            }
+            self.syncs += 1
+
+    # -- the dataplane lookup -----------------------------------------------
+
+    def resolve(
+        self, vip: str, port: int, client_ip: str = "", local_only: bool = False
+    ) -> Optional[Tuple[str, int]]:
+        """(backend_ip, backend_port) for a connection to VIP:port, or
+        None (no service / no ready backends — the reference's REJECT
+        rule).  ClientIP affinity sticks a client to its backend while
+        that backend stays ready."""
+        with self._lock:
+            rules = self._table.get((vip, port))
+            if rules is None:
+                return None
+            backends = rules.by_port.get(port, [])
+            if local_only and self.node_name:
+                backends = [
+                    b for b in backends if b[2] == self.node_name
+                ] or backends
+            if not backends:
+                return None
+            if rules.session_affinity == "ClientIP" and client_ip:
+                key = (client_ip, vip, port)
+                prior = self._affinity.get(key)
+                if prior is not None and any(
+                    (ip, tp) == prior for ip, tp, _n in backends
+                ):
+                    return prior
+            rr_key = (vip, port)
+            i = self._rr.get(rr_key, 0)
+            ip, tport, _node = backends[i % len(backends)]
+            self._rr[rr_key] = i + 1
+            if rules.session_affinity == "ClientIP" and client_ip:
+                self._affinity[(client_ip, vip, port)] = (ip, tport)
+            return ip, tport
+
+    def rules(self) -> Dict[str, List[str]]:
+        """Human-readable dump (iptables-save analogue)."""
+        with self._lock:
+            out: Dict[str, List[str]] = {}
+            for (vip, port), r in sorted(self._table.items()):
+                out[f"{vip}:{port}"] = [
+                    f"-> {ip}:{tp} (node {node or '?'})"
+                    for ip, tp, node in r.by_port.get(port, [])
+                ]
+            return out

@@ -176,6 +176,7 @@ class Scheduler:
         self.informers.informer("Node").add_handler(self._on_node)
         self.informers.informer("Pod").add_handler(self._on_pod)
         self.informers.informer("Node").add_handler(self.volumes.on_node)
+        self.informers.informer("Pod").add_handler(self.volumes.on_pod)
         for kind, handler in (
             ("PersistentVolume", self.volumes.on_pv),
             ("PersistentVolumeClaim", self.volumes.on_pvc),

@@ -55,6 +55,14 @@ _IMPOSSIBLE = api.NodeSelector(
 and_selectors = api.and_selectors  # canonical definition: api.types
 
 
+def _host_pin(node_name: str) -> api.NodeSelector:
+    return api.NodeSelector(terms=[
+        api.NodeSelectorTerm(match_expressions=[
+            api.Requirement(api.LABEL_HOSTNAME, api.OP_IN, [node_name])
+        ])
+    ])
+
+
 class VolumeBinder:
     """Host-side volume state + the Reserve/PreBind protocol.
 
@@ -81,6 +89,9 @@ class VolumeBinder:
         # entry, no cap), so attach requests are only emitted for
         # limited drivers
         self._limited_drivers: set = set()
+        # claim key -> {pod key: node}: bound consumers per claim (the
+        # VolumeRestrictions multi-attach input)
+        self._claim_consumers: Dict[str, Dict[str, str]] = {}
 
     # -- informer handlers -------------------------------------------------
 
@@ -124,6 +135,37 @@ class VolumeBinder:
                     self._limited_drivers.add(
                         key[len(api.ATTACH_LIMIT_PREFIX):]
                     )
+
+    def on_pod(self, typ: str, pod: api.Pod, old) -> None:
+        """Track which node each claim's BOUND consumers run on — the
+        VolumeRestrictions multi-attach input
+        (plugins/volumerestrictions/volume_restrictions.go:306): a
+        ReadWriteOnce volume in use on node X forces later consumers to
+        co-locate on X."""
+        claims = [
+            v.persistent_volume_claim
+            for v in pod.spec.volumes
+            if v.persistent_volume_claim
+        ]
+        if not claims:
+            return
+        pkey = f"{pod.meta.namespace}/{pod.meta.name}"
+        with self._mu:
+            for claim in claims:
+                key = f"{pod.meta.namespace}/{claim}"
+                consumers = self._claim_consumers.setdefault(key, {})
+                if (
+                    typ == st.DELETED
+                    or not pod.spec.node_name
+                    # terminal pods release the attachment — an evicted
+                    # consumer must not pin replacements to its node
+                    or pod.status.phase in ("Succeeded", "Failed")
+                ):
+                    consumers.pop(pkey, None)
+                    if not consumers:
+                        self._claim_consumers.pop(key, None)
+                else:
+                    consumers[pkey] = pod.spec.node_name
 
     # -- the pod_transform hook (encode-time requirement derivation) -------
 
@@ -172,7 +214,14 @@ class VolumeBinder:
             pv = self._pvs.get(bound_pv)
             if pv is None:
                 return _IMPOSSIBLE, ""  # bound to a vanished volume
-            return pv.spec.node_affinity, pv.spec.driver
+            sel = pv.spec.node_affinity
+            if set(pv.spec.access_modes) == {"ReadWriteOnce"}:
+                # multi-attach restriction: an RWO volume mounts on ONE
+                # node — consumers co-locate with the current attachment
+                nodes = set(self._claim_consumers.get(key, {}).values())
+                if len(nodes) == 1:
+                    sel = api.and_selectors(sel, _host_pin(next(iter(nodes))))
+            return sel, pv.spec.driver
         if key in self._assumed_claim:  # assumed for provisioning
             return None, ""
         # Crash repair (the PV controller's syncVolume half,

@@ -202,3 +202,40 @@ def test_kill_and_resume_checkpoint(tmp_path):
         )
     finally:
         agent2.stop()
+
+
+def test_pressure_eviction_lowest_priority_first():
+    """Eviction manager (pkg/kubelet/eviction): memory pressure evicts
+    the lowest-priority pod — Failed phase + DisruptionTarget condition,
+    the signal controllers recreate from."""
+    store = st.Store()
+    # heartbeat slow enough that the test observes the first eviction
+    # and lifts the pressure before a second sweep could fire
+    agent = NodeAgent(
+        store, "agent-0", register=True, tick=0.02, heartbeat_interval=0.4
+    ).start()
+    try:
+        low = _pod("low")
+        low.spec.priority = 1
+        high = _pod("high")
+        high.spec.priority = 100
+        store.create(low)
+        store.create(high)
+        assert _wait(lambda: _ready(store, "low") and _ready(store, "high"))
+        node = store.get("Node", "agent-0", namespace="")
+        node.meta.annotations["agent.kubernetes.io/memory-pressure"] = "true"
+        store.update(node, force=True)
+        assert _wait(lambda: store.get("Pod", "low").status.phase == "Failed")
+        evicted = store.get("Pod", "low")
+        assert any(
+            c.get("type") == "DisruptionTarget"
+            for c in evicted.status.conditions
+        )
+        # pressure lifted before the next sweep claims the high-prio pod
+        node = store.get("Node", "agent-0", namespace="")
+        del node.meta.annotations["agent.kubernetes.io/memory-pressure"]
+        store.update(node, force=True)
+        time.sleep(0.2)
+        assert store.get("Pod", "high").status.phase == "Running"
+    finally:
+        agent.stop()

@@ -1,0 +1,105 @@
+"""Service proxy: the VIP -> backend table (kube-proxy's artifact).
+
+Reference: pkg/proxy/iptables/proxier.go syncProxyRules — compiled
+rules track Service/EndpointSlice changes; lookups round-robin ready
+backends, honor ClientIP affinity, and reject when nothing backs the
+VIP.
+"""
+
+import time
+
+from kubernetes_tpu.api import admission as adm
+from kubernetes_tpu.api import store as st
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.controllers.endpointslice import EndpointSliceController
+from kubernetes_tpu.proxy import ServiceProxy
+
+
+def _wait(cond, timeout=10.0, every=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(every)
+    return False
+
+
+def _pod(name, ip, ready=True, node="n0"):
+    p = api.Pod(
+        meta=api.ObjectMeta(name=name, labels={"app": "web"}),
+        spec=api.PodSpec(node_name=node),
+    )
+    p.status.phase = "Running"
+    p.status.pod_ip = ip
+    if not ready:
+        p.status.conditions = [{"type": "Ready", "status": "False"}]
+    return p
+
+
+def test_vip_resolution_round_robin_and_updates():
+    store = st.Store(admission=adm.default_chain())
+    mgr = ControllerManager(store, controllers=[EndpointSliceController]).start()
+    proxy = ServiceProxy(store).start()
+    try:
+        store.create(_pod("a", "10.1.0.1"))
+        store.create(_pod("b", "10.1.0.2"))
+        svc = store.create(api.Service(
+            meta=api.ObjectMeta(name="web"),
+            spec=api.ServiceSpec(
+                selector={"app": "web"},
+                ports=[api.ServicePort(name="http", port=80, target_port=8080)],
+            ),
+        ))
+        vip = svc.spec.cluster_ip
+        assert _wait(lambda: proxy.resolve(vip, 80) is not None)
+        assert _wait(
+            lambda: len(proxy.rules().get(f"{vip}:80", [])) == 2
+        )
+        # round robin covers both backends on the target port
+        seen = {proxy.resolve(vip, 80) for _ in range(4)}
+        assert seen == {("10.1.0.1", 8080), ("10.1.0.2", 8080)}
+        # unknown VIP / port rejects
+        assert proxy.resolve("10.0.0.99", 80) is None
+        assert proxy.resolve(vip, 81) is None
+        # backend turns unready -> drops from the table
+        p = store.get("Pod", "a")
+        p.status.conditions = [{"type": "Ready", "status": "False"}]
+        store.update(p, force=True)
+        assert _wait(
+            lambda: len(proxy.rules().get(f"{vip}:80", [])) == 1
+        )
+        assert proxy.resolve(vip, 80) == ("10.1.0.2", 8080)
+    finally:
+        proxy.stop()
+        mgr.stop()
+
+
+def test_client_ip_session_affinity():
+    store = st.Store(admission=adm.default_chain())
+    mgr = ControllerManager(store, controllers=[EndpointSliceController]).start()
+    proxy = ServiceProxy(store).start()
+    try:
+        store.create(_pod("a", "10.1.0.1"))
+        store.create(_pod("b", "10.1.0.2"))
+        svc = store.create(api.Service(
+            meta=api.ObjectMeta(name="web"),
+            spec=api.ServiceSpec(
+                selector={"app": "web"},
+                ports=[api.ServicePort(name="http", port=80, target_port=8080)],
+                session_affinity="ClientIP",
+            ),
+        ))
+        vip = svc.spec.cluster_ip
+        assert _wait(
+            lambda: len(proxy.rules().get(f"{vip}:80", [])) == 2
+        )
+        first = proxy.resolve(vip, 80, client_ip="192.168.0.7")
+        for _ in range(5):
+            assert proxy.resolve(vip, 80, client_ip="192.168.0.7") == first
+        # a different client may land elsewhere but also sticks
+        other = proxy.resolve(vip, 80, client_ip="192.168.0.8")
+        assert proxy.resolve(vip, 80, client_ip="192.168.0.8") == other
+    finally:
+        proxy.stop()
+        mgr.stop()

@@ -180,3 +180,57 @@ def test_unreserve_rolls_back_on_bind_failure(sched_store):
     assert pvc.spec.volume_name == "pv-a"
     assert calls["n"] == 1
     assert not sched.volumes._assumed_pv and not sched.volumes._assumed_claim
+
+
+def test_rwo_multi_attach_colocates_consumers():
+    """VolumeRestrictions multi-attach (volume_restrictions.go:306): a
+    ReadWriteOnce volume in use on node X forces later consumers onto
+    X — they share the single attachment instead of failing mounts."""
+    import time as _t
+
+    store = st.Store()
+    for i in range(3):
+        store.create(
+            make_node(f"n{i}").capacity(cpu_milli=8000, mem=16 * GI).obj()
+        )
+    pv = api.PersistentVolume(
+        meta=api.ObjectMeta(name="disk"),
+        spec=api.PersistentVolumeSpec(
+            capacity={api.STORAGE: 10 * GI},
+            access_modes=["ReadWriteOnce"],
+            storage_class_name="std",
+        ),
+    )
+    store.create(pv)
+    pvc = api.PersistentVolumeClaim(
+        meta=api.ObjectMeta(name="data"),
+        spec=api.PersistentVolumeClaimSpec(
+            access_modes=["ReadWriteOnce"],
+            storage_class_name="std",
+            resources={api.STORAGE: 5 * GI},
+            volume_name="disk",
+        ),
+    )
+    store.create(pvc)
+    sched = Scheduler(store, batch_size=8)
+    sched.start()
+    try:
+        store.create(make_pod("first").req(cpu_milli=100).pvc("data").obj())
+        deadline = _t.monotonic() + 60
+        while _t.monotonic() < deadline:
+            first = store.get("Pod", "first")
+            if first.spec.node_name:
+                break
+            _t.sleep(0.05)
+        assert first.spec.node_name
+        # the second consumer must land on the SAME node
+        store.create(make_pod("second").req(cpu_milli=100).pvc("data").obj())
+        deadline = _t.monotonic() + 60
+        while _t.monotonic() < deadline:
+            second = store.get("Pod", "second")
+            if second.spec.node_name:
+                break
+            _t.sleep(0.05)
+        assert second.spec.node_name == first.spec.node_name
+    finally:
+        sched.stop()
