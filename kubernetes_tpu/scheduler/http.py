@@ -93,6 +93,63 @@ class HealthServer:
                         self._reply("informers not synced", 503)
                 elif self.path == "/metrics":
                     self._reply(render_prometheus(sched.metrics))
+                elif self.path == "/debug/threads":
+                    # the pprof goroutine-dump analogue: every thread's
+                    # stack, the first tool out of the bag for a hung
+                    # scheduler (component-base wires /debug/pprof the
+                    # same way)
+                    import sys as _sys
+                    import traceback
+
+                    names = {
+                        t.ident: t.name for t in threading.enumerate()
+                    }
+                    lines = []
+                    for tid, frame in _sys._current_frames().items():
+                        lines.append(
+                            f"Thread {names.get(tid, '?')} ({tid}):"
+                        )
+                        lines.extend(
+                            ln.rstrip()
+                            for ln in traceback.format_stack(frame)
+                        )
+                        lines.append("")
+                    self._reply("\n".join(lines))
+                elif self.path.startswith("/debug/profile"):
+                    # sampling profile over a short window (pprof's
+                    # /debug/pprof/profile?seconds=N): stacks of EVERY
+                    # thread sampled at ~100 Hz and aggregated by frame —
+                    # a tracing profiler would only see this handler's
+                    # thread
+                    import sys as _sys
+                    import time as _t
+                    from collections import Counter
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+                    seconds = min(float(q.get("seconds", ["2"])[0]), 30.0)
+                    me = threading.get_ident()
+                    counts: Counter = Counter()
+                    samples = 0
+                    deadline = _t.monotonic() + seconds
+                    while _t.monotonic() < deadline:
+                        for tid, frame in _sys._current_frames().items():
+                            if tid == me:
+                                continue
+                            f = frame
+                            while f is not None:
+                                co = f.f_code
+                                counts[
+                                    f"{co.co_filename.rsplit('/', 1)[-1]}"
+                                    f":{co.co_name}"
+                                ] += 1
+                                f = f.f_back
+                        samples += 1
+                        _t.sleep(0.01)
+                    lines = [f"samples: {samples} over {seconds}s"]
+                    for frame_id, n in counts.most_common(40):
+                        lines.append(f"{n / max(samples, 1):7.2%}  {frame_id}")
+                    self._reply("\n".join(lines) + "\n")
                 else:
                     self._reply("not found", 404)
 

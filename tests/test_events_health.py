@@ -97,3 +97,26 @@ def test_prometheus_rendering_shape():
     assert "# TYPE scheduler_schedule_attempts_total counter" in text
     assert "# TYPE scheduler_scheduling_attempt_duration_seconds histogram" in text
     assert "_bucket{le=" in text
+
+
+def test_debug_endpoints():
+    """/debug/threads (goroutine-dump analogue) + /debug/profile
+    (sampling profile across ALL threads) on the health server."""
+    import urllib.request
+
+    from kubernetes_tpu.scheduler.http import HealthServer
+
+    store = st.Store()
+    sched = Scheduler(store)
+    hs = HealthServer(sched).start()
+    try:
+        base = f"http://127.0.0.1:{hs.port}"
+        body = urllib.request.urlopen(f"{base}/debug/threads", timeout=5).read()
+        assert b"Thread" in body or b"File" in body
+        body = urllib.request.urlopen(
+            f"{base}/debug/profile?seconds=0.2", timeout=10
+        ).read().decode()
+        assert body.startswith("samples:")
+    finally:
+        hs.stop()
+        sched.stop()
