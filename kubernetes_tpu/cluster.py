@@ -1,0 +1,96 @@
+"""Cluster: the all-in-one composition (the hyperkube / `kind` role).
+
+Reference: the reference ships kube-apiserver, kube-scheduler,
+kube-controller-manager, and kubelets as separate binaries a deployment
+tool assembles; the single-process analogue is this one object — store
+(+ optional journal), admission chain, API server (+ optional
+authn/authz/APF), scheduler, controller manager, node agents, and an
+optional service proxy — started and stopped together.  Everything it
+wires is the same public surface tests and embedders use piecemeal.
+
+    from kubernetes_tpu.cluster import Cluster
+
+    cluster = Cluster(n_agents=3).start()
+    client = cluster.client()           # RestClient against the server
+    client.create(deployment)           # agents run the pods
+    cluster.stop()
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .agent import NodeAgent
+from .api import admission as adm
+from .api import store as st
+from .api.server import APIServer
+from .client.rest import RestClient
+from .controllers import ControllerManager
+from .proxy import ServiceProxy
+from .scheduler import Scheduler
+
+
+class Cluster:
+    def __init__(
+        self,
+        n_agents: int = 0,
+        journal_path: Optional[str] = None,
+        authn=None,
+        authz=None,
+        apf=None,
+        scheduler_config=None,
+        admission_chain=None,
+        with_proxy: bool = False,
+        agent_cpu_milli: int = 32000,
+        agent_mem: int = 64 * (1 << 30),
+    ):
+        self.store = st.Store(
+            journal_path=journal_path,
+            admission=(
+                admission_chain
+                if admission_chain is not None
+                else adm.default_chain()
+            ),
+        )
+        self.server = APIServer(
+            self.store, authn=authn, authz=authz, apf=apf
+        )
+        self.scheduler = Scheduler(self.store, config=scheduler_config)
+        self.manager = ControllerManager(self.store)
+        self.agents: List[NodeAgent] = [
+            NodeAgent(
+                self.store,
+                f"node-{i}",
+                register=True,
+                cpu_milli=agent_cpu_milli,
+                mem=agent_mem,
+            )
+            for i in range(n_agents)
+        ]
+        self.proxy = ServiceProxy(self.store) if with_proxy else None
+
+    @property
+    def url(self) -> str:
+        return self.server.url
+
+    def client(self, token: Optional[str] = None) -> RestClient:
+        return RestClient(self.url, token=token)
+
+    def start(self) -> "Cluster":
+        self.server.start()
+        for agent in self.agents:
+            agent.start()
+        self.manager.start()
+        self.scheduler.start()
+        if self.proxy is not None:
+            self.proxy.start()
+        return self
+
+    def stop(self) -> None:
+        if self.proxy is not None:
+            self.proxy.stop()
+        self.scheduler.stop()
+        self.manager.stop()
+        for agent in self.agents:
+            agent.stop()
+        self.server.stop()

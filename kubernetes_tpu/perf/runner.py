@@ -212,6 +212,21 @@ class WorkloadRunner:
             store.create(kubeyaml.pod_from_dict(d))
         created["pods"] += op.count
         if collector is not None:
+            # the barrier reads the scheduler's informer cache, which can
+            # LAG the creations just written — a first poll that sees no
+            # pending pods yet would declare victory with 0 scheduled.
+            # Wait for the cache to observe every measured pod first.
+            measured = {f"pod-{base + i}" for i in range(op.count)}
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                seen = sum(
+                    1
+                    for p in self._pods_snapshot(store, sched)
+                    if p.meta.name in measured
+                )
+                if seen >= op.count:
+                    break
+                time.sleep(0.01)
             # measured pods: wait for them all to schedule, then collect
             self._barrier(store, namespace, sched=sched)
             wall = time.monotonic() - t0

@@ -1,0 +1,62 @@
+"""The all-in-one Cluster composition (hyperkube / kind role):
+apiserver + scheduler + controllers + agents + proxy in one object.
+"""
+
+import time
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.cluster import Cluster
+
+
+def _wait(cond, timeout=90.0, every=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(every)
+    return False
+
+
+def test_cluster_end_to_end():
+    cluster = Cluster(n_agents=2, with_proxy=True).start()
+    try:
+        client = cluster.client()
+        labels = {"app": "web"}
+        client.create(api.Deployment(
+            meta=api.ObjectMeta(name="web"),
+            spec=api.DeploymentSpec(
+                replicas=2,
+                selector=api.LabelSelector(match_labels=labels),
+                template=api.PodTemplateSpec(
+                    meta=api.ObjectMeta(labels=labels),
+                    spec=api.PodSpec(containers=[
+                        api.Container(requests={api.CPU: 100})
+                    ]),
+                ),
+            ),
+        ))
+        svc = client.create(api.Service(
+            meta=api.ObjectMeta(name="web"),
+            spec=api.ServiceSpec(
+                selector=labels,
+                ports=[api.ServicePort(name="http", port=80,
+                                       target_port=8080)],
+            ),
+        ))
+        # pods schedule onto agent nodes, agents run them to Ready,
+        # slices populate, the proxy resolves the VIP
+        assert _wait(lambda: sum(
+            1 for p in client.list("Pod")[0]
+            if p.spec.node_name and api.pod_is_ready(p)
+        ) == 2)
+        vip = svc.spec.cluster_ip
+        assert _wait(
+            lambda: cluster.proxy.resolve(vip, 80) is not None
+        )
+        backend = cluster.proxy.resolve(vip, 80)
+        assert backend[0].startswith("10.88.") and backend[1] == 8080
+        # default ServiceAccount materialized; pods run as it
+        pod = client.list("Pod")[0][0]
+        assert pod.spec.service_account == "default"
+    finally:
+        cluster.stop()
