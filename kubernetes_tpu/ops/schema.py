@@ -951,7 +951,7 @@ class SnapshotBuilder:
                 port_bits[i].copy(), pref_idx[i].copy(), pref_weight[i].copy(),
             )
 
-        s_dim = vb.pad_dim(len(sel_rows), 1)
+        s_dim = vb.pad_constraint_dim(len(sel_rows))
         sel = SelectorTable(
             expr_ids=np.full((s_dim, t_cap, e_cap, k_cap), -1, dtype=np.int32),
             expr_op=np.zeros((s_dim, t_cap, e_cap), dtype=np.int32),
@@ -964,7 +964,7 @@ class SnapshotBuilder:
             sel.expr_slot[s] = slots
             sel.term_valid[s] = tv
 
-        f_dim = vb.pad_dim(len(pref_rows), 1)
+        f_dim = vb.pad_constraint_dim(len(pref_rows))
         pref = PreferredTable(
             expr_ids=np.full((f_dim, e_cap, k_cap), -1, dtype=np.int32),
             expr_op=np.zeros((f_dim, e_cap), dtype=np.int32),
@@ -1114,7 +1114,7 @@ class SnapshotBuilder:
                     spread_rows.append((c, sel, pod.meta.namespace, owner_sel_row, keys))
                 pod_spread_idx[i, j] = idx
 
-        c_dim = vb.pad_dim(len(spread_rows), 1)
+        c_dim = vb.pad_constraint_dim(len(spread_rows))
         spread = SpreadTable(
             valid=np.zeros(c_dim, dtype=bool),
             slot=np.zeros(c_dim, dtype=np.int32),
@@ -1186,7 +1186,7 @@ class SnapshotBuilder:
                 except OverflowError:
                     pass
 
-        t_dim = vb.pad_dim(len(term_rows), 1)
+        t_dim = vb.pad_constraint_dim(len(term_rows))
         t_words = (t_dim + 31) // 32
         terms = TermTable(
             valid=np.zeros(t_dim, dtype=bool),
@@ -1296,7 +1296,7 @@ class SnapshotBuilder:
                 except OverflowError:
                     pass
 
-        u_dim = vb.pad_dim(len(rows), 1)
+        u_dim = vb.pad_constraint_dim(len(rows))
         table = PrefPodTable(
             valid=np.zeros(u_dim, dtype=bool),
             slot=np.zeros(u_dim, dtype=np.int32),

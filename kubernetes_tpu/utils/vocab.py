@@ -129,3 +129,17 @@ def pad_dim(n: int, minimum: int = 8) -> int:
     size = max(n, minimum)
     bucket = 1 << (size - 1).bit_length()
     return bucket
+
+
+def pad_constraint_dim(n: int) -> int:
+    """Constraint-table row dims (selector/spread/term/preferred rows).
+    Zero rows stay at dim 1 — the feature flags gate the whole family
+    off and the [1, N] zero table costs one cached fill.  NONZERO rows
+    floor at 32: straggler batches (retries, late arrivals) carry
+    arbitrary subsets of the main batch's constraint classes, and
+    per-power-of-two row dims would compile a fresh executable for
+    nearly every straggler composition — the dominant in-window compile
+    source for constraint workloads."""
+    if n == 0:
+        return 1
+    return pad_dim(n, 32)
