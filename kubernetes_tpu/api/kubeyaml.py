@@ -483,8 +483,31 @@ def service_from_dict(d: Dict[str, Any]) -> api.Service:
     )
 
 
+def configmap_from_dict(d: Dict[str, Any]) -> api.ConfigMap:
+    return api.ConfigMap(
+        meta=_meta_from_dict(d),
+        data={k: str(v) for k, v in (d.get("data") or {}).items()},
+        binary_data=dict(d.get("binaryData") or {}),
+        immutable=bool(d.get("immutable", False)),
+    )
+
+
+def secret_from_dict(d: Dict[str, Any]) -> api.Secret:
+    return api.Secret(
+        meta=_meta_from_dict(d),
+        type=d.get("type", "Opaque"),
+        data=dict(d.get("data") or {}),
+        string_data={
+            k: str(v) for k, v in (d.get("stringData") or {}).items()
+        },
+        immutable=bool(d.get("immutable", False)),
+    )
+
+
 CONVERTERS = {
     "Service": service_from_dict,
+    "ConfigMap": configmap_from_dict,
+    "Secret": secret_from_dict,
     "Node": node_from_dict,
     "Pod": pod_from_dict,
     "Deployment": deployment_from_dict,

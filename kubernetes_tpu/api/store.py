@@ -397,6 +397,8 @@ class Store:
             if not admitted:  # the admitted copy is already unaliased
                 obj = copy.deepcopy(obj)
             obj.meta.resource_version = self._rv
+            if not obj.meta.creation_timestamp:
+                obj.meta.creation_timestamp = time.time()
             objs[key] = obj
             self._versions.setdefault(kind, {})[key] = self._rv
             self._append_journal(ADDED, kind, key, obj, self._rv)

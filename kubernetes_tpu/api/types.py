@@ -1176,6 +1176,34 @@ class ServiceAccount:
 
 
 # ---------------------------------------------------------------------------
+# Config & secrets (core/v1 ConfigMap :5789, Secret :5561): plain keyed
+# payloads workloads mount/reference; Secrets carry an opaque type tag
+# and base64-on-the-wire data semantics are the client's concern here.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ConfigMap:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+    binary_data: Dict[str, str] = field(default_factory=dict)  # b64
+    immutable: bool = False
+
+    KIND = "ConfigMap"
+
+
+@dataclass
+class Secret:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    type: str = "Opaque"
+    data: Dict[str, str] = field(default_factory=dict)  # b64 values
+    string_data: Dict[str, str] = field(default_factory=dict)
+    immutable: bool = False
+
+    KIND = "Secret"
+
+
+# ---------------------------------------------------------------------------
 # Dynamic admission (reference: admissionregistration.k8s.io/v1 —
 # Mutating/ValidatingWebhookConfiguration, ValidatingAdmissionPolicy).
 # Webhooks are HTTP callouts on the write path; policies are in-process

@@ -36,6 +36,23 @@ KINDS = {
     "endpoints": "Endpoints", "ep": "Endpoints",
     "endpointslice": "EndpointSlice", "endpointslices": "EndpointSlice",
     "eps": "EndpointSlice",
+    "configmap": "ConfigMap", "configmaps": "ConfigMap", "cm": "ConfigMap",
+    "secret": "Secret", "secrets": "Secret",
+    "serviceaccount": "ServiceAccount", "serviceaccounts": "ServiceAccount",
+    "sa": "ServiceAccount",
+    "resourcequota": "ResourceQuota", "resourcequotas": "ResourceQuota",
+    "quota": "ResourceQuota",
+    "hpa": "HorizontalPodAutoscaler",
+    "pv": "PersistentVolume", "persistentvolumes": "PersistentVolume",
+    "pvc": "PersistentVolumeClaim",
+    "persistentvolumeclaims": "PersistentVolumeClaim",
+    "crd": "CustomResourceDefinition",
+    "crds": "CustomResourceDefinition",
+    "role": "Role", "roles": "Role",
+    "clusterrole": "ClusterRole", "clusterroles": "ClusterRole",
+    "rolebinding": "RoleBinding", "rolebindings": "RoleBinding",
+    "clusterrolebinding": "ClusterRoleBinding",
+    "clusterrolebindings": "ClusterRoleBinding",
 }
 
 

@@ -22,6 +22,7 @@ from .garbagecollector import GarbageCollector
 from .job import JobController
 from .namespace import NamespaceController
 from .podautoscaler import HorizontalPodAutoscalerController
+from .podgc import PodGCController
 from .pvcontroller import PersistentVolumeController
 from .replicaset import ReplicaSetController
 from .resourcequota import ResourceQuotaController
@@ -44,6 +45,7 @@ DEFAULT_CONTROLLERS: List[Type[Controller]] = [
     ServiceAccountController,
     TTLAfterFinishedController,
     PersistentVolumeController,
+    PodGCController,
 ]
 
 

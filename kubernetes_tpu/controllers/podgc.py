@@ -1,0 +1,75 @@
+"""Pod garbage collector.
+
+Reference: pkg/controller/podgc/gc_controller.go — reaps (1) terminated
+pods beyond a threshold (oldest first, so Failed/Succeeded history
+stays bounded while recent forensics survive), and (2) pods bound to
+nodes that no longer exist (the orphaned-pod sweep).  With the node
+agent producing Failed pods on eviction and Jobs producing Succeeded
+ones, something must bound that population — exactly why the reference
+runs this controller.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..api import store as st
+from ..api import types as api
+from .base import Controller
+
+_SYNC_KEY = "podgc"
+
+
+class PodGCController(Controller):
+    KIND = "Pod"
+    NAME = "PodGC"
+    RESYNC_S = 5.0
+    # --terminated-pod-gc-threshold (the reference default is 12500;
+    # scaled to the in-process store's population)
+    TERMINATED_THRESHOLD = 500
+
+    def register(self) -> None:
+        self.informers.informer("Pod").add_handler(self._on_event)
+        self.informers.informer("Node").add_handler(self._on_event)
+        self._tick_stop = threading.Event()
+        self._ticker = threading.Thread(
+            target=self._tick, name="podgc-ticker", daemon=True
+        )
+        self._ticker.start()
+
+    def stop(self) -> None:
+        if hasattr(self, "_tick_stop"):
+            self._tick_stop.set()
+        super().stop()
+
+    def _tick(self) -> None:
+        while not self._tick_stop.wait(self.RESYNC_S):
+            self.queue.add(_SYNC_KEY)
+
+    def _on_event(self, typ: str, obj, old) -> None:
+        if typ == st.DELETED and getattr(obj, "KIND", "") == "Node":
+            self.queue.add(_SYNC_KEY)  # orphans appeared
+
+    def sync(self, key: str) -> None:
+        pods = self.informers.informer("Pod").list()
+        nodes = {n.meta.name for n in self.informers.informer("Node").list()}
+        # orphaned: bound to a node that no longer exists
+        for p in pods:
+            if p.spec.node_name and p.spec.node_name not in nodes:
+                self._delete(p)
+        terminated = sorted(
+            (
+                p for p in pods
+                if p.status.phase in ("Succeeded", "Failed")
+            ),
+            key=lambda p: p.meta.creation_timestamp or 0.0,
+        )
+        excess = len(terminated) - self.TERMINATED_THRESHOLD
+        for p in terminated[: max(excess, 0)]:
+            self._delete(p)
+
+    def _delete(self, pod: api.Pod) -> None:
+        try:
+            self.store.delete("Pod", pod.meta.name, pod.meta.namespace)
+        except KeyError:
+            pass

@@ -207,3 +207,77 @@ def test_ttl_after_finished_deletes_job():
         )
     finally:
         mgr.stop()
+
+
+def test_podgc_reaps_orphans_and_bounded_terminated():
+    """pkg/controller/podgc: pods on vanished nodes are reaped; the
+    terminated-pod population is bounded oldest-first."""
+    from kubernetes_tpu.controllers.podgc import PodGCController
+    from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+    store = st.Store()
+
+    def gc_factory(*args, **kw):
+        c = PodGCController(*args, **kw)
+        c.TERMINATED_THRESHOLD = 3
+        c.RESYNC_S = 0.1
+        return c
+    gc_factory.KIND = "Pod"
+    gc_factory.NAME = "PodGC"
+
+    store.create(make_node("n0").capacity(cpu_milli=4000).obj())
+    store.create(make_node("gone").capacity(cpu_milli=4000).obj())
+    orphan = make_pod("orphan").obj()
+    orphan.spec.node_name = "gone"
+    store.create(orphan)
+    for i in range(5):
+        p = make_pod(f"done-{i}").obj()
+        p.spec.node_name = "n0"
+        p.status.phase = "Succeeded"
+        store.create(p)
+        time.sleep(0.01)  # distinct creation timestamps
+    mgr = ControllerManager(store, controllers=[gc_factory]).start()
+    try:
+        store.delete("Node", "gone", namespace="")
+        def orphan_gone():
+            try:
+                store.get("Pod", "orphan")
+                return False
+            except KeyError:
+                return True
+        assert _wait(orphan_gone)
+        # oldest terminated pods reaped down to the threshold
+        assert _wait(lambda: sum(
+            1 for p in store.list("Pod")[0]
+            if p.status.phase == "Succeeded"
+        ) == 3)
+        remaining = {
+            p.meta.name for p in store.list("Pod")[0]
+            if p.status.phase == "Succeeded"
+        }
+        assert remaining == {"done-2", "done-3", "done-4"}
+    finally:
+        mgr.stop()
+
+
+def test_configmap_secret_round_trip():
+    from kubernetes_tpu.api import kubeyaml, wire
+
+    store = st.Store()
+    cm = kubeyaml.configmap_from_dict({
+        "kind": "ConfigMap",
+        "metadata": {"name": "settings"},
+        "data": {"mode": "fast", "replicas": "3"},
+    })
+    store.create(cm)
+    got = store.get("ConfigMap", "settings")
+    assert got.data["mode"] == "fast"
+    sec = kubeyaml.secret_from_dict({
+        "kind": "Secret",
+        "metadata": {"name": "creds"},
+        "type": "Opaque",
+        "stringData": {"password": "hunter2"},
+    })
+    store.create(sec)
+    doc = wire.to_wire(store.get("Secret", "creds"))
+    assert wire.from_wire(doc).string_data["password"] == "hunter2"
