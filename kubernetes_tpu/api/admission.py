@@ -140,6 +140,20 @@ def default_service(obj: Any, operation: str, store=None) -> None:
 default_service.wants_store = True
 
 
+def default_secret(obj: Any, operation: str) -> None:
+    """stringData is WRITE-ONLY (core/v1 Secret docs): fold it into
+    data base64-encoded at admission and clear it, so readers always
+    find secret.data[...] and plaintext never persists in the journal
+    under a side field."""
+    if not isinstance(obj, api.Secret) or not obj.string_data:
+        return
+    import base64
+
+    for k, v in obj.string_data.items():
+        obj.data[k] = base64.b64encode(v.encode()).decode()
+    obj.string_data = {}
+
+
 def validate_service(obj: Any, operation: str) -> None:
     if not isinstance(obj, api.Service):
         return
@@ -175,6 +189,7 @@ def default_chain() -> AdmissionChain:
     chain = AdmissionChain()
     chain.register_mutator(default_pod)
     chain.register_mutator(default_service)
+    chain.register_mutator(default_secret)
     # serviceaccount admission (plugin/pkg/admission/serviceaccount)
     from ..controllers.serviceaccount import default_service_account
 

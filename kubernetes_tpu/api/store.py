@@ -388,6 +388,12 @@ class Store:
             admitted = True
         kind = self._kind_of(obj)
         meta = self._meta(obj)
+        if kind in api.CLUSTER_SCOPED_KINDS and meta.namespace:
+            # resource scope normalization: cluster-scoped objects live
+            # at namespace "" regardless of what the caller set (the
+            # apiserver rejects these; normalizing keeps every
+            # convenience-default caller working)
+            meta.namespace = ""
         key = _key(meta.namespace, meta.name)
         with self._lock:
             objs = self._objects.setdefault(kind, {})
@@ -406,6 +412,8 @@ class Store:
             return copy.deepcopy(obj)
 
     def get(self, kind: str, name: str, namespace: str = "default") -> Any:
+        if kind in api.CLUSTER_SCOPED_KINDS:
+            namespace = ""
         key = _key(namespace, name)
         with self._lock:
             try:
@@ -428,6 +436,8 @@ class Store:
             admitted = True
         kind = self._kind_of(obj)
         meta = self._meta(obj)
+        if kind in api.CLUSTER_SCOPED_KINDS and meta.namespace:
+            meta.namespace = ""
         key = _key(meta.namespace, meta.name)
         with self._lock:
             objs = self._objects.get(kind, {})
@@ -468,6 +478,8 @@ class Store:
         fires; the real removal happens when the last finalizer is
         dropped via update() — the node agent's graceful pod shutdown
         and any future finalizing controller ride this."""
+        if kind in api.CLUSTER_SCOPED_KINDS:
+            namespace = ""
         key = _key(namespace, name)
         with self._lock:
             objs = self._objects.get(kind, {})

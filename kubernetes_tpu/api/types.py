@@ -1339,6 +1339,18 @@ def pod_is_ready(pod: "Pod") -> bool:
     return pod.status.phase == "Running"
 
 
+# Kinds that live outside any namespace (the reference's
+# resource-scope machinery, apimachinery RESTScope): the store
+# normalizes their namespace to "" on every path so callers using the
+# "default" convenience still find them, and namespace sweeps skip them.
+CLUSTER_SCOPED_KINDS = frozenset({
+    "Node", "PersistentVolume", "StorageClass", "Namespace",
+    "CustomResourceDefinition", "ClusterRole", "ClusterRoleBinding",
+    "DeviceClass", "MutatingWebhookConfiguration",
+    "ValidatingWebhookConfiguration", "ValidatingAdmissionPolicy",
+})
+
+
 def clone(obj):
     """Deep copy an API object (the reference's generated DeepCopy)."""
     return dataclasses.replace(

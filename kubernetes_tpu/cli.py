@@ -43,6 +43,8 @@ KINDS = {
     "resourcequota": "ResourceQuota", "resourcequotas": "ResourceQuota",
     "quota": "ResourceQuota",
     "hpa": "HorizontalPodAutoscaler",
+    "horizontalpodautoscaler": "HorizontalPodAutoscaler",
+    "horizontalpodautoscalers": "HorizontalPodAutoscaler",
     "pv": "PersistentVolume", "persistentvolumes": "PersistentVolume",
     "pvc": "PersistentVolumeClaim",
     "persistentvolumeclaims": "PersistentVolumeClaim",
@@ -102,7 +104,7 @@ def _fmt_any(o) -> List[str]:
 
 def _ns_for(kind: str, args) -> str:
     # cluster-scoped kinds live in namespace ""
-    return "" if kind == "Node" else args.namespace
+    return "" if kind in api.CLUSTER_SCOPED_KINDS else args.namespace
 
 
 def cmd_get(client: RestClient, args) -> None:
@@ -113,7 +115,8 @@ def cmd_get(client: RestClient, args) -> None:
         return
     namespace = (
         None
-        if kind == "Node" or getattr(args, "all_namespaces", False)
+        if kind in api.CLUSTER_SCOPED_KINDS
+        or getattr(args, "all_namespaces", False)
         else args.namespace
     )
     items, rv = client.list(

@@ -21,7 +21,7 @@ from ..api import types as api
 from .base import Controller
 
 # kinds that are cluster-scoped: never swept by namespace deletion
-CLUSTER_SCOPED = {"Node", "PersistentVolume", "StorageClass", "Namespace"}
+CLUSTER_SCOPED = set(api.CLUSTER_SCOPED_KINDS)
 
 
 class NamespaceController(Controller):

@@ -53,14 +53,26 @@ class PodGCController(Controller):
     def sync(self, key: str) -> None:
         pods = self.informers.informer("Pod").list()
         nodes = {n.meta.name for n in self.informers.informer("Node").list()}
-        # orphaned: bound to a node that no longer exists
+        reaped = set()
+        # orphaned: bound to a node that no longer exists — confirmed
+        # against the STORE first, because the per-kind informer threads
+        # are not mutually consistent and a just-created node may not
+        # have reached the Node cache yet (the reference double-checks
+        # with a live GET for exactly this race, gc_controller.go)
         for p in pods:
             if p.spec.node_name and p.spec.node_name not in nodes:
+                try:
+                    self.store.get("Node", p.spec.node_name, "")
+                    continue  # informer lag; the node exists
+                except KeyError:
+                    pass
                 self._delete(p)
+                reaped.add(f"{p.meta.namespace}/{p.meta.name}")
         terminated = sorted(
             (
                 p for p in pods
                 if p.status.phase in ("Succeeded", "Failed")
+                and f"{p.meta.namespace}/{p.meta.name}" not in reaped
             ),
             key=lambda p: p.meta.creation_timestamp or 0.0,
         )

@@ -261,9 +261,11 @@ def test_podgc_reaps_orphans_and_bounded_terminated():
 
 
 def test_configmap_secret_round_trip():
+    import base64
+
     from kubernetes_tpu.api import kubeyaml, wire
 
-    store = st.Store()
+    store = st.Store(admission=adm.default_chain())
     cm = kubeyaml.configmap_from_dict({
         "kind": "ConfigMap",
         "metadata": {"name": "settings"},
@@ -279,5 +281,9 @@ def test_configmap_secret_round_trip():
         "stringData": {"password": "hunter2"},
     })
     store.create(sec)
-    doc = wire.to_wire(store.get("Secret", "creds"))
-    assert wire.from_wire(doc).string_data["password"] == "hunter2"
+    # stringData is write-only: folded into data (b64) at admission
+    stored = store.get("Secret", "creds")
+    assert stored.string_data == {}
+    assert base64.b64decode(stored.data["password"]).decode() == "hunter2"
+    doc = wire.to_wire(stored)
+    assert wire.from_wire(doc).data["password"] == stored.data["password"]
