@@ -302,16 +302,40 @@ def config6():
         "scheduler_scheduling_attempt_duration_seconds",
         sched.metrics.scheduling_attempt_duration,
     )
+    # pipeline accounting: how much wall clock the binding stage spent
+    # committing, how much of that ran under a device solve (overlap),
+    # and the commit share of the solve-stage step (commits are
+    # off-thread, so a healthy pipeline keeps the non-overlapped share
+    # well under the old in-line ~50%)
+    m = sched.metrics
+    step_s = m.schedule_batch_duration.total
+    commit_s = m.commit_wave_duration.total
+    overlap_s = m.pipeline_overlap.total
+    exposed = max(commit_s - overlap_s, 0.0)  # commit time NOT hidden
     return {
         "nodes": n_nodes, "pods": n_measured, "placed": bound,
         "latency_s": round(dt, 4),
         "pods_per_s": round(bound / dt, 1) if dt else 0.0,
         "attempt_p99_ms": round(win.percentile(0.99) * 1000, 2),
         "watchers_terminated": store.watchers_terminated - terminated0,
+        "step_s_total": round(step_s, 4),
+        "solve_s_total": round(m.batch_solve_duration.total, 4),
+        "commit_s_total": round(commit_s, 4),
+        "commit_overlap_s": round(overlap_s, 4),
+        "commit_waves": m.commit_wave_size.n,
+        "commit_share_of_step": round(
+            exposed / (step_s + exposed), 4
+        ) if step_s + exposed > 0 else 0.0,
     }
 
 
 def main() -> None:
+    import os
+    import sys
+
+    from kubernetes_tpu.utils import trace as tracemod
+
+    tracemod.drain_overruns()  # measure only this run's traces
     extra = {
         "c1_fit_500": config1(),
         "c2_balanced_5k": config2(),
@@ -320,6 +344,25 @@ def main() -> None:
         "c5_gang_50k": config5(),
         "c6_churn_5k": config6(),
     }
+    # every over-threshold schedule_batch cycle, with its per-step share
+    # (commit-share per step is readable straight off the steps list);
+    # BENCH_STRICT=1 turns any such trace into a non-zero exit so CI
+    # fails on slow cycles instead of shipping them as log warnings
+    overruns = tracemod.drain_overruns()
+    extra["trace_overruns"] = [
+        {
+            "name": o["name"],
+            "total_s": o["total_s"],
+            "steps": o["steps"],
+            "commit_share": round(
+                sum(dt for w, dt in o["steps"] if w.startswith("commit"))
+                / o["total_s"],
+                4,
+            ) if o["total_s"] else 0.0,
+            **o["fields"],
+        }
+        for o in overruns
+    ]
     c5 = extra["c5_gang_50k"]
     pods_per_s = 10_000 / c5["latency_s"]
     print(
@@ -333,6 +376,15 @@ def main() -> None:
             }
         )
     )
+    if os.environ.get("BENCH_STRICT") == "1" and any(
+        o["name"] == "schedule_batch" for o in overruns
+    ):
+        print(
+            f"BENCH_STRICT: {sum(o['name'] == 'schedule_batch' for o in overruns)}"
+            " over-threshold schedule_batch trace(s)",
+            file=sys.stderr,
+        )
+        sys.exit(1)
 
 
 if __name__ == "__main__":

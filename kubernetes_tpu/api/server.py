@@ -150,7 +150,9 @@ class _Handler(BaseHTTPRequestHandler):
         """authn -> flow-control -> authz gate; replies 401/429/403 and
         returns False on rejection.  healthz stays open (the reference
         exempts health endpoints before the chain).  The APF seat, once
-        acquired, is released by the do_* wrapper's finally."""
+        acquired, is released by the do_* wrapper's finally — except for
+        watches, which release it as soon as the stream is established
+        (_watch) so long-lived streams can't pin seats."""
         subject = authmod.ANONYMOUS
         if self.authn is not None:
             subject = self.authn.authenticate(
@@ -408,6 +410,15 @@ class _Handler(BaseHTTPRequestHandler):
         """Newline-delimited JSON watch stream (endpoints/handlers/
         watch.go's chunked frames).  Ends when the client disconnects or
         the store terminates the watch."""
+        # The APF seat gates watch INITIALIZATION only (the reference's
+        # apf_filter.go forgetWatch): a seat held for the stream's whole
+        # lifetime would let N long-lived watches from one priority level
+        # permanently exhaust its N seats and 429 every later request in
+        # that class.  Release it here; handle_one_request's finally sees
+        # None and won't double-release.
+        if self._apf_level is not None:
+            self._apf_level.release()
+            self._apf_level = None
         from_rv = q.get("from_rv", [None])[0]
         w = self.store.watch(kind, int(from_rv) if from_rv else None)
         self.send_response(200)

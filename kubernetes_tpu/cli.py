@@ -219,7 +219,7 @@ def cmd_apply(client: RestClient, args) -> None:
                 f"apply -f supports {sorted(kubeyaml.CONVERTERS)}; got {kind}"
             )
         obj = conv(d)
-        ns = "" if kind == "Node" else obj.meta.namespace
+        ns = "" if kind in api.CLUSTER_SCOPED_KINDS else obj.meta.namespace
         try:
             client.get(kind, obj.meta.name, ns)
         except Exception:

@@ -91,9 +91,18 @@ class EventRecorder:
             batch, self._queue = self._queue, []
         if not batch:
             return
-        merged: Dict[Tuple[str, str, str], list] = {}
+        merged: Dict[Tuple[str, str, str, str], list] = {}
         for obj, event_type, reason, message, ts in batch:
-            key = (obj.meta.namespace, f"{obj.meta.name}.{reason.lower()}", message)
+            # event_type is part of the identity (matching _record's
+            # same-type check): a Normal and a Warning repeat of the same
+            # reason/message must not merge into one record whose type is
+            # whichever arrived first
+            key = (
+                obj.meta.namespace,
+                f"{obj.meta.name}.{reason.lower()}",
+                event_type,
+                message,
+            )
             slot = merged.get(key)
             if slot is None:
                 merged[key] = [obj, event_type, reason, message, ts, 1]

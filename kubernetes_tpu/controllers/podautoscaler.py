@@ -83,7 +83,10 @@ class HorizontalPodAutoscalerController(Controller):
         if desired != current:
             target.spec.replicas = desired
             self.store.update(target, force=True)
-            hpa.status.last_scale_time = now
+            # wall clock, like every other persisted timestamp: the
+            # monotonic value used for stabilization bookkeeping is
+            # meaningless to API consumers and across restarts
+            hpa.status.last_scale_time = time.time()
         hpa.status.current_replicas = current
         hpa.status.desired_replicas = desired
         hpa.status.current_cpu_utilization_percentage = (

@@ -26,7 +26,8 @@ the cache.go:185-260 UpdateSnapshot property.
 from __future__ import annotations
 
 import contextlib
-from typing import List, Optional, Sequence, Tuple, Union
+import time
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -226,6 +227,11 @@ class TPUBatchScheduler:
         self._fill_cache: dict = {}
         self._unpack_cache: dict = {}
         self.last_result: Optional[Result] = None
+        # encode/solve wall split of the most recent schedule_pending —
+        # the host scheduler's pipeline-overlap meter reads it: the
+        # encode half holds the cache lock (a concurrent wave commit
+        # can't overlap it), only the device half truly pipelines
+        self.last_timings: Dict[str, float] = {}
 
     # -- incremental cluster state ---------------------------------------
 
@@ -435,11 +441,17 @@ class TPUBatchScheduler:
         NOT auto-assumed — the host scheduler assumes/binds explicitly."""
         if not pending:
             return []
+        t0 = time.perf_counter()
         snap, meta = self.encode_pending(
             pending, num_pods_hint=num_pods_hint, lock=lock,
             reservations=reservations,
         )
+        t1 = time.perf_counter()
         names = self.solve_encoded(snap, meta)
+        self.last_timings = {
+            "encode_s": t1 - t0,
+            "solve_s": time.perf_counter() - t1,
+        }
         return self._gang_admission_retry(
             pending, names,
             lambda subset: self.schedule_pending_no_retry(

@@ -83,25 +83,36 @@ class ThroughputCollector:
     def _run(self) -> None:
         last = self._scheduled_count()
         last_t = time.monotonic()
-        started = False
+        self._baseline = last
         skipped = 0
         while not self._stop.wait(self.interval):
             now = time.monotonic()
             cur = self._scheduled_count()
-            if cur == 0:
-                continue
-            if not started:
-                started = True
-                last, last_t = cur, now
-                continue
             delta = cur - last
             if delta == 0:
-                skipped += 1
+                if cur == last and last == self._baseline:
+                    # still idle before the run's first placement: slide
+                    # the window start so the FIRST non-zero delta is
+                    # measured over one interval, not the whole idle
+                    # lead-in.  The old first-observation reset discarded
+                    # that delta entirely — a burst that completed inside
+                    # one interval produced NO samples and the summary
+                    # reported Average=0.0 (PreemptionBasic/500Nodes).
+                    last_t = now
+                else:
+                    skipped += 1  # mid-run stall: coalesce into the next
                 continue
-            throughput = delta / (now - last_t)
+            throughput = delta / max(now - last_t, 1e-9)
             for _ in range(skipped + 1):
                 self.samples.append(throughput)
             last, last_t, skipped = cur, now, 0
+        # final sub-window sample: a burst that finished after the last
+        # tick (or entirely between start and stop) would otherwise be
+        # dropped on the floor
+        now = time.monotonic()
+        cur = self._scheduled_count()
+        if cur - last > 0:
+            self.samples.append((cur - last) / max(now - last_t, 1e-9))
 
     def start(self) -> "ThroughputCollector":
         self._thread = threading.Thread(target=self._run, daemon=True)

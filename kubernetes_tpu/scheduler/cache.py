@@ -135,12 +135,29 @@ class SchedulerCache:
                 a.binding_finished = True
                 a.deadline = self._clock() + self.ttl
 
-    def forget(self, pod: api.Pod) -> None:
+    def finish_binding_all(self, pods: List[api.Pod]) -> None:
+        """finish_binding for a whole bind wave under one lock
+        acquisition + one clock read (the binding stage commits waves of
+        hundreds of pods; per-pod lock churn is measurable there)."""
+        with self._lock:
+            deadline = self._clock() + self.ttl
+            for pod in pods:
+                a = self._assumed.get(pod_key(pod))
+                if a is not None and not a.binding_finished:
+                    a.binding_finished = True
+                    a.deadline = deadline
+
+    def forget(self, pod: api.Pod) -> bool:
+        """Undo an assume (ForgetPod).  Returns True when an assumed
+        entry was actually released — callers use this to fire the
+        capacity-freed queue wake only when capacity really came back."""
         key = pod_key(pod)
         with self._lock:
             a = self._assumed.pop(key, None)
             if a is not None:
                 self.state.remove_pod(a.pod)
+                return True
+            return False
 
     def is_assumed(self, pod: api.Pod) -> bool:
         with self._lock:

@@ -58,6 +58,13 @@ class SchedulerConfiguration:
         default_factory=lambda: [ProfileConfig()]
     )
     batch_size: int = 4096
+    # bounded batch-accumulation window: how long pop_batch keeps
+    # collecting arrivals once it has at least one pod but fewer than
+    # batch_size, so churn-paced creates form real batches instead of
+    # near-empty solves.  Every pod in the batch pays the window as
+    # queueing latency, so it is capped at the attempt-latency budget
+    # (validation rejects > 1s; default 50ms).
+    batch_window_seconds: float = 0.05
     pod_initial_backoff_seconds: float = 1.0
     pod_max_backoff_seconds: float = 10.0
     assume_ttl_seconds: float = 30.0
@@ -117,6 +124,11 @@ class SchedulerConfiguration:
                 )
         if self.batch_size <= 0:
             raise ValueError("batch_size must be positive")
+        if not (0 <= self.batch_window_seconds <= 1.0):
+            raise ValueError(
+                "batch_window_seconds must be within [0, 1] — the window "
+                "is pure queueing latency for every pod in the batch"
+            )
         if self.pod_initial_backoff_seconds <= 0:
             raise ValueError("pod_initial_backoff_seconds must be positive")
         if self.pod_max_backoff_seconds < self.pod_initial_backoff_seconds:
@@ -144,7 +156,7 @@ _API_VERSIONS = (
 _TOP_KEYS = {
     "apiVersion", "kind", "parallelism", "percentageOfNodesToScore",
     "podInitialBackoffSeconds", "podMaxBackoffSeconds", "profiles",
-    "featureGates", "batchSize", "assumeTTLSeconds",
+    "featureGates", "batchSize", "batchWindowSeconds", "assumeTTLSeconds",
     "unschedulableFlushSeconds", "maxPreemptionsPerCycle",
 }
 
@@ -188,6 +200,8 @@ def load_config(source: Any) -> SchedulerConfiguration:
         cfg.pod_max_backoff_seconds = float(doc["podMaxBackoffSeconds"])
     if "batchSize" in doc:
         cfg.batch_size = int(doc["batchSize"])
+    if "batchWindowSeconds" in doc:
+        cfg.batch_window_seconds = float(doc["batchWindowSeconds"])
     if "assumeTTLSeconds" in doc:
         cfg.assume_ttl_seconds = float(doc["assumeTTLSeconds"])
     if "unschedulableFlushSeconds" in doc:

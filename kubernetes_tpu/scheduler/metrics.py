@@ -154,6 +154,28 @@ class Registry:
         self.batch_solve_duration = Histogram(
             "scheduler_batch_solve_duration_seconds"
         )
+        # OUR pipeline metrics (no reference analogue — the reference's
+        # binding cycle is per-pod goroutines, ours is batched waves):
+        # one full cycle of the solve stage, pop -> solve -> assume ->
+        # wave dispatch (commit happens off-thread and is NOT included)
+        self.schedule_batch_duration = Histogram(
+            "scheduler_schedule_batch_duration_seconds"
+        )
+        # one observation per bind wave the binding stage commits
+        self.commit_wave_duration = Histogram(
+            "scheduler_commit_wave_duration_seconds"
+        )
+        # pods per committed wave (coalescing effectiveness under churn)
+        self.commit_wave_size = Histogram(
+            "scheduler_commit_wave_size_pods",
+            buckets=tuple(float(2 ** i) for i in range(13)),
+        )
+        # seconds of each wave's commit that ran WHILE a device solve was
+        # in flight — the pipeline's realized solve/commit overlap; a
+        # healthy pipeline keeps this close to commit_wave_duration
+        self.pipeline_overlap = Histogram(
+            "scheduler_pipeline_overlap_seconds"
+        )
         # pod_scheduling_sli_duration_seconds (end-to-end incl. requeues)
         self.pod_scheduling_sli_duration = Histogram(
             "scheduler_pod_scheduling_sli_duration_seconds"

@@ -88,11 +88,20 @@ class SchedulingQueue:
         backoff_max: float = 10.0,
         unschedulable_flush_after: float = 300.0,
         clock=time.monotonic,
+        batch_window: float = 0.0,
     ):
         self._clock = clock
         self._base = backoff_base
         self._max_backoff = backoff_max
         self._flush_after = unschedulable_flush_after
+        # bounded accumulation window (seconds): once pop_batch has at
+        # least one pod but fewer than max_n, it keeps collecting new
+        # arrivals for up to this long before returning, so churn-paced
+        # arrivals form real batches instead of near-empty solves.  0
+        # preserves the pop-immediately behaviour.  Bounded by the
+        # attempt-latency budget: every pod in the batch pays the window
+        # as queueing latency.
+        self._batch_window = batch_window
         self._cond = threading.Condition()
         self._seq = itertools.count()
         self._active: List[tuple] = []           # (-prio, ts, seq, key)
@@ -331,7 +340,10 @@ class SchedulingQueue:
     # -- consumer side -----------------------------------------------------
 
     def pop_batch(
-        self, max_n: int, timeout: Optional[float] = None
+        self,
+        max_n: int,
+        timeout: Optional[float] = None,
+        window: Optional[float] = None,
     ) -> List[QueuedPodInfo]:
         """Drain up to max_n pods in queuesort order; blocks until at
         least one is available (or timeout).  Popped pods are 'inflight'
@@ -344,28 +356,37 @@ class SchedulingQueue:
         sees whole gangs and its all-or-nothing post-pass can hold.  A
         gang with a member the pop cannot pull (staged below its declared
         size, or inflight in another batch) is skipped whole and returned
-        to active."""
+        to active.
+
+        `window` (default: the queue's batch_window) is the bounded
+        accumulation window: with at least one pod in hand but fewer than
+        max_n, the pop keeps collecting arrivals for up to `window`
+        seconds before returning.  Never exceeds `timeout` — a timeout=0
+        (non-blocking) pop stays non-blocking."""
         deadline = None if timeout is None else self._clock() + timeout
+        if window is None:
+            window = self._batch_window
+        if timeout is not None:
+            window = min(window, timeout)
         pullable = ("active", "backoff", "unsched")
         with self._cond:
-            while True:
-                self._flush_due_locked()
-                batch: List[QueuedPodInfo] = []
+            batch: List[QueuedPodInfo] = []
+
+            def take(key: str) -> Optional[QueuedPodInfo]:
+                info = self._infos.get(key)
+                if info is None or self._tier.get(key) not in pullable:
+                    return None  # stale entry
+                self._unschedulable.pop(key, None)
+                # backoff/active heap entries are lazily skipped via
+                # the tier check on their eventual pop
+                self._tier[key] = "inflight"
+                info.attempts += 1
+                info.popped_event_seq = self._event_seq
+                batch.append(info)
+                return info
+
+            def collect() -> None:
                 skipped: Dict[str, QueuedPodInfo] = {}
-
-                def take(key: str) -> Optional[QueuedPodInfo]:
-                    info = self._infos.get(key)
-                    if info is None or self._tier.get(key) not in pullable:
-                        return None  # stale entry
-                    self._unschedulable.pop(key, None)
-                    # backoff/active heap entries are lazily skipped via
-                    # the tier check on their eventual pop
-                    self._tier[key] = "inflight"
-                    info.attempts += 1
-                    info.popped_event_seq = self._event_seq
-                    batch.append(info)
-                    return info
-
                 while self._active and len(batch) < max_n:
                     _, _, _, key = heapq.heappop(self._active)
                     info = self._infos.get(key)
@@ -392,8 +413,12 @@ class SchedulingQueue:
                         take(k)
                 for info in skipped.values():
                     self._push_active(info)
+
+            while True:
+                self._flush_due_locked()
+                collect()
                 if batch:
-                    return batch
+                    break
                 if self._closed:
                     return []
                 wait = None
@@ -405,6 +430,22 @@ class SchedulingQueue:
                         return []
                     wait = min(wait, remaining) if wait else remaining
                 self._cond.wait(wait)
+            # bounded accumulation window: wait for more arrivals so
+            # churn-paced creates form a real batch (the event-driven
+            # batching the reference gets from its queue running ahead
+            # of per-pod cycles, scheduling_queue.go:117)
+            if window and window > 0 and len(batch) < max_n:
+                wend = self._clock() + window
+                if deadline is not None:
+                    wend = min(wend, deadline)
+                while len(batch) < max_n and not self._closed:
+                    remaining = wend - self._clock()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                    self._flush_due_locked()
+                    collect()
+            return batch
 
     def done(self, pod: api.Pod) -> None:
         """Pod scheduled (assumed+bound): drop from the pending set."""

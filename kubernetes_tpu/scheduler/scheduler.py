@@ -1,17 +1,28 @@
 """The host scheduler: informer-fed cache + queue draining into batched
-device solves, with assume/bind/fail-requeue.
+device solves, with a two-stage solve/bind pipeline.
 
 Reference mapping (pkg/scheduler/scheduler.go, schedule_one.go):
 
   Scheduler.run            scheduler.go:438 Run (queue flush + hot loop)
   schedule_batch           the batched schedule_one.go:66 ScheduleOne:
-                           NextPod -> schedulePod -> assume -> bind; one
-                           device dispatch schedules the whole batch
-  _bind                    bindingCycle's DefaultBinder POST
-                           (schedule_one.go:962, defaultbinder)
+                           NextPod -> schedulePod -> assume; one device
+                           dispatch schedules the whole batch.  The bind
+                           tail is handed to the binding stage as a WAVE
+                           and commits off-thread.
+  binding stage            schedule_one.go:118's `go bindingCycle` —
+                           binds never run on the scheduling thread.
+                           Ours is a dedicated worker committing whole
+                           waves through one store transaction
+                           (store.update_wave) instead of per-pod
+                           goroutines doing per-pod POSTs; assume-cache
+                           entries bridge the gap exactly as the
+                           reference's assume/bind split does, so batch
+                           N+1's snapshot is correct while batch N's
+                           binds are still in flight.
   failure handling         handleSchedulingFailure :1017 ->
-                           AddUnschedulableIfNotPresent; bind errors
-                           forget the assume and requeue with backoff
+                           AddUnschedulableIfNotPresent; a bind error
+                           splits that pod out of the wave, forgets the
+                           assume and requeues with backoff
   event wiring             eventhandlers.go:287 addAllEventHandlers:
                            informers feed cache (assigned pods, nodes)
                            and queue (pending pods, requeue-on-event)
@@ -26,6 +37,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -109,6 +121,7 @@ class Scheduler:
             backoff_max=self.config.pod_max_backoff_seconds,
             unschedulable_flush_after=self.config.unschedulable_flush_seconds,
             clock=clock,
+            batch_window=self.config.batch_window_seconds,
         )
         self.metrics = Registry()
         # pods parked at Permit (waiting_pods_map.go); coscheduling-style
@@ -168,6 +181,26 @@ class Scheduler:
         self._clock = clock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # -- binding stage (the async binding cycle) ----------------------
+        # schedule_batch stages placements (assume + Permit) and hands the
+        # bind tail to this worker as a wave; the next cycle's pop/solve
+        # overlaps the commit.  Backlog is bounded so a commit stage that
+        # falls behind backpressures the solve stage instead of growing
+        # an unbounded requeue-latency tail.
+        self._waves: deque = deque()
+        self._wave_cv = threading.Condition()
+        self._wave_active = False
+        self._binder_stop = False
+        self._max_wave_backlog = 2
+        # device-solve intervals, for the pipeline-overlap metric (the
+        # binder reads them to attribute its commit time)
+        self._solve_lock = threading.Lock()
+        self._solve_windows: deque = deque(maxlen=64)  # (start, end)
+        self._solve_open: Optional[float] = None
+        self._bind_thread = threading.Thread(
+            target=self._bind_worker, name="bind-wave", daemon=True
+        )
+        self._bind_thread.start()
         self._wire_handlers()
 
     # -- event wiring (eventhandlers.go:287) ------------------------------
@@ -293,8 +326,146 @@ class Scheduler:
             # the interpreter down under an XLA compile aborts the process,
             # so wait the compile out
             self._thread.join(timeout=120)
+        # drain the binding stage: staged placements are assumed in the
+        # cache, so dropping their waves would leak phantom usage until
+        # the assume TTL fires
+        self.flush_binds(timeout=30)
+        with self._wave_cv:
+            self._binder_stop = True
+            self._wave_cv.notify_all()
+        self._bind_thread.join(timeout=10)
         self.informers.stop()
         self.events.stop()
+
+    # -- binding stage (the dedicated bind worker) -------------------------
+
+    def _bind_worker(self) -> None:
+        while True:
+            with self._wave_cv:
+                while not self._waves and not self._binder_stop:
+                    self._wave_cv.wait(0.2)
+                if not self._waves:
+                    return  # stopping and drained
+                wave = self._waves.popleft()
+                self._wave_active = True
+                self._wave_cv.notify_all()
+            try:
+                self._commit_wave(wave)
+            except Exception:  # noqa: BLE001 — wave containment
+                # a whole-wave fault must not kill the binding stage for
+                # the process's lifetime; the pods' assumes expire via
+                # TTL and _run requeues them
+                logging.getLogger(__name__).exception(
+                    "bind wave failed; pods ride the assume-TTL requeue"
+                )
+            finally:
+                with self._wave_cv:
+                    self._wave_active = False
+                    self._wave_cv.notify_all()
+
+    def _dispatch_wave_async(self, wave: List[tuple]) -> None:
+        """Hand a bind wave to the binding stage; blocks only when the
+        bounded backlog is full (commit slower than solve — the
+        backpressure that keeps requeue latency bounded)."""
+        with self._wave_cv:
+            while len(self._waves) >= self._max_wave_backlog:
+                self._wave_cv.wait(0.2)
+            self._waves.append(wave)
+            self._wave_cv.notify_all()
+
+    def flush_binds(self, timeout: float = 30.0) -> bool:
+        """Block until every dispatched bind wave has committed (tests
+        and shutdown; the hot path never waits).  True on drained."""
+        deadline = time.monotonic() + timeout
+        with self._wave_cv:
+            while self._waves or self._wave_active:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._wave_cv.wait(remaining)
+        return True
+
+    def _solve_window(self, start: float, end: float) -> None:
+        with self._solve_lock:
+            self._solve_windows.append((start, end))
+            self._solve_open = None
+
+    def _solve_overlap(self, t0: float, t1: float) -> float:
+        """Seconds of [t0, t1] that intersected device-solve windows —
+        the realized pipeline overlap for one wave commit."""
+        with self._solve_lock:
+            spans = list(self._solve_windows)
+            if self._solve_open is not None:
+                spans.append((self._solve_open, t1))
+        total = 0.0
+        for s, e in spans:
+            total += max(0.0, min(e, t1) - max(s, t0))
+        return min(total, max(t1 - t0, 0.0))
+
+    def _commit_wave(self, wave: List[tuple]) -> None:
+        """Commit one bind wave: PreBind per pod, then ONE store
+        transaction for every surviving bind, then the per-pod success
+        tail.  Failures split per pod back to individual requeue — a bad
+        pod never takes its wave down."""
+        t0 = self._clock()
+        binds: List[tuple] = []
+        for fwk, info, node_name, t_attempt in wave:
+            try:
+                fwk.run_pre_bind(info.pod, node_name)
+            except Exception:  # noqa: BLE001 — per-pod containment
+                self._fail_bind(fwk, info)
+                continue
+            binds.append((fwk, info, node_name, t_attempt))
+        if binds:
+            def bind_mutator(node_name: str):
+                def mutate(pod: api.Pod) -> None:
+                    pod.spec.node_name = node_name
+                    pod.status.phase = "Running"
+                return mutate
+
+            updates = [
+                (info.pod.meta.name, info.pod.meta.namespace,
+                 bind_mutator(node_name))
+                for _, info, node_name, _ in binds
+            ]
+            try:
+                _, errors = self.store.update_wave("Pod", updates)
+            except Exception:  # noqa: BLE001
+                logging.getLogger(__name__).exception(
+                    "wave transaction failed; splitting to per-pod requeue"
+                )
+                errors = None  # whole-wave failure: requeue everyone
+            done: List[api.Pod] = []
+            for fwk, info, node_name, t_attempt in binds:
+                if errors is None or pod_key(info.pod) in errors:
+                    self._fail_bind(fwk, info)
+                    continue
+                done.append(info.pod)
+                self._finish_bound(
+                    fwk, info, node_name, t_attempt, finish_binding=False
+                )
+            # TTL countdown for the whole wave under one lock/clock read
+            self.cache.finish_binding_all(done)
+        dt = self._clock() - t0
+        self.metrics.commit_wave_duration.observe(dt)
+        self.metrics.commit_wave_size.observe(float(len(wave)))
+        self.metrics.pipeline_overlap.observe(
+            self._solve_overlap(t0, self._clock())
+        )
+
+    def _fail_bind(self, fwk: Framework, info: QueuedPodInfo) -> None:
+        """The binding stage's per-pod failure tail: forget the assume,
+        roll back reservations, requeue with backoff."""
+        released = self.cache.forget(info.pod)
+        fwk.run_unreserve(info.pod)
+        if released:
+            # the assume had accounted real capacity; its release is an
+            # AssignedPodDelete-shaped event — without it, pods parked on
+            # REASON_RESOURCES would sleep until the flush interval even
+            # though the space just came back
+            self.queue.move_for_event("AssignedPodDelete")
+        self.metrics.schedule_attempts.inc("error")
+        self.queue.requeue_backoff(info)
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -318,8 +489,14 @@ class Scheduler:
     # -- the batched scheduling cycle -------------------------------------
 
     def schedule_batch(self, timeout: Optional[float] = None) -> Dict[str, int]:
-        """One batched cycle: drain -> device solve -> assume+bind each
-        placement -> park failures.  Returns counters for tests/metrics."""
+        """One solve-stage cycle: drain -> device solve -> assume each
+        placement -> hand the bind wave to the binding stage -> park
+        failures.  Returns counters for tests/metrics.
+
+        `scheduled` counts pods staged into the bind wave (assumed, past
+        Permit): the wave commits asynchronously, and a bind error later
+        splits that pod back to requeue (metrics record it as an error).
+        Callers that need the binds durable call flush_binds()."""
         batch = self.queue.pop_batch(self.batch_size, timeout=timeout)
         stats = {"popped": len(batch), "scheduled": 0, "unschedulable": 0,
                  "bind_errors": 0}
@@ -337,7 +514,9 @@ class Scheduler:
         # LogIfLong, schedule_one.go:391-431); threshold is generous
         # because first-shape compiles legitimately run tens of seconds
         with Trace("schedule_batch", threshold=1.0, pods=len(batch)) as trace:
-            return self._schedule_groups(batch, reservations, stats, trace)
+            stats = self._schedule_groups(batch, reservations, stats, trace)
+        self.metrics.schedule_batch_duration.observe(trace.total)
+        return stats
 
     def _schedule_groups(self, batch, reservations, stats, trace):
         # Group the popped batch by profile.  Each group runs its FULL
@@ -349,12 +528,15 @@ class Scheduler:
         for info in batch:
             by_fwk.setdefault(info.pod.spec.scheduler_name, []).append(info)
         failed: List[QueuedPodInfo] = []
+        wave: List[tuple] = []
         solved_any = False
         for sched_name, group in by_fwk.items():
             fwk = self.profiles.frameworks.get(sched_name)
             if fwk is None:
                 continue  # another scheduler's pod slipped in; drop
             t_solve = self._clock()
+            with self._solve_lock:
+                self._solve_open = t_solve
             try:
                 names = fwk.tpu.schedule_pending(
                     [info.pod for info in group], lock=self.cache.lock,
@@ -363,6 +545,8 @@ class Scheduler:
             except (OverflowError, ValueError):
                 group = self._reject_unencodable(group, fwk)
                 if not group:
+                    with self._solve_lock:
+                        self._solve_open = None
                     continue
                 try:
                     names = fwk.tpu.schedule_pending(
@@ -373,6 +557,8 @@ class Scheduler:
                     # cumulative/batch-level encode failure even though
                     # each pod encodes alone: park the whole group rather
                     # than killing the scheduler thread
+                    with self._solve_lock:
+                        self._solve_open = None
                     for info in group:
                         self.metrics.schedule_attempts.inc("error")
                         self.queue.add_unschedulable(
@@ -386,6 +572,17 @@ class Scheduler:
             # per-pod share so harness percentiles stay comparable with
             # the reference's per-ScheduleOne numbers
             dt_solve = self._clock() - t_solve
+            # overlap window = the DEVICE half only: the encode holds the
+            # cache lock, which a concurrent wave commit also needs, so
+            # only the device dispatch truly pipelines against commits
+            encode_s = float(
+                (getattr(fwk.tpu, "last_timings", None) or {}).get(
+                    "encode_s", 0.0
+                )
+            )
+            self._solve_window(
+                t_solve + min(encode_s, dt_solve), t_solve + dt_solve
+            )
             self.metrics.batch_solve_duration.observe(dt_solve)
             self.metrics.scheduling_algorithm_duration.observe(
                 dt_solve / max(len(group), 1), count=len(group)
@@ -396,8 +593,13 @@ class Scheduler:
             else:
                 reasons = [-1] * len(group)
             trace.step(f"solve[{sched_name}]")
-            self._commit_group(fwk, group, names, reasons, stats, failed)
+            self._stage_group(fwk, group, names, reasons, stats, failed, wave)
             trace.step(f"commit[{sched_name}]")
+        if wave:
+            # binding stage takes over: the NEXT cycle's pop+solve runs
+            # while this wave commits (assume entries already bridge it)
+            self._dispatch_wave_async(wave)
+            trace.step("dispatch")
         if not solved_any:
             return stats
 
@@ -418,7 +620,7 @@ class Scheduler:
         trace.log_if_long()
         return stats
 
-    def _commit_group(
+    def _stage_group(
         self,
         fwk: Framework,
         group: List[QueuedPodInfo],
@@ -426,9 +628,14 @@ class Scheduler:
         reasons: List[int],
         stats: Dict[str, int],
         failed: List[QueuedPodInfo],
+        wave: List[tuple],
     ) -> None:
-        """Assume + bind one profile's placements (the per-pod tail of
-        ScheduleOne, schedule_one.go:118-133 batched)."""
+        """Assume one profile's placements and stage them into the bind
+        wave (the per-pod tail of ScheduleOne, schedule_one.go:118-133
+        batched; the bind itself runs on the binding stage).  Permit
+        ordering is preserved: reject aborts here, wait parks the pod on
+        its own WaitOnPermit thread exactly as before — only the
+        allow-path bind moves into the wave."""
         for i, (info, node_name) in enumerate(zip(group, names)):
             t_attempt = self._clock()
             if node_name is not None:
@@ -484,37 +691,38 @@ class Scheduler:
                 t.start()
                 stats["waiting"] = stats.get("waiting", 0) + 1
                 continue
-            if not self._bind_tail(fwk, info, node_name, t_attempt, stats):
-                continue
+            # staged: assumed + Permit-allowed; the binding stage owns
+            # the rest (PreBind -> wave commit -> PostBind)
+            wave.append((fwk, info, node_name, t_attempt))
+            stats["scheduled"] += 1
 
-    def _bind_tail(
-        self, fwk, info, node_name, t_attempt, stats=None
-    ) -> bool:
-        """PreBind -> bind -> PostBind with failure containment; the
-        synchronous tail of the binding cycle.  stats is the calling
-        cycle's counter dict — None from binding threads, whose pods
-        completed after their cycle returned (the global metrics
-        Registry still records them)."""
+    def _bind_tail(self, fwk, info, node_name, t_attempt) -> bool:
+        """PreBind -> bind -> PostBind with failure containment: the
+        per-pod tail used by WaitOnPermit binding threads, whose pods
+        complete outside any wave (the global metrics Registry still
+        records them)."""
         try:
             fwk.run_pre_bind(info.pod, node_name)
             self._bind(info.pod, node_name)
         except Exception:
-            self.cache.forget(info.pod)
-            fwk.run_unreserve(info.pod)
-            if stats is not None:
-                stats["bind_errors"] += 1
-            self.metrics.schedule_attempts.inc("error")
-            self.queue.requeue_backoff(info)
+            self._fail_bind(fwk, info)
             return False
+        self._finish_bound(fwk, info, node_name, t_attempt)
+        return True
+
+    def _finish_bound(
+        self, fwk, info, node_name, t_attempt, finish_binding: bool = True
+    ) -> None:
+        """The success tail of a committed bind: PostBind, Scheduled
+        event, TTL countdown, queue drop, metrics."""
         fwk.run_post_bind(info.pod, node_name)
         self.events.eventf(
             info.pod, "Normal", "Scheduled",
             f"Successfully assigned {pod_key(info.pod)} to {node_name}",
         )
-        self.cache.finish_binding(info.pod)
+        if finish_binding:
+            self.cache.finish_binding(info.pod)
         self.queue.done(info.pod)
-        if stats is not None:
-            stats["scheduled"] += 1
         self.metrics.schedule_attempts.inc("scheduled")
         self.metrics.scheduling_attempt_duration.observe(
             self._clock() - t_attempt
@@ -522,7 +730,6 @@ class Scheduler:
         self.metrics.pod_scheduling_sli_duration.observe(
             self._clock() - info.initial_attempt_timestamp
         )
-        return True
 
     def _binding_cycle_async(
         self, fwk, info, node_name, wp, t_attempt

@@ -11,10 +11,27 @@ profiler attached.
 from __future__ import annotations
 
 import logging
+import threading
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 logger = logging.getLogger("kubernetes_tpu.trace")
+
+# Over-threshold traces, recorded alongside the log line so harnesses
+# (bench.py BENCH_STRICT) can FAIL on slow cycles instead of merely
+# warning into a log nobody greps.  Bounded; drain_overruns() empties it.
+_OVERRUNS: List[Dict] = []
+_OVERRUNS_LOCK = threading.Lock()
+_OVERRUNS_CAP = 256
+
+
+def drain_overruns() -> List[Dict]:
+    """Return and clear the recorded over-threshold traces.  Each entry:
+    {name, total_s, threshold_s, fields, steps: [(what, seconds)]}."""
+    with _OVERRUNS_LOCK:
+        out = list(_OVERRUNS)
+        _OVERRUNS.clear()
+    return out
 
 
 class Trace:
@@ -26,6 +43,7 @@ class Trace:
         self.fields = fields
         self._t0 = clock()
         self._last = self._t0
+        self._logged = False
         self.steps: List[Tuple[str, float]] = []
 
     def step(self, what: str) -> None:
@@ -46,11 +64,28 @@ class Trace:
     def log_if_long(self, threshold: Optional[float] = None) -> None:
         limit = self.threshold if threshold is None else threshold
         total = self.total
-        if total < limit:
+        # once per trace: callers invoke this on explicit exit paths AND
+        # the with-block exit fires it again — one slow cycle must not
+        # double-log or double-count in the overrun registry
+        if total < limit or self._logged:
             return
+        self._logged = True
         tags = ",".join(f"{k}={v}" for k, v in self.fields.items())
         parts = "; ".join(f"{w}: {dt * 1e3:.1f}ms" for w, dt in self.steps)
         logger.warning(
             "trace %s (%s) took %.1fms (threshold %.0fms): %s",
             self.name, tags, total * 1e3, limit * 1e3, parts,
         )
+        with _OVERRUNS_LOCK:
+            if len(_OVERRUNS) < _OVERRUNS_CAP:
+                _OVERRUNS.append(
+                    {
+                        "name": self.name,
+                        "total_s": round(total, 4),
+                        "threshold_s": limit,
+                        "fields": dict(self.fields),
+                        "steps": [
+                            (w, round(dt, 4)) for w, dt in self.steps
+                        ],
+                    }
+                )

@@ -44,6 +44,9 @@ def test_schedules_and_binds_through_api():
     try:
         stats = sched.schedule_batch(timeout=2)
         assert stats["scheduled"] == 8, stats
+        # the binding stage commits waves asynchronously: drain it before
+        # reading the store
+        assert sched.flush_binds(timeout=30)
         # bound through the API: store shows nodeName on every pod
         pods, _ = store.list("Pod")
         assert all(p.spec.node_name for p in pods)
@@ -113,6 +116,7 @@ def test_deleted_assigned_pod_frees_resources_for_pending():
     sched = _mk_scheduler(store)
     try:
         assert sched.schedule_batch(timeout=2)["scheduled"] == 1
+        assert sched.flush_binds(timeout=30)  # "first" durably bound
         store.create(make_pod("second").req(cpu_milli=1000).obj())
         assert sched.schedule_batch(timeout=2)["unschedulable"] == 1
         store.delete("Pod", "first")
@@ -134,6 +138,7 @@ def test_priority_order_in_contended_batch():
     sched = _mk_scheduler(store)
     try:
         sched.schedule_batch(timeout=2)
+        assert sched.flush_binds(timeout=30)
         assert store.get("Pod", "high").spec.node_name == "n0"
         assert not store.get("Pod", "low").spec.node_name
     finally:

@@ -161,16 +161,21 @@ def test_unreserve_rolls_back_on_bind_failure(sched_store):
     store.create(make_pv("pv-a", 10 * GI, "manual", zone="z1"))
     store.create(make_pvc("claim", GI, "manual"))
 
+    # binds commit through the wave transaction now: inject the failure
+    # at that layer (one split error for pod "p", first wave only)
     calls = {"n": 0}
-    orig_bind = sched._bind
+    orig_wave = store.update_wave
 
-    def failing_bind(pod, node_name):
-        if pod.meta.name == "p" and calls["n"] == 0:
+    def failing_wave(kind, updates, **kw):
+        if calls["n"] == 0 and any(u[0] == "p" for u in updates):
             calls["n"] += 1
-            raise RuntimeError("injected bind conflict")
-        return orig_bind(pod, node_name)
+            good = [u for u in updates if u[0] != "p"]
+            applied, errors = orig_wave(kind, good, **kw)
+            errors["default/p"] = RuntimeError("injected bind conflict")
+            return applied, errors
+        return orig_wave(kind, updates, **kw)
 
-    sched._bind = failing_bind
+    store.update_wave = failing_wave
     store.create(make_pod("p").req(cpu_milli=100, mem=MI).pvc("claim").obj())
     pod = _wait_bound(store, "p")
     # first attempt failed after Reserve; Unreserve must have rolled the
