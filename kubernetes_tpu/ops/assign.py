@@ -195,6 +195,10 @@ class SolveResult(NamedTuple):
     feasible_counts: jnp.ndarray  # i32[P]: feasible nodes seen by each pod
     cluster: ClusterTensors   # post-solve cluster (assumed placements applied)
     reasons: jnp.ndarray = None   # i32[P]: REASON_* for unplaced pods
+    # wavefront-path telemetry (None on the classic scan): executed wave
+    # count and fallback count (serialized waves + per-pod full re-evals)
+    wave_count: jnp.ndarray = None      # i32[]
+    wave_fallbacks: jnp.ndarray = None  # i32[]
 
 
 def class_statics(
@@ -255,39 +259,72 @@ def _pick(
     return jnp.argmax(jnp.where(tie, g, NEG_INF))
 
 
-def greedy_assign(
-    snapshot: Snapshot,
-    cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,
-    tie_seed: Optional[int] = None,
-    topo_z: Optional[int] = None,
-    features: Optional[FeatureFlags] = None,
-    n_groups: int = 0,
-) -> SolveResult:
-    """Sequential-greedy solve of the whole pending batch on device.
+def _eval_pod(
+    cl: ClusterTensors,
+    pods: PodBatch,
+    i: jnp.ndarray,
+    cls: jnp.ndarray,
+    sfeas_c: jnp.ndarray,
+    aff_c: jnp.ndarray,
+    taint_c: jnp.ndarray,
+    extra_c: Optional[jnp.ndarray],
+    new_ports,
+    sp,
+    tm,
+    spread,
+    terms,
+    features: FeatureFlags,
+    cfg: ScoreConfig,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The Filter+Score half of one scheduling step for pod i against the
+    given carry state: (feas[N], masked_scores[N], found, reason,
+    feasible_count).  Shared verbatim by the classic scan step, the
+    wavefront pre-evaluation, and the wavefront's exact re-evaluation
+    fallback, so the three paths cannot drift apart."""
+    pod = pod_view(pods, i)
+    s_static = sfeas_c[cls]
+    feas = s_static & fits_resources(cl, pod)
+    a_res = feas.any()
+    if features.ports:
+        feas = feas & ~((new_ports & pod.port_bits[None, :]).any(axis=-1))
+    a_ports = feas.any()
+    if features.spread:
+        feas = feas & spread_filter(sp, spread, i)
+    a_spread = feas.any()
+    if features.interpod:
+        feas = feas & interpod_filter(tm, terms, i)
+    found = feas.any()
+    # first stage whose filter emptied the candidate set
+    reason = jnp.where(
+        found, REASON_NONE,
+        jnp.where(
+            ~s_static.any(), REASON_STATIC,
+            jnp.where(
+                ~a_res, REASON_RESOURCES,
+                jnp.where(
+                    ~a_ports, REASON_PORTS,
+                    jnp.where(~a_spread, REASON_SPREAD, REASON_INTERPOD),
+                ),
+            ),
+        ),
+    ).astype(jnp.int32)
+    sp_score = (
+        spread_score(sp, spread, i, feas) if features.soft_spread else None
+    )
+    scores = score_from_raw(
+        cl, pod, feas, aff_c[cls], taint_c[cls], cfg, spread_score=sp_score,
+        extra=extra_c[cls] if extra_c is not None else None,
+    )
+    masked = jnp.where(feas, scores, NEG_INF)
+    return feas, masked, found, reason, feas.sum().astype(jnp.int32)
 
-    Semantically equivalent to running the reference's scheduling cycle
-    once per pod in priority order with cache assume between cycles — the
-    scan carry holds everything a placement changes: resource usage,
-    in-batch port claims, topology-spread counts, and inter-pod affinity
-    term state.
 
-    topo_z: padded topology-value vocab size (SnapshotMeta.topo_z or
-    required_topo_z); auto-derived when None.  Both topo_z and features
-    can only be auto-derived outside jit — jitted callers must pass them
-    (greedy_assign_jit's wrapper does).
-
-    n_groups (static): gang-group count.  When > 0, groups with any
-    unplaced member release every placement after the scan (all-or-nothing,
-    the coscheduling-PodGroup contract) — this is what lets gangs carrying
-    spread/interpod/port constraints keep gang semantics instead of
-    routing-away to a solver that drops them.  Later in-scan pods saw the
-    released placements' resource/count impact (conservative: they may
-    park and retry next batch); the released members return as
-    unschedulable (-1)."""
-    if features is None:
-        features = features_of(snapshot)
-    if topo_z is None:
-        topo_z = required_topo_z(snapshot)
+def _solver_prep(
+    snapshot: Snapshot, cfg: ScoreConfig, topo_z: int, features: FeatureFlags
+):
+    """Per-batch device prep shared by the scan and wavefront solvers:
+    materialized tensors, class-hoisted static tables, and the spread /
+    inter-pod prep states (the PreFilter/PreScore analogue)."""
     (cluster, pods, sel, pref, spread, terms, prefpod, images) = jax.tree.map(
         jnp.asarray, tuple(snapshot)
     )
@@ -338,6 +375,69 @@ def greedy_assign(
         if features.interpod
         else None
     )
+    return (cluster, pods, spread, terms, sfeas_c, aff_c, taint_c, extra_c,
+            sp0, tm0, c_dim, n, p)
+
+
+def _gang_release(
+    assignment, win_scores, reasons, requested, nonzero, pods, n_groups, n
+):
+    """All-or-nothing gang post-pass shared by the scan and wavefront
+    solvers: release every placement of a group with an unplaced member.
+    Only requested/nonzero need subtracting: ports and spread/interpod
+    counts are rebuilt from *actually bound* pods at the next batch's
+    prep, and the host never assumes released members."""
+    g = pods.group_id
+    gc = jnp.clip(g, 0, n_groups - 1)
+    incomplete = jnp.zeros(n_groups, bool).at[gc].max(
+        (assignment < 0) & pods.valid & (g >= 0)
+    )
+    dropped = (g >= 0) & incomplete[gc] & (assignment >= 0)
+    nodes = jnp.clip(assignment, 0, n - 1)
+    w = dropped[:, None].astype(jnp.float32)
+    requested = requested.at[nodes].add(-pods.req * w)
+    nonzero = nonzero.at[nodes].add(-pods.nonzero_req * w)
+    assignment = jnp.where(dropped, -1, assignment)
+    win_scores = jnp.where(dropped, NEG_INF, win_scores)
+    reasons = jnp.where(dropped, REASON_GANG, reasons)
+    return assignment, win_scores, reasons, requested, nonzero
+
+
+def greedy_assign(
+    snapshot: Snapshot,
+    cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,
+    tie_seed: Optional[int] = None,
+    topo_z: Optional[int] = None,
+    features: Optional[FeatureFlags] = None,
+    n_groups: int = 0,
+) -> SolveResult:
+    """Sequential-greedy solve of the whole pending batch on device.
+
+    Semantically equivalent to running the reference's scheduling cycle
+    once per pod in priority order with cache assume between cycles — the
+    scan carry holds everything a placement changes: resource usage,
+    in-batch port claims, topology-spread counts, and inter-pod affinity
+    term state.
+
+    topo_z: padded topology-value vocab size (SnapshotMeta.topo_z or
+    required_topo_z); auto-derived when None.  Both topo_z and features
+    can only be auto-derived outside jit — jitted callers must pass them
+    (greedy_assign_jit's wrapper does).
+
+    n_groups (static): gang-group count.  When > 0, groups with any
+    unplaced member release every placement after the scan (all-or-nothing,
+    the coscheduling-PodGroup contract) — this is what lets gangs carrying
+    spread/interpod/port constraints keep gang semantics instead of
+    routing-away to a solver that drops them.  Later in-scan pods saw the
+    released placements' resource/count impact (conservative: they may
+    park and retry next batch); the released members return as
+    unschedulable (-1)."""
+    if features is None:
+        features = features_of(snapshot)
+    if topo_z is None:
+        topo_z = required_topo_z(snapshot)
+    (cluster, pods, spread, terms, sfeas_c, aff_c, taint_c, extra_c,
+     sp0, tm0, c_dim, n, p) = _solver_prep(snapshot, cfg, topo_z, features)
     order = solve_order(pods)
     keys = (
         jax.random.split(jax.random.PRNGKey(tie_seed), p)
@@ -351,45 +451,17 @@ def greedy_assign(
         cl = cluster._replace(requested=requested, nonzero_requested=nonzero)
         pod = pod_view(pods, i)
         cls = jnp.clip(pods.class_id[i], 0, c_dim - 1)
-        s_static = sfeas_c[cls]
-        feas = s_static & fits_resources(cl, pod)
-        a_res = feas.any()
-        if features.ports:
-            feas = feas & ~((new_ports & pod.port_bits[None, :]).any(axis=-1))
-        a_ports = feas.any()
         sp = tm = None
         if features.spread:
             sp = sp0._replace(counts_node=sp_counts)
-            feas = feas & spread_filter(sp, spread, i)
-        a_spread = feas.any()
         if features.interpod:
             tm = tm0._replace(
                 present_bits=tm_present, blocked_bits=tm_blocked, global_any=tm_global
             )
-            feas = feas & interpod_filter(tm, terms, i)
-        found = feas.any()
-        # first stage whose filter emptied the candidate set
-        reason = jnp.where(
-            found, REASON_NONE,
-            jnp.where(
-                ~s_static.any(), REASON_STATIC,
-                jnp.where(
-                    ~a_res, REASON_RESOURCES,
-                    jnp.where(
-                        ~a_ports, REASON_PORTS,
-                        jnp.where(~a_spread, REASON_SPREAD, REASON_INTERPOD),
-                    ),
-                ),
-            ),
-        ).astype(jnp.int32)
-        sp_score = (
-            spread_score(sp, spread, i, feas) if features.soft_spread else None
+        feas, masked, found, reason, feas_cnt = _eval_pod(
+            cl, pods, i, cls, sfeas_c, aff_c, taint_c, extra_c,
+            new_ports, sp, tm, spread, terms, features, cfg,
         )
-        scores = score_from_raw(
-            cl, pod, feas, aff_c[cls], taint_c[cls], cfg, spread_score=sp_score,
-            extra=extra_c[cls] if extra_c is not None else None,
-        )
-        masked = jnp.where(feas, scores, NEG_INF)
         choice = _pick(masked, feas, keys[k] if keys is not None else None)
         idx = jnp.where(found, choice, -1).astype(jnp.int32)
 
@@ -414,7 +486,7 @@ def greedy_assign(
                 tm.present_bits, tm.blocked_bits, tm.global_any
             )
         out = (i, idx, jnp.where(found, masked[choice], NEG_INF),
-               feas.sum().astype(jnp.int32), reason)
+               feas_cnt, reason)
         carry = (requested, nonzero, new_ports, sp_counts, tm_present, tm_blocked, tm_global)
         return carry, out
 
@@ -437,25 +509,13 @@ def greedy_assign(
     feas_counts = jnp.zeros(p, jnp.int32).at[pod_is].set(feas_o)
     reasons = jnp.full(p, REASON_NONE, jnp.int32).at[pod_is].set(reason_o)
 
-    # Gang post-pass: release every placement of a group with an unplaced
-    # member (all-or-nothing), mirroring ops.auction's post-pass.  Only
-    # requested/nonzero need subtracting: ports and spread/interpod counts
-    # are rebuilt from *actually bound* pods at the next batch's prep, and
-    # the host never assumes released members.
+    # Gang post-pass: all-or-nothing release, mirroring ops.auction's
+    # post-pass (shared with the wavefront solver via _gang_release).
     if n_groups > 0:
-        g = pods.group_id
-        gc = jnp.clip(g, 0, n_groups - 1)
-        incomplete = jnp.zeros(n_groups, bool).at[gc].max(
-            (assignment < 0) & pods.valid & (g >= 0)
+        assignment, win_scores, reasons, requested, nonzero = _gang_release(
+            assignment, win_scores, reasons, requested, nonzero,
+            pods, n_groups, n,
         )
-        dropped = (g >= 0) & incomplete[gc] & (assignment >= 0)
-        nodes = jnp.clip(assignment, 0, n - 1)
-        w = dropped[:, None].astype(jnp.float32)
-        requested = requested.at[nodes].add(-pods.req * w)
-        nonzero = nonzero.at[nodes].add(-pods.nonzero_req * w)
-        assignment = jnp.where(dropped, -1, assignment)
-        win_scores = jnp.where(dropped, NEG_INF, win_scores)
-        reasons = jnp.where(dropped, REASON_GANG, reasons)
 
     final = cluster._replace(
         requested=requested,
@@ -504,6 +564,608 @@ def greedy_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
             n_groups = pad_dim(n_groups, 1)
         return run(snapshot, topo_z, features, n_groups)
 
+    call.jitted = run  # raw jit, for AOT prewarm (lower().compile())
+    return call
+
+
+# -- wavefront greedy -------------------------------------------------------
+#
+# The scan above pays one sequential device step per pod.  The wavefront
+# solver partitions the priority-ordered batch into WAVES and pays one
+# heavy step per wave: the [K, N] Filter+Score evaluation of all wave
+# members runs batched against the wave-start carry, and the sequential
+# decisions inside the wave run in an O(K) mini-scan that only *corrects*
+# the precomputed scores at nodes picked earlier in the wave (the
+# allocation scores are the only usage-dependent score family, and they
+# are per-node closed forms).  Exact one-pod-at-a-time semantics are
+# preserved:
+#
+#   * Wave membership guarantees no dynamic coupling: pairwise-disjoint
+#     host-port bits and no spread/inter-pod row written by an earlier
+#     member that a later member reads.  The device re-verifies this
+#     (ports/spread/term pairwise masks) and serializes the whole wave
+#     through the original step body when the partitioner got it wrong —
+#     ANY contiguous partition of the solve order is therefore correct.
+#   * Within a safe wave, a member's sequential score vector differs from
+#     its wave-start vector only at nodes picked earlier in the wave, so
+#     the mini-scan compares the corrected picked-node scores against the
+#     best unpicked candidate from a precomputed top-(K+1) list —
+#     first-max-index tie-breaks included (lax.top_k is index-stable).
+#   * Resource tightening that FLIPS a member's fit at a picked node
+#     would change its feasible set (and the score normalization over
+#     it), so that member falls back to an exact full re-evaluation
+#     against the live carry inside its mini-step (lax.cond — the rare
+#     branch costs nothing when untaken).
+#
+# Gang all-or-nothing rides the same shared post-pass.  Keyed (tie_seed)
+# solves stay on the classic scan — reservoir sampling needs the full
+# gumbel tie set per step.
+
+DEFAULT_WAVE_CAP = 32
+
+
+class WavePlan(NamedTuple):
+    """Host-side wave partition of one batch (plan_waves)."""
+
+    members: np.ndarray  # i32[W_pad, K] pod indices in solve order, -1 pad
+    n_waves: int         # real (non-empty) wave count
+
+
+def _pack_idx_rows(idx: np.ndarray, dim: int) -> np.ndarray:
+    """i32[P, M] index lists (-1 pad) -> packed u32[P, words] membership."""
+    p = idx.shape[0]
+    words = max(1, (dim + 31) // 32)
+    out = np.zeros((p, words), dtype=np.uint32)
+    rows, vals = np.nonzero(idx >= 0)
+    ids = idx[rows, vals]
+    np.bitwise_or.at(out, (rows, ids >> 5), np.uint32(1) << (ids & 31))
+    return out
+
+
+def plan_waves(
+    snapshot: Snapshot,
+    features: Optional[FeatureFlags] = None,
+    wave_cap: int = DEFAULT_WAVE_CAP,
+    headroom_frac: float = 1.0,
+) -> WavePlan:
+    """Partition the solve order into conflict-free waves (host numpy).
+
+    A pod joins the open wave unless one of these would break:
+      * size: the wave already holds `wave_cap` members;
+      * ports: its host-port bits intersect a member's (the in-wave port
+        carry must stay untouched for wave members);
+      * spread/terms: a wave member WRITES a constraint row this pod
+        READS (spread: pod_matches vs pod_idx; terms: matches_incoming ∪
+        anti vs matches_incoming ∪ anti ∪ aff) — count/bit drift inside
+        the wave would break the wave-start evaluation;
+      * headroom: aggregate wave demand would exceed `headroom_frac` of
+        the emptiest node's free capacity (elementwise) — a heuristic
+        that keeps per-member fit-flip fallbacks rare, not a correctness
+        condition (the device detects flips exactly).
+
+    The partition is a pure performance hint: wavefront_assign re-checks
+    coupling on device and serializes unsafe waves, so any output of this
+    function yields placements identical to the scan."""
+    from ..utils.vocab import pad_dim
+
+    if features is None:
+        features = features_of(snapshot)
+    pods = snapshot.pods
+    priority = np.asarray(pods.priority)
+    p = priority.shape[0]
+    order = np.argsort(-priority, kind="stable").astype(np.int32)
+
+    use_ports = bool(features.ports)
+    use_spread = bool(features.spread or features.soft_spread)
+    use_terms = bool(features.interpod)
+    port_bits = np.asarray(pods.port_bits) if use_ports else None
+    if use_spread:
+        sp_idx = np.asarray(snapshot.spread.pod_idx)
+        reads_sp = _pack_idx_rows(sp_idx, np.asarray(snapshot.spread.valid).shape[0])
+        pm = np.asarray(snapshot.spread.pod_matches)
+        writes_sp = np.packbits(
+            pm, axis=1, bitorder="little"
+        )
+        # pad packbits' u8 words up to the u32 row width of reads_sp
+        w32 = reads_sp.shape[1] * 4
+        if writes_sp.shape[1] < w32:
+            writes_sp = np.pad(writes_sp, ((0, 0), (0, w32 - writes_sp.shape[1])))
+        writes_sp = writes_sp[:, :w32].copy().view(np.uint32)
+    if use_terms:
+        t_dim = np.asarray(snapshot.terms.valid).shape[0]
+        mi = np.asarray(snapshot.terms.matches_incoming)
+        anti = _pack_idx_rows(np.asarray(snapshot.terms.anti_idx), t_dim)
+        aff = _pack_idx_rows(np.asarray(snapshot.terms.aff_idx), t_dim)
+        w = min(mi.shape[1], anti.shape[1])
+        writes_tm = mi[:, :w] | anti[:, :w]
+        reads_tm = writes_tm | aff[:, :w]
+
+    req = np.asarray(pods.req)
+    alloc = np.asarray(snapshot.cluster.allocatable)
+    used = np.asarray(snapshot.cluster.requested)
+    valid = np.asarray(snapshot.cluster.node_valid)
+    free = np.where(valid[:, None], alloc - used, 0.0)
+    slack = free.max(axis=0) * float(headroom_frac)
+
+    waves: List[List[int]] = []
+    cur: List[int] = []
+    port_acc = None if not use_ports else np.zeros_like(port_bits[0])
+    sp_acc = None if not use_spread else np.zeros_like(writes_sp[0])
+    tm_acc = None if not use_terms else np.zeros_like(writes_tm[0])
+    demand = np.zeros(req.shape[1], dtype=np.float64)
+
+    def close():
+        nonlocal cur, port_acc, sp_acc, tm_acc, demand
+        if cur:
+            waves.append(cur)
+        cur = []
+        if use_ports:
+            port_acc = np.zeros_like(port_bits[0])
+        if use_spread:
+            sp_acc = np.zeros_like(writes_sp[0])
+        if use_terms:
+            tm_acc = np.zeros_like(writes_tm[0])
+        demand = np.zeros(req.shape[1], dtype=np.float64)
+
+    for i in order.tolist():
+        conflict = len(cur) >= wave_cap
+        if not conflict and cur:
+            if use_ports and (port_acc & port_bits[i]).any():
+                conflict = True
+            elif use_spread and (sp_acc & reads_sp[i]).any():
+                conflict = True
+            elif use_terms and (tm_acc & reads_tm[i]).any():
+                conflict = True
+            elif ((demand + req[i]) > slack).any():
+                conflict = True
+        if conflict:
+            close()
+        cur.append(i)
+        if use_ports:
+            port_acc |= port_bits[i]
+        if use_spread:
+            sp_acc |= writes_sp[i]
+        if use_terms:
+            tm_acc |= writes_tm[i]
+        demand += req[i]
+    close()
+
+    n_waves = len(waves)
+    w_pad = pad_dim(max(n_waves, 1), 8)
+    members = np.full((w_pad, wave_cap), -1, dtype=np.int32)
+    for wi, wv in enumerate(waves):
+        members[wi, : len(wv)] = wv
+    return WavePlan(members=members, n_waves=n_waves)
+
+
+def _rows_cluster(cap, requested, nonzero):
+    """A K-row stand-in ClusterTensors for the per-node allocation score
+    recomputes (resource_score_parts only touches these three fields)."""
+    return ClusterTensors(
+        allocatable=cap, requested=requested, nonzero_requested=nonzero,
+        node_valid=None, name_id=None, label_bits=None, taint_bits=None,
+        port_bits=None, topo_ids=None, image_bits=None,
+    )
+
+
+def wavefront_assign(
+    snapshot: Snapshot,
+    wave_members: jnp.ndarray,
+    cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,
+    topo_z: Optional[int] = None,
+    features: Optional[FeatureFlags] = None,
+    n_groups: int = 0,
+) -> SolveResult:
+    """Wave-parallel greedy solve with exact scan parity (see module
+    section comment).  wave_members: i32[W, K] pod indices covering every
+    batch position in solve order (-1 pads), from plan_waves."""
+    from .scores import resource_score_parts
+
+    if features is None:
+        features = features_of(snapshot)
+    if topo_z is None:
+        topo_z = required_topo_z(snapshot)
+    (cluster, pods, spread, terms, sfeas_c, aff_c, taint_c, extra_c,
+     sp0, tm0, c_dim, n, p) = _solver_prep(snapshot, cfg, topo_z, features)
+    wave_members = jnp.asarray(wave_members, jnp.int32)
+    k_dim = wave_members.shape[1]
+    kk = min(k_dim + 1, n)
+    arange_k = jnp.arange(k_dim, dtype=jnp.int32)
+
+    # per-pod coupling rows for the device-side wave-safety check
+    if features.interpod:
+        t_dim = terms.valid.shape[0]
+        from .interpod import _idx_to_bits, _pack_bits_t
+
+        anti_w = _pack_bits_t(_idx_to_bits(terms.anti_idx, t_dim))
+        aff_w = _pack_bits_t(_idx_to_bits(terms.aff_idx, t_dim))
+        tw = min(terms.matches_incoming.shape[1], anti_w.shape[1])
+        tm_writes = terms.matches_incoming[:, :tw] | anti_w[:, :tw]
+        tm_reads = tm_writes | aff_w[:, :tw]
+    if features.spread or features.soft_spread:
+        c_rows = spread.valid.shape[0]
+        sp_reads_all = (
+            jnp.arange(c_rows)[None, None, :] == spread.pod_idx[:, :, None]
+        ).any(axis=1)  # bool[P, C]
+
+    def wave_safe(mk, mvalid):
+        """True when no member writes dynamic state an in-wave successor
+        reads — the conflict-detection pass.  mk: clipped member ids."""
+        tri = (arange_k[:, None] < arange_k[None, :]) & (
+            mvalid[:, None] & mvalid[None, :]
+        )
+        ok = jnp.bool_(True)
+        if features.ports:
+            pb = pods.port_bits[mk]  # [K, PW]
+            hit = (pb[:, None, :] & pb[None, :, :]).any(-1)
+            ok = ok & ~(tri & hit).any()
+        if features.spread or features.soft_spread:
+            wr = spread.pod_matches[mk]  # [K, C]
+            rd = sp_reads_all[mk]
+            hit = (wr[:, None, :] & rd[None, :, :]).any(-1)
+            ok = ok & ~(tri & hit).any()
+        if features.interpod:
+            wr = tm_writes[mk]
+            rd = tm_reads[mk]
+            hit = (wr[:, None, :] & rd[None, :, :]).any(-1)
+            ok = ok & ~(tri & hit).any()
+        return ok
+
+    def wave_step(carry, members):
+        (requested, nonzero, new_ports, sp_counts,
+         tm_present, tm_blocked, tm_global, n_fb, n_waves) = carry
+        mvalid = members >= 0
+        mk = jnp.clip(members, 0, p - 1)
+        req0, nz0 = requested, nonzero
+        cl0 = cluster._replace(requested=requested, nonzero_requested=nonzero)
+        sp = tm = None
+        if features.spread:
+            sp = sp0._replace(counts_node=sp_counts)
+        if features.interpod:
+            tm = tm0._replace(
+                present_bits=tm_present, blocked_bits=tm_blocked,
+                global_any=tm_global,
+            )
+
+        def run_wave(_):
+            # heavy half, batched: every member evaluated from the
+            # wave-start carry in one vectorized pass
+            def eval_one(i):
+                cls = jnp.clip(pods.class_id[i], 0, c_dim - 1)
+                _, masked, found, reason, cnt = _eval_pod(
+                    cl0, pods, i, cls, sfeas_c, aff_c, taint_c, extra_c,
+                    new_ports, sp, tm, spread, terms, features, cfg,
+                )
+                return masked, found, reason, cnt
+
+            masked_k, found_k, reason_k, cnt_k = jax.vmap(eval_one)(mk)
+            topv, topi = jax.lax.top_k(masked_k, kk)
+
+            def fast(_):
+                def mini(mc, j):
+                    req_c, nz_c, picked, fb = mc
+                    i = mk[j]
+                    valid_j = mvalid[j]
+                    pod = pod_view(pods, i)
+                    cls = jnp.clip(pods.class_id[i], 0, c_dim - 1)
+                    prev = (arange_k < j) & (picked >= 0)
+                    pxc = jnp.clip(picked, 0, n - 1)
+                    cap_rows = cluster.allocatable[pxc]
+                    skip = (pod.req[None, :] <= 0)
+                    fits0 = (
+                        skip | (req0[pxc] + pod.req[None, :] <= cap_rows)
+                    ).all(-1)
+                    fitsc = (
+                        skip | (req_c[pxc] + pod.req[None, :] <= cap_rows)
+                    ).all(-1)
+                    flip = (
+                        prev & sfeas_c[cls][pxc] & (fits0 != fitsc)
+                    ).any() & valid_j
+
+                    def full(_):
+                        # exact re-evaluation against the live carry:
+                        # ports/spread/terms are wave-start but untouched
+                        # within a safe wave, so this IS the sequential
+                        # state
+                        clj = cluster._replace(
+                            requested=req_c, nonzero_requested=nz_c
+                        )
+                        _, masked, found, reason, cnt = _eval_pod(
+                            clj, pods, i, cls, sfeas_c, aff_c, taint_c,
+                            extra_c, new_ports, sp, tm, spread, terms,
+                            features, cfg,
+                        )
+                        found = found & valid_j
+                        choice = jnp.argmax(masked).astype(jnp.int32)
+                        return (
+                            choice,
+                            jnp.where(found, masked[choice], NEG_INF),
+                            cnt, reason, found, jnp.int32(1),
+                        )
+
+                    def cheap(_):
+                        # sequential scores differ from the wave-start
+                        # vector only at picked nodes, and only in the
+                        # (un-normalized) allocation parts — correct
+                        # those entries in closed form
+                        fit0, bal0 = resource_score_parts(
+                            _rows_cluster(cap_rows, req0[pxc], nz0[pxc]),
+                            pod, cfg,
+                        )
+                        fitc, balc = resource_score_parts(
+                            _rows_cluster(cap_rows, req_c[pxc], nz_c[pxc]),
+                            pod, cfg,
+                        )
+                        d_alloc = (
+                            cfg.fit_weight * (fitc - fit0)
+                            + cfg.balanced_weight * (balc - bal0)
+                        )
+                        base = masked_k[j][pxc]
+                        cand_ok = prev & (base > NEG_INF)
+                        cand_val = base + d_alloc
+                        tv, ti = topv[j], topi[j]
+                        ispicked = (
+                            (ti[:, None] == pxc[None, :]) & prev[None, :]
+                        ).any(-1)
+                        un_ok = ~ispicked & (tv > NEG_INF)
+                        first = jnp.argmax(un_ok)
+                        has_un = un_ok.any()
+                        bu_val = jnp.where(has_un, tv[first], NEG_INF)
+                        bu_idx = jnp.where(has_un, ti[first], n).astype(
+                            jnp.int32
+                        )
+                        vals = jnp.concatenate(
+                            [jnp.where(cand_ok, cand_val, NEG_INF),
+                             bu_val[None]]
+                        )
+                        idxs = jnp.concatenate([pxc, bu_idx[None]])
+                        best = jnp.max(vals)
+                        found = found_k[j] & valid_j & (best > NEG_INF)
+                        # first-max-index over the candidate union ==
+                        # first-max-index over the corrected [N] vector
+                        choice = jnp.min(
+                            jnp.where((vals >= best) & (vals > NEG_INF),
+                                      idxs, n)
+                        ).astype(jnp.int32)
+                        return (
+                            choice, jnp.where(found, best, NEG_INF),
+                            cnt_k[j], reason_k[j], found, jnp.int32(0),
+                        )
+
+                    choice, win, cnt, reason, found, used_full = (
+                        jax.lax.cond(flip, full, cheap, None)
+                    )
+                    cc = jnp.clip(choice, 0, n - 1)
+                    wgt = found.astype(req_c.dtype)
+                    req_c = req_c.at[cc].add(pod.req * wgt)
+                    nz_c = nz_c.at[cc].add(pod.nonzero_req * wgt)
+                    picked = picked.at[j].set(jnp.where(found, cc, -1))
+                    out = (jnp.where(found, cc, -1).astype(jnp.int32),
+                           win, cnt, reason)
+                    return (req_c, nz_c, picked, fb + used_full), out
+
+                (req2, nz2, picked, fb), (a_k, w_k, c_k, r_k) = jax.lax.scan(
+                    mini,
+                    (requested, nonzero,
+                     jnp.full(k_dim, -1, jnp.int32), jnp.int32(0)),
+                    arange_k,
+                )
+                # deferred dynamic-state updates: no member read these, so
+                # they commit batched at wave end (adds/ORs commute)
+                ports2 = new_ports
+                if features.ports:
+                    okp = picked >= 0
+                    tgt = jnp.where(okp, picked, n)  # OOB rows drop
+                    bits = pods.port_bits[mk] * okp[:, None].astype(
+                        jnp.uint32
+                    )
+                    ports2 = new_ports.at[tgt].add(bits)
+                spc2 = sp_counts
+                if features.spread:
+                    # unrolled so XLA fuses the K count-updates into one
+                    # pass over [C, N] instead of K carried array writes
+                    st = sp0._replace(counts_node=sp_counts)
+                    for j in range(k_dim):
+                        ch = jnp.clip(a_k[j], 0, n - 1)
+                        st = spread_update(
+                            st, spread, mk[j], st.v[:, ch],
+                            st.eligible[:, ch], a_k[j] >= 0,
+                        )
+                    spc2 = st.counts_node
+                pr2, bl2, ga2 = tm_present, tm_blocked, tm_global
+                if features.interpod:
+                    st = tm0._replace(
+                        present_bits=tm_present, blocked_bits=tm_blocked,
+                        global_any=tm_global,
+                    )
+                    for j in range(k_dim):
+                        ch = jnp.clip(a_k[j], 0, n - 1)
+                        st = interpod_update(
+                            st, terms, mk[j], cluster.topo_ids[ch],
+                            a_k[j] >= 0, slots=features.term_slots,
+                        )
+                    pr2, bl2, ga2 = (
+                        st.present_bits, st.blocked_bits, st.global_any
+                    )
+                return ((req2, nz2, ports2, spc2, pr2, bl2, ga2, fb),
+                        (a_k, w_k, c_k, r_k))
+
+            def serial(_):
+                # unsafe wave (in-wave coupling): run the original scan
+                # step over the members — exact by construction
+                def sstep(c, j):
+                    (req_c, nz_c, ports_c, spc, pr, bl, ga) = c
+                    i = mk[j]
+                    valid_j = mvalid[j]
+                    clj = cluster._replace(
+                        requested=req_c, nonzero_requested=nz_c
+                    )
+                    spj = tmj = None
+                    if features.spread:
+                        spj = sp0._replace(counts_node=spc)
+                    if features.interpod:
+                        tmj = tm0._replace(
+                            present_bits=pr, blocked_bits=bl, global_any=ga
+                        )
+                    cls = jnp.clip(pods.class_id[i], 0, c_dim - 1)
+                    pod = pod_view(pods, i)
+                    _, masked, found, reason, cnt = _eval_pod(
+                        clj, pods, i, cls, sfeas_c, aff_c, taint_c,
+                        extra_c, ports_c, spj, tmj, spread, terms,
+                        features, cfg,
+                    )
+                    found = found & valid_j
+                    choice = jnp.argmax(masked).astype(jnp.int32)
+                    cc = jnp.clip(choice, 0, n - 1)
+                    wgt = found.astype(req_c.dtype)
+                    req_c = req_c.at[cc].add(pod.req * wgt)
+                    nz_c = nz_c.at[cc].add(pod.nonzero_req * wgt)
+                    if features.ports:
+                        row = jnp.where(
+                            found, ports_c[cc] | pod.port_bits, ports_c[cc]
+                        )
+                        ports_c = ports_c.at[cc].set(row)
+                    if features.spread:
+                        spj = spread_update(
+                            spj, spread, i, spj.v[:, cc],
+                            spj.eligible[:, cc], found,
+                        )
+                        spc = spj.counts_node
+                    if features.interpod:
+                        tmj = interpod_update(
+                            tmj, terms, i, cluster.topo_ids[cc], found,
+                            slots=features.term_slots,
+                        )
+                        pr, bl, ga = (
+                            tmj.present_bits, tmj.blocked_bits,
+                            tmj.global_any,
+                        )
+                    out = (jnp.where(found, cc, -1).astype(jnp.int32),
+                           jnp.where(found, masked[choice], NEG_INF),
+                           cnt, reason)
+                    return (req_c, nz_c, ports_c, spc, pr, bl, ga), out
+
+                (req2, nz2, ports2, spc2, pr2, bl2, ga2), outs = (
+                    jax.lax.scan(
+                        sstep,
+                        (requested, nonzero, new_ports, sp_counts,
+                         tm_present, tm_blocked, tm_global),
+                        arange_k,
+                    )
+                )
+                return ((req2, nz2, ports2, spc2, pr2, bl2, ga2,
+                         mvalid.sum().astype(jnp.int32)), outs)
+
+            safe = wave_safe(mk, mvalid)
+            (req2, nz2, ports2, spc2, pr2, bl2, ga2, fb), outs = (
+                jax.lax.cond(safe, fast, serial, None)
+            )
+            return ((req2, nz2, ports2, spc2, pr2, bl2, ga2,
+                     n_fb + fb, n_waves + 1), outs)
+
+        def skip_wave(_):
+            outs = (
+                jnp.full(k_dim, -1, jnp.int32),
+                jnp.full(k_dim, NEG_INF),
+                jnp.zeros(k_dim, jnp.int32),
+                jnp.full(k_dim, REASON_NONE, jnp.int32),
+            )
+            return ((requested, nonzero, new_ports, sp_counts, tm_present,
+                     tm_blocked, tm_global, n_fb, n_waves), outs)
+
+        new_carry, outs = jax.lax.cond(
+            mvalid.any(), run_wave, skip_wave, None
+        )
+        return new_carry, outs
+
+    zero = jnp.zeros(())
+    init = (
+        cluster.requested,
+        cluster.nonzero_requested,
+        jnp.zeros_like(cluster.port_bits) if features.ports else zero,
+        sp0.counts_node if features.spread else zero,
+        tm0.present_bits if features.interpod else zero,
+        tm0.blocked_bits if features.interpod else zero,
+        tm0.global_any if features.interpod else zero,
+        jnp.int32(0),
+        jnp.int32(0),
+    )
+    (requested, nonzero, new_ports, *_rest, n_fb, n_waves), (
+        assign_w, win_w, cnt_w, reason_w
+    ) = jax.lax.scan(wave_step, init, wave_members)
+
+    flat_members = wave_members.reshape(-1)
+    pod_is = jnp.where(flat_members >= 0, flat_members, p)  # OOB drop
+    assignment = jnp.full(p, -1, jnp.int32).at[pod_is].set(
+        assign_w.reshape(-1)
+    )
+    win_scores = jnp.full(p, NEG_INF).at[pod_is].set(win_w.reshape(-1))
+    feas_counts = jnp.zeros(p, jnp.int32).at[pod_is].set(cnt_w.reshape(-1))
+    reasons = jnp.full(p, REASON_NONE, jnp.int32).at[pod_is].set(
+        reason_w.reshape(-1)
+    )
+
+    if n_groups > 0:
+        assignment, win_scores, reasons, requested, nonzero = _gang_release(
+            assignment, win_scores, reasons, requested, nonzero,
+            pods, n_groups, n,
+        )
+
+    final = cluster._replace(
+        requested=requested,
+        nonzero_requested=nonzero,
+        port_bits=(cluster.port_bits | new_ports) if features.ports
+        else cluster.port_bits,
+    )
+    return SolveResult(
+        assignment, win_scores, feas_counts, final, reasons,
+        wave_count=n_waves, wave_fallbacks=n_fb,
+    )
+
+
+def wavefront_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
+    """Jitted wavefront solver: one executable per (shape-bucket, topo_z,
+    features, n_groups, wave shape).  The wave plan is a device argument
+    (i32[W, K]) so repartitions of the same shapes reuse the executable."""
+
+    @partial(jax.jit, static_argnums=(2, 3, 4))
+    def run(
+        snapshot: Snapshot, wave_members, topo_z: int,
+        features: FeatureFlags, n_groups: int,
+    ) -> SolveResult:
+        return wavefront_assign(
+            snapshot, wave_members, cfg, topo_z=topo_z, features=features,
+            n_groups=n_groups,
+        )
+
+    def call(
+        snapshot: Snapshot,
+        wave_members=None,
+        topo_z: Optional[int] = None,
+        features: Optional[FeatureFlags] = None,
+        n_groups: Optional[int] = None,
+        wave_cap: int = DEFAULT_WAVE_CAP,
+    ) -> SolveResult:
+        if features is None:
+            features = features_of(snapshot)
+        if topo_z is None:
+            topo_z = required_topo_z(snapshot) if needs_topo(features) else 1
+        if n_groups is None:
+            n_groups = num_groups(snapshot)
+        if n_groups > 0:
+            from ..utils.vocab import pad_dim
+
+            n_groups = pad_dim(n_groups, 1)
+        if wave_members is None:
+            wave_members = plan_waves(
+                snapshot, features=features, wave_cap=wave_cap
+            ).members
+        return run(
+            snapshot, jnp.asarray(wave_members, jnp.int32), topo_z,
+            features, n_groups,
+        )
+
+    call.jitted = run  # raw jit, for AOT prewarm (lower().compile())
     return call
 
 

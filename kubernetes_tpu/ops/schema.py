@@ -312,6 +312,11 @@ class SnapshotMeta:
     topo_split: Optional[tuple] = None     # (z_spread, z_terms)
     n_groups: Optional[int] = None
     tie_k: Optional[int] = None
+    # solve-route statics derived at encode time while the arrays are
+    # host-resident: the chosen solver route and, for wavefront-routed
+    # batches, the host-planned wave partition (assign.WavePlan)
+    route: Optional[str] = None
+    wave_plan: Optional[object] = None
 
     def node_name(self, idx: int) -> Optional[str]:
         if 0 <= idx < self.num_nodes:

@@ -24,6 +24,23 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+
+def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """jax.shard_map graduated from jax.experimental after 0.4.x and
+    renamed check_rep to check_vma; accept both APIs so the sharded
+    solvers run on either jax generation."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
 from ..ops.assign import (
     NEG_INF,
     FeatureFlags,
@@ -172,7 +189,7 @@ def sharded_greedy_assign(
     )
 
     @partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=in_specs,
         out_specs=out_specs,
@@ -384,7 +401,7 @@ def sharded_auction_assign(
     )
 
     @partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=in_specs,
         out_specs=out_specs,

@@ -156,6 +156,10 @@ class MetricsCollector:
         "scheduler_scheduling_algorithm_duration_seconds",
         "scheduler_batch_solve_duration_seconds",
         "scheduler_pod_scheduling_sli_duration_seconds",
+        # solve-side pipeline: exposed compile time and the readback
+        # hidden behind host work (scheduler/metrics.py)
+        "scheduler_solve_compile_duration_seconds",
+        "scheduler_decode_overlap_seconds",
     )
 
     def __init__(

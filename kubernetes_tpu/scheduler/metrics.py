@@ -176,6 +176,32 @@ class Registry:
         self.pipeline_overlap = Histogram(
             "scheduler_pipeline_overlap_seconds"
         )
+        # OUR solve-side pipeline metrics (no reference analogue):
+        # waves per wavefront-routed greedy solve (ops.assign wavefront:
+        # the scan's P sequential steps collapse to ~P/W)
+        self.solve_wave_count = Histogram(
+            "scheduler_solve_wave_count",
+            buckets=tuple(float(2 ** i) for i in range(13)),
+        )
+        # fallbacks per wavefront solve: serialized (coupled) waves plus
+        # per-pod exact re-evaluations (fit flips) — a high count means
+        # the partitioner is mis-planning for this workload
+        self.solve_wave_fallbacks = Histogram(
+            "scheduler_solve_wave_fallbacks",
+            buckets=tuple(float(2 ** i) for i in range(13)),
+        )
+        # wall seconds of solver executable compiles: synchronous
+        # first-shape compiles observed on the dispatch path plus
+        # background prewarm-pool compiles (SolverPrewarmPool)
+        self.solve_compile_duration = Histogram(
+            "scheduler_solve_compile_duration_seconds"
+        )
+        # seconds of device solve + readback hidden behind host work
+        # (the pop window) per group — the realized solve-side overlap;
+        # a healthy pipeline keeps this close to the device solve time
+        self.decode_overlap = Histogram(
+            "scheduler_decode_overlap_seconds"
+        )
         # pod_scheduling_sli_duration_seconds (end-to-end incl. requeues)
         self.pod_scheduling_sli_duration = Histogram(
             "scheduler_pod_scheduling_sli_duration_seconds"

@@ -44,6 +44,7 @@ class Trace:
         self._t0 = clock()
         self._last = self._t0
         self._logged = False
+        self._log_lock = threading.Lock()
         self.steps: List[Tuple[str, float]] = []
 
     def step(self, what: str) -> None:
@@ -64,12 +65,19 @@ class Trace:
     def log_if_long(self, threshold: Optional[float] = None) -> None:
         limit = self.threshold if threshold is None else threshold
         total = self.total
-        # once per trace: callers invoke this on explicit exit paths AND
-        # the with-block exit fires it again — one slow cycle must not
-        # double-log or double-count in the overrun registry
-        if total < limit or self._logged:
+        # Exactly once per trace, even when a caller's explicit exit-path
+        # call races or stacks with the with-block exit (the r05 bench
+        # tail showed every over-threshold schedule_batch trace twice —
+        # the explicit call at the end of the group loop plus __exit__,
+        # each formatting its own slightly-later total).  The flag is
+        # checked-and-set under a lock so a trace finalized from another
+        # thread (deferred-cycle finalize) can't double-emit either.
+        if total < limit:
             return
-        self._logged = True
+        with self._log_lock:
+            if self._logged:
+                return
+            self._logged = True
         tags = ",".join(f"{k}={v}" for k, v in self.fields.items())
         parts = "; ".join(f"{w}: {dt * 1e3:.1f}ms" for w, dt in self.steps)
         logger.warning(

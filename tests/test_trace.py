@@ -2,7 +2,7 @@
 
 import logging
 
-from kubernetes_tpu.utils.trace import Trace
+from kubernetes_tpu.utils.trace import Trace, drain_overruns
 
 
 def test_trace_logs_only_when_slow(caplog):
@@ -25,3 +25,66 @@ def test_trace_logs_only_when_slow(caplog):
         msg = caplog.records[0].getMessage()
         assert "slow" in msg and "pods=7" in msg
         assert "solve: 400.0ms" in msg and "bind: 800.0ms" in msg
+
+
+def test_over_threshold_trace_emits_exactly_once(caplog):
+    """Regression: the r05 bench tail showed every over-threshold
+    schedule_batch trace TWICE (e.g. `took 1162.2ms` then `1162.4ms`) —
+    an explicit exit-path log_if_long call followed by the with-block
+    exit, each computing its own total.  However many times the caller
+    finalizes, one trace must produce one log line and one overrun
+    entry."""
+    t = [0.0]
+    drain_overruns()
+    with caplog.at_level(logging.WARNING, logger="kubernetes_tpu.trace"):
+        with Trace("schedule_batch", threshold=1.0, clock=lambda: t[0],
+                   pods=1024) as tr:
+            t[0] += 1.2
+            tr.step("solve[default-scheduler]")
+            tr.log_if_long()  # the old explicit exit-path call
+            t[0] += 0.0002    # the with-exit recomputes a later total
+        tr.log_if_long()      # a stray post-exit finalize
+    assert len(caplog.records) == 1
+    overruns = drain_overruns()
+    assert len(overruns) == 1
+    assert overruns[0]["name"] == "schedule_batch"
+
+
+def test_scheduler_cycle_traces_emit_once(caplog):
+    """End-to-end: a slow schedule_batch cycle through the real
+    Scheduler produces exactly one trace line."""
+    import time as _time
+
+    from kubernetes_tpu.api import store as st
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing.wrappers import GI, MI, make_node, make_pod
+
+    store = st.Store()
+    sched = Scheduler(store, batch_size=16)
+    for i in range(2):
+        sched.cache.add_node(
+            make_node(f"n{i}")
+            .capacity(cpu_milli=32000, mem=64 * GI, pods=110)
+            .obj()
+        )
+    orig = sched.tpu.schedule_pending_async
+
+    def slow(*a, **k):
+        _time.sleep(1.1)
+        return orig(*a, **k)
+
+    sched.tpu.schedule_pending_async = slow
+    pods = [
+        make_pod(f"p{i}").req(cpu_milli=10, mem=16 * MI).obj()
+        for i in range(4)
+    ]
+    for p in pods:
+        store.create(p)
+        sched.queue.add(p)
+    with caplog.at_level(logging.WARNING, logger="kubernetes_tpu.trace"):
+        stats = sched.schedule_batch(timeout=0.5)
+    assert stats["scheduled"] == 4
+    traces = [
+        r for r in caplog.records if "schedule_batch" in r.getMessage()
+    ]
+    assert len(traces) == 1, [r.getMessage() for r in traces]
