@@ -1,0 +1,21 @@
+# Developer entry points.  Tier-1 is the gate CI runs on every PR; the
+# chaos suite (randomized seeded fault injection, tests/test_chaos.py)
+# is opt-in because each of its 20 fixed seeds drives a full cluster
+# run.
+
+PY ?= python
+
+.PHONY: test chaos bench
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow and not chaos' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+# the fixed seed matrix lives in tests/test_chaos.py (SEEDS = range(20));
+# every seed replays byte-identically via FaultRegistry(seed)
+chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -m chaos -q \
+		-p no:cacheprovider
+
+bench:
+	JAX_PLATFORMS=cpu BENCH_STRICT=1 $(PY) bench.py

@@ -31,9 +31,11 @@ import logging
 import queue
 import threading
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
+from ..testing import faults
 from . import types as api
 
 ADDED = "ADDED"
@@ -106,6 +108,12 @@ class Watch:
                     pass  # __next__'s stopped check is the backstop
 
     def _offer(self, ev: Event) -> bool:
+        # hot path (per event per watcher): the disarmed check is one
+        # module-attribute load, not a function call
+        if faults._registry is not None and faults.fire("watch.offer") == faults.DROP:
+            # injected slow watcher: the store treats a refused offer
+            # exactly like a full queue — overflow-kill + relist
+            return False
         try:
             self._q.put_nowait(ev)
             return True
@@ -182,6 +190,19 @@ class Store:
         self._journal_records = 0
         self._journal_dirty = False
         self._journal_flushed_at = time.monotonic()
+        # journal health/recovery counters (surfaced as
+        # scheduler_journal_recovered_records by the perf collectors):
+        #   recovered — corrupt records replay survived (skipped mid-file
+        #       lines + truncated tails), i.e. every time the CRC path
+        #       saved a restart;
+        #   tail truncations — torn final appends cut back to the last
+        #       good record;
+        #   write errors — appends/flushes that failed and were contained
+        #       (the store keeps serving; durability is degraded until
+        #       appends succeed again).
+        self.journal_recovered_records = 0
+        self.journal_tail_truncations = 0
+        self.journal_write_errors = 0
         # "write": flush per record — every acknowledged write is on
         # disk (etcd's ack-after-fsync contract; the replay test's
         # kill-anywhere guarantee).  "interval": group-commit with a
@@ -229,6 +250,27 @@ class Store:
 
     # -- journal (crash-only durability) -----------------------------------
 
+    @staticmethod
+    def _encode_record(rec: dict) -> str:
+        """One journal line: the record JSON with a trailing crc32 over
+        the crc-less serialization.  Replay re-serializes the parsed
+        record (key order and value round-trips are stable under
+        json.dumps) and compares — a partial page write or bit flip
+        anywhere in the line fails the check even when the damage still
+        parses as JSON."""
+        import json
+
+        s = json.dumps(rec)
+        return '%s, "crc": %d}\n' % (s[:-1], zlib.crc32(s.encode()))
+
+    @staticmethod
+    def _record_crc_ok(rec: dict, crc) -> bool:
+        import json
+
+        if crc is None:
+            return True  # pre-CRC journal line: accept (upgrade path)
+        return zlib.crc32(json.dumps(rec).encode()) == crc
+
     def _replay_journal(self, path: str) -> int:
         import json
         import os
@@ -250,21 +292,27 @@ class Store:
                     rec = json.loads(line)
                     if not isinstance(rec, dict):
                         raise ValueError("journal record is not an object")
+                    crc = rec.pop("crc", None)
+                    if not self._record_crc_ok(rec, crc):
+                        raise ValueError("journal record crc mismatch")
                     op, rv, kind = rec["op"], rec["rv"], rec["kind"]
                     key = rec["key"]
                     obj = (
                         None if op == DELETED else wire.from_wire(rec["obj"])
                     )
                 except (json.JSONDecodeError, ValueError, KeyError, TypeError):
-                    # undecodable OR structurally-corrupt record (a line
-                    # that parses as JSON but lost its fields or its
-                    # object payload aborts replay just as hard as a
-                    # torn one)
+                    # undecodable, CRC-failing, OR structurally-corrupt
+                    # record (a line that parses as JSON but lost its
+                    # fields or its object payload aborts replay just as
+                    # hard as a torn one)
+                    self.journal_recovered_records += 1
                     if good_offset + len(raw) >= size:
-                        # torn TAIL: the process died mid-append; the
-                        # record was never acknowledged durable — stop
-                        # replay and truncate so appends continue from
-                        # the last good line
+                        # corrupt TAIL (the first corrupt record with
+                        # nothing valid after it): the process died
+                        # mid-append; the record was never acknowledged
+                        # durable — stop replay and truncate so appends
+                        # continue from the last good line
+                        self.journal_tail_truncations += 1
                         with open(path, "r+b") as t:
                             t.truncate(good_offset)
                         break
@@ -272,7 +320,7 @@ class Store:
                     # AFTER it were acknowledged durable — skip the bad
                     # line, keep replaying, do NOT truncate them away
                     logging.getLogger(__name__).error(
-                        "journal %s: undecodable record at offset %d "
+                        "journal %s: corrupt record at offset %d "
                         "(not tail); skipping it and keeping later "
                         "records", path, good_offset,
                     )
@@ -292,7 +340,11 @@ class Store:
         return replayed
 
     def _compact_journal(self, path: str) -> None:
-        import json
+        """Rewrite history as one ADDED per live object, crash-safely:
+        write-temp, flush+fsync the temp, then atomic rename — a crash
+        at ANY point leaves either the old journal or the complete new
+        one, never a half-written mix (the etcd snapshot+WAL-rotation
+        discipline)."""
         import os
 
         from . import wire
@@ -309,41 +361,85 @@ class Store:
                         "key": key,
                         "obj": wire.to_wire(obj),
                     }
-                    f.write(json.dumps(rec) + "\n")
+                    f.write(self._encode_record(rec))
                     n += 1
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        # fsync the directory so the rename itself is durable
+        try:
+            dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass  # platform without directory fsync
         self._journal = open(path, "a")
         self._journal_records = n
+
+    def _flush_journal(self) -> None:
+        # caller holds the lock
+        faults.fire("store.journal.fsync")
+        self._journal.flush()
+
+    def _journal_commit(self, lines: List[str]) -> None:
+        """Write+flush journal lines with failure containment: a torn or
+        failed append degrades durability (counted, logged) but never
+        fails the already-committed in-memory write — the store keeps
+        serving (availability over the fsync ack, unlike etcd's
+        fail-stop; replay's CRC path handles whatever landed)."""
+        try:
+            act = faults.fire("store.journal.append", records=len(lines))
+            data = "".join(lines)
+            if isinstance(act, faults.TornWrite):
+                cut = max(1, int(len(data) * act.frac))
+                self._journal.write(data[:cut].rstrip("\n"))
+                self._journal.flush()
+                raise faults.FaultInjected("torn journal append")
+            self._journal.write(data)
+            if self._journal_sync == "write":
+                self._flush_journal()
+            else:
+                # group commit: one flush covers a burst of records (a
+                # bind wave is thousands back-to-back); the flusher
+                # thread bounds the window at _JOURNAL_FLUSH_S
+                self._journal_dirty = True
+                now = time.monotonic()
+                if now - self._journal_flushed_at >= self._JOURNAL_FLUSH_S:
+                    self._flush_journal()
+                    self._journal_dirty = False
+                    self._journal_flushed_at = now
+        except Exception:  # noqa: BLE001 — durability degradation, not an API error
+            self.journal_write_errors += 1
+            logging.getLogger(__name__).exception(
+                "journal append failed; continuing with degraded durability"
+            )
+            return
+        self._journal_records += len(lines)
+        live = sum(len(objs) for objs in self._objects.values())
+        if self._journal_records > max(1024, 8 * max(live, 1)):
+            try:
+                self._journal.close()
+                self._compact_journal(self._journal_path)
+            except Exception:  # noqa: BLE001
+                self.journal_write_errors += 1
+                logging.getLogger(__name__).exception(
+                    "journal compaction failed; reopening for append"
+                )
+                if self._journal is None or self._journal.closed:
+                    self._journal = open(self._journal_path, "a")
 
     def _append_journal(self, op: str, kind: str, key: str, obj, rv: int) -> None:
         # caller holds the lock; called after the in-memory commit
         if self._journal is None:
             return
-        import json
-
         from . import wire
 
         rec = {"op": op, "rv": rv, "kind": kind, "key": key}
         if op != DELETED:
             rec["obj"] = wire.to_wire(obj)
-        self._journal.write(json.dumps(rec) + "\n")
-        if self._journal_sync == "write":
-            self._journal.flush()
-        else:
-            # group commit: one flush covers a burst of records (a bind
-            # wave is thousands back-to-back); the flusher thread bounds
-            # the window at _JOURNAL_FLUSH_S
-            self._journal_dirty = True
-            now = time.monotonic()
-            if now - self._journal_flushed_at >= self._JOURNAL_FLUSH_S:
-                self._journal.flush()
-                self._journal_dirty = False
-                self._journal_flushed_at = now
-        self._journal_records += 1
-        live = sum(len(objs) for objs in self._objects.values())
-        if self._journal_records > max(1024, 8 * max(live, 1)):
-            self._journal.close()
-            self._compact_journal(self._journal_path)
+        self._journal_commit([self._encode_record(rec)])
 
     # -- helpers -----------------------------------------------------------
 
@@ -509,6 +605,7 @@ class Store:
         watch consumers already share one Event payload across every
         watcher, so the alias adds no new mutability hazard — it removes
         the single biggest per-pod cost of a 1k-pod bind wave."""
+        faults.fire("store.update_wave", kind=kind, updates=len(updates))
         applied: List[str] = []
         errors: Dict[str, Exception] = {}
         events: List[Event] = []
@@ -561,8 +658,6 @@ class Store:
         # caller holds the lock; one write + one flush for the wave
         if self._journal is None:
             return
-        import json
-
         from . import wire
 
         lines = []
@@ -570,22 +665,8 @@ class Store:
             rec = {"op": op, "rv": rv, "kind": kind, "key": key}
             if op != DELETED:
                 rec["obj"] = wire.to_wire(obj)
-            lines.append(json.dumps(rec) + "\n")
-        self._journal.write("".join(lines))
-        if self._journal_sync == "write":
-            self._journal.flush()
-        else:
-            self._journal_dirty = True
-            now = time.monotonic()
-            if now - self._journal_flushed_at >= self._JOURNAL_FLUSH_S:
-                self._journal.flush()
-                self._journal_dirty = False
-                self._journal_flushed_at = now
-        self._journal_records += len(records)
-        live = sum(len(objs) for objs in self._objects.values())
-        if self._journal_records > max(1024, 8 * max(live, 1)):
-            self._journal.close()
-            self._compact_journal(self._journal_path)
+            lines.append(self._encode_record(rec))
+        self._journal_commit(lines)
 
     def _dispatch_wave(self, kind: str, events: List[Event]) -> None:
         # caller holds the lock; one buffer extend + one fan-out pass
@@ -684,7 +765,20 @@ class Store:
                     )
                 for ev in self._buffer:
                     if ev.kind == kind and ev.rv > from_rv:
-                        w._offer(ev)
+                        if not w._offer(ev):
+                            # the replay itself overflowed (or was
+                            # fault-dropped): this stream would be lossy
+                            # FROM BIRTH with no overflow-kill to expose
+                            # it — the silently-lost event would never be
+                            # re-delivered and its object would stay
+                            # stale in every consumer forever.  Refuse
+                            # the watch; the client relists (410 path).
+                            self.watchers_terminated += 1
+                            self.terminated_kinds.append(kind)
+                            raise Expired(
+                                f"rv {from_rv} replay overflowed the "
+                                "watch queue; relist"
+                            )
             self._watchers.setdefault(kind, []).append(w)
             return w
 

@@ -11,12 +11,14 @@ transaction's analogue).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, Optional
 
 from ..api import store as st
 from ..api import types as api
+from ..testing import faults
 
 
 class LeaderElector:
@@ -44,10 +46,14 @@ class LeaderElector:
         self._leading = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # renew attempts that raised (store fault, injected failure) and
+        # were treated as a failed renew rather than killing the loop
+        self.renew_errors = 0
 
     # -- the tryAcquireOrRenew step ----------------------------------------
 
     def try_acquire_or_renew(self) -> bool:
+        faults.fire("leader.renew", identity=self.identity)
         now = self._clock()
         try:
             lease = self.store.get("Lease", self.lease_name, self.namespace)
@@ -90,7 +96,19 @@ class LeaderElector:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            got = self.try_acquire_or_renew()
+            try:
+                got = self.try_acquire_or_renew()
+            except Exception:  # noqa: BLE001 — renew containment
+                # an exception mid-renew (store fault, injected failure)
+                # is a FAILED renew, not a dead elector: the holder must
+                # step down exactly once (below) and keep retrying — a
+                # dead loop with _leading still set would be split-brain
+                got = False
+                self.renew_errors += 1
+                logging.getLogger(__name__).exception(
+                    "leader renew failed for %s; treating as lost lease",
+                    self.identity,
+                )
             if got and not self._leading.is_set():
                 self._leading.set()
                 if self.on_started_leading:

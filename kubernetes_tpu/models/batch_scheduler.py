@@ -40,9 +40,104 @@ from ..ops import assign as assign_ops
 from ..ops import auction as auction_ops
 from ..ops import schema
 from ..ops.scores import DEFAULT_SCORE_CONFIG, ScoreConfig
+from ..testing import faults
 from .mirror import DeviceClusterMirror
 
 Result = Union[assign_ops.SolveResult, auction_ops.AuctionResult]
+
+
+class SolveUnhealthy(RuntimeError):
+    """The device returned a structurally-broken solve (non-finite score
+    for a placed pod, NaN anywhere in the score tensor): the placements
+    cannot be trusted.  Treated exactly like an XLA runtime error by the
+    circuit breaker."""
+
+
+class SolveCircuitBreaker:
+    """Device-solve circuit breaker (the kube pattern: contain a failing
+    dependency, probe for recovery).
+
+    closed     → device solves flow normally.
+    open       → the device path failed twice in a row (one retry);
+                 every batch routes to the host fallback until the
+                 cooldown elapses.
+    half-open  → cooldown elapsed: ONE batch probes the device; success
+                 closes the breaker, failure re-opens it with a fresh
+                 cooldown.
+
+    The breaker deliberately has no failure-rate window: the device
+    solve is all-or-nothing per batch, so consecutive-failure semantics
+    (fail → retry → trip) match the dispatch shape."""
+
+    CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+    _STATE_CODE = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
+
+    def __init__(self, cooldown: float = 5.0, clock=time.monotonic):
+        self.cooldown = cooldown
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.state = self.CLOSED
+        self._open_until = 0.0
+        self.trips = 0       # CLOSED/HALF_OPEN -> OPEN transitions
+        self.fallbacks = 0   # batches solved on the host path
+        self.probes = 0      # half-open device attempts
+
+    def state_code(self) -> float:
+        return self._STATE_CODE[self.state]
+
+    def allow_device(self) -> bool:
+        """True when this batch may use the device: closed, or open with
+        the cooldown elapsed (the call transitions to half-open and the
+        batch becomes the probe)."""
+        with self._lock:
+            if self.state == self.CLOSED:
+                return True
+            if self.state == self.OPEN and self._clock() >= self._open_until:
+                self.state = self.HALF_OPEN
+                self.probes += 1
+                return True
+            # open inside the cooldown, or half-open with the probe
+            # already in flight on another thread
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self.state != self.CLOSED:
+                self.state = self.CLOSED
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.trips += 1
+            self.state = self.OPEN
+            self._open_until = self._clock() + self.cooldown
+
+
+class HostSolve:
+    """A completed host-fallback solve quacking like DeviceSolve: names
+    are already materialized, there is no device future to read back and
+    no reason tensor (pods it cannot place park with reason -1 and are
+    woken by every event — acceptable in degraded mode)."""
+
+    result = None
+    wave_count = None
+    wave_fallbacks = None
+
+    def __init__(self, names: List[Optional[str]]):
+        self._names = names
+        self.encode_s = 0.0
+        self.dispatch_s = 0.0
+        self.decode_wait_s = 0.0
+        self.deferred_s = 0.0
+        self.dispatched_at = time.perf_counter()
+
+    def ready(self) -> bool:
+        return True
+
+    def names(self) -> List[Optional[str]]:
+        return self._names
+
+    def reasons(self) -> Optional[List[int]]:
+        return None
 
 
 _FILL_CACHE_MAX = 64  # entries; shape buckets churn as the cluster grows —
@@ -230,14 +325,27 @@ class DeviceSolve:
             self.deferred_s = t0 - self.dispatched_at
             tree = {
                 "assignment": self.result.assignment,
+                "scores": getattr(self.result, "scores", None),
                 "reasons": self.result.reasons,  # None stays None
                 "wave_count": getattr(self.result, "wave_count", None),
                 "wave_fallbacks": getattr(self.result, "wave_fallbacks", None),
             }
             got = jax.device_get(tree)  # one coalesced readback
             self.decode_wait_s = self._clock() - t0
+            assignment = np.asarray(got["assignment"])
+            # health check (the circuit breaker's non-finite-score trip
+            # wire): a NaN score, or a placed pod whose winning score is
+            # non-finite, means the solve state is corrupt and none of
+            # this batch's placements can be trusted
+            if got["scores"] is not None:
+                s = np.asarray(got["scores"])[: self.meta.num_pods]
+                placed = assignment[: self.meta.num_pods] >= 0
+                if np.isnan(s).any() or not np.isfinite(s[placed]).all():
+                    raise SolveUnhealthy(
+                        "non-finite score tensor in device solve"
+                    )
             self._decoded = (
-                np.asarray(got["assignment"]),
+                assignment,
                 None if got["reasons"] is None else np.asarray(got["reasons"]),
                 None if got["wave_count"] is None else int(got["wave_count"]),
                 None if got["wave_fallbacks"] is None
@@ -440,9 +548,18 @@ class TPUBatchScheduler:
             )
         self._mirror = DeviceClusterMirror(self.state)
         self.use_mirror = use_mirror
+        # device-solve circuit breaker: XLA runtime/compile errors and
+        # non-finite score tensors retry once, then trip every batch to
+        # the host-side per-pod exact-evaluation fallback for a cooldown
+        # (docs/robustness.md)
+        self.breaker = SolveCircuitBreaker()
         self._fill_cache: dict = {}
         self._unpack_cache: dict = {}
         self.last_result: Optional[Result] = None
+        # the effective solve object of the most recent finalize_pending
+        # (the caller's DeviceSolve unless the breaker's retry/fallback
+        # replaced it)
+        self.last_solve = None
         # encode/solve wall split of the most recent schedule_pending —
         # the host scheduler's pipeline-overlap meter reads it: the
         # encode half holds the cache lock (a concurrent wave commit
@@ -796,7 +913,14 @@ class TPUBatchScheduler:
         """Dispatch a prebuilt snapshot; the result stays a device future
         (DeviceSolve) and the readback happens on first names()/reasons()
         access — callers overlap it with host work."""
+        act = faults.fire("batch.solve", pods=meta.num_pods)
         result = self._dispatch(snap, meta)
+        if act == faults.CORRUPT and getattr(result, "scores", None) is not None:
+            # injected device corruption: poison the score tensor so the
+            # decode-side health check (SolveUnhealthy) trips
+            result = result._replace(
+                scores=jnp.full_like(result.scores, jnp.nan)
+            )
         self.last_result = result
         return DeviceSolve(result, meta)
 
@@ -820,13 +944,34 @@ class TPUBatchScheduler:
         device solve and the readback."""
         if not pending:
             return None
+        if not self.breaker.allow_device():
+            # breaker open: the device path is sick; solve on the host
+            # (throughput stays > 0 while the cooldown runs)
+            return self._host_fallback(
+                pending, lock=lock, reservations=reservations
+            )
         t0 = time.perf_counter()
         snap, meta = self.encode_pending(
             pending, num_pods_hint=num_pods_hint, lock=lock,
             reservations=reservations,
         )
         t1 = time.perf_counter()
-        ds = self.solve_encoded_async(snap, meta)
+        try:
+            ds = self.solve_encoded_async(snap, meta)
+        except Exception:  # noqa: BLE001 — device dispatch/compile fault
+            logging.getLogger(__name__).exception(
+                "device solve dispatch failed; retrying once"
+            )
+            try:
+                ds = self.solve_encoded_async(snap, meta)
+            except Exception:  # noqa: BLE001
+                self.breaker.record_failure()
+                logging.getLogger(__name__).exception(
+                    "device solve retry failed; breaker open, host fallback"
+                )
+                return self._host_fallback(
+                    pending, lock=lock, reservations=reservations
+                )
         ds.encode_s = t1 - t0
         # trace/compile + dispatch-enqueue wall: on a first-of-a-bucket
         # batch this IS the XLA compile (jit blocks until the executable
@@ -844,10 +989,43 @@ class TPUBatchScheduler:
     ) -> List[Optional[str]]:
         """Decode a dispatched batch (one coalesced readback), record the
         encode/solve/decode wall split, and run the gang admission retry
-        if the batch needs it."""
+        if the batch needs it.
+
+        Device faults surfacing at decode time (XLA runtime errors in
+        device_get, the SolveUnhealthy non-finite check) retry the solve
+        once; a second failure trips the circuit breaker and this batch
+        — like every batch until the cooldown's half-open probe — solves
+        on the host fallback instead."""
         if ds is None:
             return []
-        names = ds.names()
+        try:
+            names = ds.names()
+            if not isinstance(ds, HostSolve):
+                self.breaker.record_success()
+        except Exception:  # noqa: BLE001 — device readback fault
+            logging.getLogger(__name__).exception(
+                "device solve readback failed; retrying once"
+            )
+            try:
+                snap, meta = self.encode_pending(
+                    pending, lock=lock, reservations=reservations
+                )
+                ds = self.solve_encoded_async(snap, meta)
+                names = ds.names()
+                self.breaker.record_success()
+            except Exception:  # noqa: BLE001
+                self.breaker.record_failure()
+                logging.getLogger(__name__).exception(
+                    "device solve retry failed; breaker open, host fallback"
+                )
+                ds = self._host_fallback(
+                    pending, lock=lock, reservations=reservations
+                )
+                names = ds.names()
+        # the EFFECTIVE solve for this batch (retry or fallback may have
+        # replaced the caller's handle): telemetry readers (wave counts,
+        # reason tensors) must touch this one, not the sick original
+        self.last_solve = ds
         self.last_timings = {
             "encode_s": getattr(ds, "encode_s", 0.0),
             "compile_s": getattr(ds, "dispatch_s", 0.0),
@@ -887,11 +1065,77 @@ class TPUBatchScheduler:
     def schedule_pending_no_retry(
         self, pending, lock=None, reservations=(), num_pods_hint: int = 0
     ) -> List[Optional[str]]:
+        if not self.breaker.allow_device():
+            return self._host_fallback(
+                pending, lock=lock, reservations=reservations
+            ).names()
         snap, meta = self.encode_pending(
             pending, lock=lock, reservations=reservations,
             num_pods_hint=num_pods_hint,
         )
         return self.solve_encoded(snap, meta)
+
+    # -- degraded mode (the circuit breaker's fallback) --------------------
+
+    def _host_fallback(
+        self,
+        pending: Sequence[api.Pod],
+        lock=None,
+        reservations: Sequence[Tuple[str, api.Pod]] = (),
+    ) -> HostSolve:
+        """Solve one batch on the host: the per-pod exact-evaluation path
+        (testing.oracle.Oracle — the independent reference-semantics
+        reimplementation the parity suite validates the kernels against)
+        over the retained node/pod objects, with the device post-pass's
+        gang all-or-nothing mirrored host-side.
+
+        On healthy snapshots with default plugin weights the oracle IS
+        scan-parity-identical (tests/test_assign_parity.py), so a tripped
+        breaker degrades throughput, not placement quality.  Nominated
+        reservations are accounted as bound pods on their nominated
+        nodes — a slight over-reservation (ports/labels count too) that
+        errs schedulable-pods-safe."""
+        from ..testing.oracle import Oracle
+
+        t0 = time.perf_counter()
+        with lock if lock is not None else contextlib.nullcontext():
+            state = self.state
+            nodes = [
+                state._node_objs[name]
+                for name in state._rows
+                if name in state._node_objs
+            ]
+            oracle = Oracle(
+                nodes, fit_strategy=self.score_config.fit_strategy
+            )
+            by_name = {s.node.meta.name: s for s in oracle.states}
+            for key, pod in state._pods.items():
+                ns = by_name.get(
+                    state._pod_node.get(key) or pod.spec.node_name
+                )
+                if ns is not None:
+                    ns.add_pod(pod)
+            for node_name, pod in reservations:
+                ns = by_name.get(node_name)
+                if ns is not None:
+                    ns.add_pod(pod)
+            names = oracle.schedule(list(pending))
+        # gang all-or-nothing post-pass (ops.assign _gang_release's host
+        # mirror): an incomplete gang releases every member
+        groups: Dict[str, List[int]] = {}
+        for i, p in enumerate(pending):
+            g = p.spec.scheduling_group
+            if g:
+                groups.setdefault(g, []).append(i)
+        for idx in groups.values():
+            if any(names[i] is None for i in idx):
+                for i in idx:
+                    names[i] = None
+        self.breaker.fallbacks += 1
+        self.last_result = None  # no reason tensor aligns with these names
+        hs = HostSolve(names)
+        hs.encode_s = time.perf_counter() - t0
+        return hs
 
     def _gang_admission_retry(
         self,

@@ -1365,6 +1365,10 @@ class ClusterState:
         self._free: List[int] = []
         self._high = 0  # rows in use (high watermark after frees are reused)
         self.node_names: List[Optional[str]] = []
+        # the api objects behind the rows, retained like _pods below: the
+        # host-fallback solver (models.batch_scheduler._host_fallback)
+        # rebuilds an object-model view when the device path is tripped
+        self._node_objs: Dict[str, api.Node] = {}
         self._pods: Dict[str, api.Pod] = {}       # bound/assumed, by pod key
         self._pod_node: Dict[str, str] = {}
         self._pods_by_node: Dict[str, List[str]] = {}
@@ -1455,6 +1459,7 @@ class ClusterState:
             self.node_names.append(None)
         self._rows[name] = i
         self.node_names[i] = name
+        self._node_objs[name] = node
         self._pods_by_node.setdefault(name, [])
         self.builder._write_node_row(
             node, i, self.node_valid, self.name_id, self.allocatable,
@@ -1467,6 +1472,7 @@ class ClusterState:
         (requested/ports) is preserved — it derives from bound pods, not
         the node object."""
         i = self._rows[node.meta.name]
+        self._node_objs[node.meta.name] = node
         self.builder._resource_vector(node.status.allocatable, 0, grow=True)
         self.ensure_resources()
         self.builder._write_node_row(
@@ -1477,6 +1483,7 @@ class ClusterState:
 
     def remove_node(self, name: str) -> None:
         i = self._rows.pop(name)
+        self._node_objs.pop(name, None)
         for pk in self._pods_by_node.pop(name, []):
             self._pods.pop(pk, None)
             self._pod_node.pop(pk, None)

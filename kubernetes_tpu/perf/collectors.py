@@ -16,7 +16,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..api import store as st
-from ..scheduler.metrics import Histogram, Registry
+from ..scheduler.metrics import Counter, Gauge, Histogram, Registry
 
 
 class DataItem(dict):
@@ -162,6 +162,16 @@ class MetricsCollector:
         "scheduler_decode_overlap_seconds",
     )
 
+    # breaker / supervision / journal-recovery scalars (gauges and
+    # counters, reported as one Total value — docs/robustness.md)
+    SCALAR_METRICS = (
+        "scheduler_solve_breaker_state",
+        "scheduler_solve_fallback_total",
+        "scheduler_binder_restarts_total",
+        "scheduler_binder_poison_waves_total",
+        "scheduler_journal_recovered_records",
+    )
+
     def __init__(
         self,
         registry: Registry,
@@ -211,4 +221,17 @@ class MetricsCollector:
                     labels,
                 )
             )
+        for name in self.SCALAR_METRICS:
+            m = snap.get(name)
+            if isinstance(m, Counter):
+                value = m.total
+            elif isinstance(m, Gauge):
+                value = m.get()
+            else:
+                continue
+            if value == 0.0:
+                continue  # quiet metrics don't clutter the summary
+            labels = dict(self.labels)
+            labels["Metric"] = name
+            out.append(DataItem({"Total": value}, "count", labels))
         return out

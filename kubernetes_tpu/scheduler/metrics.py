@@ -211,6 +211,23 @@ class Registry:
         self.framework_extension_point_duration = HistogramVec(
             "scheduler_framework_extension_point_duration_seconds"
         )
+        # -- degraded-mode / robustness surface (docs/robustness.md) ------
+        # circuit-breaker state: 0 closed, 1 half-open, 2 open
+        self.solve_breaker_state = Gauge("scheduler_solve_breaker_state")
+        # running total of batches solved on the host fallback path
+        # (mirrored from the breaker each cycle — monotonic)
+        self.solve_fallback_total = Gauge("scheduler_solve_fallback_total")
+        # binding-worker restarts by the watchdog (binder supervision)
+        self.binder_restarts = Counter("scheduler_binder_restarts_total")
+        # waves that failed twice and were split into per-pod commits
+        self.binder_poison_waves = Counter(
+            "scheduler_binder_poison_waves_total"
+        )
+        # corrupt journal records replay survived (mirrored from the
+        # store: skipped mid-file lines + truncated torn tails)
+        self.journal_recovered_records = Gauge(
+            "scheduler_journal_recovered_records"
+        )
         # schedule_attempts_total{result="scheduled|unschedulable|error"}
         self.schedule_attempts = Counter("scheduler_schedule_attempts_total")
         # pending_pods{queue="active|backoff|unschedulable|gated"}

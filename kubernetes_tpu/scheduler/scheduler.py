@@ -48,6 +48,7 @@ from ..client.events import EventRecorder
 from ..client.informers import InformerFactory
 from ..models.batch_scheduler import TPUBatchScheduler
 from ..ops import assign as assign_ops
+from ..testing import faults
 from ..utils.trace import Trace
 from .cache import SchedulerCache
 from .config import SchedulerConfiguration
@@ -77,12 +78,18 @@ def _combine_transforms(transforms):
 class _Cycle:
     """One in-flight solve-stage cycle: popped-batch staging state plus
     (optionally) the last profile group still out on the device as a
-    DeviceSolve future (scheduler._run's readback pipeline)."""
+    DeviceSolve future (scheduler._run's readback pipeline).
+
+    `batch` is every popped info and `handled` the keys a terminal path
+    has taken ownership of (staged into the wave, parked, requeued,
+    handed to a Permit thread): a cycle that dies mid-flight is salvaged
+    by requeueing batch − handled, so a fault can never strand pods in
+    the 'inflight' tier (Scheduler._salvage_cycle)."""
 
     __slots__ = ("stats", "trace", "reservations", "failed", "wave",
-                 "pending", "solved_any")
+                 "pending", "solved_any", "batch", "handled")
 
-    def __init__(self, stats, trace, reservations):
+    def __init__(self, stats, trace, reservations, batch):
         self.stats = stats
         self.trace = trace
         self.reservations = reservations
@@ -90,6 +97,8 @@ class _Cycle:
         self.wave: List[tuple] = []
         self.pending = None  # (fwk, sched_name, group, DeviceSolve, t_solve)
         self.solved_any = False
+        self.batch: List[QueuedPodInfo] = batch
+        self.handled: set = set()
 
 
 _REASON_TEXT = {
@@ -212,11 +221,14 @@ class Scheduler:
         # overlaps the commit.  Backlog is bounded so a commit stage that
         # falls behind backpressures the solve stage instead of growing
         # an unbounded requeue-latency tail.
-        self._waves: deque = deque()
+        self._waves: deque = deque()  # (entries, attempts) pairs
         self._wave_cv = threading.Condition()
         self._wave_active = False
         self._binder_stop = False
         self._max_wave_backlog = 2
+        # the cycle currently mid-dispatch/finalize: _salvage_cycle reads
+        # it when a cycle dies so popped pods never strand inflight
+        self._inflight_cycle: Optional[_Cycle] = None
         # device-solve intervals, for the pipeline-overlap metric (the
         # binder reads them to attribute its commit time)
         self._solve_lock = threading.Lock()
@@ -364,6 +376,10 @@ class Scheduler:
 
     # -- binding stage (the dedicated bind worker) -------------------------
 
+    # a wave that failed this many whole-wave commits splits into per-pod
+    # commits (the poison-wave escape hatch): one retry, then isolation
+    _MAX_WAVE_ATTEMPTS = 1
+
     def _bind_worker(self) -> None:
         while True:
             with self._wave_cv:
@@ -371,44 +387,114 @@ class Scheduler:
                     self._wave_cv.wait(0.2)
                 if not self._waves:
                     return  # stopping and drained
-                wave = self._waves.popleft()
+                entries, attempts = self._waves.popleft()
                 self._wave_active = True
                 self._wave_cv.notify_all()
+            # entries not yet committed or failed: the crash handler
+            # requeues exactly this remainder, so a crash-grade fault at
+            # ANY point (first commit, retry bookkeeping, mid-split)
+            # loses nothing to the assume-TTL
+            remaining = list(entries)
             try:
-                self._commit_wave(wave)
-            except Exception:  # noqa: BLE001 — wave containment
-                # a whole-wave fault must not kill the binding stage for
-                # the process's lifetime; the pods' assumes expire via
-                # TTL and _run requeues them
-                logging.getLogger(__name__).exception(
-                    "bind wave failed; pods ride the assume-TTL requeue"
-                )
-            finally:
+                try:
+                    self._commit_wave(entries)
+                    remaining = []
+                except Exception:  # noqa: BLE001 — wave containment
+                    # a whole-wave fault must not kill the binding stage
+                    # for the process's lifetime NOR park its pods on
+                    # the assume-TTL: retry the wave once, then treat it
+                    # as poison and split to per-pod commits with
+                    # bounded per-pod failure handling
+                    if attempts < self._MAX_WAVE_ATTEMPTS:
+                        logging.getLogger(__name__).exception(
+                            "bind wave failed (attempt %d); retrying",
+                            attempts,
+                        )
+                        with self._wave_cv:
+                            self._waves.appendleft((entries, attempts + 1))
+                            self._wave_cv.notify_all()
+                        remaining = []
+                    else:
+                        logging.getLogger(__name__).exception(
+                            "bind wave failed twice; splitting poison "
+                            "wave into per-pod commits"
+                        )
+                        self.metrics.binder_poison_waves.inc()
+                        while remaining:
+                            entry = remaining[0]
+                            try:
+                                self._commit_wave([entry])
+                            except Exception:  # noqa: BLE001 — per-pod
+                                logging.getLogger(__name__).exception(
+                                    "per-pod commit failed for %s; "
+                                    "requeueing", pod_key(entry[1].pod),
+                                )
+                                self._fail_bind(entry[0], entry[1])
+                            remaining.pop(0)
+            except BaseException:
+                # injected crash / interpreter-level fault: the worker
+                # is about to die — put the unprocessed remainder back
+                # for the restarted worker (_ensure_binder)
                 with self._wave_cv:
+                    if remaining:
+                        self._waves.appendleft((remaining, attempts + 1))
                     self._wave_active = False
                     self._wave_cv.notify_all()
+                raise
+            with self._wave_cv:
+                self._wave_active = False
+                self._wave_cv.notify_all()
+
+    def _ensure_binder(self) -> None:
+        """Binder watchdog: restart the binding worker if it died (a
+        crash-grade fault escaped containment).  Called from the hot
+        loop, the wave dispatch path and flush_binds, so direct
+        schedule_batch() callers recover too."""
+        if self._bind_thread.is_alive() or self._binder_stop:
+            return
+        with self._wave_cv:
+            if self._bind_thread.is_alive() or self._binder_stop:
+                return
+            # the dead worker can't clear its active flag; a stale True
+            # would wedge flush_binds forever
+            self._wave_active = False
+            self.metrics.binder_restarts.inc()
+            logging.getLogger(__name__).error(
+                "binding worker died; restarting (binder supervision)"
+            )
+            self._bind_thread = threading.Thread(
+                target=self._bind_worker, name="bind-wave", daemon=True
+            )
+            self._bind_thread.start()
+            self._wave_cv.notify_all()
 
     def _dispatch_wave_async(self, wave: List[tuple]) -> None:
         """Hand a bind wave to the binding stage; blocks only when the
         bounded backlog is full (commit slower than solve — the
         backpressure that keeps requeue latency bounded)."""
+        self._ensure_binder()
         with self._wave_cv:
             while len(self._waves) >= self._max_wave_backlog:
                 self._wave_cv.wait(0.2)
-            self._waves.append(wave)
+                if not self._bind_thread.is_alive():
+                    break  # watchdog's restart will drain the backlog
+            self._waves.append((wave, 0))
             self._wave_cv.notify_all()
+        self._ensure_binder()
 
     def flush_binds(self, timeout: float = 30.0) -> bool:
         """Block until every dispatched bind wave has committed (tests
         and shutdown; the hot path never waits).  True on drained."""
         deadline = time.monotonic() + timeout
-        with self._wave_cv:
-            while self._waves or self._wave_active:
+        while True:
+            self._ensure_binder()
+            with self._wave_cv:
+                if not self._waves and not self._wave_active:
+                    return True
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
-                self._wave_cv.wait(remaining)
-        return True
+                self._wave_cv.wait(min(remaining, 0.2))
 
     def _solve_window(self, start: float, end: float) -> None:
         with self._solve_lock:
@@ -432,6 +518,7 @@ class Scheduler:
         transaction for every surviving bind, then the per-pod success
         tail.  Failures split per pod back to individual requeue — a bad
         pod never takes its wave down."""
+        faults.fire("binder.commit_wave", pods=len(wave))
         t0 = self._clock()
         binds: List[tuple] = []
         for fwk, info, node_name, t_attempt in wave:
@@ -444,6 +531,13 @@ class Scheduler:
         if binds:
             def bind_mutator(node_name: str):
                 def mutate(pod: api.Pod) -> None:
+                    if pod.spec.node_name and pod.spec.node_name != node_name:
+                        # bound-exactly-once guard: a retried wave must
+                        # never move an already-bound pod (same-node
+                        # recommit is an idempotent no-op-shaped write)
+                        raise st.Conflict(
+                            f"pod already bound to {pod.spec.node_name}"
+                        )
                     pod.spec.node_name = node_name
                     pod.status.phase = "Running"
                 return mutate
@@ -501,6 +595,7 @@ class Scheduler:
         # next batch encodes, so snapshots still see every assume.
         cycle: Optional[_Cycle] = None
         while not self._stop.is_set():
+            self._ensure_binder()
             if self.leader_elector and not self.leader_elector.is_leader():
                 cycle = self._finish_contained(cycle)
                 time.sleep(0.05)
@@ -515,6 +610,17 @@ class Scheduler:
                 batch = self.queue.pop_batch(self.batch_size, timeout=timeout)
             except Exception:  # noqa: BLE001
                 batch = []
+            if (
+                batch
+                and self.leader_elector
+                and not self.leader_elector.is_leader()
+            ):
+                # leadership was lost INSIDE the pop window: a
+                # stepped-down scheduler must not dispatch — hand the
+                # batch back and wait for re-acquisition
+                for info in batch:
+                    self.queue.requeue_backoff(info)
+                batch = []
             try:
                 if cycle is not None:
                     self._finish_cycle(cycle)
@@ -525,7 +631,10 @@ class Scheduler:
                 # the reference contains per-cycle errors (ScheduleOne
                 # logs and returns; the wait.Until loop re-enters) — one
                 # lost race must not kill the scheduling thread for the
-                # process's lifetime
+                # process's lifetime.  Salvage first: popped pods the
+                # dead cycle never dispositioned go back to the queue
+                # instead of stranding in the 'inflight' tier.
+                self._salvage_cycle(self._inflight_cycle)
                 cycle = None
                 logging.getLogger(__name__).exception(
                     "schedule_batch cycle failed; continuing"
@@ -535,11 +644,45 @@ class Scheduler:
                 self.queue.add(pod)
         self._finish_contained(cycle)
 
+    def _salvage_cycle(self, cycle: Optional["_Cycle"]) -> None:
+        """A cycle died mid-flight: dispatch whatever bind-wave entries
+        it had fully staged (assumed + Permit-allowed — safe to commit),
+        then requeue every popped pod no terminal path owned, forgetting
+        any assume the dead cycle left behind.  The chaos invariant this
+        maintains: every popped pod ends bound or back in the queue,
+        never wedged inflight."""
+        self._inflight_cycle = None
+        if cycle is None:
+            return
+        if cycle.wave:
+            staged, cycle.wave = cycle.wave, []
+            for _, info, _, _ in staged:
+                cycle.handled.add(pod_key(info.pod))
+            try:
+                self._dispatch_wave_async(staged)
+            except Exception:  # noqa: BLE001
+                logging.getLogger(__name__).exception(
+                    "salvage: staged wave dispatch failed; requeueing"
+                )
+                for fwk, info, _, _ in staged:
+                    self._fail_bind(fwk, info)
+        for info in cycle.batch:
+            key = pod_key(info.pod)
+            if key in cycle.handled:
+                continue
+            cycle.handled.add(key)
+            if self.cache.is_assumed(info.pod):
+                # the dead cycle assumed it but lost it before staging
+                self.cache.forget(info.pod)
+            self.metrics.schedule_attempts.inc("error")
+            self.queue.requeue_backoff(info)
+
     def _finish_contained(self, cycle: Optional["_Cycle"]) -> Optional["_Cycle"]:
         if cycle is not None:
             try:
                 self._finish_cycle(cycle)
             except Exception:  # noqa: BLE001
+                self._salvage_cycle(self._inflight_cycle)
                 logging.getLogger(__name__).exception(
                     "deferred cycle finalize failed"
                 )
@@ -565,7 +708,13 @@ class Scheduler:
         if not batch:
             return {"popped": 0, "scheduled": 0, "unschedulable": 0,
                     "bind_errors": 0}
-        return self._finish_cycle(self._dispatch_batch(batch))
+        try:
+            return self._finish_cycle(self._dispatch_batch(batch))
+        except Exception:
+            # direct callers see the error, but popped pods must not
+            # strand inflight (the same salvage the hot loop runs)
+            self._salvage_cycle(self._inflight_cycle)
+            raise
 
     def _dispatch_batch(self, batch: List[QueuedPodInfo]) -> "_Cycle":
         """The dispatch half of one cycle: group the popped batch by
@@ -591,7 +740,24 @@ class Scheduler:
         # _finish_cycle's log_if_long is the ONE emission point — the old
         # with-block exit double-logged every over-threshold trace.
         trace = Trace("schedule_batch", threshold=1.0, pods=len(batch))
-        cycle = _Cycle(stats, trace, reservations)
+        cycle = _Cycle(stats, trace, reservations, batch)
+        self._inflight_cycle = cycle
+        # A pod can be popped twice into one accumulation window (delete
+        # + recreate races a mid-cycle requeue): the duplicate would make
+        # cache.assume raise "already assumed" downstream — requeue it
+        # per-pod here instead of letting it near the solve.
+        seen: set = set()
+        deduped: List[QueuedPodInfo] = []
+        for info in batch:
+            key = pod_key(info.pod)
+            if key in seen:
+                cycle.handled.add(key)
+                self.metrics.schedule_attempts.inc("error")
+                self.queue.requeue_backoff(info)
+                continue
+            seen.add(key)
+            deduped.append(info)
+        batch = deduped
         by_fwk: Dict[str, List[QueuedPodInfo]] = {}
         for info in batch:
             by_fwk.setdefault(info.pod.spec.scheduler_name, []).append(info)
@@ -624,7 +790,7 @@ class Scheduler:
                 pods, lock=self.cache.lock, reservations=cycle.reservations
             )
         except (OverflowError, ValueError):
-            group = self._reject_unencodable(group, fwk)
+            group = self._reject_unencodable(group, fwk, cycle)
             if not group:
                 with self._solve_lock:
                     self._solve_open = None
@@ -641,6 +807,7 @@ class Scheduler:
                 with self._solve_lock:
                     self._solve_open = None
                 for info in group:
+                    cycle.handled.add(pod_key(info.pod))
                     self.metrics.schedule_attempts.inc("error")
                     self.queue.add_unschedulable(
                         info, reason=assign_ops.REASON_UNENCODABLE
@@ -656,6 +823,10 @@ class Scheduler:
             [info.pod for info in group], ds, lock=self.cache.lock,
             reservations=cycle.reservations,
         )
+        # the breaker's retry/fallback may have replaced the solve the
+        # names came from — read telemetry off the effective one, never
+        # the sick original (its decode raises)
+        ds = getattr(fwk.tpu, "last_solve", None) or ds
         lt = fwk.tpu.last_timings or {}
         encode_s = float(lt.get("encode_s", 0.0))
         compile_s = float(lt.get("compile_s", 0.0))
@@ -703,10 +874,7 @@ class Scheduler:
         else:
             reasons = [-1] * len(group)
         cycle.trace.step(f"decode[{sched_name}]")
-        self._stage_group(
-            fwk, group, names, reasons, cycle.stats, cycle.failed,
-            cycle.wave,
-        )
+        self._stage_group(fwk, group, names, reasons, cycle)
         cycle.trace.step(f"commit[{sched_name}]")
 
     def _finish_cycle(self, cycle: "_Cycle") -> Dict[str, int]:
@@ -740,6 +908,16 @@ class Scheduler:
                 self.metrics.pending_pods.set(v, tier)
         trace.log_if_long()
         self.metrics.schedule_batch_duration.observe(trace.total)
+        # degraded-mode observability: mirror the breaker and journal
+        # recovery state into the registry every cycle (cheap gauge sets)
+        breaker = getattr(self.tpu, "breaker", None)
+        if breaker is not None:
+            self.metrics.solve_breaker_state.set(breaker.state_code())
+            self.metrics.solve_fallback_total.set(float(breaker.fallbacks))
+        recovered = getattr(self.store, "journal_recovered_records", None)
+        if recovered is not None:
+            self.metrics.journal_recovered_records.set(float(recovered))
+        self._inflight_cycle = None
         return stats
 
     def _stage_group(
@@ -748,16 +926,20 @@ class Scheduler:
         group: List[QueuedPodInfo],
         names: List[Optional[str]],
         reasons: List[int],
-        stats: Dict[str, int],
-        failed: List[QueuedPodInfo],
-        wave: List[tuple],
+        cycle: "_Cycle",
     ) -> None:
         """Assume one profile's placements and stage them into the bind
         wave (the per-pod tail of ScheduleOne, schedule_one.go:118-133
         batched; the bind itself runs on the binding stage).  Permit
         ordering is preserved: reject aborts here, wait parks the pod on
         its own WaitOnPermit thread exactly as before — only the
-        allow-path bind moves into the wave."""
+        allow-path bind moves into the wave.  Every branch marks the pod
+        handled so a mid-cycle fault salvages only truly-orphaned pods.
+
+        A duplicate assume ("already assumed" ValueError — the same pod
+        reaching the solve twice despite the dispatch dedup) is contained
+        to a per-pod requeue-with-backoff; it never kills the cycle."""
+        stats, failed, wave = cycle.stats, cycle.failed, cycle.wave
         for i, (info, node_name) in enumerate(zip(group, names)):
             t_attempt = self._clock()
             if node_name is not None:
@@ -776,6 +958,7 @@ class Scheduler:
                     f"0 nodes available ({_REASON_TEXT.get(reasons[i], 'unschedulable')})",
                 )
                 failed.append(info)
+                cycle.handled.add(pod_key(info.pod))
                 continue
             try:
                 self.cache.assume(info.pod, node_name)
@@ -784,6 +967,7 @@ class Scheduler:
                 stats["bind_errors"] += 1
                 self.metrics.schedule_attempts.inc("error")
                 self.queue.requeue_backoff(info)
+                cycle.handled.add(pod_key(info.pod))
                 continue
             # Permit (schedule_one.go:231): reject aborts; wait parks
             # the pod in the waiting map and the binding runs on its own
@@ -800,6 +984,7 @@ class Scheduler:
                     f"permit rejected on node {node_name}",
                 )
                 self.queue.requeue_backoff(info)
+                cycle.handled.add(pod_key(info.pod))
                 continue
             if verdict == "wait":
                 wp = WaitingPod(info.pod, node_name, timeout)
@@ -812,11 +997,13 @@ class Scheduler:
                 )
                 t.start()
                 stats["waiting"] = stats.get("waiting", 0) + 1
+                cycle.handled.add(pod_key(info.pod))
                 continue
             # staged: assumed + Permit-allowed; the binding stage owns
             # the rest (PreBind -> wave commit -> PostBind)
             wave.append((fwk, info, node_name, t_attempt))
             stats["scheduled"] += 1
+            cycle.handled.add(pod_key(info.pod))
 
     def _bind_tail(self, fwk, info, node_name, t_attempt) -> bool:
         """PreBind -> bind -> PostBind with failure containment: the
@@ -912,7 +1099,10 @@ class Scheduler:
         return result.nominated_node if result else None
 
     def _reject_unencodable(
-        self, batch: List[QueuedPodInfo], fwk: Optional[Framework] = None
+        self,
+        batch: List[QueuedPodInfo],
+        fwk: Optional[Framework] = None,
+        cycle: Optional["_Cycle"] = None,
     ) -> List[QueuedPodInfo]:
         """Batch encode failed: find the offending pods by encoding each
         alone against the SAME profile's builder (rare path; the per-pod
@@ -925,6 +1115,8 @@ class Scheduler:
                 tpu.encode_pending([info.pod], lock=self.cache.lock)
                 good.append(info)
             except (OverflowError, ValueError):
+                if cycle is not None:
+                    cycle.handled.add(pod_key(info.pod))
                 self.metrics.schedule_attempts.inc("error")
                 # only a pod UPDATE (spec change) can help — no cluster
                 # event wakes this reason (queue.move_for_event)

@@ -1,0 +1,235 @@
+"""Deterministic, seeded fault injection for the solve→assume→bind
+pipeline.
+
+The registry is the chaos suite's only lever: named fault points are
+threaded through the hot path (journal append/fsync, the wave
+transaction, watch fan-out, the device solve, the binder commit, lease
+renewal) and each point consults the armed registry through one
+module-level indirection.  Disarmed — the production state — the check
+is a single global load and an early return, so the hot path pays
+nothing measurable (BENCH_STRICT budgets hold with the points in
+place).
+
+Schedules are bounded and seeded: a `FaultRegistry(seed=N)` draws every
+probabilistic decision from its own `random.Random(N)`, so a failing
+chaos seed replays byte-identically.  Supported schedule kinds:
+
+  fail(point, n)        raise (fail-once / fail-N); custom exception type
+  crash(point, n)       raise FaultCrash — a BaseException that escapes
+                        `except Exception` containment and kills the
+                        worker thread (binder-supervision coverage)
+  delay(point, s, n)    sleep `s` seconds (latency injection)
+  torn_write(point)     the caller writes a PREFIX of its payload and
+                        then fails (journal torn-tail coverage)
+  drop(point, n)        the caller discards its payload (watch.offer →
+                        simulated slow watcher)
+  corrupt(point, n)     the caller poisons its result (batch.solve →
+                        NaN score tensor)
+
+Sites that need caller-interpreted behaviour (torn/drop/corrupt) read
+fire()'s return value; exception-kind schedules raise from inside
+fire() so most sites need no control flow at all.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+from random import Random
+from typing import Dict, List, Optional
+
+# Every fault point the hot path exposes.  fail()/crash()/... validate
+# against this set so a typo'd point name fails the test loudly instead
+# of silently never firing.
+KNOWN_POINTS = frozenset({
+    "store.journal.append",
+    "store.journal.fsync",
+    "store.update_wave",
+    "watch.offer",
+    "batch.solve",
+    "binder.commit_wave",
+    "leader.renew",
+})
+
+# caller-interpreted actions returned by fire()
+DROP = "drop"
+CORRUPT = "corrupt"
+
+
+class FaultInjected(RuntimeError):
+    """The default injected failure."""
+
+
+class FaultCrash(BaseException):
+    """Escapes `except Exception` containment: the injected analogue of
+    a worker thread dying outright (stack overflow, interpreter-level
+    fault) — what binder supervision exists to recover from."""
+
+
+@dataclass
+class TornWrite:
+    """Returned by fire(): write only `frac` of the payload, then fail."""
+
+    frac: float = 0.5
+
+
+@dataclass
+class _Schedule:
+    mode: str                 # fail | crash | delay | torn | drop | corrupt
+    remaining: int            # fires left; -1 = unbounded
+    exc: type = FaultInjected
+    seconds: float = 0.0
+    probability: float = 1.0
+    frac: float = 0.5
+
+
+class FaultRegistry:
+    """One chaos run's fault plan: schedules per point, consumed in
+    registration order, every probabilistic draw from the run's seed."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._rng = Random(seed)
+        self._lock = threading.Lock()
+        self._schedules: Dict[str, List[_Schedule]] = {}
+        # observability for the suite's coverage assertions
+        self.fired: Dict[str, int] = {}
+        self.log: List[tuple] = []  # (point, mode)
+
+    # -- schedule registration -------------------------------------------
+
+    def _add(self, point: str, sched: _Schedule) -> "FaultRegistry":
+        if point not in KNOWN_POINTS:
+            raise ValueError(
+                f"unknown fault point {point!r}; known: {sorted(KNOWN_POINTS)}"
+            )
+        self._schedules.setdefault(point, []).append(sched)
+        return self
+
+    def fail(
+        self,
+        point: str,
+        n: int = 1,
+        exc: type = FaultInjected,
+        probability: float = 1.0,
+    ) -> "FaultRegistry":
+        return self._add(
+            point, _Schedule("fail", n, exc=exc, probability=probability)
+        )
+
+    def crash(
+        self, point: str, n: int = 1, probability: float = 1.0
+    ) -> "FaultRegistry":
+        return self._add(
+            point, _Schedule("crash", n, probability=probability)
+        )
+
+    def delay(
+        self, point: str, seconds: float, n: int = 1, probability: float = 1.0
+    ) -> "FaultRegistry":
+        return self._add(
+            point,
+            _Schedule("delay", n, seconds=seconds, probability=probability),
+        )
+
+    def torn_write(
+        self, point: str, frac: float = 0.5, n: int = 1
+    ) -> "FaultRegistry":
+        return self._add(point, _Schedule("torn", n, frac=frac))
+
+    def drop(
+        self, point: str, n: int = 1, probability: float = 1.0
+    ) -> "FaultRegistry":
+        return self._add(point, _Schedule("drop", n, probability=probability))
+
+    def corrupt(
+        self, point: str, n: int = 1, probability: float = 1.0
+    ) -> "FaultRegistry":
+        return self._add(
+            point, _Schedule("corrupt", n, probability=probability)
+        )
+
+    def pending(self) -> Dict[str, int]:
+        """Point → fires still scheduled (0 once a bounded plan drained;
+        the chaos suite's bounded-quiesce precondition)."""
+        with self._lock:
+            return {
+                point: sum(
+                    s.remaining for s in scheds if s.remaining > 0
+                )
+                for point, scheds in self._schedules.items()
+            }
+
+    # -- the hot-path side ------------------------------------------------
+
+    def fire(self, point: str, **ctx):
+        delay_s = 0.0
+        action = None
+        exc: Optional[BaseException] = None
+        with self._lock:
+            for sched in self._schedules.get(point, ()):
+                if sched.remaining == 0:
+                    continue
+                if (
+                    sched.probability < 1.0
+                    and self._rng.random() >= sched.probability
+                ):
+                    continue
+                if sched.remaining > 0:
+                    sched.remaining -= 1
+                self.fired[point] = self.fired.get(point, 0) + 1
+                self.log.append((point, sched.mode))
+                if sched.mode == "delay":
+                    delay_s = sched.seconds
+                    continue  # latency composes with a later failure
+                if sched.mode == "fail":
+                    exc = sched.exc(f"injected fault at {point}")
+                elif sched.mode == "crash":
+                    exc = FaultCrash(f"injected crash at {point}")
+                elif sched.mode == "torn":
+                    action = TornWrite(sched.frac)
+                elif sched.mode == "drop":
+                    action = DROP
+                elif sched.mode == "corrupt":
+                    action = CORRUPT
+                break  # at most one non-delay schedule fires per call
+        if delay_s > 0.0:
+            time.sleep(delay_s)
+        if exc is not None:
+            raise exc
+        return action
+
+
+# -- module-level arming ----------------------------------------------------
+
+_registry: Optional[FaultRegistry] = None
+
+
+def arm(registry: FaultRegistry) -> FaultRegistry:
+    global _registry
+    _registry = registry
+    return registry
+
+
+def disarm() -> None:
+    global _registry
+    _registry = None
+
+
+@contextlib.contextmanager
+def armed(registry: FaultRegistry):
+    arm(registry)
+    try:
+        yield registry
+    finally:
+        disarm()
+
+
+def fire(point: str, **ctx):
+    """The hot-path entry: a single global load when disarmed."""
+    reg = _registry
+    if reg is None:
+        return None
+    return reg.fire(point, **ctx)

@@ -1,0 +1,205 @@
+"""Chaos suite: randomized seeded fault schedules over full cluster runs.
+
+Each seed derives a bounded fault plan covering EVERY registered fault
+point (testing/faults.py) — device-solve failures and score corruption,
+binder commit failures/crashes, wave-transaction faults, journal
+torn/failed appends and fsyncs, watch-queue drops, lease-renew failures,
+latency — then runs a live Scheduler (informers + hot loop + leader
+election + journal) through a pod burst and asserts the pipeline
+invariants:
+
+  * no pod lost: every pod ends bound within the bounded quiesce window
+    (faults are bounded, so the system must heal);
+  * bound exactly once: no pod is ever committed to two different nodes;
+  * resourceVersion stays strictly monotonic across every committed
+    event (per-object and wave paths both);
+  * the assume set drains to empty at quiesce (no phantom usage);
+  * the journal replays without error and is prefix-consistent with the
+    live store (a replayed binding never disagrees with the final one).
+
+Marked `chaos` (and `slow`): excluded from tier-1, run via `make chaos`
+or `python -m pytest -m chaos`.
+"""
+
+import random
+import threading
+import time
+from collections import defaultdict
+
+import pytest
+
+from kubernetes_tpu.api import store as st
+from kubernetes_tpu.client.leaderelection import LeaderElector
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.scheduler.config import SchedulerConfiguration
+from kubernetes_tpu.testing import faults
+from kubernetes_tpu.testing.wrappers import GI, make_node, make_pod
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+SEEDS = list(range(20))  # the fixed seed matrix (make chaos)
+
+
+def _fault_plan(rng: random.Random) -> faults.FaultRegistry:
+    """A bounded randomized schedule at every registered point: the
+    system must absorb all of it and still satisfy the invariants."""
+    reg = faults.FaultRegistry(seed=rng.randint(0, 2 ** 31))
+    reg.fail("batch.solve", n=rng.randint(1, 2))
+    if rng.random() < 0.5:
+        reg.corrupt("batch.solve", n=1)
+    reg.fail("binder.commit_wave", n=rng.randint(1, 2))
+    if rng.random() < 0.5:
+        reg.crash("binder.commit_wave", n=1)
+    reg.delay("binder.commit_wave", seconds=0.01, n=2)
+    reg.fail("store.update_wave", n=rng.randint(1, 2))
+    reg.fail("store.journal.append", n=rng.randint(1, 2), probability=0.5)
+    reg.torn_write("store.journal.append", frac=rng.random(), n=1)
+    reg.fail("store.journal.fsync", n=1)
+    reg.drop("watch.offer", n=rng.randint(1, 3), probability=0.5)
+    reg.fail("leader.renew", n=rng.randint(1, 2))
+    return reg
+
+
+class _EventAudit:
+    """Shims the store's two dispatch paths to audit every committed
+    event: rv monotonicity and per-pod bound-node history."""
+
+    def __init__(self, store: st.Store):
+        self.violations = []
+        self.bound_nodes = defaultdict(set)
+        self._last_rv = 0
+        self._lock = threading.Lock()
+        orig_dispatch = store._dispatch
+        orig_wave = store._dispatch_wave
+
+        def check(ev):
+            with self._lock:
+                if ev.rv <= self._last_rv:
+                    self.violations.append(
+                        f"rv {ev.rv} after {self._last_rv} not monotonic"
+                    )
+                self._last_rv = max(self._last_rv, ev.rv)
+                if ev.kind == "Pod" and ev.obj.spec.node_name:
+                    key = f"{ev.obj.meta.namespace}/{ev.obj.meta.name}"
+                    self.bound_nodes[key].add(ev.obj.spec.node_name)
+
+        def dispatch(ev):
+            check(ev)
+            orig_dispatch(ev)
+
+        def dispatch_wave(kind, events):
+            for ev in events:
+                check(ev)
+            orig_wave(kind, events)
+
+        store._dispatch = dispatch
+        store._dispatch_wave = dispatch_wave
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+@pytest.mark.parametrize("seed", SEEDS)
+def test_chaos_pipeline_invariants(seed, tmp_path):
+    rng = random.Random(seed)
+    reg = _fault_plan(rng)
+    path = str(tmp_path / "journal.jsonl")
+    store = st.Store(journal_path=path)
+    audit = _EventAudit(store)
+
+    n_nodes = rng.randint(4, 8)
+    for i in range(n_nodes):
+        store.create(
+            make_node(f"n{i}")
+            .capacity(cpu_milli=8000, mem=16 * GI, pods=110)
+            .zone(f"z{i % 3}")
+            .obj()
+        )
+    elector = LeaderElector(
+        store, "chaos-sched", f"holder-{seed}",
+        lease_duration=1.0, renew_period=0.05,
+    ).start()
+    config = SchedulerConfiguration(
+        pod_initial_backoff_seconds=0.05,
+        pod_max_backoff_seconds=0.4,
+        batch_window_seconds=0.01,
+        unschedulable_flush_seconds=0.5,
+    )
+    sched = Scheduler(
+        store, assume_ttl=1.0, leader_elector=elector, config=config
+    )
+    n_pods = rng.randint(20, 40)
+    try:
+        with faults.armed(reg):
+            sched.start()
+            assert elector.wait_for_leadership(10)
+            for i in range(n_pods):
+                spec = make_pod(f"p{i}").req(
+                    cpu_milli=rng.choice([50, 100, 200]),
+                    mem=rng.choice([GI // 4, GI // 2]),
+                )
+                if rng.random() < 0.2:
+                    spec = spec.label("app", f"g{i % 3}")
+                store.create(spec.obj())
+                if rng.random() < 0.3:
+                    time.sleep(rng.random() * 0.01)
+            # bounded quiesce: the plan is bounded, so the pipeline must
+            # heal and place every pod well inside the deadline
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                pods, _ = store.list("Pod")
+                if pods and all(p.spec.node_name for p in pods):
+                    break
+                time.sleep(0.1)
+
+        # -- invariants (faults disarmed; residual schedules drained) ----
+        assert reg.fired, f"seed {seed}: no fault ever fired"
+        pods, _ = store.list("Pod")
+        assert len(pods) == n_pods
+        unbound = [p.meta.name for p in pods if not p.spec.node_name]
+        assert not unbound, (
+            f"seed {seed}: pods lost/wedged past bounded quiesce: {unbound}\n"
+            f"  tiers: {({n: sched.queue._tier.get(f'default/{n}') for n in unbound})}\n"
+            f"  queue: {sched.queue.stats()}\n"
+            f"  assumed: {list(sched.cache._assumed)}\n"
+            f"  breaker: {sched.tpu.breaker.state} "
+            f"fallbacks={sched.tpu.breaker.fallbacks}\n"
+            f"  binder alive={sched._bind_thread.is_alive()} "
+            f"waves={len(sched._waves)} active={sched._wave_active}\n"
+            f"  sched alive={sched._thread.is_alive()} "
+            f"leader={elector.is_leader()}\n"
+            f"  fired={reg.fired} pending={reg.pending()}\n"
+            f"  watchers_terminated={store.watchers_terminated}"
+        )
+        assert not audit.violations, f"seed {seed}: {audit.violations[:5]}"
+        rebound = {
+            k: nodes for k, nodes in audit.bound_nodes.items()
+            if len(nodes) > 1
+        }
+        assert not rebound, f"seed {seed}: double binds {rebound}"
+        assert sched.flush_binds(15)
+        # assume set drains once the informer confirms every bind
+        deadline = time.monotonic() + 10
+        while sched.cache.assumed_count() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sched.cache.assumed_count() == 0, (
+            f"seed {seed}: assume set not empty at quiesce"
+        )
+    finally:
+        faults.disarm()
+        sched.stop()
+        elector.stop()
+
+    # -- journal: replays clean and prefix-consistent with the live store
+    live = {
+        f"{p.meta.namespace}/{p.meta.name}": p.spec.node_name
+        for p in store.list("Pod")[0]
+    }
+    replayed = st.Store(journal_path=path)  # must not raise
+    for p in replayed.list("Pod")[0]:
+        key = f"{p.meta.namespace}/{p.meta.name}"
+        assert key in live, f"seed {seed}: journal invented pod {key}"
+        assert p.spec.node_name in ("", live[key]), (
+            f"seed {seed}: journal binding {p.spec.node_name!r} "
+            f"contradicts live {live[key]!r} for {key}"
+        )

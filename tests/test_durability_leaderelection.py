@@ -179,6 +179,84 @@ def test_two_schedulers_fail_over():
         el_b.stop()
 
 
+def test_leader_renew_failure_steps_down_once_then_reacquires():
+    """Satellite: a failed renew must fire on_stopped_leading EXACTLY
+    once (step down), and the holder re-acquires on the next period once
+    renewal succeeds again — firing on_started_leading again."""
+    from kubernetes_tpu.testing import faults
+
+    store = st.Store()
+    started, stopped = [], []
+    a = LeaderElector(
+        store, "sched", "A", lease_duration=5.0, renew_period=0.05,
+        on_started_leading=lambda: started.append(time.monotonic()),
+        on_stopped_leading=lambda: stopped.append(time.monotonic()),
+    ).start()
+    try:
+        assert a.wait_for_leadership(5)
+        assert len(started) == 1 and not stopped
+        reg = faults.FaultRegistry().fail("leader.renew", n=1)
+        with faults.armed(reg):
+            deadline = time.monotonic() + 5
+            while not stopped and time.monotonic() < deadline:
+                time.sleep(0.01)
+        assert len(stopped) == 1, "step-down did not fire exactly once"
+        assert a.renew_errors == 1
+        # the lease is still ours in the store: the next healthy renew
+        # re-acquires and leadership resumes
+        assert a.wait_for_leadership(5), "never re-acquired after renew blip"
+        assert len(started) == 2
+        assert len(stopped) == 1  # no spurious extra step-downs
+    finally:
+        faults.disarm()
+        a.stop()
+
+
+def test_renew_failure_pauses_scheduler_dispatch_until_reacquired():
+    """Satellite: while stepped down the scheduler hot loop must not
+    dispatch; once the elector re-acquires, pending pods schedule."""
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing import faults
+    from kubernetes_tpu.testing.wrappers import GI as _GI
+
+    store = st.Store()
+    store.create(make_node("n0").capacity(cpu_milli=8000, mem=8 * _GI, pods=20).obj())
+    el = LeaderElector(store, "kube-scheduler", "A",
+                       lease_duration=5.0, renew_period=0.05).start()
+    sched = Scheduler(store, leader_elector=el)
+    sched.informers.informer("Node").start()
+    sched.informers.informer("Pod").start()
+    assert sched.informers.wait_for_sync(10)
+    sched._thread = __import__("threading").Thread(
+        target=sched._run, daemon=True
+    )
+    sched._thread.start()
+    try:
+        assert el.wait_for_leadership(5)
+        # renew fails persistently: the holder steps down and STAYS down
+        reg = faults.FaultRegistry().fail("leader.renew", n=-1)
+        with faults.armed(reg):
+            deadline = time.monotonic() + 5
+            while el.is_leader() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert not el.is_leader()
+            store.create(make_pod("paused").req(cpu_milli=100).obj())
+            time.sleep(0.4)  # several loop iterations while stepped down
+            assert not store.get("Pod", "paused").spec.node_name, (
+                "scheduler dispatched while not leading"
+            )
+        # faults disarmed: renewal recovers, dispatch resumes
+        assert el.wait_for_leadership(5)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not store.get("Pod", "paused").spec.node_name:
+            time.sleep(0.05)
+        assert store.get("Pod", "paused").spec.node_name == "n0"
+    finally:
+        faults.disarm()
+        sched.stop()
+        el.stop()
+
+
 def test_journal_tolerates_torn_tail(tmp_path):
     """A crash mid-append leaves a truncated last line; replay must stop
     at the last good record and keep working (review finding)."""
