@@ -5,7 +5,14 @@
 
 PY ?= python
 
-.PHONY: test chaos bench
+.PHONY: test chaos bench lint
+
+# graftlint: the project-native static analysis suite (guarded-by,
+# hot-path purity, registry drift, lock-order — docs/static_analysis.md).
+# Exits non-zero on any finding outside kubernetes_tpu/analysis/baseline.json
+# and on stale baseline entries.  Import-light: no JAX init.
+lint:
+	$(PY) -m kubernetes_tpu.analysis
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow and not chaos' \

@@ -1,0 +1,240 @@
+"""graftlint — project-native static analysis for the scheduler tree.
+
+Four passes enforce the conventions the solve→assume→bind pipeline's
+correctness rests on (docs/static_analysis.md):
+
+  guarded-by   fields declared guarded (``GUARDED_FIELDS`` class attr or
+               a ``# guarded_by: _lock`` comment in ``__init__``) may
+               only be touched inside ``with self.<lock>:`` or from a
+               method reviewed to run with the lock held / before the
+               object is shared (``LOCKED_METHODS``, ``_locked_*`` /
+               ``*_locked`` names, ``__init__``).
+  purity       functions reachable from ``@hot_path`` roots (the solve
+               kernels and the dispatch path) must not host-sync
+               (``jax.device_get`` / ``.block_until_ready()`` /
+               ``np.asarray`` / ``.item()``), leak tracers through
+               ``float()``/``int()``, read wall clocks, draw unseeded
+               randomness, or take locks.
+  registry     every ``faults.fire("p")`` site names a declared point in
+               testing/faults.py and vice versa; every metric the
+               scheduler Registry defines is exported by a
+               perf/collectors.py surface and vice versa.
+  lock-order   the static lock-acquisition graph (lock held → lock
+               acquired) must be acyclic.  The runtime half lives in
+               analysis/runtime.py.
+
+Escape hatch: ``# graftlint: disable=<check>[,<check>...]`` on the
+offending line (or on a ``def`` line to exempt a whole function from
+the purity walk).  Grandfathered findings live in ``baseline.json``
+next to this file; the CLI fails on findings outside the baseline AND
+on stale baseline entries, so the baseline can only shrink.
+
+This package is import-light on purpose (stdlib ``ast`` only): ``make
+lint`` must run without initializing JAX.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: every check id the suppression syntax accepts
+CHECK_IDS = ("guarded-by", "purity", "registry", "lock-order")
+
+# check ids after `disable=`, comma-separated; anything after the ids
+# (conventionally ` -- <justification>`) is free text
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([\w-]+(?:\s*,\s*[\w-]+)*)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    check: str     # one of CHECK_IDS
+    file: str      # path relative to the scanned root
+    line: int      # 1-based; informational only (baseline keys skip it)
+    symbol: str    # "Class.method", "function", or the drifted name
+    message: str
+
+    def key(self) -> Tuple[str, str, str, str]:
+        """Line-number-independent identity used by the baseline, so an
+        unrelated edit above a grandfathered finding doesn't un-baseline
+        it."""
+        return (self.check, self.file, self.symbol, self.message)
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.check}] {self.symbol}: {self.message}"
+
+
+class SourceFile:
+    """One parsed module: AST + per-line suppression sets."""
+
+    def __init__(self, path: str, relpath: str, text: str):
+        self.path = path
+        self.relpath = relpath
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=relpath)
+        # 1-based line -> set of suppressed check ids ("all" wildcards)
+        self.suppress: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                self.suppress[i] = {
+                    c.strip() for c in m.group(1).split(",") if c.strip()
+                }
+
+    def suppressed(self, line: int, check: str) -> bool:
+        s = self.suppress.get(line)
+        return s is not None and (check in s or "all" in s)
+
+    # module name relative to the scan root, e.g. "kubernetes_tpu.ops.assign"
+    @property
+    def module(self) -> str:
+        mod = self.relpath[:-3] if self.relpath.endswith(".py") else self.relpath
+        mod = mod.replace(os.sep, ".")
+        if mod.endswith(".__init__"):
+            mod = mod[: -len(".__init__")]
+        return mod
+
+
+def load_sources(
+    root: str, subdirs: Optional[Sequence[str]] = None
+) -> List[SourceFile]:
+    """Parse every .py file under root (or root/<subdir> for each given
+    subdir).  Unparseable files are skipped — the interpreter and tier-1
+    tests own syntax errors; graftlint owns semantics."""
+    out: List[SourceFile] = []
+    bases = [os.path.join(root, s) for s in subdirs] if subdirs else [root]
+    for base in bases:
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [
+                d for d in dirnames if d != "__pycache__" and not d.startswith(".")
+            ]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, root)
+                try:
+                    with open(path, "r", encoding="utf-8") as f:
+                        text = f.read()
+                    out.append(SourceFile(path, rel, text))
+                except (SyntaxError, UnicodeDecodeError, OSError):
+                    continue
+    return out
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for nested Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def str_constants(node: ast.AST) -> List[str]:
+    """Every string literal inside a set/tuple/list/dict-key literal."""
+    out: List[str] = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            out.append(sub.value)
+    return out
+
+
+# -- runner ------------------------------------------------------------------
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, list):
+        raise ValueError(f"baseline {path}: expected a JSON list")
+    return data
+
+
+def save_baseline(path: str, findings: Iterable[Finding]) -> None:
+    data = [
+        {
+            "check": f.check,
+            "file": f.file,
+            "symbol": f.symbol,
+            "message": f.message,
+        }
+        for f in findings
+    ]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: Sequence[dict]
+) -> Tuple[List[Finding], List[dict]]:
+    """(new findings, stale baseline entries).  A baseline entry matches
+    at most once, so duplicated findings surface past a single
+    grandfathered instance."""
+    pool: Dict[Tuple[str, str, str, str], int] = {}
+    for entry in baseline:
+        key = (
+            entry.get("check", ""),
+            entry.get("file", ""),
+            entry.get("symbol", ""),
+            entry.get("message", ""),
+        )
+        pool[key] = pool.get(key, 0) + 1
+    new: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        if pool.get(k, 0) > 0:
+            pool[k] -= 1
+        else:
+            new.append(f)
+    stale = []
+    for entry in baseline:
+        key = (
+            entry.get("check", ""),
+            entry.get("file", ""),
+            entry.get("symbol", ""),
+            entry.get("message", ""),
+        )
+        if pool.get(key, 0) > 0:
+            pool[key] -= 1
+            stale.append(entry)
+    return new, stale
+
+
+def run_all(
+    root: str,
+    checks: Optional[Sequence[str]] = None,
+    package: str = "kubernetes_tpu",
+) -> List[Finding]:
+    """Run the selected passes (default: all four) over root/<package>."""
+    from . import guarded, lockorder, purity, registry
+
+    files = load_sources(root, [package])
+    selected = set(checks or CHECK_IDS)
+    findings: List[Finding] = []
+    if "guarded-by" in selected:
+        findings.extend(guarded.check(files))
+    if "purity" in selected:
+        findings.extend(purity.check(files))
+    if "registry" in selected:
+        findings.extend(registry.check(files))
+    if "lock-order" in selected:
+        findings.extend(lockorder.check(files))
+    findings.sort(key=lambda f: (f.file, f.line, f.check, f.message))
+    return findings

@@ -1,0 +1,95 @@
+"""graftlint CLI: ``python -m kubernetes_tpu.analysis`` (or ``make lint``).
+
+Runs the four static passes over the repository's ``kubernetes_tpu``
+tree, subtracts the reviewed baseline, and exits non-zero on any new
+finding OR any stale baseline entry (the baseline only shrinks).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import (
+    CHECK_IDS,
+    apply_baseline,
+    default_baseline_path,
+    load_baseline,
+    run_all,
+    save_baseline,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kubernetes_tpu.analysis",
+        description="graftlint: project-native static analysis",
+    )
+    parser.add_argument(
+        "root",
+        nargs="?",
+        default=None,
+        help="repository root (default: the directory containing the "
+        "kubernetes_tpu package)",
+    )
+    parser.add_argument(
+        "--checks",
+        default=",".join(CHECK_IDS),
+        help=f"comma-separated subset of {', '.join(CHECK_IDS)}",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline file (default: kubernetes_tpu/analysis/baseline.json)",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="rewrite the baseline to exactly the current findings "
+        "(requires review: every entry must be justified)",
+    )
+    args = parser.parse_args(argv)
+
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    checks = [c.strip() for c in args.checks.split(",") if c.strip()]
+    unknown = [c for c in checks if c not in CHECK_IDS]
+    if unknown:
+        print(f"unknown checks: {', '.join(unknown)}", file=sys.stderr)
+        return 2
+
+    findings = run_all(root, checks=checks)
+    baseline_path = args.baseline or default_baseline_path()
+
+    if args.write_baseline:
+        save_baseline(baseline_path, findings)
+        print(
+            f"graftlint: wrote {len(findings)} baseline entries to "
+            f"{baseline_path}"
+        )
+        return 0
+
+    baseline = load_baseline(baseline_path)
+    new, stale = apply_baseline(findings, baseline)
+
+    for f in new:
+        print(f.render())
+    for entry in stale:
+        print(
+            f"stale baseline entry (finding no longer occurs — remove it): "
+            f"{entry}",
+        )
+    n_grandfathered = len(findings) - len(new)
+    summary = (
+        f"graftlint: {len(new)} finding(s), {n_grandfathered} grandfathered, "
+        f"{len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'} "
+        f"across {len(checks)} check(s)"
+    )
+    print(summary)
+    return 1 if new or stale else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,165 @@
+"""guarded-by: lock-discipline enforcement for annotated shared fields.
+
+A class declares its lock-guarded state either way:
+
+    class Store:
+        GUARDED_FIELDS = {"_objects": "_lock", "_rv": "_lock"}
+        LOCKED_METHODS = frozenset({"_dispatch"})  # caller holds the lock
+
+or inline in ``__init__``::
+
+        self._assumed = {}   # guarded_by: _lock
+
+``GUARDED_FIELDS`` may also be a plain set/tuple of names (the lock
+defaults to ``_lock``).  Every ``self.<field>`` read or write must then
+sit lexically inside ``with self.<lock>:`` — closures defined inside
+the block inherit it (the queue's pop helpers) — or live in an exempt
+method:
+
+  * ``__init__`` / ``__del__`` (the object is not shared yet / anymore);
+  * names matching ``_locked_*`` or ``*_locked`` (the project's
+    caller-holds-the-lock convention);
+  * names listed in ``LOCKED_METHODS`` (reviewed: caller holds the lock,
+    or the method runs in a single-threaded phase such as construction
+    or registration-before-arming).
+
+``# graftlint: disable=guarded-by`` on the access line suppresses one
+finding (say why — usually a double-checked-locking fast path).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, List, Set
+
+from . import Finding, SourceFile, str_constants
+
+CHECK = "guarded-by"
+
+_INLINE_RE = re.compile(
+    r"self\.(\w+)\s*(?::[^=]+)?=.*#\s*guarded_by:\s*(\w+)"
+)
+
+_EXEMPT_NAMES = {"__init__", "__del__", "__post_init__"}
+
+
+def _class_decls(
+    src: SourceFile, cls: ast.ClassDef
+) -> tuple[Dict[str, str], Set[str]]:
+    """(field -> lock, exempt method names) for one class."""
+    guarded: Dict[str, str] = {}
+    locked_methods: Set[str] = set()
+    for stmt in cls.body:
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+            continue
+        tgt = stmt.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        if tgt.id == "GUARDED_FIELDS":
+            if isinstance(stmt.value, ast.Dict):
+                for k, v in zip(stmt.value.keys, stmt.value.values):
+                    if (
+                        isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)
+                    ):
+                        guarded[k.value] = v.value
+            else:
+                for name in str_constants(stmt.value):
+                    guarded[name] = "_lock"
+        elif tgt.id == "LOCKED_METHODS":
+            locked_methods.update(str_constants(stmt.value))
+    # inline `# guarded_by: <lock>` comments anywhere in the class span
+    end = getattr(cls, "end_lineno", None) or cls.lineno
+    for lineno in range(cls.lineno, end + 1):
+        if lineno - 1 < len(src.lines):
+            m = _INLINE_RE.search(src.lines[lineno - 1])
+            if m:
+                guarded.setdefault(m.group(1), m.group(2))
+    return guarded, locked_methods
+
+
+def _method_exempt(name: str, locked_methods: Set[str]) -> bool:
+    return (
+        name in _EXEMPT_NAMES
+        or name.startswith("_locked_")
+        or name.endswith("_locked")
+        or name in locked_methods
+    )
+
+
+def _with_locks(node: ast.With) -> Set[str]:
+    """Lock attr names acquired by `with self.<attr>[, ...]:`."""
+    out: Set[str] = set()
+    for item in node.items:
+        ctx = item.context_expr
+        if (
+            isinstance(ctx, ast.Attribute)
+            and isinstance(ctx.value, ast.Name)
+            and ctx.value.id == "self"
+        ):
+            out.add(ctx.attr)
+    return out
+
+
+def _check_method(
+    src: SourceFile,
+    cls_name: str,
+    fn: ast.FunctionDef,
+    guarded: Dict[str, str],
+    findings: List[Finding],
+) -> None:
+    symbol = f"{cls_name}.{fn.name}"
+
+    def visit(node: ast.AST, held: FrozenSet[str]) -> None:
+        if isinstance(node, ast.With):
+            held = held | _with_locks(node)
+            for item in node.items:
+                visit(item.context_expr, held)
+            for stmt in node.body:
+                visit(stmt, held)
+            return
+        if isinstance(node, ast.Attribute):
+            if (
+                isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr in guarded
+            ):
+                lock = guarded[node.attr]
+                if lock not in held and not src.suppressed(node.lineno, CHECK):
+                    findings.append(
+                        Finding(
+                            CHECK,
+                            src.relpath,
+                            node.lineno,
+                            symbol,
+                            f"field '{node.attr}' accessed outside "
+                            f"'with self.{lock}'",
+                        )
+                    )
+        # nested defs/lambdas inherit the lexical lock context: closures
+        # defined under `with self._lock:` run with it held
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in fn.body:
+        visit(stmt, frozenset())
+
+
+def check(files: List[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in files:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            guarded, locked_methods = _class_decls(src, node)
+            if not guarded:
+                continue
+            for stmt in node.body:
+                if isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and not _method_exempt(stmt.name, locked_methods):
+                    _check_method(src, node.name, stmt, guarded, findings)
+    return findings

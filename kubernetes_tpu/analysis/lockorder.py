@@ -1,0 +1,266 @@
+"""lock-order: the static lock-acquisition graph must be acyclic.
+
+A lock identity is ``Class.attr`` for every ``self.<attr> =
+threading.Lock()/RLock()/Condition()`` assignment found in the tree.
+For every function the pass records which locks it acquires directly
+(``with self.<attr>:``) and which calls it makes while holding one;
+call edges resolve conservatively (same class via ``self.``, imported
+names, module aliases, and otherwise only method names defined exactly
+once across the tree — ambiguous names are skipped rather than
+over-approximated into false cycles).  A fixpoint propagates the
+"eventually acquires" set through the call graph, then every held →
+acquired pair becomes an edge and cycles are reported.
+
+Self-edges (re-acquiring the lock you hold) are ignored: the project's
+shared locks are RLock/Condition and reentrancy is an explicit design
+choice (admission under the store lock).
+
+``# graftlint: disable=lock-order`` on a ``with`` or call line drops
+that acquisition/edge from the graph.
+
+The runtime complement (analysis/runtime.py) records ACTUAL acquisition
+edges under pytest and fails on inversion — the static pass proves the
+absence of cycles the resolver can see; the tracker catches the ones it
+cannot.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from . import Finding, SourceFile, dotted_name
+
+CHECK = "lock-order"
+
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+}
+
+
+class _Fn:
+    def __init__(self, src: SourceFile, module: str, cls: Optional[str],
+                 node: ast.FunctionDef):
+        self.src = src
+        self.module = module
+        self.cls = cls
+        self.node = node
+        self.qual = (
+            f"{module}:{cls}.{node.name}" if cls else f"{module}:{node.name}"
+        )
+        self.acquires: Set[str] = set()          # locks taken anywhere in fn
+        # (held lock, acquired lock, line) for direct nesting
+        self.direct_edges: Set[Tuple[str, str, int]] = set()
+        # (held locks, callee qual, line) for calls made under a lock,
+        # plus lock-free calls (held == frozenset()) for ACQ propagation
+        self.calls: List[Tuple[FrozenSet[str], str, int]] = []
+
+
+def _lock_attrs(files: List[SourceFile]) -> Dict[str, Set[str]]:
+    """class name -> lock attribute names (from self.<x> = threading.*())."""
+    out: Dict[str, Set[str]] = {}
+    for src in files:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            attrs: Set[str] = set()
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Assign)
+                    and isinstance(sub.value, ast.Call)
+                    and dotted_name(sub.value.func) in _LOCK_CTORS
+                ):
+                    for tgt in sub.targets:
+                        if (
+                            isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                        ):
+                            attrs.add(tgt.attr)
+            if attrs:
+                out.setdefault(node.name, set()).update(attrs)
+    return out
+
+
+def _collect(files: List[SourceFile]) -> Dict[str, _Fn]:
+    table: Dict[str, _Fn] = {}
+    for src in files:
+        mod = src.module
+        for node in src.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = _Fn(src, mod, None, node)
+                table[fn.qual] = fn
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        fn = _Fn(src, mod, node.name, sub)
+                        table[fn.qual] = fn
+    return table
+
+
+def _import_maps(src: SourceFile) -> Tuple[Dict[str, str], Dict[str, str]]:
+    from .purity import _import_maps as impl
+
+    return impl(src)
+
+
+def _analyze(
+    fn: _Fn,
+    table: Dict[str, _Fn],
+    by_name: Dict[str, List[str]],
+    locks_by_class: Dict[str, Set[str]],
+    name_map: Dict[str, str],
+    alias_map: Dict[str, str],
+) -> None:
+    src = fn.src
+    own_locks = locks_by_class.get(fn.cls or "", set())
+
+    def resolve(call: ast.Call) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            target = name_map.get(f.id)
+            if target is not None:
+                m, _, sym = target.rpartition(".")
+                qual = f"{m}:{sym}"
+                if qual in table:
+                    return qual
+            qual = f"{fn.module}:{f.id}"
+            return qual if qual in table else None
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name):
+                if f.value.id == "self" and fn.cls:
+                    qual = f"{fn.module}:{fn.cls}.{f.attr}"
+                    if qual in table:
+                        return qual
+                target_mod = alias_map.get(f.value.id)
+                if target_mod is not None:
+                    qual = f"{target_mod}:{f.attr}"
+                    if qual in table:
+                        return qual
+            cands = by_name.get(f.attr, [])
+            if len(cands) == 1:
+                return cands[0]
+        return None
+
+    def visit(node: ast.AST, held: FrozenSet[str]) -> None:
+        if isinstance(node, ast.With):
+            acquired: Set[str] = set()
+            for item in node.items:
+                ctx = item.context_expr
+                if (
+                    isinstance(ctx, ast.Attribute)
+                    and isinstance(ctx.value, ast.Name)
+                    and ctx.value.id == "self"
+                    and ctx.attr in own_locks
+                    and not src.suppressed(node.lineno, CHECK)
+                ):
+                    lock = f"{fn.cls}.{ctx.attr}"
+                    acquired.add(lock)
+                    fn.acquires.add(lock)
+                    for h in held:
+                        if h != lock:
+                            fn.direct_edges.add((h, lock, node.lineno))
+                visit(item.context_expr, held)
+            held = held | acquired
+            for stmt in node.body:
+                visit(stmt, held)
+            return
+        if isinstance(node, ast.Call):
+            callee = resolve(node)
+            if callee is not None and not src.suppressed(node.lineno, CHECK):
+                fn.calls.append((held, callee, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in fn.node.body:
+        visit(stmt, frozenset())
+
+
+def build_graph(
+    files: List[SourceFile],
+) -> Tuple[Dict[str, Set[str]], Dict[Tuple[str, str], Tuple[str, int, str]]]:
+    """(adjacency, edge -> one (file, line, function) witness site)."""
+    locks_by_class = _lock_attrs(files)
+    table = _collect(files)
+    by_name: Dict[str, List[str]] = {}
+    for qual, fn in table.items():
+        by_name.setdefault(fn.node.name, []).append(qual)
+    maps_cache: Dict[str, Tuple[Dict[str, str], Dict[str, str]]] = {}
+    for fn in table.values():
+        if fn.src.relpath not in maps_cache:
+            maps_cache[fn.src.relpath] = _import_maps(fn.src)
+        name_map, alias_map = maps_cache[fn.src.relpath]
+        _analyze(fn, table, by_name, locks_by_class, name_map, alias_map)
+
+    # fixpoint: ACQ(fn) = direct ∪ ⋃ ACQ(callee)
+    acq: Dict[str, Set[str]] = {q: set(f.acquires) for q, f in table.items()}
+    changed = True
+    while changed:
+        changed = False
+        for qual, fn in table.items():
+            cur = acq[qual]
+            before = len(cur)
+            for _, callee, _ in fn.calls:
+                cur |= acq.get(callee, set())
+            if len(cur) != before:
+                changed = True
+
+    adj: Dict[str, Set[str]] = {}
+    sites: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    for qual, fn in table.items():
+        for a, b, line in fn.direct_edges:
+            adj.setdefault(a, set()).add(b)
+            sites.setdefault((a, b), (fn.src.relpath, line, qual))
+        for held, callee, line in fn.calls:
+            if not held:
+                continue
+            for b in acq.get(callee, ()):  # transitive acquisitions
+                for a in held:
+                    if a != b:
+                        adj.setdefault(a, set()).add(b)
+                        sites.setdefault(
+                            (a, b), (fn.src.relpath, line, qual)
+                        )
+    return adj, sites
+
+
+def _find_cycles(adj: Dict[str, Set[str]]) -> List[List[str]]:
+    """Elementary cycles via DFS; each cycle reported once (canonical
+    rotation)."""
+    cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str], seen: Set[str]) -> None:
+        for nxt in sorted(adj.get(node, ())):
+            if nxt == start:
+                cyc = path[:]
+                pivot = cyc.index(min(cyc))
+                cycles.add(tuple(cyc[pivot:] + cyc[:pivot]))
+            elif nxt not in seen and nxt > start:
+                # only explore nodes >= start: each cycle found from its
+                # smallest node, bounding the search
+                dfs(start, nxt, path + [nxt], seen | {nxt})
+
+    for node in sorted(adj):
+        dfs(node, node, [node], {node})
+    return [list(c) for c in sorted(cycles)]
+
+
+def check(files: List[SourceFile]) -> List[Finding]:
+    adj, sites = build_graph(files)
+    findings: List[Finding] = []
+    for cycle in _find_cycles(adj):
+        edges = list(zip(cycle, cycle[1:] + cycle[:1]))
+        where = sites.get(edges[0], ("<unknown>", 1, "?"))
+        detail = "; ".join(
+            f"{a}->{b} at {sites.get((a, b), ('?', 0, '?'))[0]}:"
+            f"{sites.get((a, b), ('?', 0, '?'))[1]}"
+            for a, b in edges
+        )
+        findings.append(
+            Finding(
+                CHECK, where[0], where[1],
+                " -> ".join(cycle + [cycle[0]]),
+                f"lock-order cycle: {detail}",
+            )
+        )
+    return findings

@@ -1,0 +1,19 @@
+"""Markers the static analysis passes key off.
+
+Import-light on purpose: ops/ modules tag their solve roots with
+``@hot_path`` and must not drag anything beyond the stdlib in when they
+do.  The purity pass (analysis/purity.py) matches the decorator by
+NAME (``hot_path``, ``markers.hot_path``, ...), so the runtime effect
+here is only an attribute for introspection/tests.
+"""
+
+from __future__ import annotations
+
+
+def hot_path(fn):
+    """Mark a function as a hot-path root: everything statically
+    reachable from it must stay free of host syncs, tracer leaks, wall
+    clocks, unseeded randomness, and locks (the purity pass walks the
+    call graph from these roots)."""
+    fn.__graftlint_hot_path__ = True
+    return fn

@@ -1,0 +1,278 @@
+"""purity: hot-path functions must not host-sync, leak tracers, read
+wall clocks, draw unseeded randomness, or take locks.
+
+Roots are functions carrying the ``@hot_path`` decorator
+(analysis/markers.py) — the solve kernels in ops/ and the dispatch path
+in models/.  A call-graph walk over the ops/, models/ and parallel/
+packages marks everything statically reachable from a root, then flags:
+
+  * ``jax.device_get`` and ``.block_until_ready()`` / ``.item()`` calls
+    (explicit host syncs);
+  * ``np.asarray`` / ``np.array`` on the hot path (an implicit
+    blocking device→host readback when handed a device array);
+  * ``float(x)`` / ``int(x)`` where ``x`` contains a call or subscript —
+    the tracer-leak shape (``float(scores[i])`` blocks; ``float(cfg_x)``
+    on a plain name is config coercion and is allowed);
+  * ``time.time()`` / ``time.monotonic()`` (wall clocks: hot-path code
+    must be replayable and trace-stable);
+  * module-level ``random.*`` draws (unseeded; seeded ``Random(seed)``
+    instances and ``jax.random`` are fine);
+  * lock acquisition: ``with <x>._lock/._mu/._cond`` or ``.acquire()``.
+
+Call-edge resolution is deliberately conservative: same-module
+functions, ``from x import y`` names, module-alias attributes,
+``self.method`` within a class, and otherwise only attribute names that
+are defined exactly once across the analyzed packages.  Unresolvable
+calls are ignored (jit closures, stdlib).
+
+``# graftlint: disable=purity`` on a ``def`` line exempts that function
+entirely (host-side prep helpers that must never run under jit document
+themselves this way); on a call or access line it suppresses that one
+site and cuts the call edge.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Finding, SourceFile, dotted_name
+
+CHECK = "purity"
+
+#: packages (relative to the scanned package root) the call graph spans
+DEFAULT_SCOPE = ("ops", "models", "parallel")
+
+_HOST_SYNC_ATTRS = {"block_until_ready", "item"}
+_NUMPY_ALIASES = {"np", "numpy"}
+_RANDOM_FNS = {
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "uniform", "gauss", "sample", "betavariate", "normalvariate",
+}
+_LOCK_ATTRS = {"_lock", "_mu", "_cond"}
+_WALL_CLOCKS = {"time.time", "time.monotonic"}
+
+
+class FuncInfo:
+    def __init__(self, src: SourceFile, module: str, cls: Optional[str],
+                 node: ast.FunctionDef):
+        self.src = src
+        self.module = module
+        self.cls = cls
+        self.node = node
+        self.qual = (
+            f"{module}:{cls}.{node.name}" if cls else f"{module}:{node.name}"
+        )
+        self.is_root = False
+        self.exempt = src.suppressed(node.lineno, CHECK)
+        self.calls: List[Tuple[int, str]] = []       # (line, callee qual)
+        self.violations: List[Tuple[int, str]] = []  # (line, message)
+
+
+def _in_scope(relpath: str, package: str, scope: Tuple[str, ...]) -> bool:
+    parts = relpath.replace("\\", "/").split("/")
+    return len(parts) >= 2 and parts[0] == package and parts[1] in scope
+
+
+def _import_maps(src: SourceFile) -> Tuple[Dict[str, str], Dict[str, str]]:
+    """(name -> defining module, alias -> module) from this module's
+    imports, with relative imports resolved against the module path."""
+    name_map: Dict[str, str] = {}
+    alias_map: Dict[str, str] = {}
+    mod_parts = src.module.split(".")
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                alias_map[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base = mod_parts[: len(mod_parts) - node.level]
+            else:
+                base = []
+            target = ".".join(base + (node.module or "").split("."))
+            target = target.strip(".")
+            for a in node.names:
+                bound = a.asname or a.name
+                # could be a symbol OR a submodule; record both guesses
+                name_map[bound] = f"{target}.{a.name}" if target else a.name
+                alias_map.setdefault(
+                    bound, f"{target}.{a.name}" if target else a.name
+                )
+    return name_map, alias_map
+
+
+def _is_hot_path_decorator(dec: ast.AST) -> bool:
+    name = dotted_name(dec)
+    return name is not None and name.split(".")[-1] == "hot_path"
+
+
+def _collect_functions(
+    files: List[SourceFile], package: str, scope: Tuple[str, ...]
+) -> Dict[str, FuncInfo]:
+    table: Dict[str, FuncInfo] = {}
+    for src in files:
+        if not _in_scope(src.relpath, package, scope):
+            continue
+        mod = src.module
+        for node in src.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FuncInfo(src, mod, None, node)
+                table[fi.qual] = fi
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        fi = FuncInfo(src, mod, node.name, sub)
+                        table[fi.qual] = fi
+    for fi in table.values():
+        fi.is_root = any(
+            _is_hot_path_decorator(d) for d in fi.node.decorator_list
+        )
+    return table
+
+
+def _analyze_function(
+    fi: FuncInfo,
+    table: Dict[str, FuncInfo],
+    by_name: Dict[str, List[str]],
+    name_map: Dict[str, str],
+    alias_map: Dict[str, str],
+) -> None:
+    src, mod = fi.src, fi.module
+
+    def resolve(call: ast.Call) -> Optional[str]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            # imported symbol, else same-module function
+            target = name_map.get(fn.id)
+            if target is not None:
+                # target is "pkg.mod.sym"
+                m, _, sym = target.rpartition(".")
+                qual = f"{m}:{sym}"
+                if qual in table:
+                    return qual
+            qual = f"{mod}:{fn.id}"
+            if qual in table:
+                return qual
+            return None
+        if isinstance(fn, ast.Attribute):
+            if isinstance(fn.value, ast.Name):
+                if fn.value.id == "self" and fi.cls:
+                    qual = f"{mod}:{fi.cls}.{fn.attr}"
+                    if qual in table:
+                        return qual
+                target_mod = alias_map.get(fn.value.id)
+                if target_mod is not None:
+                    qual = f"{target_mod}:{fn.attr}"
+                    if qual in table:
+                        return qual
+            cands = by_name.get(fn.attr, [])
+            if len(cands) == 1:
+                return cands[0]
+        return None
+
+    def flag(line: int, message: str) -> None:
+        if not src.suppressed(line, CHECK):
+            fi.violations.append((line, message))
+
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                ctx = item.context_expr
+                if isinstance(ctx, ast.Attribute) and ctx.attr in _LOCK_ATTRS:
+                    flag(node.lineno, f"takes lock '.{ctx.attr}'")
+        if not isinstance(node, ast.Call):
+            continue
+        line = node.lineno
+        name = dotted_name(node.func)
+        if name == "jax.device_get":
+            flag(line, "jax.device_get (host sync)")
+        elif name in _WALL_CLOCKS:
+            flag(line, f"{name}() (wall clock on the hot path)")
+        elif name is not None and name.split(".")[0] in _NUMPY_ALIASES and (
+            name.split(".")[-1] in ("asarray", "array")
+        ):
+            flag(line, f"{name} (implicit device→host readback)")
+        elif (
+            name is not None
+            and name.startswith("random.")
+            and name.split(".")[-1] in _RANDOM_FNS
+        ):
+            flag(line, f"{name} (unseeded randomness)")
+        elif isinstance(node.func, ast.Attribute):
+            if node.func.attr in _HOST_SYNC_ATTRS:
+                flag(line, f".{node.func.attr}() (host sync)")
+            elif node.func.attr == "acquire":
+                flag(line, ".acquire() (lock on the hot path)")
+        elif isinstance(node.func, ast.Name) and node.func.id in ("float", "int"):
+            if len(node.args) == 1 and any(
+                isinstance(sub, (ast.Call, ast.Subscript))
+                for sub in ast.walk(node.args[0])
+            ):
+                flag(
+                    line,
+                    f"{node.func.id}() on a computed value (tracer leak / "
+                    "host sync)",
+                )
+        callee = resolve(node)
+        if callee is not None and not src.suppressed(line, CHECK):
+            fi.calls.append((line, callee))
+
+
+def check(
+    files: List[SourceFile],
+    package: str = "kubernetes_tpu",
+    scope: Tuple[str, ...] = DEFAULT_SCOPE,
+) -> List[Finding]:
+    table = _collect_functions(files, package, scope)
+    by_name: Dict[str, List[str]] = {}
+    for qual, fi in table.items():
+        by_name.setdefault(fi.node.name, []).append(qual)
+    maps_cache: Dict[str, Tuple[Dict[str, str], Dict[str, str]]] = {}
+    for fi in table.values():
+        if fi.exempt:
+            continue
+        if fi.src.relpath not in maps_cache:
+            maps_cache[fi.src.relpath] = _import_maps(fi.src)
+        name_map, alias_map = maps_cache[fi.src.relpath]
+        _analyze_function(fi, table, by_name, name_map, alias_map)
+
+    # BFS from the @hot_path roots; remember one witness path for messages
+    reachable: Dict[str, str] = {}  # qual -> root qual
+    parent: Dict[str, str] = {}
+    q: deque = deque()
+    for qual, fi in table.items():
+        if fi.is_root and not fi.exempt:
+            reachable[qual] = qual
+            q.append(qual)
+    while q:
+        cur = q.popleft()
+        for _, callee in table[cur].calls:
+            if callee in reachable or table[callee].exempt:
+                continue
+            reachable[callee] = reachable[cur]
+            parent[callee] = cur
+            q.append(callee)
+
+    findings: List[Finding] = []
+    for qual in sorted(reachable):
+        fi = table[qual]
+        root = reachable[qual]
+        for line, message in fi.violations:
+            via = ""
+            if root != qual:
+                chain: List[str] = []
+                cur = qual
+                while cur != root and cur in parent:
+                    cur = parent[cur]
+                    chain.append(cur.split(":")[-1])
+                via = f" (reached from @hot_path root '{root.split(':')[-1]}'" + (
+                    f" via {' -> '.join(reversed(chain))})" if chain else ")"
+                )
+            findings.append(
+                Finding(
+                    CHECK, fi.src.relpath, line,
+                    qual.split(":")[-1], message + via,
+                )
+            )
+    return findings

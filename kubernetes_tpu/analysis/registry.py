@@ -1,0 +1,204 @@
+"""registry: stringly-typed registries must not drift.
+
+Two surfaces are reconciled:
+
+fault points — every ``faults.fire("<point>")`` site in the tree must
+name a point declared in ``testing/faults.py`` KNOWN_POINTS, and every
+declared point must have at least one fire site (a dead registration is
+a chaos schedule that can never fire — a test that silently asserts
+nothing).  Fire sites must use string literals so the reconciliation
+stays static.
+
+metrics — every name exported by perf/collectors.py
+(``DEFAULT_METRICS`` ms-scaled histograms, ``COUNT_METRICS`` raw-count
+histograms, ``SCALAR_METRICS`` counters/gauges) must exist in the
+scheduler metrics ``Registry``, and every metric the Registry
+constructs must be exported through exactly those surfaces —
+``HistogramVec`` families excepted (their children are dynamic labeled
+names).  A metric that is deliberately internal carries
+``# graftlint: disable=registry`` on its construction line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Finding, SourceFile, dotted_name, str_constants
+
+CHECK = "registry"
+
+FAULTS_FILE = "testing/faults.py"
+METRICS_FILE = "scheduler/metrics.py"
+COLLECTORS_FILE = "perf/collectors.py"
+
+_EXPORT_TUPLES = ("DEFAULT_METRICS", "COUNT_METRICS", "SCALAR_METRICS")
+_METRIC_CTORS = {"Histogram", "Counter", "Gauge"}
+_METRIC_FAMILIES = {"HistogramVec"}  # dynamic children: exempt from export
+
+
+def _endswith(src: SourceFile, suffix: str) -> bool:
+    return src.relpath.replace("\\", "/").endswith(suffix)
+
+
+def _declared_points(src: SourceFile) -> Tuple[Set[str], int]:
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "KNOWN_POINTS"
+            for t in node.targets
+        ):
+            return set(str_constants(node.value)), node.lineno
+    return set(), 1
+
+
+def _fire_sites(src: SourceFile) -> List[Tuple[str, int]]:
+    """(point, line) for every faults.fire()/fire() call with a literal
+    first argument; non-literal args come back as ("<dynamic>", line)."""
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name is None:
+            continue
+        last = name.split(".")[-1]
+        if last != "fire":
+            continue
+        # `fire(...)` bare or `<alias>.fire(...)` where the alias looks
+        # like the faults module; anything else named .fire is skipped
+        if "." in name and not name.split(".")[-2].endswith("faults"):
+            # e.g. registry.fire inside faults.py itself, or most_recent_fire
+            if name.split(".")[-2] not in ("faults",):
+                continue
+        if not node.args:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.append((arg.value, node.lineno))
+        else:
+            out.append(("<dynamic>", node.lineno))
+    return out
+
+
+def _registry_metrics(src: SourceFile) -> Dict[str, Tuple[str, int]]:
+    """metric name -> (ctor kind, line) from the Registry class body."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Registry":
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Name)
+                    and sub.func.id in (_METRIC_CTORS | _METRIC_FAMILIES)
+                    and sub.args
+                    and isinstance(sub.args[0], ast.Constant)
+                    and isinstance(sub.args[0].value, str)
+                ):
+                    out[sub.args[0].value] = (sub.func.id, sub.lineno)
+    return out
+
+
+def _export_tuples(src: SourceFile) -> Dict[str, List[Tuple[str, int]]]:
+    out: Dict[str, List[Tuple[str, int]]] = {}
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id in _EXPORT_TUPLES
+            for t in node.targets
+        ):
+            tname = next(
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            )
+            names = out.setdefault(tname, [])
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                    names.append((sub.value, sub.lineno))
+    return out
+
+
+def check(files: List[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    faults_src = metrics_src = collectors_src = None
+    for src in files:
+        if _endswith(src, FAULTS_FILE):
+            faults_src = src
+        elif _endswith(src, METRICS_FILE):
+            metrics_src = src
+        elif _endswith(src, COLLECTORS_FILE):
+            collectors_src = src
+
+    # -- fault points ------------------------------------------------------
+    if faults_src is not None:
+        declared, decl_line = _declared_points(faults_src)
+        fired: Dict[str, List[Tuple[SourceFile, int]]] = {}
+        for src in files:
+            if src is faults_src:
+                continue
+            for point, line in _fire_sites(src):
+                fired.setdefault(point, []).append((src, line))
+        for point, sites in sorted(fired.items()):
+            for src, line in sites:
+                if src.suppressed(line, CHECK):
+                    continue
+                if point == "<dynamic>":
+                    findings.append(
+                        Finding(
+                            CHECK, src.relpath, line, "faults.fire",
+                            "fault point must be a string literal "
+                            "(static reconciliation)",
+                        )
+                    )
+                elif point not in declared:
+                    findings.append(
+                        Finding(
+                            CHECK, src.relpath, line, point,
+                            f"fired fault point '{point}' is not declared "
+                            "in testing/faults.py KNOWN_POINTS",
+                        )
+                    )
+        for point in sorted(declared - set(fired)):
+            if not faults_src.suppressed(decl_line, CHECK):
+                findings.append(
+                    Finding(
+                        CHECK, faults_src.relpath, decl_line, point,
+                        f"declared fault point '{point}' has no fire site "
+                        "(dead registration)",
+                    )
+                )
+
+    # -- metrics -----------------------------------------------------------
+    if metrics_src is not None and collectors_src is not None:
+        registry = _registry_metrics(metrics_src)
+        exports = _export_tuples(collectors_src)
+        exported: Dict[str, Tuple[str, int]] = {}
+        for tname, entries in exports.items():
+            for name, line in entries:
+                exported[name] = (tname, line)
+        for name, (tname, line) in sorted(exported.items()):
+            if collectors_src.suppressed(line, CHECK):
+                continue
+            if name not in registry:
+                findings.append(
+                    Finding(
+                        CHECK, collectors_src.relpath, line, name,
+                        f"{tname} exports '{name}' which scheduler/"
+                        "metrics.py Registry does not define (dead export)",
+                    )
+                )
+        for name, (kind, line) in sorted(registry.items()):
+            if kind in _METRIC_FAMILIES:
+                continue
+            if metrics_src.suppressed(line, CHECK):
+                continue
+            if name not in exported:
+                surface = (
+                    "SCALAR_METRICS" if kind in ("Counter", "Gauge")
+                    else "DEFAULT_METRICS/COUNT_METRICS"
+                )
+                findings.append(
+                    Finding(
+                        CHECK, metrics_src.relpath, line, name,
+                        f"Registry {kind} '{name}' is not exported through "
+                        f"perf/collectors.py {surface} (unexported metric)",
+                    )
+                )
+    return findings

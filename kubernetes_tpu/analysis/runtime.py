@@ -1,0 +1,216 @@
+"""Runtime lock-order tracker — the dynamic half of the lock-order pass.
+
+The static pass (analysis/lockorder.py) proves the absence of cycles
+its conservative call-edge resolver can see; this tracker records the
+acquisition edges that ACTUALLY happen while tests run and fails on
+inversion: acquiring lock B while holding lock A after some thread has
+already acquired A while holding B.
+
+Usage (scoped — the patch is process-global while active):
+
+    from kubernetes_tpu.analysis import runtime as lockorder
+
+    with lockorder.tracked() as tracker:
+        ...  # run the scenario
+    tracker.assert_no_inversions()
+
+Under pytest, set ``GRAFTLINT_LOCK_ORDER=1`` to arm the tracker for the
+whole session (tests/conftest.py wires the fixture); the session fails
+if any inversion was recorded.
+
+Locks created while the tracker is installed are wrapped in a
+:class:`TrackedLock` proxy named after their allocation site.  Edges
+are keyed per lock OBJECT (two-object AB/BA inversions are the
+deadlock shape; site-level aggregation would false-positive on
+sibling instances of the same class).  Reentrant re-acquisition is
+ignored.  The proxy forwards the private ``_is_owned`` /
+``_release_save`` / ``_acquire_restore`` hooks so ``threading.
+Condition`` built on a tracked (R)Lock keeps working.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class LockOrderViolation(AssertionError):
+    """Two locks were acquired in both orders (potential deadlock)."""
+
+
+class LockOrderTracker:
+    def __init__(self):
+        # edges[(id_a, id_b)] = (name_a, name_b, where) — a held while
+        # acquiring b.  The tracker's own mutex is a raw lock created
+        # BEFORE install() patches the factories, so it is never tracked.
+        self._mu = threading.Lock()
+        self._edges: Dict[Tuple[int, int], Tuple[str, str, str]] = {}
+        self._tl = threading.local()
+        self.inversions: List[str] = []
+
+    # -- held-stack bookkeeping (per thread) -------------------------------
+
+    def _held(self) -> List[Tuple[int, str]]:
+        stack = getattr(self._tl, "stack", None)
+        if stack is None:
+            stack = self._tl.stack = []
+        return stack
+
+    def before_acquire(self, lock_id: int, name: str) -> None:
+        held = self._held()
+        if any(lid == lock_id for lid, _ in held):
+            return  # reentrant
+        with self._mu:
+            for held_id, held_name in held:
+                edge = (held_id, lock_id)
+                back = (lock_id, held_id)
+                if back in self._edges and edge not in self._edges:
+                    a_name, b_name, where = self._edges[back]
+                    self.inversions.append(
+                        f"lock-order inversion: acquiring '{name}' while "
+                        f"holding '{held_name}', but '{b_name}' was "
+                        f"previously acquired while holding '{a_name}' "
+                        f"(first order seen at {where})"
+                    )
+                self._edges.setdefault(
+                    edge, (held_name, name, _caller_site(3))
+                )
+
+    def on_acquired(self, lock_id: int, name: str) -> None:
+        self._held().append((lock_id, name))
+
+    def on_release(self, lock_id: int) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == lock_id:
+                del held[i]
+                return
+
+    # -- results -----------------------------------------------------------
+
+    def edges(self) -> List[Tuple[str, str]]:
+        with self._mu:
+            return [(a, b) for (a, b, _) in self._edges.values()]
+
+    def assert_no_inversions(self) -> None:
+        if self.inversions:
+            raise LockOrderViolation(
+                "\n".join(self.inversions[:20])
+                + (
+                    f"\n... and {len(self.inversions) - 20} more"
+                    if len(self.inversions) > 20
+                    else ""
+                )
+            )
+
+
+def _caller_site(depth: int) -> str:
+    try:
+        f = sys._getframe(depth)
+        return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+    except ValueError:
+        return "<unknown>"
+
+
+class TrackedLock:
+    """Duck-typed proxy over a real Lock/RLock recording acquisition
+    order.  Reentrant acquires are transparent to the tracker."""
+
+    def __init__(self, inner, name: str, tracker: LockOrderTracker):
+        self._inner = inner
+        self._name = name
+        self._tracker = tracker
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        self._tracker.before_acquire(id(self), self._name)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._tracker.on_acquired(id(self), self._name)
+        return got
+
+    def release(self):
+        self._tracker.on_release(id(self))
+        return self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # threading.Condition integration: forward the private hooks when the
+    # inner lock has them (RLock), with coarse stack bookkeeping
+    def _is_owned(self):
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        self._tracker.on_release(id(self))
+        if hasattr(self._inner, "_release_save"):
+            return self._inner._release_save()
+        self._inner.release()
+        return None
+
+    def _acquire_restore(self, state):
+        self._tracker.before_acquire(id(self), self._name)
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+        self._tracker.on_acquired(id(self), self._name)
+
+    def __repr__(self):
+        return f"<TrackedLock {self._name} {self._inner!r}>"
+
+
+_active: Optional[LockOrderTracker] = None
+
+
+@contextlib.contextmanager
+def tracked(tracker: Optional[LockOrderTracker] = None):
+    """Install lock tracking for the dynamic extent of the context:
+    every threading.Lock/RLock CREATED inside is wrapped.  Pre-existing
+    locks are untouched (they predate the window and cannot participate
+    in a fresh inversion pair with each other being tracked)."""
+    global _active
+    if _active is not None:
+        # nested arming shares the outer tracker (session fixture +
+        # per-test use must not double-patch)
+        yield _active
+        return
+    tracker = tracker or LockOrderTracker()
+    real_lock, real_rlock = threading.Lock, threading.RLock
+
+    def make_lock():
+        return TrackedLock(real_lock(), f"Lock@{_caller_site(2)}", tracker)
+
+    def make_rlock():
+        return TrackedLock(real_rlock(), f"RLock@{_caller_site(2)}", tracker)
+
+    threading.Lock = make_lock
+    threading.RLock = make_rlock
+    _active = tracker
+    try:
+        yield tracker
+    finally:
+        threading.Lock = real_lock
+        threading.RLock = real_rlock
+        _active = None
+
+
+def wrap(lock, name: str, tracker: LockOrderTracker) -> TrackedLock:
+    """Explicitly wrap an existing lock (tests that build their own
+    scenario without the global patch)."""
+    return TrackedLock(lock, name, tracker)

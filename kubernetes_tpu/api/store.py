@@ -158,6 +158,39 @@ class Store:
     attach after recovery and relist, exactly like a reflector hitting a
     fresh apiserver."""
 
+    # graftlint guarded-by declarations: object maps, version counters,
+    # the event ring, watcher fan-out lists, and all journal state share
+    # the store mutex (writes and watch dispatch hold one lock — module
+    # docstring)
+    GUARDED_FIELDS = {
+        "_rv": "_lock",
+        "_objects": "_lock",
+        "_versions": "_lock",
+        "_buffer": "_lock",
+        "_watchers": "_lock",
+        "_journal": "_lock",
+        "_journal_records": "_lock",
+        "_journal_dirty": "_lock",
+        "_journal_flushed_at": "_lock",
+        "watchers_terminated": "_lock",
+        "terminated_kinds": "_lock",
+        "journal_recovered_records": "_lock",
+        "journal_tail_truncations": "_lock",
+        "journal_write_errors": "_lock",
+    }
+    # reviewed lock-free: replay/compaction run from __init__ before the
+    # store is shared; the rest document "caller holds the lock"
+    LOCKED_METHODS = frozenset({
+        "_replay_journal",
+        "_compact_journal",
+        "_flush_journal",
+        "_journal_commit",
+        "_append_journal",
+        "_append_journal_wave",
+        "_dispatch",
+        "_dispatch_wave",
+    })
+
     def __init__(
         self,
         buffer_size: int = 4096,

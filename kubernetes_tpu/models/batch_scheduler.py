@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.markers import hot_path
 from ..api import types as api
 from ..ops import assign as assign_ops
 from ..ops import auction as auction_ops
@@ -72,6 +73,14 @@ class SolveCircuitBreaker:
     CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
     _STATE_CODE = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
 
+    GUARDED_FIELDS = {
+        "state": "_lock",
+        "_open_until": "_lock",
+        "trips": "_lock",
+        "fallbacks": "_lock",
+        "probes": "_lock",
+    }
+
     def __init__(self, cooldown: float = 5.0, clock=time.monotonic):
         self.cooldown = cooldown
         self._clock = clock
@@ -83,7 +92,21 @@ class SolveCircuitBreaker:
         self.probes = 0      # half-open device attempts
 
     def state_code(self) -> float:
-        return self._STATE_CODE[self.state]
+        # the metrics mirror reads this off the scheduling thread while
+        # dispatch threads transition the breaker — take the lock (the
+        # unlocked read was a graftlint guarded-by finding)
+        with self._lock:
+            return self._STATE_CODE[self.state]
+
+    def record_fallback(self) -> None:
+        """Count a batch solved on the host path (called by the owner's
+        _host_fallback — the counter shares the breaker mutex)."""
+        with self._lock:
+            self.fallbacks += 1
+
+    def fallback_count(self) -> int:
+        with self._lock:
+            return self.fallbacks
 
     def allow_device(self) -> bool:
         """True when this batch may use the device: closed, or open with
@@ -392,6 +415,8 @@ class SolverPrewarmPool:
     tearing the interpreter down mid-compile aborts the process, so
     every owner must close (TPUBatchScheduler registers atexit)."""
 
+    GUARDED_FIELDS = {"_seen": "_lock", "_thread": "_lock"}
+
     def __init__(self, compile_observer=None, max_pending: int = 16):
         import queue as _q
 
@@ -470,7 +495,8 @@ class SolverPrewarmPool:
             self._q.put_nowait(None)
         except Exception:  # noqa: BLE001
             pass
-        t = self._thread
+        with self._lock:
+            t = self._thread
         if t is not None and t.is_alive():
             t.join(timeout=timeout)
 
@@ -683,7 +709,7 @@ class TPUBatchScheduler:
             ),
         )
 
-    def _prewarm_neighbors(
+    def _prewarm_neighbors(  # graftlint: disable=purity -- speculative compile bookkeeping; the pool mutex is uncontended and compiles run off-thread
         self, snap, route, topo_z, features, n_groups, wave_shape=None
     ) -> None:
         """On a first-seen executable key, speculatively compile the keys
@@ -761,6 +787,7 @@ class TPUBatchScheduler:
                 )
         return self._greedy(snap, topo_z, features)
 
+    @hot_path
     def _dispatch(
         self, snap: schema.Snapshot, meta: Optional[schema.SnapshotMeta] = None
     ) -> Result:
@@ -907,6 +934,7 @@ class TPUBatchScheduler:
             snap = snap._replace(cluster=cluster)
         return snap, meta
 
+    @hot_path
     def solve_encoded_async(
         self, snap: schema.Snapshot, meta: schema.SnapshotMeta
     ) -> DeviceSolve:
@@ -1131,7 +1159,7 @@ class TPUBatchScheduler:
             if any(names[i] is None for i in idx):
                 for i in idx:
                     names[i] = None
-        self.breaker.fallbacks += 1
+        self.breaker.record_fallback()
         self.last_result = None  # no reason tensor aligns with these names
         hs = HostSolve(names)
         hs.encode_s = time.perf_counter() - t0
@@ -1250,9 +1278,24 @@ class TPUBatchScheduler:
             snap, meta = self.snapshot(
                 nodes, pods, bound, num_pods_hint=len(pending)
             )
-            result = self._dispatch(snap)
-            self.last_result = result
-            idx = np.asarray(result.assignment)[: meta.num_pods]
-            return [meta.node_name(int(i)) for i in idx]
+            # derive the routing statics host-side while the snapshot is
+            # host-resident (the stateless twin of encode_pending's
+            # derivation) so the dispatch path never re-probes device
+            # arrays, then decode through DeviceSolve: ONE coalesced
+            # device_get instead of a bare np.asarray readback per
+            # gang-retry subset solve (a graftlint purity finding —
+            # each bare readback paid a blocking round-trip)
+            meta.features = assign_ops.features_of(snap)
+            meta.topo_split = assign_ops.required_topo_z_split(snap)
+            meta.n_groups = schema.num_groups(snap)
+            meta.tie_k = auction_ops.default_tie_k(snap)
+            meta.route = self._route(
+                snap, meta.features, meta.topo_split, meta.n_groups
+            )
+            if meta.route == "wavefront":
+                meta.wave_plan = assign_ops.plan_waves(
+                    snap, features=meta.features, wave_cap=self.wave_cap
+                )
+            return self.solve_encoded_async(snap, meta).names()
 
         return self._gang_admission_retry(pending, solve(pending), solve)

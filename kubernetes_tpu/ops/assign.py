@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.markers import hot_path
 from .filters import (
     fits_resources,
     pod_view,
@@ -86,7 +87,7 @@ class FeatureFlags(NamedTuple):
     bound_pref: bool = False
 
 
-def required_topo_z(snapshot: Snapshot) -> int:
+def required_topo_z(snapshot: Snapshot) -> int:  # graftlint: disable=purity -- host-side prep on the pre-transfer snapshot
     """Smallest valid topo-value capacity for this snapshot.  Using a
     smaller z would alias topology values together in the prep-time count
     scatter and silently corrupt spread/inter-pod state."""
@@ -95,7 +96,7 @@ def required_topo_z(snapshot: Snapshot) -> int:
     return pad_dim(int(np.asarray(snapshot.cluster.topo_ids).max()) + 1, 1)
 
 
-def required_topo_z_split(snapshot: Snapshot) -> Tuple[int, int]:
+def required_topo_z_split(snapshot: Snapshot) -> Tuple[int, int]:  # graftlint: disable=purity -- host-side prep on the pre-transfer snapshot
     """(z_spread, z_terms): value capacities sized to the topology slots
     each family actually uses.  Hostname ids scale with the cluster (50k
     nodes → 50k values) while zone/region stay tiny; sizing each family's
@@ -127,7 +128,7 @@ def needs_topo(features: FeatureFlags) -> bool:
     return features.spread or features.interpod or features.interpod_pref
 
 
-def features_of(
+def features_of(  # graftlint: disable=purity -- host-side prep: cheap numpy reductions on the pre-transfer snapshot
     snapshot: Snapshot, no_bound_pods: bool = False
 ) -> FeatureFlags:
     """Derive the static gates host-side (cheap numpy reductions).
@@ -403,6 +404,7 @@ def _gang_release(
     return assignment, win_scores, reasons, requested, nonzero
 
 
+@hot_path
 def greedy_assign(
     snapshot: Snapshot,
     cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,
@@ -622,7 +624,7 @@ def _pack_idx_rows(idx: np.ndarray, dim: int) -> np.ndarray:
     return out
 
 
-def plan_waves(
+def plan_waves(  # graftlint: disable=purity -- host-side prep: the wave partition walks host numpy (module docstring)
     snapshot: Snapshot,
     features: Optional[FeatureFlags] = None,
     wave_cap: int = DEFAULT_WAVE_CAP,
@@ -748,6 +750,7 @@ def _rows_cluster(cap, requested, nonzero):
     )
 
 
+@hot_path
 def wavefront_assign(
     snapshot: Snapshot,
     wave_members: jnp.ndarray,
@@ -1169,6 +1172,7 @@ def wavefront_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
     return call
 
 
+@hot_path
 def evaluate_single(
     snapshot: Snapshot,
     cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,

@@ -67,6 +67,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.markers import hot_path
 from .assign import (
     NEG_INF,
     REASON_GANG,
@@ -117,7 +118,7 @@ def auction_features_ok(features: FeatureFlags) -> bool:
     return not (features.ports or features.interpod_aff)
 
 
-def default_tie_k(snapshot: Snapshot) -> int:
+def default_tie_k(snapshot: Snapshot) -> int:  # graftlint: disable=purity -- host-side prep on the pre-transfer snapshot
     """Tie nodes enumerated per class per round: enough for the LARGEST
     class to bid distinct nodes (a burst of identical pods would
     otherwise cram onto tie_k nodes instead of spreading over the tie
@@ -131,6 +132,7 @@ def default_tie_k(snapshot: Snapshot) -> int:
     return min(pad_dim(max(biggest, 64), 1), snapshot.cluster.allocatable.shape[0])
 
 
+@hot_path
 def auction_assign(
     snapshot: Snapshot,
     cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,

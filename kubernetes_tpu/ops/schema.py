@@ -245,7 +245,7 @@ class Snapshot(NamedTuple):
     images: ImageTable
 
 
-def num_groups(snapshot: Snapshot) -> int:
+def num_groups(snapshot: Snapshot) -> int:  # graftlint: disable=purity -- host-side prep on the pre-transfer snapshot
     """Static gang-group count for this batch (0 = no gangs).  The one
     source of truth for the group-id convention (-1 = ungrouped, dense
     ids from 0): both solvers' all-or-nothing post-passes key off it."""

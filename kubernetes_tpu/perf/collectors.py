@@ -151,6 +151,11 @@ class MetricsCollector:
     """Extracts percentile summaries from the scheduler's histograms by
     reference metric name (scheduler_perf.go:100-112)."""
 
+    # seconds-unit histograms, reported as ms percentiles.  The three
+    # export surfaces below are reconciled against scheduler/metrics.py
+    # Registry by graftlint's registry pass (make lint): every name here
+    # must exist there, and every Registry metric must appear in exactly
+    # one of these tuples.
     DEFAULT_METRICS = (
         "scheduler_scheduling_attempt_duration_seconds",
         "scheduler_scheduling_algorithm_duration_seconds",
@@ -160,16 +165,34 @@ class MetricsCollector:
         # hidden behind host work (scheduler/metrics.py)
         "scheduler_solve_compile_duration_seconds",
         "scheduler_decode_overlap_seconds",
+        # solve/bind pipeline stages (docs/scheduler_loop.md) — were
+        # registered but never exported (graftlint registry drift)
+        "scheduler_schedule_batch_duration_seconds",
+        "scheduler_commit_wave_duration_seconds",
+        "scheduler_pipeline_overlap_seconds",
+    )
+
+    # count-unit histograms: reported as raw percentiles (no ms scaling —
+    # wave/batch sizes and victim counts, not durations)
+    COUNT_METRICS = (
+        "scheduler_commit_wave_size_pods",
+        "scheduler_solve_wave_count",
+        "scheduler_solve_wave_fallbacks",
+        "scheduler_preemption_victims",
     )
 
     # breaker / supervision / journal-recovery scalars (gauges and
-    # counters, reported as one Total value — docs/robustness.md)
+    # counters, reported as one Total value — docs/robustness.md), plus
+    # the attempt/pending totals that were registered but unexported
     SCALAR_METRICS = (
         "scheduler_solve_breaker_state",
         "scheduler_solve_fallback_total",
         "scheduler_binder_restarts_total",
         "scheduler_binder_poison_waves_total",
         "scheduler_journal_recovered_records",
+        "scheduler_schedule_attempts_total",
+        "scheduler_pending_pods",
+        "scheduler_preemption_attempts_total",
     )
 
     def __init__(
@@ -221,12 +244,36 @@ class MetricsCollector:
                     labels,
                 )
             )
+        for name in self.COUNT_METRICS:
+            h = snap.get(name)
+            if not isinstance(h, Histogram):
+                continue
+            h = self._windowed(name, h)
+            if h.n == 0:
+                continue
+            labels = dict(self.labels)
+            labels["Metric"] = name
+            out.append(
+                DataItem(
+                    {
+                        "Average": h.average,
+                        "Perc50": h.percentile(0.50),
+                        "Perc90": h.percentile(0.90),
+                        "Perc95": h.percentile(0.95),
+                        "Perc99": h.percentile(0.99),
+                    },
+                    "count",
+                    labels,
+                )
+            )
         for name in self.SCALAR_METRICS:
             m = snap.get(name)
             if isinstance(m, Counter):
                 value = m.total
             elif isinstance(m, Gauge):
-                value = m.get()
+                # labeled gauges (pending_pods per tier) report the
+                # cross-label total; unlabeled ones their bare value
+                value = m.total
             else:
                 continue
             if value == 0.0:

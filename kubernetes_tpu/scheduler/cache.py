@@ -43,6 +43,17 @@ class _Assumed:
 
 
 class SchedulerCache:
+    # graftlint guarded-by declarations: every access to these fields
+    # must hold self._lock (analysis/guarded.py; docs/static_analysis.md)
+    GUARDED_FIELDS = {
+        "state": "_lock",
+        "_assumed": "_lock",
+        "_nominated": "_lock",
+        "_waiting_on_node": "_lock",
+    }
+    # reviewed to run with the lock already held (callers acquire it)
+    LOCKED_METHODS = frozenset({"_account"})
+
     def __init__(
         self,
         state: schema.ClusterState,

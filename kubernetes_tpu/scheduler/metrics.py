@@ -133,6 +133,14 @@ class Gauge:
         with self._lock:
             return self._v.get(labels, 0.0)
 
+    @property
+    def total(self) -> float:
+        """Sum over every label tuple — equal to the bare value for
+        unlabeled gauges; the cross-tier total for labeled ones (what
+        the perf collectors report for pending_pods)."""
+        with self._lock:
+            return sum(self._v.values())
+
 
 class Registry:
     """One scheduler's metric set, by reference name."""

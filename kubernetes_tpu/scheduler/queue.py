@@ -82,6 +82,29 @@ class QueuedPodInfo:
 
 
 class SchedulingQueue:
+    # graftlint guarded-by declarations: all three tiers plus the gang
+    # and in-flight-event bookkeeping mutate under the queue condition
+    # (producer handlers, pop_batch, and the wake paths race otherwise)
+    GUARDED_FIELDS = {
+        "_active": "_cond",
+        "_backoff": "_cond",
+        "_unschedulable": "_cond",
+        "_gated": "_cond",
+        "_infos": "_cond",
+        "_tier": "_cond",
+        "_group_keys": "_cond",
+        "_group_size": "_cond",
+        "_gang_staged": "_cond",
+        "_event_seq": "_cond",
+        "_events_log": "_cond",
+        "_closed": "_cond",
+    }
+    # helpers only reached from under `with self._cond:` (the *_locked
+    # suffix convention covers the rest)
+    LOCKED_METHODS = frozenset(
+        {"_push_active", "_push_backoff", "_drop_group_member"}
+    )
+
     def __init__(
         self,
         backoff_base: float = 1.0,

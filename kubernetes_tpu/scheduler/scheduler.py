@@ -112,6 +112,17 @@ _REASON_TEXT = {
 
 
 class Scheduler:
+    # graftlint guarded-by declarations: the binding-stage backlog and
+    # worker flags share the wave condition; the device-solve interval
+    # log (pipeline-overlap attribution) shares the solve lock
+    GUARDED_FIELDS = {
+        "_waves": "_wave_cv",
+        "_wave_active": "_wave_cv",
+        "_binder_stop": "_wave_cv",
+        "_solve_windows": "_solve_lock",
+        "_solve_open": "_solve_lock",
+    }
+
     def __init__(
         self,
         store: st.Store,
@@ -450,7 +461,10 @@ class Scheduler:
         crash-grade fault escaped containment).  Called from the hot
         loop, the wave dispatch path and flush_binds, so direct
         schedule_batch() callers recover too."""
-        if self._bind_thread.is_alive() or self._binder_stop:
+        # double-checked locking: the hot loop calls this every cycle and
+        # the worker is almost always alive — the lock-free probe is the
+        # fast path; the locked re-check below is authoritative
+        if self._bind_thread.is_alive() or self._binder_stop:  # graftlint: disable=guarded-by
             return
         with self._wave_cv:
             if self._bind_thread.is_alive() or self._binder_stop:
@@ -913,7 +927,9 @@ class Scheduler:
         breaker = getattr(self.tpu, "breaker", None)
         if breaker is not None:
             self.metrics.solve_breaker_state.set(breaker.state_code())
-            self.metrics.solve_fallback_total.set(float(breaker.fallbacks))
+            self.metrics.solve_fallback_total.set(
+                float(breaker.fallback_count())
+            )
         recovered = getattr(self.store, "journal_recovered_records", None)
         if recovered is not None:
             self.metrics.journal_recovered_records.set(float(recovered))

@@ -35,8 +35,8 @@ class WaitingPod:
         self.deadline = time.monotonic() + timeout
         self._done = threading.Event()
         self._mu = threading.Lock()
-        self._claimed = False
-        self._verdict: Optional[str] = None  # "allow" | reason string
+        self._claimed = False           # guarded_by: _mu
+        self._verdict: Optional[str] = None  # "allow" | reason  # guarded_by: _mu
 
     def try_claim(self) -> bool:
         """Atomically reserve the decision (phase 1 of a group release);
@@ -72,6 +72,13 @@ class WaitingPod:
                 self._done.set()
             return self._verdict == reason
 
+    def _locked_verdict(self) -> Optional[str]:
+        """The latched decision, read under the mutex: wait()'s readers
+        run on the binding thread while allow/reject latch from plugin
+        threads — the unlocked read was a graftlint guarded-by finding."""
+        with self._mu:
+            return self._verdict
+
     def wait(self) -> str:
         """Block until Allow/Reject/timeout (WaitOnPermit); returns
         "allow" or the rejection reason ("timeout" when the permit
@@ -80,15 +87,17 @@ class WaitingPod:
         while True:
             remaining = self.deadline - time.monotonic()
             if self._done.wait(timeout=max(remaining, 0)):
-                return self._verdict or "rejected"
+                return self._locked_verdict() or "rejected"
             if self.reject("timeout"):
                 return "timeout"
             # claimed: the group release is deciding — wait it out
             if self._done.wait(timeout=0.05):
-                return self._verdict or "rejected"
+                return self._locked_verdict() or "rejected"
 
 
 class WaitingPodsMap:
+    GUARDED_FIELDS = {"_pods": "_lock"}
+
     def __init__(self):
         self._lock = threading.Lock()
         self._pods: Dict[str, WaitingPod] = {}

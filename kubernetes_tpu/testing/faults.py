@@ -89,6 +89,17 @@ class FaultRegistry:
     """One chaos run's fault plan: schedules per point, consumed in
     registration order, every probabilistic draw from the run's seed."""
 
+    GUARDED_FIELDS = {
+        "_schedules": "_lock",
+        "_rng": "_lock",
+        "fired": "_lock",
+        "log": "_lock",
+    }
+    # schedule registration precedes arm(): the builder-style fail()/
+    # crash()/... calls run single-threaded before any hot-path thread
+    # can reach fire()
+    LOCKED_METHODS = frozenset({"_add"})
+
     def __init__(self, seed: int = 0):
         self.seed = seed
         self._rng = Random(seed)

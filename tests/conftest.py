@@ -29,3 +29,16 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+if os.environ.get("GRAFTLINT_LOCK_ORDER") == "1":
+    # opt-in runtime lock-order tracking (docs/static_analysis.md): every
+    # threading.Lock/RLock created during the session is wrapped and the
+    # session fails if any pair of locks was acquired in both orders.
+    @pytest.fixture(autouse=True, scope="session")
+    def _graftlint_lock_order():
+        from kubernetes_tpu.analysis import runtime as lockorder
+
+        with lockorder.tracked() as tracker:
+            yield tracker
+        tracker.assert_no_inversions()
