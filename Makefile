@@ -5,14 +5,21 @@
 
 PY ?= python
 
-.PHONY: test chaos bench lint
+.PHONY: test chaos bench lint lint-shapes
 
 # graftlint: the project-native static analysis suite (guarded-by,
-# hot-path purity, registry drift, lock-order — docs/static_analysis.md).
-# Exits non-zero on any finding outside kubernetes_tpu/analysis/baseline.json
-# and on stale baseline entries.  Import-light: no JAX init.
+# hot-path purity, registry drift, lock-order, tensor-contract —
+# docs/static_analysis.md).  Exits non-zero on any finding outside
+# kubernetes_tpu/analysis/baseline.json and on stale baseline entries.
+# Import-light: no JAX init.
 lint:
 	$(PY) -m kubernetes_tpu.analysis
+
+# recompile-discipline: eval_shape over the pad-bucket lattice + real
+# encoder shape validation (analysis/shapes.py).  Imports JAX, hence a
+# separate mode — `make lint` must stay import-light.
+lint-shapes:
+	JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.analysis --shapes
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow and not chaos' \

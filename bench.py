@@ -80,9 +80,18 @@ class _Runner:
     SAMPLES = 3
 
     def run(self, mk_pods):
+        from kubernetes_tpu.analysis import retrace
+
         # compile; identical shapes.  Its wall clock IS the first-shape
         # cost (XLA compile dominates) — recorded, not mixed into steady.
+        retrace.clear_steady()
         _, first_s, _ = self.step(mk_pods("warmup"))
+        # warmup traced every executable this scenario needs; any trace
+        # during the timed steps below is a steady-state recompile — a
+        # kernel argument escaped the pad-bucket lattice (the
+        # recompile-discipline invariant, analysis/retrace.py)
+        retrace.mark_steady()
+        steady0 = retrace.steady_total()
         # the axon tunnel's latency varies 2-3x run to run; min-of-3
         # timed runs reports the machine, not the tunnel's mood, and
         # the full sample list makes the recorded JSON self-diagnosing
@@ -92,18 +101,24 @@ class _Runner:
             samples.append(round(d, 4))
             if dt is None or d < dt:
                 names, dt, best_t = nms, d, lt
+        steady_recompiles = retrace.steady_total() - steady0
+        retrace.clear_steady()
         placed = sum(n is not None for n in names)
-        return _Run(names, placed, dt, samples, first_s, best_t)
+        return _Run(
+            names, placed, dt, samples, first_s, best_t, steady_recompiles
+        )
 
 
 class _Run:
-    def __init__(self, names, placed, dt, samples, first_s, timings):
+    def __init__(self, names, placed, dt, samples, first_s, timings,
+                 steady_recompiles=0):
         self.names = names
         self.placed = placed
         self.dt = dt
         self.samples = samples
         self.first_s = first_s
         self.timings = timings
+        self.steady_recompiles = steady_recompiles
 
     def report(self, nodes, pods, **extra):
         t = self.timings
@@ -121,6 +136,10 @@ class _Run:
                 (t.get("compile_s", 0.0) + t.get("solve_s", 0.0))
                 / self.dt, 4,
             ) if self.dt else 0.0,
+            # XLA traces during the TIMED steps (warmup excluded): must
+            # be zero — a steady-state retrace eats a full compile on
+            # the hot path (BENCH_STRICT gates on this)
+            "steady_recompiles": self.steady_recompiles,
         }
         out.update(extra)
         return out
@@ -416,6 +435,10 @@ def config6():
         "decode_overlap_s": round(m.decode_overlap.total, 4),
         "wave_solves": m.solve_wave_count.n,
         "wave_fallbacks_total": round(m.solve_wave_fallbacks.total, 1),
+        # total solver XLA traces this config's full loop performed
+        # (retrace tracker mirror; churn legitimately walks buckets, so
+        # this is reported, not gated)
+        "solve_retrace_total": round(m.solve_retrace_total.total, 1),
         "commit_s_total": round(commit_s, 4),
         "commit_overlap_s": round(overlap_s, 4),
         "commit_waves": m.commit_wave_size.n,
@@ -429,19 +452,28 @@ def main() -> None:
     import os
     import sys
 
+    from kubernetes_tpu.analysis import retrace
     from kubernetes_tpu.utils import trace as tracemod
 
     tracemod.drain_overruns()  # measure only this run's traces
-    extra = {
-        "c1_fit_500": config1(),
-        "c2_balanced_5k": config2(),
-        "c3_spread_10k": config3(),
-        "c3s_spread_1k": config3s(),
-        "c4_interpod_20k": config4(),
-        "c4s_interpod_1k": config4s(),
-        "c5_gang_50k": config5(),
-        "c6_churn_5k": config6(),
-    }
+    # arm the recompile-discipline runtime tracker for the whole run:
+    # each _Runner marks its steady window after warmup, and the churn
+    # config's scheduler mirrors the trace total into
+    # scheduler_solve_retrace_total (perf/collectors SCALAR_METRICS).
+    # c6 deliberately has no steady window — churn walks the pod-bucket
+    # ladder by design, so its first-seen buckets are not steady-state
+    # retraces.
+    with retrace.tracked():
+        extra = {
+            "c1_fit_500": config1(),
+            "c2_balanced_5k": config2(),
+            "c3_spread_10k": config3(),
+            "c3s_spread_1k": config3s(),
+            "c4_interpod_20k": config4(),
+            "c4s_interpod_1k": config4s(),
+            "c5_gang_50k": config5(),
+            "c6_churn_5k": config6(),
+        }
     # every over-threshold schedule_batch cycle, with its per-step share
     # (commit- and solve-share per step are readable straight off the
     # steps list); BENCH_STRICT=1 turns any such trace into a non-zero
@@ -482,6 +514,15 @@ def main() -> None:
         if extra[name]["latency_s"] > budget
     ]
     extra["solve_regressions"] = solve_regressions
+    # recompile-discipline gate: zero steady-state retraces on every
+    # fixed-shape scenario (c6 reports through solve_retrace_total
+    # instead — see the tracked() comment above)
+    steady_retraces = {
+        name: cfg["steady_recompiles"]
+        for name, cfg in extra.items()
+        if isinstance(cfg, dict) and cfg.get("steady_recompiles")
+    }
+    extra["steady_retraces"] = steady_retraces
     c5 = extra["c5_gang_50k"]
     pods_per_s = 10_000 / c5["latency_s"]
     print(
@@ -508,6 +549,13 @@ def main() -> None:
                 + ", ".join(
                     f"{r['config']}={r['latency_s']}s (budget {r['budget_s']}s)"
                     for r in solve_regressions
+                )
+            )
+        if steady_retraces:
+            failures.append(
+                "steady-state XLA retraces (pad-bucket escape): "
+                + ", ".join(
+                    f"{name}={n}" for name, n in sorted(steady_retraces.items())
                 )
             )
         if failures:

@@ -1,7 +1,8 @@
 """graftlint — project-native static analysis for the scheduler tree.
 
-Four passes enforce the conventions the solve→assume→bind pipeline's
-correctness rests on (docs/static_analysis.md):
+Five import-light passes (plus the JAX-backed ``--shapes`` mode) enforce
+the conventions the solve→assume→bind pipeline's correctness rests on
+(docs/static_analysis.md):
 
   guarded-by   fields declared guarded (``GUARDED_FIELDS`` class attr or
                a ``# guarded_by: _lock`` comment in ``__init__``) may
@@ -22,6 +23,20 @@ correctness rests on (docs/static_analysis.md):
   lock-order   the static lock-acquisition graph (lock held → lock
                acquired) must be acyclic.  The runtime half lives in
                analysis/runtime.py.
+  tensor-contract
+               every NamedTuple array field in the ops tree carries a
+               parseable ``# <dtype>[<axes>]`` contract; kernel code
+               must stay dtype-stable (no 64-bit numpy values, no
+               bare-int bitset shifts) and axis-consistent (a
+               ``P``-derived variable must not index an ``N`` axis).
+  recompile-discipline
+               (``--shapes`` mode / ``make lint-shapes``: imports JAX)
+               every @hot_path kernel driven through ``jax.eval_shape``
+               across the pad-bucket lattice must produce outputs
+               matching the contracts, and the encoder must land
+               exactly on the lattice — no argument can trigger an
+               unexpected XLA retrace.  The runtime half is the
+               GRAFTLINT_SHAPES=1 retrace tracker (analysis/retrace.py).
 
 Escape hatch: ``# graftlint: disable=<check>[,<check>...]`` on the
 offending line (or on a ``def`` line to exempt a whole function from
@@ -42,8 +57,18 @@ import re
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-#: every check id the suppression syntax accepts
-CHECK_IDS = ("guarded-by", "purity", "registry", "lock-order")
+#: every check id the suppression syntax accepts.  The first five run in
+#: the default import-light CLI; "recompile-discipline" imports JAX and
+#: runs only under `python -m kubernetes_tpu.analysis --shapes`.
+CHECK_IDS = (
+    "guarded-by", "purity", "registry", "lock-order", "tensor-contract",
+    "recompile-discipline",
+)
+
+#: the stdlib-ast subset run_all executes (no JAX initialization)
+STATIC_CHECK_IDS = (
+    "guarded-by", "purity", "registry", "lock-order", "tensor-contract",
+)
 
 # check ids after `disable=`, comma-separated; anything after the ids
 # (conventionally ` -- <justification>`) is free text
@@ -222,11 +247,14 @@ def run_all(
     checks: Optional[Sequence[str]] = None,
     package: str = "kubernetes_tpu",
 ) -> List[Finding]:
-    """Run the selected passes (default: all four) over root/<package>."""
-    from . import guarded, lockorder, purity, registry
+    """Run the selected static passes (default: all five import-light
+    checks) over root/<package>.  The JAX-backed recompile-discipline
+    pass is NOT run here — it lives behind the CLI's ``--shapes`` mode
+    (analysis/shapes.py) so ``make lint`` stays import-light."""
+    from . import guarded, lockorder, purity, registry, tensorcontract
 
     files = load_sources(root, [package])
-    selected = set(checks or CHECK_IDS)
+    selected = set(checks or STATIC_CHECK_IDS)
     findings: List[Finding] = []
     if "guarded-by" in selected:
         findings.extend(guarded.check(files))
@@ -236,5 +264,7 @@ def run_all(
         findings.extend(registry.check(files))
     if "lock-order" in selected:
         findings.extend(lockorder.check(files))
+    if "tensor-contract" in selected:
+        findings.extend(tensorcontract.check(files))
     findings.sort(key=lambda f: (f.file, f.line, f.check, f.message))
     return findings

@@ -1,8 +1,14 @@
 """graftlint CLI: ``python -m kubernetes_tpu.analysis`` (or ``make lint``).
 
-Runs the four static passes over the repository's ``kubernetes_tpu``
-tree, subtracts the reviewed baseline, and exits non-zero on any new
-finding OR any stale baseline entry (the baseline only shrinks).
+Default mode runs the five import-light static passes over the
+repository's ``kubernetes_tpu`` tree, subtracts the reviewed baseline,
+and exits non-zero on any new finding OR any stale baseline entry (the
+baseline only shrinks).
+
+``--shapes`` mode (``make lint-shapes``) runs the JAX-backed
+recompile-discipline pass instead — eval_shape over the pad-bucket
+lattice plus real-encoder shape validation (analysis/shapes.py).  It is
+a separate mode on purpose: the default lint must never initialize JAX.
 """
 
 from __future__ import annotations
@@ -13,6 +19,7 @@ import sys
 
 from . import (
     CHECK_IDS,
+    STATIC_CHECK_IDS,
     apply_baseline,
     default_baseline_path,
     load_baseline,
@@ -35,8 +42,15 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--checks",
-        default=",".join(CHECK_IDS),
-        help=f"comma-separated subset of {', '.join(CHECK_IDS)}",
+        default=",".join(STATIC_CHECK_IDS),
+        help=f"comma-separated subset of {', '.join(STATIC_CHECK_IDS)} "
+        "(ignored under --shapes)",
+    )
+    parser.add_argument(
+        "--shapes",
+        action="store_true",
+        help="run the recompile-discipline pass (imports JAX; use "
+        "JAX_PLATFORMS=cpu for a hardware-free run)",
     )
     parser.add_argument(
         "--baseline",
@@ -54,13 +68,24 @@ def main(argv=None) -> int:
     root = args.root or os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
-    checks = [c.strip() for c in args.checks.split(",") if c.strip()]
-    unknown = [c for c in checks if c not in CHECK_IDS]
-    if unknown:
-        print(f"unknown checks: {', '.join(unknown)}", file=sys.stderr)
-        return 2
+    if args.shapes:
+        from . import shapes
 
-    findings = run_all(root, checks=checks)
+        checks = ["recompile-discipline"]
+        findings = shapes.check(root)
+    else:
+        checks = [c.strip() for c in args.checks.split(",") if c.strip()]
+        unknown = [c for c in checks if c not in CHECK_IDS]
+        if unknown:
+            print(f"unknown checks: {', '.join(unknown)}", file=sys.stderr)
+            return 2
+        if "recompile-discipline" in checks:
+            print(
+                "recompile-discipline runs under --shapes (it imports JAX)",
+                file=sys.stderr,
+            )
+            return 2
+        findings = run_all(root, checks=checks)
     baseline_path = args.baseline or default_baseline_path()
 
     if args.write_baseline:
@@ -72,7 +97,10 @@ def main(argv=None) -> int:
         return 0
 
     baseline = load_baseline(baseline_path)
-    new, stale = apply_baseline(findings, baseline)
+    # baseline entries belong to the mode that produced them: the shape
+    # mode must not mark the static passes' entries stale and vice versa
+    relevant = [b for b in baseline if b.get("check") in checks]
+    new, stale = apply_baseline(findings, relevant)
 
     for f in new:
         print(f.render())

@@ -1,0 +1,239 @@
+"""Tensor contracts: the machine-readable half of the dense schema.
+
+Every ``np.ndarray`` / ``jnp.ndarray`` field of a NamedTuple in the ops
+tree carries a trailing comment of the form::
+
+    allocatable: np.ndarray        # f32[N, R]
+    taint_bits: np.ndarray         # u32[3, N, TW]  effect-major
+    matches_incoming: np.ndarray   # u32[P, ceil(T/32)] packed ...
+    rounds: jnp.ndarray            # i32[]: bidding rounds executed
+
+This module parses those comments into :class:`Contract` objects —
+``(class, field, dtype, symbolic axes)`` — which are the single source
+of truth two enforcement layers share:
+
+  * the ``tensor-contract`` static pass (analysis/tensorcontract.py)
+    fails on unannotated/unparseable fields and checks kernel code
+    against the declared dtypes and axis symbols;
+  * the ``recompile-discipline`` pass (analysis/shapes.py) resolves the
+    symbolic axes against concrete pad-bucket environments to build
+    abstract snapshots for ``jax.eval_shape`` and to validate that the
+    real encoder lands exactly on the declared shapes.
+
+Grammar (everything after the closing ``]`` is free prose)::
+
+    contract := dtype '[' axes? ']'
+    dtype    := 'bool' | [iuf] (8|16|32|64) | 'bf16'
+    axes     := axis (',' axis)*
+    axis     := INT | IDENT | 'ceil(' IDENT '/' INT ')'
+
+Import-light on purpose (stdlib only): ``make lint`` parses contracts
+without initializing JAX.
+"""
+
+from __future__ import annotations
+
+import ast
+import math
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import SourceFile
+
+#: contract dtype token -> numpy dtype name
+DTYPES = {
+    "bool": "bool",
+    "i8": "int8",
+    "i16": "int16",
+    "i32": "int32",
+    "i64": "int64",
+    "u8": "uint8",
+    "u16": "uint16",
+    "u32": "uint32",
+    "u64": "uint64",
+    "f16": "float16",
+    "bf16": "bfloat16",
+    "f32": "float32",
+    "f64": "float64",
+}
+
+_SPEC_RE = re.compile(
+    r"^(?P<dtype>bool|bf16|[iuf](?:8|16|32|64))\[(?P<axes>[^\]]*)\]"
+)
+_CEIL_RE = re.compile(r"^ceil\(\s*([A-Za-z_]\w*)\s*/\s*(\d+)\s*\)$")
+_IDENT_RE = re.compile(r"^[A-Za-z_]\w*$")
+
+
+@dataclass(frozen=True)
+class Axis:
+    """One axis of a contract: a literal size, a symbol, or ceil(sym/k)."""
+
+    sym: Optional[str]   # None for a literal axis
+    const: int = 0       # literal size, or the ceil divisor
+    ceil: bool = False
+
+    def resolve(self, env: Dict[str, int]) -> int:
+        if self.sym is None:
+            return self.const
+        v = env[self.sym]
+        return math.ceil(v / self.const) if self.ceil else v
+
+    def render(self) -> str:
+        if self.sym is None:
+            return str(self.const)
+        if self.ceil:
+            return f"ceil({self.sym}/{self.const})"
+        return self.sym
+
+
+@dataclass(frozen=True)
+class Contract:
+    cls: str
+    field: str
+    dtype: str           # numpy dtype name ("int32", "bool", ...)
+    axes: Tuple[Axis, ...]
+    line: int            # 1-based line of the field in its source file
+    file: str            # relpath of the defining source file
+
+    @property
+    def rank(self) -> int:
+        return len(self.axes)
+
+    def shape(self, env: Dict[str, int]) -> Tuple[int, ...]:
+        return tuple(a.resolve(env) for a in self.axes)
+
+    def render(self) -> str:
+        short = {v: k for k, v in DTYPES.items()}[self.dtype]
+        return f"{short}[{', '.join(a.render() for a in self.axes)}]"
+
+
+def parse_spec(text: str) -> Optional[Tuple[str, Tuple[Axis, ...]]]:
+    """Parse a comment body into (numpy dtype name, axes), or None."""
+    m = _SPEC_RE.match(text.strip())
+    if m is None:
+        return None
+    dtype = DTYPES[m.group("dtype")]
+    axes: List[Axis] = []
+    body = m.group("axes").strip()
+    if body:
+        for token in body.split(","):
+            token = token.strip()
+            if token.isdigit():
+                axes.append(Axis(sym=None, const=int(token)))
+                continue
+            cm = _CEIL_RE.match(token)
+            if cm is not None:
+                axes.append(Axis(sym=cm.group(1), const=int(cm.group(2)), ceil=True))
+                continue
+            if _IDENT_RE.match(token):
+                axes.append(Axis(sym=token))
+                continue
+            return None
+    return dtype, tuple(axes)
+
+
+_ARRAY_ANNOTATIONS = {"ndarray", "Array"}
+
+
+def _is_array_annotation(node: ast.AST) -> bool:
+    """np.ndarray / jnp.ndarray / numpy.ndarray / jax.Array."""
+    while isinstance(node, ast.Attribute):
+        if node.attr in _ARRAY_ANNOTATIONS:
+            return True
+        node = node.value
+    return isinstance(node, ast.Name) and node.id in _ARRAY_ANNOTATIONS
+
+
+def _is_namedtuple_class(node: ast.ClassDef) -> bool:
+    for base in node.bases:
+        name = base.attr if isinstance(base, ast.Attribute) else (
+            base.id if isinstance(base, ast.Name) else None
+        )
+        if name == "NamedTuple":
+            return True
+    return False
+
+
+@dataclass(frozen=True)
+class ContractIssue:
+    """A field that should carry a contract but doesn't parse."""
+
+    cls: str
+    field: str
+    line: int
+    reason: str  # "unannotated" | "unparseable: <comment>"
+
+
+def collect(src: SourceFile) -> Tuple[List[Contract], List[ContractIssue]]:
+    """Contracts (and presence/parse issues) for every array-annotated
+    NamedTuple field in one module."""
+    contracts: List[Contract] = []
+    issues: List[ContractIssue] = []
+    for node in src.tree.body:
+        if not isinstance(node, ast.ClassDef) or not _is_namedtuple_class(node):
+            continue
+        for stmt in node.body:
+            if not isinstance(stmt, ast.AnnAssign):
+                continue
+            if not isinstance(stmt.target, ast.Name):
+                continue
+            if not _is_array_annotation(stmt.annotation):
+                continue
+            field = stmt.target.id
+            line = stmt.lineno
+            text = src.lines[line - 1] if line <= len(src.lines) else ""
+            _, hash_, comment = text.partition("#")
+            if not hash_:
+                issues.append(
+                    ContractIssue(node.name, field, line, "unannotated")
+                )
+                continue
+            spec = parse_spec(comment)
+            if spec is None:
+                issues.append(
+                    ContractIssue(
+                        node.name, field, line,
+                        f"unparseable contract comment {comment.strip()!r}",
+                    )
+                )
+                continue
+            dtype, axes = spec
+            contracts.append(
+                Contract(node.name, field, dtype, axes, line, src.relpath)
+            )
+    return contracts, issues
+
+
+def index_by_class(
+    contracts: Sequence[Contract],
+) -> Dict[str, Dict[str, Contract]]:
+    out: Dict[str, Dict[str, Contract]] = {}
+    for c in contracts:
+        out.setdefault(c.cls, {})[c.field] = c
+    return out
+
+
+def container_map(src: SourceFile) -> Dict[str, str]:
+    """Field-name -> class-name for NamedTuple fields annotated with
+    OTHER NamedTuple classes (the Snapshot composition: ``pods:
+    PodBatch`` makes ``<x>.pods.<field>`` resolvable to PodBatch's
+    contract for ``<field>``)."""
+    classes = {
+        node.name
+        for node in src.tree.body
+        if isinstance(node, ast.ClassDef) and _is_namedtuple_class(node)
+    }
+    out: Dict[str, str] = {}
+    for node in src.tree.body:
+        if not isinstance(node, ast.ClassDef) or not _is_namedtuple_class(node):
+            continue
+        for stmt in node.body:
+            if (
+                isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+                and isinstance(stmt.annotation, ast.Name)
+                and stmt.annotation.id in classes
+            ):
+                out[stmt.target.id] = stmt.annotation.id
+    return out

@@ -1,0 +1,583 @@
+"""recompile-discipline: no kernel argument may trigger an unexpected
+XLA retrace.
+
+The perf stack's whole compile story (wavefront solve, prewarm pool,
+persistent compile cache) rests on one discipline: every array entering
+a ``@hot_path`` kernel is padded onto the power-of-two bucket lattice
+(utils.vocab.pad_dim / pad_constraint_dim) with the dtypes the schema
+contracts declare, so the set of XLA compile keys a workload generates
+is exactly the bucket set.  A single un-bucketed dimension or silently
+promoted dtype re-traces XLA and eats a 10-40 s compile on the hot
+path.  This pass PROVES the discipline by abstract interpretation:
+
+  encode     real ``SnapshotBuilder`` encodes at awkward raw sizes must
+             land exactly on the lattice: every array unifies with its
+             contract (analysis/contracts.py) under an axis environment
+             where ``N``/``P`` are pinned to their pad buckets and
+             free row axes must be constraint buckets;
+  kernels    every solver kernel (greedy / wavefront / auction) driven
+             through ``jax.eval_shape`` over contract-built abstract
+             snapshots across the lattice must yield outputs matching
+             the result contracts at every bucket — dtype-stable, no
+             shape that depends on anything but the bucket;
+  closure    the abstract input signatures (the compile keys) must be
+             exactly one per lattice point, and the lattice must be
+             closed under the gang-admission-retry subset solves
+             (``num_pods_hint`` pins every binary-search subset into
+             the full batch's bucket).
+
+This module imports JAX and therefore runs as its own CLI mode
+(``python -m kubernetes_tpu.analysis --shapes`` / ``make lint-shapes``)
+and tier-1 test (tests/test_shapes.py), keeping ``make lint``
+import-light.  The runtime complement is analysis/retrace.py: a
+``GRAFTLINT_SHAPES=1``-armable tracker counting the retraces that
+actually happen while tests and benches run.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import Finding, load_sources
+from . import contracts as ct
+
+CHECK = "recompile-discipline"
+
+#: (node bucket, pod bucket) lattice the kernels are driven across.
+#: Small buckets on purpose: eval_shape is tracing-only, but the solver
+#: scan bodies are large programs.
+LATTICE: Tuple[Tuple[int, int], ...] = ((8, 8), (16, 8), (16, 16), (32, 16))
+
+#: raw (nodes, pods) sizes the encoder is validated at — deliberately
+#: NOT powers of two (landing on the lattice is the encoder's doing)
+#: and with n/p in DIFFERENT buckets, so an N/P axis swap cannot hide
+ENCODE_SIZES: Tuple[Tuple[int, int], ...] = ((3, 12), (20, 2))
+
+#: representative raw batch sizes for the gang-retry closure check
+GANG_RETRY_SIZES: Tuple[int, ...] = (5, 8, 100, 1024)
+
+
+def _schema_contracts(root: str, package: str = "kubernetes_tpu"):
+    files = load_sources(root, [os.path.join(package, "ops")])
+    contracts: List[ct.Contract] = []
+    for src in files:
+        got, _issues = ct.collect(src)  # presence is tensor-contract's job
+        contracts.extend(got)
+    return ct.index_by_class(contracts)
+
+
+# -- axis environments -------------------------------------------------------
+
+def _class_env(
+    cls: str, limits, n: int, p: int, rows: Dict[str, int]
+) -> Dict[str, int]:
+    """Concrete axis environment for one schema class.  ``rows`` sets
+    the free constraint-row axes (default 1 = the no-constraints
+    bucket); everything else derives from SnapshotLimits — the same
+    derivations SnapshotBuilder uses, so drift fails the unify step."""
+    from ..ops import schema
+
+    r = rows.get("R", len(schema.FIXED_RESOURCES))
+    tk = len(limits.topology_keys)
+    common = {"N": n, "P": p, "R": r, "TK": tk}
+    if cls == "ClusterTensors":
+        return {
+            **common,
+            "LW": limits.label_words,
+            "TW": limits.taint_words,
+            "PW": limits.port_words,
+            "IW": limits.image_words,
+        }
+    if cls == "SelectorTable":
+        return {
+            "S": rows.get("S", 1),
+            "T": limits.max_terms,
+            "E": limits.max_exprs,
+            "K": limits.max_ids_per_expr,
+        }
+    if cls == "PreferredTable":
+        return {
+            "F": rows.get("F", 1),
+            "E": limits.max_exprs,
+            "K": limits.max_ids_per_expr,
+        }
+    if cls == "SpreadTable":
+        return {**common, "C": rows.get("C", 1), "MC": limits.max_spread_per_pod}
+    if cls == "TermTable":
+        return {**common, "T": rows.get("T", 1), "MA": limits.max_pod_terms}
+    if cls == "PodBatch":
+        c = rows.get("classes", 1)
+        return {
+            **common,
+            "TW": limits.taint_words,
+            "PW": limits.port_words,
+            "MT": limits.max_preferred,
+            "C": c,
+            "Cs": c,
+            "Cc": rows.get("cons_classes", 1),
+        }
+    if cls == "PrefPodTable":
+        return {**common, "U": rows.get("U", 1), "MA": limits.max_pod_terms}
+    if cls == "ImageTable":
+        return {**common, "I_pad": rows.get("I", 1), "MI": limits.max_pod_images}
+    raise KeyError(f"no axis environment for schema class {cls}")
+
+
+def _snapshot_classes():
+    """Snapshot field name -> component class (resolved, not the string
+    annotations)."""
+    import typing
+
+    from ..ops import schema
+
+    hints = typing.get_type_hints(schema.Snapshot)
+    return {f: hints[f] for f in schema.Snapshot._fields}
+
+
+def abstract_snapshot(
+    byclass, limits=None, n: int = 8, p: int = 8,
+    rows: Optional[Dict[str, int]] = None,
+):
+    """A Snapshot of ShapeDtypeStructs built FROM the contracts — the
+    contracts drive eval_shape, so schema/contract drift fails loudly."""
+    import jax
+    import numpy as np
+
+    from ..ops import schema
+
+    limits = limits or schema.SnapshotLimits()
+    rows = rows or {}
+    parts = {}
+    for field, cls in _snapshot_classes().items():
+        env = _class_env(cls.__name__, limits, n, p, rows)
+        cfields = byclass.get(cls.__name__, {})
+        vals = {}
+        for f in cls._fields:
+            c = cfields.get(f)
+            if c is None:
+                raise KeyError(
+                    f"{cls.__name__}.{f} has no parsed contract (run the "
+                    "tensor-contract pass first)"
+                )
+            vals[f] = jax.ShapeDtypeStruct(c.shape(env), np.dtype(c.dtype))
+        parts[field] = cls(**vals)
+    return schema.Snapshot(**parts)
+
+
+# -- unification (real arrays vs contracts) ----------------------------------
+
+def _is_pow2(x: int) -> bool:
+    from ..utils.vocab import is_pad_bucket
+
+    return is_pad_bucket(x, 1)
+
+
+def _constraint_bucket_ok(x: int) -> bool:
+    """pad_constraint_dim's range: 1 (no rows) or a power of two >= 32."""
+    from ..utils.vocab import is_constraint_bucket
+
+    return is_constraint_bucket(x)
+
+
+def _unify_table(
+    table, cfields: Dict[str, ct.Contract], env: Dict[str, int],
+    free_row_axes: Sequence[str], where: str, findings: List[Finding],
+    file: str, pow2_axes: Sequence[str] = (),
+) -> None:
+    """Check every array (or abstract ShapeDtypeStruct) of one table
+    against its contract, binding free axes on first sight and requiring
+    consistency afterwards.  ``free_row_axes`` must land on
+    pad_constraint_dim buckets; ``pow2_axes`` on pad_dim(x, 1) buckets
+    (the pod-class axes)."""
+    env = dict(env)
+    pend: List[Tuple[ct.Axis, int, str, int]] = []
+    for f in type(table)._fields:
+        arr = getattr(table, f)
+        c = cfields.get(f)
+        if c is None or arr is None or not hasattr(arr, "shape"):
+            continue
+        a = arr
+        sym = f"{c.cls}.{f}"
+        if str(a.dtype) != c.dtype:
+            findings.append(
+                Finding(
+                    CHECK, file, c.line, sym,
+                    f"{where}: dtype {a.dtype} != contract {c.render()}",
+                )
+            )
+        if len(a.shape) != c.rank:
+            findings.append(
+                Finding(
+                    CHECK, file, c.line, sym,
+                    f"{where}: rank {len(a.shape)} != contract {c.render()}",
+                )
+            )
+            continue
+        for j, (axis, dim) in enumerate(zip(c.axes, a.shape)):
+            if axis.sym is None:
+                if dim != axis.const:
+                    findings.append(
+                        Finding(
+                            CHECK, file, c.line, sym,
+                            f"{where}: axis {j} = {dim}, contract "
+                            f"{c.render()} pins it to {axis.const}",
+                        )
+                    )
+                continue
+            if axis.ceil:
+                pend.append((axis, dim, sym, c.line))
+                continue
+            bound = env.get(axis.sym)
+            if bound is None:
+                env[axis.sym] = dim
+                if axis.sym in free_row_axes and not _constraint_bucket_ok(dim):
+                    findings.append(
+                        Finding(
+                            CHECK, file, c.line, sym,
+                            f"{where}: free row axis {axis.sym} = {dim} is "
+                            "not a pad_constraint_dim bucket (1 or a power "
+                            "of two >= 32) — this shape recompiles per "
+                            "composition",
+                        )
+                    )
+                elif axis.sym in pow2_axes and not _is_pow2(dim):
+                    findings.append(
+                        Finding(
+                            CHECK, file, c.line, sym,
+                            f"{where}: free axis {axis.sym} = {dim} is not "
+                            "a pad_dim power-of-two bucket — this shape "
+                            "recompiles per composition",
+                        )
+                    )
+            elif bound != dim:
+                findings.append(
+                    Finding(
+                        CHECK, file, c.line, sym,
+                        f"{where}: axis {axis.sym} = {dim} but {axis.sym} = "
+                        f"{bound} elsewhere (contract {c.render()})",
+                    )
+                )
+    for axis, dim, sym, line in pend:
+        base = env.get(axis.sym)
+        if base is None:
+            continue
+        want = math.ceil(base / axis.const)
+        if dim != want:
+            findings.append(
+                Finding(
+                    CHECK, file, line, sym,
+                    f"{where}: ceil({axis.sym}/{axis.const}) = {want} "
+                    f"(from {axis.sym}={base}), got {dim}",
+                )
+            )
+
+
+#: Snapshot component class -> free (encode-determined) row axes that
+#: must land on pad_constraint_dim buckets
+_FREE_ROW_AXES = {
+    "ClusterTensors": (),
+    "SelectorTable": ("S",),
+    "PreferredTable": ("F",),
+    "SpreadTable": ("C",),
+    "TermTable": ("T",),
+    "PodBatch": (),
+    "PrefPodTable": ("U",),
+    "ImageTable": (),
+}
+
+#: free axes padded with pad_dim(x, 1): any power of two (pod-class and
+#: image-vocab axes)
+_POW2_AXES = {
+    "PodBatch": ("C", "Cs", "Cc"),
+    "ImageTable": ("I_pad",),
+}
+
+
+def _check_encode(byclass, findings: List[Finding]) -> None:
+    """Real SnapshotBuilder encodes at awkward raw sizes must land on
+    the lattice with contract dtypes everywhere."""
+    from ..api import types as api
+    from ..ops import schema
+    from ..testing.wrappers import GI, MI, make_node, make_pod
+    from ..utils import vocab as vb
+
+    file = "kubernetes_tpu/ops/schema.py"
+    for raw_n, raw_p in ENCODE_SIZES:
+        builder = schema.SnapshotBuilder()
+        nodes = [
+            make_node(f"n{i}")
+            .capacity(cpu_milli=4000, mem=8 * GI, pods=16)
+            .zone(f"z{i % 2}")
+            .obj()
+            for i in range(raw_n)
+        ]
+        pods = []
+        for i in range(raw_p):
+            pw = (
+                make_pod(f"p{i}")
+                .req(cpu_milli=100, mem=128 * MI)
+                .label("app", f"svc-{i % 2}")
+            )
+            if i % 2 == 0:
+                pw.spread(
+                    1, api.LABEL_ZONE, "DoNotSchedule", {"app": f"svc-{i % 2}"}
+                )
+            else:
+                pw.pod_anti_affinity(
+                    {"app": f"svc-{i % 2}"}, api.LABEL_HOSTNAME
+                )
+            pods.append(pw.obj())
+        snap, meta = builder.build(nodes, pods)
+        lim = builder.limits
+        n_pad = vb.pad_dim(raw_n, lim.min_nodes)
+        p_pad = vb.pad_dim(raw_p, lim.min_pods)
+        rows = {"R": len(meta.resource_names)}
+        for field, table in zip(type(snap)._fields, snap):
+            cls = type(table).__name__
+            env = _class_env(cls, lim, n_pad, p_pad, rows)
+            # free axes bind to what the encoder produced; drop their
+            # seeded defaults so unify sees them as free
+            free = _FREE_ROW_AXES.get(cls, ())
+            pow2 = _POW2_AXES.get(cls, ())
+            env = {
+                k: v for k, v in env.items()
+                if k not in free and k not in pow2
+            }
+            _unify_table(
+                table, byclass.get(cls, {}), env, free,
+                f"encode[{raw_n}x{raw_p}].{field}", findings, file,
+                pow2_axes=pow2,
+            )
+
+
+def _result_contract_check(
+    result, cls_name: str, byclass, env: Dict[str, int], where: str,
+    findings: List[Finding], file: str,
+) -> None:
+    """eval_shape output vs the result NamedTuple's contracts; component
+    tables (SolveResult.cluster) recurse into their own contracts."""
+    cfields = byclass.get(cls_name, {})
+    for f in type(result)._fields:
+        val = getattr(result, f)
+        if val is None:
+            continue
+        c = cfields.get(f)
+        if c is None:
+            sub = type(val).__name__
+            if sub in byclass:
+                sub_env = {
+                    k: env[k] for k in ("N", "P", "R", "TK", "LW", "TW",
+                                        "PW", "IW") if k in env
+                }
+                _unify_table(
+                    val, byclass[sub], sub_env, (), f"{where}.{f}",
+                    findings, file,
+                )
+            continue
+        want_shape = c.shape(env)
+        if tuple(val.shape) != want_shape or str(val.dtype) != c.dtype:
+            findings.append(
+                Finding(
+                    CHECK, file, c.line, f"{cls_name}.{f}",
+                    f"{where}: eval_shape output {val.dtype}"
+                    f"{tuple(val.shape)} != contract {c.render()} "
+                    f"(= {c.dtype}{want_shape})",
+                )
+            )
+
+
+def _check_kernels(byclass, findings: List[Finding]) -> None:
+    """Drive the three solver kernels through eval_shape across the
+    lattice; outputs must match the result contracts at every bucket
+    and the abstract signature set must be exactly one per call."""
+    import jax
+
+    from ..ops import assign, auction, schema
+    from . import retrace
+
+    limits = schema.SnapshotLimits()
+    ff_off = assign.FeatureFlags()
+
+    def env_for(n, p, rows=None):
+        env = _class_env("ClusterTensors", limits, n, p, rows or {})
+        return env
+
+    signatures = {"greedy": set(), "wavefront": set(), "auction": set()}
+    calls = {"greedy": 0, "wavefront": 0, "auction": 0}
+
+    for n, p in LATTICE:
+        snap = abstract_snapshot(byclass, limits, n=n, p=p)
+
+        # greedy scan
+        calls["greedy"] += 1
+        signatures["greedy"].add(
+            retrace.signature(snap, (1, ff_off, 0))
+        )
+        try:
+            res = jax.eval_shape(
+                lambda s: assign.greedy_assign(
+                    s, topo_z=1, features=ff_off, n_groups=0
+                ),
+                snap,
+            )
+            _result_contract_check(
+                res, "SolveResult", byclass, env_for(n, p),
+                f"greedy[{n}x{p}]", findings, "kubernetes_tpu/ops/assign.py",
+            )
+        except Exception as e:  # noqa: BLE001 — abstract eval failed
+            findings.append(
+                Finding(
+                    CHECK, "kubernetes_tpu/ops/assign.py", 1,
+                    "greedy_assign",
+                    f"eval_shape failed at bucket {n}x{p}: {e}",
+                )
+            )
+
+        # wavefront (wave plan is a device arg: i32[W_pad, K], the
+        # same shape plan_waves pads to)
+        from ..utils.vocab import pad_dim
+
+        w_pad = pad_dim(max(-(-p // assign.DEFAULT_WAVE_CAP), 1), 8)
+        members = jax.ShapeDtypeStruct(
+            (w_pad, assign.DEFAULT_WAVE_CAP), "int32"
+        )
+        calls["wavefront"] += 1
+        signatures["wavefront"].add(
+            retrace.signature((snap, members), (1, ff_off, 0))
+        )
+        try:
+            res = jax.eval_shape(
+                lambda s, m: assign.wavefront_assign(
+                    s, m, topo_z=1, features=ff_off, n_groups=0
+                ),
+                snap, members,
+            )
+            _result_contract_check(
+                res, "SolveResult", byclass, env_for(n, p),
+                f"wavefront[{n}x{p}]", findings,
+                "kubernetes_tpu/ops/assign.py",
+            )
+        except Exception as e:  # noqa: BLE001
+            findings.append(
+                Finding(
+                    CHECK, "kubernetes_tpu/ops/assign.py", 1,
+                    "wavefront_assign",
+                    f"eval_shape failed at bucket {n}x{p}: {e}",
+                )
+            )
+
+        # auction (joint solve)
+        tie_k = min(64, n)
+        calls["auction"] += 1
+        signatures["auction"].add(
+            retrace.signature(snap, (0, ff_off, (1, 1), tie_k))
+        )
+        try:
+            res = jax.eval_shape(
+                lambda s: auction.auction_assign(
+                    s, n_groups=0, features=ff_off, topo_z=(1, 1),
+                    tie_k=tie_k,
+                ),
+                snap,
+            )
+            _result_contract_check(
+                res, "AuctionResult", byclass, env_for(n, p),
+                f"auction[{n}x{p}]", findings,
+                "kubernetes_tpu/ops/auction.py",
+            )
+        except Exception as e:  # noqa: BLE001
+            findings.append(
+                Finding(
+                    CHECK, "kubernetes_tpu/ops/auction.py", 1,
+                    "auction_assign",
+                    f"eval_shape failed at bucket {n}x{p}: {e}",
+                )
+            )
+
+    # a constraint-family flip IS a distinct compile key (the prewarm
+    # pool compiles the flipped variant for exactly this reason): the
+    # spread-enabled signature must differ from the base one
+    n, p = 16, 16
+    snap_sp = abstract_snapshot(
+        byclass, limits, n=n, p=p, rows={"C": 32}
+    )
+    ff_sp = assign.FeatureFlags(spread=True, spread_slots=(1,))
+    sig_sp = retrace.signature(snap_sp, (8, ff_sp, 0))
+    if sig_sp in signatures["greedy"]:
+        findings.append(
+            Finding(
+                CHECK, "kubernetes_tpu/ops/assign.py", 1, "greedy_assign",
+                "spread-enabled signature collides with a base-lattice "
+                "compile key (feature flags must be part of the key)",
+            )
+        )
+    try:
+        res = jax.eval_shape(
+            lambda s: assign.greedy_assign(
+                s, topo_z=8, features=ff_sp, n_groups=0
+            ),
+            snap_sp,
+        )
+        _result_contract_check(
+            res, "SolveResult", byclass, env_for(n, p),
+            f"greedy+spread[{n}x{p}]", findings,
+            "kubernetes_tpu/ops/assign.py",
+        )
+    except Exception as e:  # noqa: BLE001
+        findings.append(
+            Finding(
+                CHECK, "kubernetes_tpu/ops/assign.py", 1, "greedy_assign",
+                f"eval_shape (spread features) failed at {n}x{p}: {e}",
+            )
+        )
+
+    for label, sigs in signatures.items():
+        if len(sigs) != calls[label]:
+            findings.append(
+                Finding(
+                    CHECK, "kubernetes_tpu/ops/assign.py", 1, label,
+                    f"{calls[label]} lattice points produced "
+                    f"{len(sigs)} distinct compile keys — the abstract "
+                    "signature set must be exactly the bucket set",
+                )
+            )
+
+
+def _check_gang_retry_closure(findings: List[Finding]) -> None:
+    """The gang-admission binary search re-solves SUBSETS of the batch
+    with num_pods_hint pinned to the full batch size: every subset must
+    land in the full batch's pad bucket (one executable for the whole
+    search, not one per subset size)."""
+    from ..ops import schema
+    from ..utils import vocab as vb
+
+    min_pods = schema.SnapshotLimits().min_pods
+    for full in GANG_RETRY_SIZES:
+        bucket = vb.pad_dim(full, min_pods)
+        bad = [
+            k for k in range(1, full + 1)
+            if vb.pad_dim(max(k, full), min_pods) != bucket
+        ]
+        if bad:
+            findings.append(
+                Finding(
+                    CHECK, "kubernetes_tpu/utils/vocab.py", 1, "pad_dim",
+                    f"bucket lattice not closed under gang-retry subsets "
+                    f"of a {full}-pod batch: sizes {bad[:5]} escape bucket "
+                    f"{bucket}",
+                )
+            )
+
+
+def check(root: str, package: str = "kubernetes_tpu") -> List[Finding]:
+    """Run the full recompile-discipline suite.  Imports JAX; callers
+    wanting an import-light lint use run_all instead."""
+    byclass = _schema_contracts(root, package)
+    findings: List[Finding] = []
+    _check_encode(byclass, findings)
+    _check_kernels(byclass, findings)
+    _check_gang_retry_closure(findings)
+    findings.sort(key=lambda f: (f.file, f.line, f.message))
+    return findings

@@ -1,0 +1,468 @@
+"""tensor-contract: the dense-tensor schema is a checked contract.
+
+The Filter/Score pipeline lives in statically-shaped arrays whose
+dtype/axis conventions (ops/schema.py) used to be prose comments.  This
+pass parses them into machine-readable contracts (analysis/contracts.py)
+and enforces, over the ``ops/``, ``models/`` and ``parallel/`` packages:
+
+  presence    every array field of every NamedTuple carries a parseable
+              ``# <dtype>[<axes>]`` contract comment;
+  dtype       kernel/host-prep code must stay dtype-stable: no 64-bit
+              numpy dtypes (``np.float64`` host values weak-type-promote
+              downstream f32 device math; ``np.int64`` widens i32/u32
+              bitset state), no ``dtype=float`` / ``dtype=int`` /
+              ``.astype(float)`` round-trips through Python's 64-bit
+              builtins;
+  bitset      ``u32`` bitset updates must wrap Python int shifts
+              (``bits |= 1 << i`` silently widens the whole expression
+              to i64; ``bits |= np.uint32(1 << i)`` does not);
+  axes        a variable derived from one symbolic axis must not index
+              an array along a different one: ``p = pods.req.shape[0]``
+              binds ``p ≡ P``, so ``cluster.allocatable[:p]`` (axis 0 is
+              ``N``) is flagged.  ``X.shape[k]`` beyond the declared
+              rank is flagged too;
+  boundary    device transfers of bare Python list/tuple literals
+              (``jnp.asarray([..])`` promotes to 64-bit by default) must
+              carry an explicit dtype — host/device crossings go through
+              the schema dtypes.
+
+Chain resolution is conservative: ``<...>.pods.req`` resolves through
+the Snapshot composition (contracts.container_map), a bare field name
+resolves only when exactly one NamedTuple in scope declares it, and
+everything else is skipped.  Deliberate 64-bit host-only state (e.g.
+ClusterState's generation counters, which never cross to the device)
+carries a line suppression with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import Finding, SourceFile, dotted_name
+from . import contracts as ct
+
+CHECK = "tensor-contract"
+
+#: packages (relative to the scanned package root) the pass spans
+DEFAULT_SCOPE = ("ops", "models", "parallel")
+
+SCHEMA_FILE = "ops/schema.py"
+
+_WIDE_DTYPES = {"float64", "int64", "uint64", "double", "longlong"}
+_NUMPY_ROOTS = {"np", "numpy", "jnp", "jax"}
+_TRANSFER_FNS = {"asarray", "array", "device_put"}
+_UINT_WRAPPERS = {"uint32", "uint16", "uint8", "int32"}
+_BITWISE_OPS = (ast.BitOr, ast.BitAnd, ast.BitXor)
+
+
+def _in_scope(relpath: str, package: str, scope: Tuple[str, ...]) -> bool:
+    parts = relpath.replace("\\", "/").split("/")
+    return len(parts) >= 2 and parts[0] == package and parts[1] in scope
+
+
+def _attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """['snap', 'pods', 'req'] for a pure Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+class _Resolver:
+    """Attribute-chain -> Contract, via the Snapshot composition map or
+    a globally-unique field name."""
+
+    def __init__(self, contracts: Sequence[ct.Contract],
+                 containers: Dict[str, str]):
+        self.by_class = ct.index_by_class(contracts)
+        self.containers = containers
+        by_field: Dict[str, List[ct.Contract]] = {}
+        for c in contracts:
+            by_field.setdefault(c.field, []).append(c)
+        self.unique = {
+            f: cs[0] for f, cs in by_field.items() if len(cs) == 1
+        }
+
+    def resolve(self, node: ast.AST) -> Optional[ct.Contract]:
+        chain = _attr_chain(node)
+        if chain is None or len(chain) < 2:
+            return None
+        field = chain[-1]
+        container = chain[-2]
+        cls = self.containers.get(container)
+        if cls is not None:
+            return self.by_class.get(cls, {}).get(field)
+        return self.unique.get(field)
+
+
+def _index_elements(index: ast.AST) -> Optional[List[ast.AST]]:
+    """Positional index elements, or None when the subscript uses
+    Ellipsis/newaxis (axis positions no longer line up)."""
+    elts = list(index.elts) if isinstance(index, ast.Tuple) else [index]
+    for e in elts:
+        if isinstance(e, ast.Constant) and e.value in (Ellipsis, None):
+            return None
+    return elts
+
+
+def _names_in_index_elt(elt: ast.AST) -> List[str]:
+    """Bare axis-variable names an index element compares against the
+    declared axis: a plain name, or the lower/upper of a plain slice."""
+    if isinstance(elt, ast.Name):
+        return [elt.id]
+    if isinstance(elt, ast.Slice):
+        out = []
+        for side in (elt.lower, elt.upper):
+            if isinstance(side, ast.Name):
+                out.append(side.id)
+        return out
+    return []
+
+
+class _FunctionChecker(ast.NodeVisitor):
+    """Axis-consistency walk of one function body."""
+
+    def __init__(self, pass_, symbol: str):
+        self.p = pass_
+        self.symbol = symbol
+        self.bindings: Dict[str, str] = {}  # var -> axis symbol
+
+    # -- bindings ---------------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._maybe_bind(node)
+        self.generic_visit(node)
+
+    def _maybe_bind(self, node: ast.Assign) -> None:
+        if len(node.targets) != 1:
+            return
+        target, value = node.targets[0], node.value
+        # v = <chain>.shape[k]
+        if (
+            isinstance(target, ast.Name)
+            and isinstance(value, ast.Subscript)
+            and isinstance(value.value, ast.Attribute)
+            and value.value.attr == "shape"
+            and isinstance(value.slice, ast.Constant)
+            and isinstance(value.slice.value, int)
+        ):
+            contract = self.p.resolver.resolve(value.value.value)
+            if contract is None:
+                return
+            k = value.slice.value
+            if k >= contract.rank or k < -contract.rank:
+                self.p.flag(
+                    value.lineno, self.symbol,
+                    f"shape[{k}] out of range for {contract.cls}."
+                    f"{contract.field} {contract.render()} "
+                    f"(rank {contract.rank})",
+                )
+                return
+            axis = contract.axes[k]
+            if axis.sym is not None and not axis.ceil:
+                self.bindings[target.id] = axis.sym
+            return
+        # a, b = <chain>.shape
+        if (
+            isinstance(target, ast.Tuple)
+            and isinstance(value, ast.Attribute)
+            and value.attr == "shape"
+        ):
+            contract = self.p.resolver.resolve(value.value)
+            if contract is None:
+                return
+            if any(isinstance(t, ast.Starred) for t in target.elts):
+                return
+            if len(target.elts) != contract.rank:
+                self.p.flag(
+                    value.lineno, self.symbol,
+                    f"unpacks {len(target.elts)} dims from {contract.cls}."
+                    f"{contract.field} {contract.render()} "
+                    f"(rank {contract.rank})",
+                )
+                return
+            for t, axis in zip(target.elts, contract.axes):
+                if (
+                    isinstance(t, ast.Name)
+                    and axis.sym is not None
+                    and not axis.ceil
+                ):
+                    self.bindings[t.id] = axis.sym
+
+    # -- usage ------------------------------------------------------------
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # <chain>.shape[k] rank check (unassigned uses too)
+        if (
+            isinstance(node.value, ast.Attribute)
+            and node.value.attr == "shape"
+            and isinstance(node.slice, ast.Constant)
+            and isinstance(node.slice.value, int)
+        ):
+            contract = self.p.resolver.resolve(node.value.value)
+            if contract is not None:
+                k = node.slice.value
+                if k >= contract.rank or k < -contract.rank:
+                    self.p.flag(
+                        node.lineno, self.symbol,
+                        f"shape[{k}] out of range for {contract.cls}."
+                        f"{contract.field} {contract.render()} "
+                        f"(rank {contract.rank})",
+                    )
+            self.generic_visit(node)
+            return
+        contract = self.p.resolver.resolve(node.value)
+        if contract is not None:
+            elts = _index_elements(node.slice)
+            if elts is not None:
+                for j, elt in enumerate(elts):
+                    if j >= contract.rank:
+                        self.p.flag(
+                            node.lineno, self.symbol,
+                            f"{contract.rank + 1}+ indices into "
+                            f"{contract.cls}.{contract.field} "
+                            f"{contract.render()} (rank {contract.rank})",
+                        )
+                        break
+                    declared = contract.axes[j]
+                    if declared.sym is None or declared.ceil:
+                        continue
+                    for name in _names_in_index_elt(elt):
+                        used = self.bindings.get(name)
+                        if used is not None and used != declared.sym:
+                            self.p.flag(
+                                node.lineno, self.symbol,
+                                f"indexes {contract.cls}.{contract.field} "
+                                f"axis {j} (declared {declared.sym}) with "
+                                f"{used}-derived variable '{name}'",
+                            )
+        self.generic_visit(node)
+
+    # nested defs get their own binding scope via the outer walk
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.p.check_function(node, f"{self.symbol}.{node.name}",
+                              parent_bindings=self.bindings)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+class _FilePass:
+    def __init__(self, src: SourceFile, resolver: _Resolver,
+                 findings: List[Finding]):
+        self.src = src
+        self.resolver = resolver
+        self.findings = findings
+
+    def flag(self, line: int, symbol: str, message: str) -> None:
+        if not self.src.suppressed(line, CHECK):
+            self.findings.append(
+                Finding(CHECK, self.src.relpath, line, symbol, message)
+            )
+
+    # -- per-function axis walk -------------------------------------------
+
+    def check_function(self, node, symbol: str,
+                       parent_bindings: Optional[Dict[str, str]] = None):
+        checker = _FunctionChecker(self, symbol)
+        if parent_bindings:
+            checker.bindings.update(parent_bindings)
+        for stmt in node.body:
+            checker.visit(stmt)
+
+    def check_axes(self) -> None:
+        for node in self.src.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.check_function(node, node.name)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self.check_function(sub, f"{node.name}.{sub.name}")
+
+    # -- dtype / bitset / boundary hazards --------------------------------
+
+    def check_dtypes(self) -> None:
+        seen = set()
+        parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.src.tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        symbol_of = self._symbol_index()
+        for node in ast.walk(self.src.tree):
+            line = getattr(node, "lineno", None)
+            if line is None:
+                continue
+            symbol = symbol_of(line)
+            # 64-bit numpy dtype mention anywhere in kernel scope
+            if isinstance(node, ast.Attribute) and node.attr in _WIDE_DTYPES:
+                root = _attr_chain(node)
+                if root is not None and root[0] in _NUMPY_ROOTS:
+                    key = (line, node.attr)
+                    if key not in seen:
+                        seen.add(key)
+                        self.flag(
+                            line, symbol,
+                            f"64-bit dtype {'.'.join(root)} (weak-type "
+                            "promotes f32/i32 schema state; use the "
+                            "contract dtype)",
+                        )
+            if isinstance(node, ast.keyword) and node.arg == "dtype":
+                v = node.value
+                if isinstance(v, ast.Name) and v.id in ("float", "int"):
+                    self.flag(
+                        line, symbol,
+                        f"dtype={v.id} resolves to 64-bit "
+                        "(use the contract dtype)",
+                    )
+                elif (
+                    isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)
+                    and v.value in _WIDE_DTYPES
+                ):
+                    self.flag(
+                        line, symbol,
+                        f"dtype='{v.value}' (64-bit; use the contract dtype)",
+                    )
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype"
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in ("float", "int")
+            ):
+                self.flag(
+                    line, symbol,
+                    f".astype({node.args[0].id}) round-trips through a "
+                    "64-bit builtin (use the contract dtype)",
+                )
+            # u32 scalar shifted by an unwrapped arithmetic expression:
+            # `np.uint32(1) << (i32 & 31)` promotes the WHOLE expression
+            # to i64 under NumPy 2 value-independent promotion
+            if (
+                isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.LShift)
+                and isinstance(node.left, ast.Call)
+                and (dotted_name(node.left.func) or "").split(".")[-1]
+                in _UINT_WRAPPERS
+                and isinstance(node.right, (ast.BinOp, ast.Name))
+            ):
+                self.flag(
+                    line, symbol,
+                    "uint-wrapped scalar shifted by an unwrapped "
+                    "expression promotes to i64 (NumPy 2); cast the "
+                    "shift count with .astype(np.uint32)",
+                )
+            # u32 bitset math widened to i64 by a bare Python int shift
+            if (
+                isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.LShift)
+                and isinstance(node.left, ast.Constant)
+                and isinstance(node.left.value, int)
+            ):
+                cur, in_bitexpr, wrapped = node, False, False
+                while cur in parents:
+                    cur = parents[cur]
+                    if isinstance(cur, ast.BinOp) and isinstance(
+                        cur.op, _BITWISE_OPS
+                    ):
+                        in_bitexpr = True
+                    elif isinstance(cur, ast.AugAssign) and isinstance(
+                        cur.op, _BITWISE_OPS
+                    ):
+                        in_bitexpr = True
+                    elif isinstance(cur, ast.Call):
+                        name = dotted_name(cur.func)
+                        if name is not None and name.split(".")[-1] in _UINT_WRAPPERS:
+                            wrapped = True
+                    elif isinstance(cur, (ast.FunctionDef, ast.ClassDef)):
+                        break
+                if in_bitexpr and not wrapped:
+                    self.flag(
+                        line, symbol,
+                        "bare Python int shift in bitset math widens to "
+                        "i64; wrap in np.uint32(...)",
+                    )
+            # host/device boundary: literal transfers without a dtype
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if (
+                    name is not None
+                    and name.split(".")[0] in ("jnp", "jax")
+                    and name.split(".")[-1] in _TRANSFER_FNS
+                    and node.args
+                    and isinstance(node.args[0], (ast.List, ast.Tuple))
+                    and not any(k.arg == "dtype" for k in node.keywords)
+                ):
+                    self.flag(
+                        line, symbol,
+                        f"{name} of a Python literal without dtype "
+                        "(promotes to 64-bit; cross the boundary through "
+                        "schema dtypes)",
+                    )
+
+    def _symbol_index(self):
+        """line -> enclosing 'Class.method'/'function' name (best effort)."""
+        spans: List[Tuple[int, int, str]] = []
+
+        def add(node, name):
+            end = getattr(node, "end_lineno", node.lineno)
+            spans.append((node.lineno, end, name))
+
+        for node in self.src.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                add(node, node.name)
+            elif isinstance(node, ast.ClassDef):
+                add(node, node.name)
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        add(sub, f"{node.name}.{sub.name}")
+        spans.sort()
+
+        def lookup(line: int) -> str:
+            best = "<module>"
+            for lo, hi, name in spans:
+                if lo <= line <= hi:
+                    best = name  # later (inner) spans refine
+            return best
+
+        return lookup
+
+
+def check(
+    files: List[SourceFile],
+    package: str = "kubernetes_tpu",
+    scope: Tuple[str, ...] = DEFAULT_SCOPE,
+) -> List[Finding]:
+    in_scope = [f for f in files if _in_scope(f.relpath, package, scope)]
+
+    # contract presence + the shared contract table
+    all_contracts: List[ct.Contract] = []
+    containers: Dict[str, str] = {}
+    findings: List[Finding] = []
+    for src in in_scope:
+        contracts, issues = ct.collect(src)
+        all_contracts.extend(contracts)
+        containers.update(ct.container_map(src))
+        for issue in issues:
+            if src.suppressed(issue.line, CHECK):
+                continue
+            findings.append(
+                Finding(
+                    CHECK, src.relpath, issue.line,
+                    f"{issue.cls}.{issue.field}",
+                    f"array field without a tensor contract ({issue.reason}); "
+                    "annotate `# <dtype>[<axes>]`",
+                )
+            )
+
+    resolver = _Resolver(all_contracts, containers)
+    for src in in_scope:
+        fp = _FilePass(src, resolver, findings)
+        fp.check_dtypes()
+        fp.check_axes()
+    return findings

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import retrace
 from ..analysis.markers import hot_path
 from ..api import types as api
 from ..ops import assign as assign_ops
@@ -304,7 +305,12 @@ def _packed_device_put(tree, unpack_cache: dict):
         buf = entry["bufs"][flip] = np.zeros(nbytes, dtype=np.uint8)
     for a, o in zip(arrs, offsets):
         buf[o : o + a.nbytes] = a.view(np.uint8).ravel()
-    outs = entry["unpack"](jax.device_put(buf[:nbytes]))
+    unpack = entry["unpack"]
+    outs = unpack(jax.device_put(buf[:nbytes]))
+    # layout churn recompiles the unpack program: report it to the
+    # recompile-discipline tracker like the solver dispatches (specs IS
+    # the executable key here)
+    retrace.note("snapshot-unpack", unpack, lambda: specs)
     for i, out in zip(host_idx, outs):
         leaves[i] = out
     return jax.tree.unflatten(treedef, leaves)

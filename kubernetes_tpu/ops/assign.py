@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import retrace
 from ..analysis.markers import hot_path
 from .filters import (
     fits_resources,
@@ -564,7 +565,12 @@ def greedy_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
             from ..utils.vocab import pad_dim
 
             n_groups = pad_dim(n_groups, 1)
-        return run(snapshot, topo_z, features, n_groups)
+        out = run(snapshot, topo_z, features, n_groups)
+        retrace.note(
+            "greedy", run,
+            lambda: retrace.signature(snapshot, (topo_z, features, n_groups)),
+        )
+        return out
 
     call.jitted = run  # raw jit, for AOT prewarm (lower().compile())
     return call
@@ -620,7 +626,12 @@ def _pack_idx_rows(idx: np.ndarray, dim: int) -> np.ndarray:
     out = np.zeros((p, words), dtype=np.uint32)
     rows, vals = np.nonzero(idx >= 0)
     ids = idx[rows, vals]
-    np.bitwise_or.at(out, (rows, ids >> 5), np.uint32(1) << (ids & 31))
+    # the shift count must be u32: `np.uint32(1) << (i32 & 31)` promotes
+    # the whole expression to i64 under NumPy 2 (a tensor-contract
+    # bitset-widening true positive)
+    np.bitwise_or.at(
+        out, (rows, ids >> 5), np.uint32(1) << (ids & 31).astype(np.uint32)
+    )
     return out
 
 
@@ -694,7 +705,11 @@ def plan_waves(  # graftlint: disable=purity -- host-side prep: the wave partiti
     port_acc = None if not use_ports else np.zeros_like(port_bits[0])
     sp_acc = None if not use_spread else np.zeros_like(writes_sp[0])
     tm_acc = None if not use_terms else np.zeros_like(writes_tm[0])
-    demand = np.zeros(req.shape[1], dtype=np.float64)
+    # f32, matching the schema's request dtype: an f64 accumulator here
+    # promoted every downstream `demand + req[i]` comparison to f64 (a
+    # tensor-contract finding), and request quantities stay inside f32's
+    # exact-integer envelope by construction (schema.F32_EXACT_LIMIT)
+    demand = np.zeros(req.shape[1], dtype=np.float32)
 
     def close():
         nonlocal cur, port_acc, sp_acc, tm_acc, demand
@@ -707,7 +722,7 @@ def plan_waves(  # graftlint: disable=purity -- host-side prep: the wave partiti
             sp_acc = np.zeros_like(writes_sp[0])
         if use_terms:
             tm_acc = np.zeros_like(writes_tm[0])
-        demand = np.zeros(req.shape[1], dtype=np.float64)
+        demand = np.zeros(req.shape[1], dtype=np.float32)
 
     for i in order.tolist():
         conflict = len(cur) >= wave_cap
@@ -1163,10 +1178,15 @@ def wavefront_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
             wave_members = plan_waves(
                 snapshot, features=features, wave_cap=wave_cap
             ).members
-        return run(
-            snapshot, jnp.asarray(wave_members, jnp.int32), topo_z,
-            features, n_groups,
+        members = jnp.asarray(wave_members, jnp.int32)
+        out = run(snapshot, members, topo_z, features, n_groups)
+        retrace.note(
+            "wavefront", run,
+            lambda: retrace.signature(
+                (snapshot, members), (topo_z, features, n_groups)
+            ),
         )
+        return out
 
     call.jitted = run  # raw jit, for AOT prewarm (lower().compile())
     return call

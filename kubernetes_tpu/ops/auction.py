@@ -67,6 +67,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import retrace
 from ..analysis.markers import hot_path
 from .assign import (
     NEG_INF,
@@ -110,7 +111,7 @@ class AuctionResult(NamedTuple):
     gang_dropped: jnp.ndarray  # bool[P]: placed but released with its gang
     cluster: ClusterTensors   # post-solve cluster
     reasons: jnp.ndarray = None  # i32[P]: assign.REASON_* for unplaced pods
-    debug_sp_counts: jnp.ndarray = None  # [C, N] final spread counts (debug)
+    debug_sp_counts: jnp.ndarray = None  # f32[C, N] final spread counts (debug)
 
 
 def auction_features_ok(features: FeatureFlags) -> bool:
@@ -884,6 +885,13 @@ def auction_assign_jit(
             topo_z = required_topo_z_split(snapshot)
         if tie_k is None:
             tie_k = default_tie_k(snapshot)
-        return run(snapshot, n_groups, features, topo_z, tie_k)
+        out = run(snapshot, n_groups, features, topo_z, tie_k)
+        retrace.note(
+            "auction", run,
+            lambda: retrace.signature(
+                snapshot, (n_groups, features, topo_z, tie_k)
+            ),
+        )
+        return out
 
     return call

@@ -793,21 +793,30 @@ class SnapshotBuilder:
         if image_bits is not None:
             self._image_row(node, image_bits[i])
 
-    def _check_f32_exact(self, node_name: str, alloc_row: np.ndarray) -> None:
-        """Warn (once per builder) when a node's allocatable exceeds the
-        f32 exact-integer envelope: score floors may drift ±1 vs the
+    def _check_f32_exact(
+        self, name: str, row: np.ndarray, kind: str = "node"
+    ) -> None:
+        """Warn (once per builder) when an encoded resource value exceeds
+        the f32 exact-integer envelope: score floors may drift ±1 vs the
         reference's int64 math (the `* 100 < 2^24` claim in ops/scores.py
-        is only guaranteed inside this range)."""
+        is only guaranteed inside this range).
+
+        Fired at EVERY encode site that feeds the score kernels'
+        `quantity * 100` products (a tensor-contract audit item): node
+        allocatable (_write_node_row), pending-pod request rows
+        (_build_pods — the `cap - req` / `req * 100` numerators), and
+        bound/assumed pod usage (pod_usage — accumulated requested
+        state)."""
         if getattr(self, "_f32_warned", False):
             return
-        over = alloc_row[alloc_row > F32_EXACT_LIMIT]
+        over = row[row > F32_EXACT_LIMIT]
         if over.size:
             self._f32_warned = True
             warnings.warn(
-                f"node {node_name!r}: allocatable value {over.max():.0f} "
+                f"{kind} {name!r}: encoded resource value {over.max():.0f} "
                 f"(device units) exceeds {F32_EXACT_LIMIT:.0f}; "
                 "Least/MostAllocated scores may differ from the reference "
-                "by ±1 on this node (f32 exactness envelope)",
+                "by ±1 here (f32 exactness envelope)",
                 stacklevel=3,
             )
 
@@ -821,6 +830,7 @@ class SnapshotBuilder:
         here would be dropped, so grow=False keeps the axis stable."""
         req = self._resource_vector(self.effective_requests(pod), r, grow=False)
         req[RESOURCE_PODS] = 1.0
+        self._check_f32_exact(pod.meta.name, req, kind="pod")
         nz = req.copy()
         nz_cpu, nz_mem = pod.nonzero_requests()
         nz[RESOURCE_CPU] = nz_cpu
@@ -906,6 +916,7 @@ class SnapshotBuilder:
                 self.effective_requests(pod), r, grow=False
             )
             rv[RESOURCE_PODS] = 1.0
+            self._check_f32_exact(pod.meta.name, rv, kind="pod")
             req[i] = rv
             nz = rv.copy()
             nz_cpu, nz_mem = pod.nonzero_requests()
@@ -1403,8 +1414,11 @@ class ClusterState:
         self.port_bits = np.zeros((cap, lim.port_words), dtype=np.uint32)
         self.topo_ids = np.full((cap, len(lim.topology_keys)), -1, dtype=np.int32)
         self.image_bits = np.zeros((cap, lim.image_words), dtype=np.uint32)
-        self._static_gen = np.zeros(cap, dtype=np.int64)
-        self._usage_gen = np.zeros(cap, dtype=np.int64)
+        # i64 is deliberate here: monotonic host-side generation counters
+        # for the mirror sync protocol — they never cross to the device
+        # and must not wrap within a process lifetime
+        self._static_gen = np.zeros(cap, dtype=np.int64)  # graftlint: disable=tensor-contract -- host-only generation counter, never device-resident
+        self._usage_gen = np.zeros(cap, dtype=np.int64)  # graftlint: disable=tensor-contract -- host-only generation counter, never device-resident
 
     def _grow(self, cap: int) -> None:
         old = self.tensors(pad=False)

@@ -187,6 +187,8 @@ class MetricsCollector:
     SCALAR_METRICS = (
         "scheduler_solve_breaker_state",
         "scheduler_solve_fallback_total",
+        # solver XLA traces seen by the retrace tracker (armed runs only)
+        "scheduler_solve_retrace_total",
         "scheduler_binder_restarts_total",
         "scheduler_binder_poison_waves_total",
         "scheduler_journal_recovered_records",

@@ -236,6 +236,12 @@ class Registry:
         self.journal_recovered_records = Gauge(
             "scheduler_journal_recovered_records"
         )
+        # XLA traces of the solver executables observed by the
+        # recompile-discipline runtime tracker (analysis/retrace.py),
+        # mirrored each cycle when the tracker is armed (bench runs,
+        # GRAFTLINT_SHAPES=1 test sessions); steady-state increments
+        # mean a kernel argument escaped the pad-bucket lattice
+        self.solve_retrace_total = Gauge("scheduler_solve_retrace_total")
         # schedule_attempts_total{result="scheduled|unschedulable|error"}
         self.schedule_attempts = Counter("scheduler_schedule_attempts_total")
         # pending_pods{queue="active|backoff|unschedulable|gated"}

@@ -42,6 +42,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..analysis import retrace as _retrace
 from ..api import store as st
 from ..api import types as api
 from ..client.events import EventRecorder
@@ -930,6 +931,9 @@ class Scheduler:
             self.metrics.solve_fallback_total.set(
                 float(breaker.fallback_count())
             )
+        # solver executable traces, when the recompile-discipline
+        # runtime tracker is armed (bench / GRAFTLINT_SHAPES=1 runs)
+        self.metrics.solve_retrace_total.set(float(_retrace.total()))
         recovered = getattr(self.store, "journal_recovered_records", None)
         if recovered is not None:
             self.metrics.journal_recovered_records.set(float(recovered))

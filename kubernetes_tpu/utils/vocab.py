@@ -131,6 +131,20 @@ def pad_dim(n: int, minimum: int = 8) -> int:
     return bucket
 
 
+def is_pad_bucket(n: int, minimum: int = 1) -> bool:
+    """True when n is a value pad_dim can produce (a power of two no
+    smaller than the floor) — the recompile-discipline pass's landing
+    check for encode-determined axes (analysis/shapes.py)."""
+    minimum = pad_dim(minimum, 1) if minimum > 1 else 1
+    return n >= minimum and (n & (n - 1)) == 0
+
+
+def is_constraint_bucket(n: int) -> bool:
+    """True when n is a value pad_constraint_dim can produce: 1 (no
+    rows) or a power of two floored at 32."""
+    return n == 1 or (n >= 32 and is_pad_bucket(n))
+
+
 def pad_constraint_dim(n: int) -> int:
     """Constraint-table row dims (selector/spread/term/preferred rows).
     Zero rows stay at dim 1 — the feature flags gate the whole family

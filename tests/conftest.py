@@ -42,3 +42,19 @@ if os.environ.get("GRAFTLINT_LOCK_ORDER") == "1":
         with lockorder.tracked() as tracker:
             yield tracker
         tracker.assert_no_inversions()
+
+
+if os.environ.get("GRAFTLINT_SHAPES") == "1":
+    # opt-in runtime recompile-discipline tracking (docs/
+    # static_analysis.md): every solver jit dispatch reports to the
+    # retrace tracker, and the session fails if any executable key was
+    # traced twice — the compile cache must hold every key for a whole
+    # test session (steady-state windows are a bench concept; tests
+    # legitimately visit new buckets all the time).
+    @pytest.fixture(autouse=True, scope="session")
+    def _graftlint_shapes():
+        from kubernetes_tpu.analysis import retrace
+
+        with retrace.tracked() as tracker:
+            yield tracker
+        tracker.assert_no_duplicate_traces()

@@ -1,4 +1,4 @@
-"""Snapshot encoder unit tests."""
+"""Snapshot encoder unit tests + the schema-drift contract gate."""
 
 import numpy as np
 
@@ -92,3 +92,65 @@ def test_scalar_resource_discovery():
     idx = meta.resource_names.index("example.com/gpu")
     assert snap.cluster.allocatable[0, idx] == 4
     assert snap.pods.req[0, idx] == 2
+
+
+# -- schema-drift gate: every field carries a machine-readable contract ------
+
+def _schema_contracts():
+    from kubernetes_tpu.analysis import SourceFile
+    from kubernetes_tpu.analysis import contracts as ct
+
+    path = schema.__file__
+    with open(path, "r", encoding="utf-8") as f:
+        src = SourceFile(path, "kubernetes_tpu/ops/schema.py", f.read())
+    return ct.collect(src)
+
+
+def test_every_schema_field_parses_to_a_contract():
+    """ISSUE acceptance: every NamedTuple array field in ops/schema.py
+    carries a parseable `# <dtype>[<axes>]` contract — a new field
+    without one fails here before it fails `make lint`."""
+    contracts, issues = _schema_contracts()
+    assert issues == [], [f"{i.cls}.{i.field}: {i.reason}" for i in issues]
+    assert contracts, "no contracts parsed from schema.py at all"
+
+
+def test_contracts_cover_every_snapshot_component_field():
+    """Every field of every Snapshot component class is an array and
+    must therefore have a contract (Snapshot itself composes the
+    tables and carries none)."""
+    from kubernetes_tpu.analysis import contracts as ct
+
+    contracts, _ = _schema_contracts()
+    byclass = ct.index_by_class(contracts)
+    for cls in (
+        schema.ClusterTensors, schema.PodBatch, schema.SelectorTable,
+        schema.PreferredTable, schema.SpreadTable, schema.TermTable,
+        schema.PrefPodTable, schema.ImageTable,
+    ):
+        got = set(byclass.get(cls.__name__, {}))
+        want = set(cls._fields)
+        assert got == want, (
+            f"{cls.__name__}: contract drift — missing {want - got}, "
+            f"orphaned {got - want}"
+        )
+
+
+def test_contract_dtypes_match_encoded_arrays():
+    """The declared dtypes are what the encoder actually produces (the
+    cheap static half of the --shapes encode validation)."""
+    from kubernetes_tpu.analysis import contracts as ct
+
+    contracts, _ = _schema_contracts()
+    byclass = ct.index_by_class(contracts)
+    nodes = [make_node("n0").zone("a").obj()]
+    pods = [make_pod("p0").req(cpu_milli=100, mem=128 * MI).obj()]
+    snap, _meta = schema.SnapshotBuilder().build(nodes, pods)
+    for table in snap:
+        cfields = byclass[type(table).__name__]
+        for f in type(table)._fields:
+            arr = np.asarray(getattr(table, f))
+            assert str(arr.dtype) == cfields[f].dtype, (
+                f"{type(table).__name__}.{f}: encoded {arr.dtype} != "
+                f"contract {cfields[f].render()}"
+            )

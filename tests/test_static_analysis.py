@@ -5,10 +5,12 @@ Two layers:
   * fixture tests: per-checker good/bad snippets (constructed as
     in-memory SourceFiles) prove each pass flags seeded violations and
     stays quiet on conforming code;
-  * the real-tree gate: all four passes run over the actual repository
-    and must produce nothing beyond the reviewed baseline — the tier-1
-    regression wire for lock discipline, hot-path purity, registry
-    consistency and lock ordering.
+  * the real-tree gate: all five static passes run over the actual
+    repository and must produce nothing beyond the reviewed baseline —
+    the tier-1 regression wire for lock discipline, hot-path purity,
+    registry consistency, lock ordering and tensor contracts.  (The
+    JAX-backed recompile-discipline pass has its own tier-1 gate in
+    tests/test_shapes.py.)
 
 Plus the runtime lock-order tracker's inversion regression tests
 (analysis/runtime.py).
@@ -29,6 +31,7 @@ from kubernetes_tpu.analysis import (
 )
 from kubernetes_tpu.analysis import guarded, lockorder, purity, registry
 from kubernetes_tpu.analysis import runtime as rt
+from kubernetes_tpu.analysis import tensorcontract
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -292,6 +295,152 @@ def f(name):
     ]
     findings = registry.check(files)
     assert any("string literal" in f.message for f in findings)
+
+
+# -- tensor-contract ---------------------------------------------------------
+
+TC_BAD = '''
+from typing import NamedTuple
+import numpy as np
+import jax.numpy as jnp
+
+
+class Cluster(NamedTuple):
+    alloc: np.ndarray      # f32[N, R]
+    bits: np.ndarray       # u32[N, LW]
+    naked: np.ndarray      # no contract here: finding
+
+
+class Pods(NamedTuple):
+    req: np.ndarray        # f32[P, R]
+    garbled: np.ndarray    # float32 of shape [P]  (unparseable: finding)
+
+
+class Snap(NamedTuple):
+    cluster: Cluster
+    pods: Pods
+
+
+def mix_axes(snap):
+    p = snap.pods.req.shape[0]
+    return snap.cluster.alloc[:p]          # P-var on the N axis: finding
+
+
+def widen(values):
+    demand = np.zeros(4, dtype=np.float64)  # 64-bit dtype: finding
+    return demand + values
+
+
+def shift(bits, i):
+    bits[i >> 5] |= 1 << (i & 31)          # bare int shift: finding
+    return bits
+
+
+def half_wrapped(ids):
+    return np.uint32(1) << (ids & 31)      # i64 promotion: finding
+
+
+def transfer(rows):
+    return jnp.asarray([1.5, 2.5])         # literal without dtype: finding
+'''
+
+TC_GOOD = '''
+from typing import NamedTuple
+import numpy as np
+import jax.numpy as jnp
+
+
+class Cluster(NamedTuple):
+    alloc: np.ndarray      # f32[N, R]
+    bits: np.ndarray       # u32[N, LW]
+    packed: np.ndarray     # u32[P, ceil(T/32)] packed membership
+    rounds: np.ndarray     # i32[]  scalar telemetry
+
+
+class Snap(NamedTuple):
+    cluster: Cluster
+
+
+def consistent(snap):
+    n = snap.cluster.alloc.shape[0]
+    return snap.cluster.bits[:n]           # N-var on the N axis: fine
+
+
+def gen_counter(cap):
+    # justified host-only 64-bit state
+    return np.zeros(cap, dtype=np.int64)  # graftlint: disable=tensor-contract -- host-only counter
+
+
+def shift_ok(bits, i):
+    bits[i >> 5] |= np.uint32(1 << (i & 31))
+    return bits
+
+
+def half_wrapped_ok(ids):
+    return np.uint32(1) << (ids & 31).astype(np.uint32)
+
+
+def transfer_ok(rows):
+    return jnp.asarray([1, 2], dtype=np.int32)
+'''
+
+
+def test_tensor_contract_flags_seeded_violations():
+    findings = tensorcontract.check([src("kubernetes_tpu/ops/k.py", TC_BAD)])
+    msgs = {(f.symbol, f.message.split(" (")[0].split(":")[0]) for f in findings}
+    assert ("Cluster.naked", "array field without a tensor contract") in msgs
+    assert ("Pods.garbled", "array field without a tensor contract") in msgs
+    assert any(s == "mix_axes" for s, _ in msgs)
+    assert any(
+        f.symbol == "mix_axes" and "declared N" in f.message
+        and "'p'" in f.message
+        for f in findings
+    )
+    assert any(
+        f.symbol == "widen" and "64-bit dtype np.float64" in f.message
+        for f in findings
+    )
+    assert any(
+        f.symbol == "shift" and "bare Python int shift" in f.message
+        for f in findings
+    )
+    assert any(
+        f.symbol == "half_wrapped" and "promotes to i64" in f.message
+        for f in findings
+    )
+    assert any(
+        f.symbol == "transfer" and "without dtype" in f.message
+        for f in findings
+    )
+
+
+def test_tensor_contract_quiet_on_conforming_code():
+    assert tensorcontract.check(
+        [src("kubernetes_tpu/ops/k.py", TC_GOOD)]
+    ) == []
+
+
+def test_tensor_contract_ignores_out_of_scope_packages():
+    # same code under scheduler/ (host-side by design): quiet
+    assert tensorcontract.check(
+        [src("kubernetes_tpu/scheduler/k.py", TC_BAD)]
+    ) == []
+
+
+def test_contract_parser_grammar():
+    from kubernetes_tpu.analysis import contracts as ct
+
+    dtype, axes = ct.parse_spec(" f32[N, R]   trailing prose")
+    assert dtype == "float32"
+    assert [a.render() for a in axes] == ["N", "R"]
+    dtype, axes = ct.parse_spec("u32[3, N, TW]  effect-major")
+    assert dtype == "uint32" and axes[0].const == 3 and axes[0].sym is None
+    dtype, axes = ct.parse_spec("u32[P, ceil(T/32)] packed")
+    assert axes[1].ceil and axes[1].resolve({"P": 8, "T": 33}) == 2
+    dtype, axes = ct.parse_spec("i32[]: scalar")
+    assert dtype == "int32" and axes == ()
+    assert ct.parse_spec("[C, N] missing dtype") is None
+    assert ct.parse_spec("f33[N]") is None
 
 
 # -- lock-order (static) -----------------------------------------------------
