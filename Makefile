@@ -25,8 +25,11 @@ test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow and not chaos' \
 		--continue-on-collection-errors -p no:cacheprovider
 
-# the fixed seed matrix lives in tests/test_chaos.py (SEEDS = range(20));
-# every seed replays byte-identically via FaultRegistry(seed)
+# the fixed seed matrices live in tests/test_chaos.py: SEEDS = range(20)
+# for the full-pipeline plans plus the overload-protection scenarios
+# (SLOW_CONSUMER_SEEDS, RELIST_STORM_SEEDS — backpressured fan-out,
+# coalescing, relist-storm containment); every seed replays
+# byte-identically via FaultRegistry(seed)
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -m chaos -q \
 		-p no:cacheprovider

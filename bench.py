@@ -15,6 +15,8 @@ informer-fed cache.  `extra` carries all five configs:
   c4s   5k nodes / 1024 pods  anti-affinity, pinned greedy/wavefront (strict budget)
   c5   50k nodes /  10k pods  gang/coscheduling burst, joint auction solve
   c6    5k nodes /   2k pods  kubemark churn through the full loop
+  c6s  50k nodes /   4k pods  SUSTAINED constant-rate arrival stream
+       (strict budget: >= 1050 pods/s, watchers_terminated == 0)
 
 vs_baseline compares c5 against the upstream-folklore scheduler SLO of
 ~100 pods/s at 5k nodes (the reference publishes no in-tree absolute
@@ -416,6 +418,7 @@ def config6():
     # off-thread, so a healthy pipeline keeps the non-overlapped share
     # well under the old in-line ~50%)
     m = sched.metrics
+    ws = store.watch_stats()
     step_s = m.schedule_batch_duration.total
     commit_s = m.commit_wave_duration.total
     overlap_s = m.pipeline_overlap.total
@@ -426,6 +429,13 @@ def config6():
         "pods_per_s": round(bound / dt, 1) if dt else 0.0,
         "attempt_p99_ms": round(win.percentile(0.99) * 1000, 2),
         "watchers_terminated": store.watchers_terminated - terminated0,
+        # overload-protection surface: events compacted by per-watcher
+        # coalescing, watchers expired to relist, and the adaptive
+        # window the loop settled on
+        "watch_coalesced_total": ws["watch_coalesced_total"],
+        "watch_expired_total": ws["watch_expired_total"],
+        "batch_window_ms": round(m.batch_window_ms.total, 2),
+        "overload_level": m.overload_level.total,
         "step_s_total": round(step_s, 4),
         # batch_solve now observes the EXPOSED solve cost (encode +
         # compile + the decode wait the host blocked on); readback hidden
@@ -445,6 +455,89 @@ def config6():
         "commit_share_of_step": round(
             exposed / (step_s + exposed), 4
         ) if step_s + exposed > 0 else 0.0,
+    }
+
+
+# Sustained-churn budget, enforced under BENCH_STRICT=1: the control
+# plane must hold >= 2x the BENCH_r05 churn throughput (526 pods/s) on
+# a CONSTANT arrival stream with zero destructively-terminated watchers
+# (ISSUE 6 acceptance).
+STRICT_SUSTAINED_MIN_PODS_PER_S = 1050.0
+
+
+def config6_sustained():
+    """50k-node sustained churn: a CONSTANT pod arrival stream (not a
+    burst) against hollow-node heartbeats — the millions-of-users shape.
+    The backpressured watch fan-out + adaptive batch window must hold a
+    minimum sustained pods/s with `watchers_terminated == 0`; coalescing
+    and Expired-relist absorb any consumer that falls behind."""
+    import threading
+
+    from kubernetes_tpu import kubemark
+    from kubernetes_tpu.api import store as st
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing.wrappers import MI, make_pod
+
+    n_nodes, n_measured, arrival_rate = 50_000, 4_000, 2_000.0
+    store = st.Store()
+    hollow = kubemark.HollowCluster(
+        store, n_nodes, heartbeat_interval=10.0
+    ).start()
+    sched = Scheduler(store, batch_size=1024)
+    sched.start()
+
+    def mk(i, prefix):
+        return (
+            make_pod(f"{prefix}-{i}")
+            .req(cpu_milli=100 + (i % 5) * 100, mem=256 * MI)
+            .obj()
+        )
+
+    sched.warmup([mk(i, "warm") for i in range(1024)])
+    sched.wait_for_idle(timeout=240)
+
+    terminated0 = store.watchers_terminated
+    t0 = time.perf_counter()
+    # the constant arrival stream: pace creates at arrival_rate instead
+    # of dumping a burst — the batch window must adapt to the stream
+    period = 1.0 / arrival_rate
+    next_t = t0
+    for i in range(n_measured):
+        store.create(mk(i, "c6s"))
+        next_t += period
+        lag = next_t - time.perf_counter()
+        if lag > 0:
+            time.sleep(lag)
+    deadline = time.monotonic() + 600
+    while time.monotonic() < deadline:
+        bound = sum(
+            1
+            for p in sched.informers.informer("Pod").list()
+            if p.meta.name.startswith("c6s-") and p.spec.node_name
+        )
+        if bound >= n_measured:
+            break
+        time.sleep(0.05)
+    dt = time.perf_counter() - t0
+    sched.stop()
+    hollow.stop()
+    m = sched.metrics
+    ws = store.watch_stats()
+    return {
+        "nodes": n_nodes, "pods": n_measured, "placed": bound,
+        "arrival_rate_pods_per_s": arrival_rate,
+        "latency_s": round(dt, 4),
+        "pods_per_s": round(bound / dt, 1) if dt else 0.0,
+        "watchers_terminated": store.watchers_terminated - terminated0,
+        "watch_coalesced_total": ws["watch_coalesced_total"],
+        "watch_expired_total": ws["watch_expired_total"],
+        "watch_queue_depth": ws["watch_queue_depth"],
+        "batch_window_ms": round(m.batch_window_ms.total, 2),
+        "overload_level": m.overload_level.total,
+        "overload_shed_total": m.overload_shed_total.total,
+        "commit_waves": m.commit_wave_size.n,
+        "commit_s_total": round(m.commit_wave_duration.total, 4),
+        "solve_s_total": round(m.batch_solve_duration.total, 4),
     }
 
 
@@ -473,6 +566,7 @@ def main() -> None:
             "c4s_interpod_1k": config4s(),
             "c5_gang_50k": config5(),
             "c6_churn_5k": config6(),
+            "c6s_sustained_50k": config6_sustained(),
         }
     # every over-threshold schedule_batch cycle, with its per-step share
     # (commit- and solve-share per step are readable straight off the
@@ -557,6 +651,25 @@ def main() -> None:
                 + ", ".join(
                     f"{name}={n}" for name, n in sorted(steady_retraces.items())
                 )
+            )
+        # overload-protection gates: NO scenario may destructively
+        # terminate a watcher (backpressure must absorb the load), and
+        # the sustained-churn stream must hold its throughput floor
+        terminated = {
+            name: cfg["watchers_terminated"]
+            for name, cfg in extra.items()
+            if isinstance(cfg, dict) and cfg.get("watchers_terminated")
+        }
+        if terminated:
+            failures.append(
+                "watchers terminated (backpressure must hold): "
+                + ", ".join(f"{k}={v}" for k, v in sorted(terminated.items()))
+            )
+        c6s = extra["c6s_sustained_50k"]
+        if c6s["pods_per_s"] < STRICT_SUSTAINED_MIN_PODS_PER_S:
+            failures.append(
+                f"sustained churn below budget: {c6s['pods_per_s']} < "
+                f"{STRICT_SUSTAINED_MIN_PODS_PER_S} pods/s"
             )
         if failures:
             print("BENCH_STRICT: " + "; ".join(failures), file=sys.stderr)

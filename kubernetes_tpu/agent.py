@@ -174,8 +174,9 @@ class NodeAgent:
         try:
             while not self._stop.is_set():
                 if w.stopped:
-                    # terminated as a slow watcher: relist + rewatch
-                    # (reflector contract), reconciling the worker set
+                    # expired as a slow watcher (coalescing overflow):
+                    # relist + rewatch (reflector contract), reconciling
+                    # the worker set
                     w.stop()
                     pods, rv = self.store.list("Pod")
                     mine = set()

@@ -18,20 +18,27 @@ Semantics kept from the reference:
     a from_rv older than the buffer raises Expired — the client relists
     (the 410 Gone path).
 
-Threading: writes and watch dispatch hold one lock; delivery is
-per-watcher bounded queues.  A slow watcher that overflows its queue is
-stopped (the cacher's terminate-blocked-watcher behaviour,
-cacher.go dispatchEvent) and must relist.
+Threading: writes hold one lock and only append the committed events to
+a dispatch backlog; a dedicated fan-out thread delivers them to
+per-watcher bounded COALESCING buffers off the lock, so a slow consumer
+can never stall writers.  A watcher that falls behind has its MODIFIED
+runs compacted latest-wins and its ADDED+DELETED pairs annihilated;
+only when the coalesced backlog itself overflows (more *distinct
+objects* pending than the capacity) is the watcher marked Expired —
+bookmark rv + forced relist, the 410 path — never silently terminated
+(the survivable-overload replacement for the cacher's
+terminate-blocked-watcher behaviour; see docs/robustness.md).
 """
 
 from __future__ import annotations
 
 import copy
 import logging
-import queue
 import threading
 import time
+import weakref
 import zlib
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
@@ -72,78 +79,171 @@ def _key(namespace: str, name: str) -> str:
     return f"{namespace}/{name}" if namespace else name
 
 
-class Watch:
-    """One watch stream: iterate to receive events; stop() to cancel.
-    Iteration ends when the store stops the watch (overflow/close)."""
+# Watch._offer verdicts (read by the fan-out thread)
+OFFER_OK = "ok"
+OFFER_STOPPED = "stopped"
+OFFER_EXPIRED = "expired"
 
-    _SENTINEL = object()
+
+class Watch:
+    """One watch stream backed by a bounded per-watcher COALESCING
+    buffer: iterate to receive events; stop() to cancel.
+
+    Backpressure semantics (the survivable-overload contract):
+
+      * events for DISTINCT objects queue in rv order;
+      * a MODIFIED landing on a pending entry replaces it latest-wins
+        (an un-consumed ADDED stays ADDED with the newest object — the
+        consumer never saw the original);
+      * a DELETED landing on a pending ADDED annihilates both (the
+        consumer never learns the object existed);
+      * a DELETED landing on a pending MODIFIED collapses to DELETED;
+      * an ADDED landing on a pending DELETED (delete + recreate while
+        the consumer lagged) collapses to MODIFIED with the new object —
+        cache-diffing consumers (SharedInformer) synthesize the right
+        local transition either way;
+      * compaction always keeps the LATEST rv and re-sorts the entry to
+        the back, so delivery stays strictly rv-monotonic.
+
+    Only when the number of distinct pending objects would exceed the
+    capacity is the stream EXPIRED: pending events are dropped, the
+    bookmark rv recorded, and iteration raises `Expired` so the consumer
+    relists (the 410 path).  `stopped` is also set so poll-style
+    consumers (agent, kubemark, the HTTP server) fall into their
+    existing relist branch.  Consumer-initiated stop() ends iteration
+    with StopIteration instead.
+    """
+
+    GUARDED_FIELDS = {
+        "_pending": "_mu",
+        "_last_rv": "_mu",
+        "stopped": "_mu",
+        "expired": "_mu",
+        "expired_rv": "_mu",
+        "coalesced": "_mu",
+    }
 
     def __init__(self, store: "Store", capacity: int):
         self._store = store
-        self._q: "queue.Queue" = queue.Queue(maxsize=capacity)
+        self._capacity = capacity
+        self._mu = threading.Condition()
+        # object key -> coalesced Event, insertion/compaction order ==
+        # ascending rv (every insert/replace carries the current max rv
+        # and moves to the back)
+        self._pending: "OrderedDict[str, Event]" = OrderedDict()
+        # highest rv delivered into (or compacted through) this buffer:
+        # the fan-out thread's offers dedup against it, which makes the
+        # replay-at-registration + async-backlog seam exactly-once
+        self._last_rv = 0
         self.stopped = False
+        self.expired = False
+        self.expired_rv = 0     # bookmark: last consistent rv at expiry
+        self.coalesced = 0      # events compacted away in this buffer
 
     def stop(self) -> None:
         self._store._drop_watch(self)
-        self._close()
-
-    def _close(self) -> None:
-        if not self.stopped:
+        with self._mu:
             self.stopped = True
-            try:
-                self._q.put_nowait(self._SENTINEL)
-            except queue.Full:
-                # the overflow-kill path closes a FULL queue: evict one
-                # buffered event to guarantee the sentinel lands — the
-                # stream is already lossy (that's why it's being killed)
-                # and a consumer blocked on get() with no sentinel would
-                # hang its reflector FOREVER instead of relisting
-                try:
-                    self._q.get_nowait()
-                except queue.Empty:
-                    pass
-                try:
-                    self._q.put_nowait(self._SENTINEL)
-                except queue.Full:
-                    pass  # __next__'s stopped check is the backstop
+            self._mu.notify_all()
 
-    def _offer(self, ev: Event) -> bool:
+    def _offer(self, ev: Event) -> str:
         # hot path (per event per watcher): the disarmed check is one
         # module-attribute load, not a function call
         if faults._registry is not None and faults.fire("watch.offer") == faults.DROP:
-            # injected slow watcher: the store treats a refused offer
-            # exactly like a full queue — overflow-kill + relist
-            return False
-        try:
-            self._q.put_nowait(ev)
-            return True
-        except queue.Full:
-            return False
+            # injected overload: as if coalescing itself overflowed —
+            # the watcher expires and its consumer relists
+            with self._mu:
+                self._expire_locked()
+            return OFFER_EXPIRED
+        with self._mu:
+            if self.expired:
+                return OFFER_EXPIRED
+            if self.stopped:
+                return OFFER_STOPPED
+            if ev.rv <= self._last_rv:
+                # already replayed at registration (or re-offered by the
+                # backlog after a replay covered it): exactly-once dedup
+                return OFFER_OK
+            key = _key(ev.obj.meta.namespace, ev.obj.meta.name)
+            cur = self._pending.get(key)
+            if cur is None:
+                if len(self._pending) >= self._capacity:
+                    self._expire_locked()
+                    return OFFER_EXPIRED
+                self._pending[key] = ev
+            elif cur.type == ADDED and ev.type == DELETED:
+                # annihilation: the consumer never saw the object
+                del self._pending[key]
+                self.coalesced += 2
+            else:
+                typ = ev.type
+                if cur.type == ADDED and ev.type == MODIFIED:
+                    typ = ADDED          # still unseen: stays a create
+                elif cur.type == DELETED and ev.type == ADDED:
+                    typ = MODIFIED       # delete+recreate: latest-wins
+                self._pending[key] = Event(typ, ev.kind, ev.obj, ev.rv)
+                self._pending.move_to_end(key)
+                self.coalesced += 1
+            self._last_rv = ev.rv
+            self._mu.notify_all()
+            return OFFER_OK
+
+    def _expire_locked(self) -> None:
+        if self.expired:
+            return
+        self.expired = True
+        self.stopped = True  # poll-style consumers relist off .stopped
+        self.expired_rv = self._last_rv
+        # pending events are dropped: the forced relist recovers them
+        # (and everything after) from one consistent snapshot
+        self._pending.clear()
+        self._mu.notify_all()
+
+    def depth(self) -> int:
+        with self._mu:
+            return len(self._pending)
 
     def __iter__(self) -> Iterator[Event]:
         return self
 
     def __next__(self) -> Event:
-        while True:
-            try:
-                # bounded wait so a lost sentinel can never park the
-                # consumer forever (belt to _close()'s braces)
-                ev = self._q.get(timeout=0.5)
-            except queue.Empty:
+        if faults._registry is not None:
+            faults.fire("watch.consume")  # injected slow consumer
+        with self._mu:
+            while True:
+                if self._pending:
+                    _, ev = self._pending.popitem(last=False)
+                    return ev
+                if self.expired:
+                    raise Expired(
+                        f"watch expired at rv {self.expired_rv}; relist"
+                    )
                 if self.stopped:
-                    raise StopIteration from None
-                continue
-            if ev is self._SENTINEL:
-                raise StopIteration
-            return ev
+                    raise StopIteration
+                # bounded wait: a missed notify can never park the
+                # consumer forever
+                self._mu.wait(0.5)
 
     def get(self, timeout: Optional[float] = None) -> Optional[Event]:
-        """One event, or None on timeout / stream end."""
-        try:
-            ev = self._q.get(timeout=timeout)
-        except queue.Empty:
-            return None
-        return None if ev is self._SENTINEL else ev
+        """One event, or None on timeout / stream end (expiry included —
+        check `.expired` / `.stopped` to distinguish and relist)."""
+        if faults._registry is not None:
+            faults.fire("watch.consume")  # injected slow consumer
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._mu:
+            while True:
+                if self._pending:
+                    _, ev = self._pending.popitem(last=False)
+                    return ev
+                if self.stopped or self.expired:
+                    return None
+                if deadline is None:
+                    self._mu.wait(0.5)
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._mu.wait(remaining)
 
 
 class Store:
@@ -160,8 +260,9 @@ class Store:
 
     # graftlint guarded-by declarations: object maps, version counters,
     # the event ring, watcher fan-out lists, and all journal state share
-    # the store mutex (writes and watch dispatch hold one lock — module
-    # docstring)
+    # the store mutex; the fan-out backlog has its own condition (writers
+    # append under _lock -> _dispatch_cv, the dispatcher pops under
+    # _dispatch_cv alone — one lock-order direction, never a cycle)
     GUARDED_FIELDS = {
         "_rv": "_lock",
         "_objects": "_lock",
@@ -173,7 +274,11 @@ class Store:
         "_journal_dirty": "_lock",
         "_journal_flushed_at": "_lock",
         "watchers_terminated": "_lock",
-        "terminated_kinds": "_lock",
+        "terminated_by_kind": "_lock",
+        "watch_expired_total": "_lock",
+        "_watch_coalesced_closed": "_lock",
+        "_dispatch_thread": "_lock",
+        "_dispatch_backlog": "_dispatch_cv",
         "journal_recovered_records": "_lock",
         "journal_tail_truncations": "_lock",
         "journal_write_errors": "_lock",
@@ -210,8 +315,27 @@ class Store:
         self._buffer_size = buffer_size
         self._watch_capacity = watch_capacity
         self._watchers: Dict[str, List[Watch]] = {}     # kind -> watches
-        self.watchers_terminated = 0                    # slow-watcher kills
-        self.terminated_kinds: List[str] = []           # ... by kind
+        # destructive slow-watcher kills — the backpressured fan-out
+        # never performs them, so churn benches assert this stays 0
+        self.watchers_terminated = 0
+        self.terminated_by_kind: Dict[str, int] = {}    # bounded: one key/kind
+        # overload-protection observability (mirrored into the scheduler
+        # Registry as scheduler_watch_* each cycle):
+        #   expired — watchers converted to bookmark+relist after their
+        #       coalescing buffer overflowed (or a replay overflowed);
+        #   coalesced (closed) — compacted-event counts folded in from
+        #       watchers that have since expired or stopped (live
+        #       watchers keep their own counters; watch_stats() sums).
+        self.watch_expired_total = 0
+        self._watch_coalesced_closed = 0
+        # fan-out backlog: writers append committed event batches under
+        # the store lock; the dedicated dispatch thread (started lazily
+        # with the first watcher, weakly referenced so abandoned stores
+        # don't leak pollers) delivers them to the coalescing buffers
+        # OFF the lock — a slow consumer can never stall writers
+        self._dispatch_cv = threading.Condition()
+        self._dispatch_backlog: deque = deque()
+        self._dispatch_thread: Optional[threading.Thread] = None
         # optional api.admission.AdmissionChain: mutate-then-validate on
         # every create/update before the commit (the apiserver admission
         # chain's position in the write path, server/config.go:983)
@@ -487,22 +611,68 @@ class Store:
         return kind
 
     def _dispatch(self, ev: Event) -> None:
-        # caller holds the lock
+        # caller holds the lock: ring append + backlog handoff only —
+        # the fan-out itself runs on the dispatch thread off the lock
         self._buffer.append(ev)
         if len(self._buffer) > self._buffer_size:
             del self._buffer[: self._buffer_size // 4]
-        dead: List[Watch] = []
-        for w in self._watchers.get(ev.kind, ()):  # fan-out (cacher.go:514)
-            if not w._offer(ev):
-                dead.append(w)
-        for w in dead:
-            self._watchers[ev.kind].remove(w)
-            w._close()
-            # observability: churn benches assert no watcher was too
-            # slow for the event rate (cacher terminations == data loss
-            # for that consumer until it relists)
-            self.watchers_terminated += 1
-            self.terminated_kinds.append(ev.kind)
+        self._queue_fanout_locked(ev.kind, [ev])
+
+    def _queue_fanout_locked(self, kind: str, events: List[Event]) -> None:
+        # caller holds the lock.  No watchers for the kind means no
+        # delivery obligation: a watcher registered later replays from
+        # the ring (watch(from_rv)) or starts from-now with _last_rv
+        # pinned to the current rv, so skipping the backlog is exact.
+        if not self._watchers.get(kind):
+            return
+        self._ensure_dispatcher_locked()
+        with self._dispatch_cv:
+            self._dispatch_backlog.append((kind, events))
+            self._dispatch_cv.notify_all()
+
+    def _ensure_dispatcher_locked(self) -> None:
+        # caller holds the lock.  Lazy + self-healing: the thread starts
+        # with the first watcher and is restarted here if an injected
+        # crash killed it (every dispatch passes through this check).
+        t = self._dispatch_thread
+        if t is not None and t.is_alive():
+            return
+        t = threading.Thread(
+            target=_watch_dispatch_loop,
+            args=(weakref.ref(self),),
+            name="watch-dispatch",
+            daemon=True,
+        )
+        self._dispatch_thread = t
+        t.start()
+
+    def _fan_out(self, kind: str, events: List[Event]) -> None:
+        """Deliver one committed batch to every watcher of `kind` — the
+        dispatch thread's half of the watch path, running OFF the store
+        lock so per-watcher coalescing work never blocks writers."""
+        with self._lock:
+            watchers = list(self._watchers.get(kind, ()))
+        expired: List[Watch] = []
+        for w in watchers:
+            for ev in events:
+                verdict = w._offer(ev)
+                if verdict is OFFER_EXPIRED:
+                    expired.append(w)
+                    break
+                if verdict is OFFER_STOPPED:
+                    break  # _drop_watch unregisters it; skip the rest
+        for w in expired:
+            self._retire_expired_watch(w, kind)
+
+    def _retire_expired_watch(self, w: Watch, kind: str) -> None:
+        with self._lock:
+            ws = self._watchers.get(kind)
+            if ws is not None and w in ws:
+                ws.remove(w)
+            self.watch_expired_total += 1
+            with w._mu:  # Store._lock -> Watch._mu (same order as replay)
+                self._watch_coalesced_closed += w.coalesced
+                w.coalesced = 0
 
     # -- CRUD --------------------------------------------------------------
 
@@ -702,23 +872,13 @@ class Store:
         self._journal_commit(lines)
 
     def _dispatch_wave(self, kind: str, events: List[Event]) -> None:
-        # caller holds the lock; one buffer extend + one fan-out pass
-        # over the kind's watchers instead of len(events) passes
+        # caller holds the lock; one buffer extend + ONE backlog handoff
+        # for the whole wave (the fan-out thread delivers it as a batch)
         self._buffer.extend(events)
         excess = len(self._buffer) - self._buffer_size
         if excess > 0:
             del self._buffer[: excess + self._buffer_size // 4]
-        dead: List[Watch] = []
-        for w in self._watchers.get(kind, ()):
-            for ev in events:
-                if not w._offer(ev):
-                    dead.append(w)
-                    break
-        for w in dead:
-            self._watchers[kind].remove(w)
-            w._close()
-            self.watchers_terminated += 1
-            self.terminated_kinds.append(kind)
+        self._queue_fanout_locked(kind, events)
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> Any:
         """Remove an object.  Objects carrying finalizers get the
@@ -766,6 +926,10 @@ class Store:
         selector: Optional[Callable[[Any], bool]] = None,
     ) -> Tuple[List[Any], int]:
         """(items, resource_version) — the ListAndWatch handoff point."""
+        if faults._registry is not None:
+            # relist-storm chaos: injected list latency models a control
+            # plane whose snapshot path is the contended resource
+            faults.fire("store.list", kind=kind)
         with self._lock:
             items = [
                 copy.deepcopy(o)
@@ -798,21 +962,24 @@ class Store:
                     )
                 for ev in self._buffer:
                     if ev.kind == kind and ev.rv > from_rv:
-                        if not w._offer(ev):
-                            # the replay itself overflowed (or was
-                            # fault-dropped): this stream would be lossy
-                            # FROM BIRTH with no overflow-kill to expose
-                            # it — the silently-lost event would never be
-                            # re-delivered and its object would stay
-                            # stale in every consumer forever.  Refuse
-                            # the watch; the client relists (410 path).
-                            self.watchers_terminated += 1
-                            self.terminated_kinds.append(kind)
+                        if w._offer(ev) is not OFFER_OK:
+                            # the replay itself overflowed the coalescing
+                            # buffer (or was fault-dropped): this stream
+                            # would be lossy FROM BIRTH — refuse it; the
+                            # client relists (410 path)
+                            self.watch_expired_total += 1
                             raise Expired(
                                 f"rv {from_rv} replay overflowed the "
-                                "watch queue; relist"
+                                "watch buffer; relist"
                             )
+            with w._mu:
+                # pin the dedup horizon to the commit the registration
+                # is consistent with: backlog stragglers at or below it
+                # were covered by the replay (or predate a from-now
+                # watch) and must not be re-delivered
+                w._last_rv = max(w._last_rv, self._rv)
             self._watchers.setdefault(kind, []).append(w)
+            self._ensure_dispatcher_locked()
             return w
 
     def _drop_watch(self, w: Watch) -> None:
@@ -820,7 +987,30 @@ class Store:
             for ws in self._watchers.values():
                 if w in ws:
                     ws.remove(w)
-                    return
+                    break
+            with w._mu:
+                self._watch_coalesced_closed += w.coalesced
+                w.coalesced = 0
+
+    def watch_stats(self) -> Dict[str, int]:
+        """Fan-out observability snapshot: deepest per-watcher pending
+        backlog, total compacted events, expiries, and (legacy)
+        destructive terminations — mirrored into the scheduler Registry
+        as scheduler_watch_* gauges every cycle."""
+        with self._lock:
+            depth = 0
+            coalesced = self._watch_coalesced_closed
+            for ws in self._watchers.values():
+                for w in ws:
+                    with w._mu:
+                        depth = max(depth, len(w._pending))
+                        coalesced += w.coalesced
+            return {
+                "watch_queue_depth": depth,
+                "watch_coalesced_total": coalesced,
+                "watch_expired_total": self.watch_expired_total,
+                "watchers_terminated": self.watchers_terminated,
+            }
 
     # -- convenience -------------------------------------------------------
 
@@ -828,3 +1018,36 @@ class Store:
     def resource_version(self) -> int:
         with self._lock:
             return self._rv
+
+
+def _watch_dispatch_loop(store_ref: "weakref.ref[Store]") -> None:
+    """The fan-out worker: drains the store's dispatch backlog and
+    delivers each committed batch to its watchers off the store lock.
+
+    Holds the store only through a weakref between iterations, so an
+    abandoned store's dispatcher exits instead of leaking one polling
+    thread per Store (tests construct thousands).  Fault-schedule
+    exceptions escaping a delivery are contained — a poisoned offer must
+    not take the whole fan-out path down (and _ensure_dispatcher_locked
+    restarts the thread if something interpreter-grade does)."""
+    while True:
+        store = store_ref()
+        if store is None:
+            return
+        batch = None
+        with store._dispatch_cv:
+            if not store._dispatch_backlog:
+                store._dispatch_cv.wait(0.2)
+            if store._dispatch_backlog:
+                batch = store._dispatch_backlog.popleft()
+        if batch is not None:
+            try:
+                store._fan_out(*batch)
+            except Exception:  # noqa: BLE001 — delivery containment
+                logging.getLogger(__name__).exception(
+                    "watch fan-out batch failed; continuing"
+                )
+        # drop the strong reference before sleeping so GC can collect
+        # an otherwise-abandoned store
+        store = None
+        batch = None

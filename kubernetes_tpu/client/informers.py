@@ -5,7 +5,9 @@ parts:
 
   Reflector      list+watch against the Store, relisting on Expired /
                  stream termination (reflector.go:340 ListAndWatch, the
-                 410-Gone relist path)
+                 410-Gone relist path) with jittered exponential backoff
+                 on consecutive expiries, through a shared RelistGate
+                 bounding concurrent relists (storm containment)
   SharedInformer local thread-safe object cache + handler fan-out
                  (shared_informer.go:459 Run; handlers get add/update/
                  delete callbacks after an initial synthetic-ADDED sync,
@@ -21,6 +23,7 @@ informer); handlers must not block it.
 from __future__ import annotations
 
 import logging
+import random
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
@@ -32,10 +35,42 @@ Handler = Callable[[str, Any, Optional[Any]], None]
 # Handler(event_type, obj, old_obj): old_obj set for MODIFIED only.
 
 
+class RelistGate:
+    """Shared relist limiter: when N informers expire together (a relist
+    storm — the store expired their watches in one overload episode), a
+    bounded semaphore caps how many hit `Store.list` concurrently; the
+    rest queue on the gate instead of synchronously hammering the one
+    snapshot path every consumer is already waiting on.  Combined with
+    each reflector's jittered backoff, simultaneous expiries de-correlate
+    instead of re-synchronizing on the next relist."""
+
+    def __init__(self, max_concurrent: int = 2):
+        self.max_concurrent = max_concurrent
+        self._sem = threading.BoundedSemaphore(max_concurrent)
+
+    def __enter__(self) -> "RelistGate":
+        self._sem.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._sem.release()
+
+
 class SharedInformer:
     """One kind's local cache, kept in sync by a reflector thread."""
 
-    def __init__(self, store: st.Store, kind: str):
+    # jittered exponential backoff on Expired (the 410/overflow path):
+    # base doubles per consecutive expiry up to the cap; the actual wait
+    # is uniform in [cap/2, cap] so simultaneous expiries spread
+    _RELIST_BACKOFF_BASE = 0.05
+    _RELIST_BACKOFF_MAX = 2.0
+
+    def __init__(
+        self,
+        store: st.Store,
+        kind: str,
+        relist_gate: Optional[RelistGate] = None,
+    ):
         self._store = store
         self.kind = kind
         self._lock = threading.RLock()
@@ -45,6 +80,10 @@ class SharedInformer:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._watch: Optional[st.Watch] = None
+        self._gate = relist_gate or RelistGate()
+        self._expired_streak = 0  # consecutive Expired relists
+        self._rng = random.Random()
+        self.relists = 0          # observability (tests assert recovery)
 
     # -- wiring ------------------------------------------------------------
 
@@ -107,16 +146,32 @@ class SharedInformer:
             try:
                 rv = self._relist()
                 self._synced.set()
+                self._expired_streak = 0  # a stream established == healthy
                 self._stream(rv)
             except st.Expired:
-                continue  # relist (the 410 path)
+                # the 410 path: watch(from_rv) too old, replay overflow,
+                # or the store expired the stream (coalescing overflow).
+                # Jittered exponential backoff so N informers expiring
+                # together don't relist in lockstep (relist storm).
+                self._stop.wait(self._expired_delay())
+                continue
             except Exception:
                 if self._stop.is_set():
                     return
                 self._stop.wait(0.05)  # backoff then relist
 
+    def _expired_delay(self) -> float:
+        self._expired_streak = min(self._expired_streak + 1, 8)
+        cap = min(
+            self._RELIST_BACKOFF_BASE * (2 ** (self._expired_streak - 1)),
+            self._RELIST_BACKOFF_MAX,
+        )
+        return self._rng.uniform(cap / 2, cap)
+
     def _relist(self) -> int:
-        items, rv = self._store.list(self.kind)
+        with self._gate:  # bounded concurrent relists (storm containment)
+            items, rv = self._store.list(self.kind)
+        self.relists += 1
         with self._lock:
             fresh = {self._obj_key(o): o for o in items}
             stale = set(self._cache) - set(fresh)
@@ -125,12 +180,28 @@ class SharedInformer:
                 self._emit(st.DELETED, old, None)
             for key, obj in fresh.items():
                 old = self._cache.get(key)
+                if old is not None and self._recreated(old, obj):
+                    self._cache.pop(key)
+                    self._emit(st.DELETED, old, None)
+                    old = None
                 self._cache[key] = obj
                 if old is None:
                     self._emit(st.ADDED, obj, None)
                 elif old.meta.resource_version != obj.meta.resource_version:
                     self._emit(st.MODIFIED, obj, old)
         return rv
+
+    @staticmethod
+    def _recreated(old, new) -> bool:
+        """True when `new` is a DIFFERENT object under the same key — a
+        delete + recreate the watch path compacted into one event (or a
+        relist jumped over).  The split is re-synthesized as
+        DELETED(old) + ADDED(new) so uid-sensitive consumers (the PV
+        controller's claimRef.UID check, the scheduler cache's
+        accounting) see the true transition."""
+        old_uid = getattr(old.meta, "uid", "")
+        new_uid = getattr(new.meta, "uid", "")
+        return bool(old_uid) and bool(new_uid) and old_uid != new_uid
 
     def _stream(self, rv: int) -> None:
         self._watch = self._store.watch(self.kind, from_rv=rv)
@@ -145,13 +216,21 @@ class SharedInformer:
                         self._emit(st.DELETED, ev.obj, old)
                     else:
                         old = self._cache.get(key)
+                        if old is not None and self._recreated(old, ev.obj):
+                            # delete + recreate compacted by the watch
+                            # buffer: synthesize the split
+                            self._cache.pop(key)
+                            self._emit(st.DELETED, old, None)
+                            old = None
                         self._cache[key] = ev.obj
                         self._emit(
                             st.ADDED if old is None else st.MODIFIED, ev.obj, old
                         )
         finally:
             self._watch = None
-        # stream ended (overflow / store closed it): loop relists
+        # stream ended (consumer stop / store closed it): loop relists.
+        # An EXPIRED stream raises st.Expired out of the iteration above
+        # instead — _run's 410 handler adds the jittered backoff.
 
     def _emit(self, typ: str, obj: Any, old: Optional[Any]) -> None:
         # Handler faults must not kill the stream or starve later handlers
@@ -174,12 +253,17 @@ class InformerFactory:
         self.store = store
         self._informers: Dict[str, SharedInformer] = {}
         self._lock = threading.Lock()
+        # one gate for every informer this factory hands out: the
+        # relist-storm bound is per CONSUMER PROCESS, not per kind
+        self.relist_gate = RelistGate()
 
     def informer(self, kind: str) -> SharedInformer:
         with self._lock:
             inf = self._informers.get(kind)
             if inf is None:
-                inf = SharedInformer(self.store, kind)
+                inf = SharedInformer(
+                    self.store, kind, relist_gate=self.relist_gate
+                )
                 self._informers[kind] = inf
             return inf
 

@@ -99,9 +99,10 @@ class HollowCluster:
     def _pod_runner(self) -> None:
         """The kubelet status half: bound Pending pods become Running
         (status written through the API, like status manager PATCHes).
-        A watch the store terminated for falling behind is re-established
-        with a catch-up list (the reflector contract) — churn benches
-        kill slow watchers by design."""
+        A watch the store EXPIRED for falling behind (coalescing
+        overflow sets .stopped too) is re-established with a catch-up
+        list — the reflector contract; the store never destructively
+        terminates a slow watcher."""
         w = self.store.watch("Pod")
         try:
             while not self._stop.is_set():

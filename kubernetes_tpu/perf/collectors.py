@@ -192,6 +192,15 @@ class MetricsCollector:
         "scheduler_binder_restarts_total",
         "scheduler_binder_poison_waves_total",
         "scheduler_journal_recovered_records",
+        # overload protection: watch fan-out backpressure + adaptive
+        # batch window (docs/robustness.md)
+        "scheduler_watch_queue_depth",
+        "scheduler_watch_coalesced_total",
+        "scheduler_watch_expired_total",
+        "scheduler_watch_terminated_total",
+        "scheduler_batch_window_ms",
+        "scheduler_overload_level",
+        "scheduler_overload_shed_total",
         "scheduler_schedule_attempts_total",
         "scheduler_pending_pods",
         "scheduler_preemption_attempts_total",

@@ -63,8 +63,17 @@ class SchedulerConfiguration:
     # batch_size, so churn-paced creates form real batches instead of
     # near-empty solves.  Every pod in the batch pays the window as
     # queueing latency, so it is capped at the attempt-latency budget
-    # (validation rejects > 1s; default 50ms).
+    # (validation rejects > 1s; default 50ms).  With the adaptive
+    # controller enabled this is the no-signal starting window.
     batch_window_seconds: float = 0.05
+    # adaptive window (docs/scheduler_loop.md): pop_batch's window tracks
+    # observed arrival rate and solve/commit cost so sustained churn
+    # forms big batches while sparse arrivals pop near-immediately;
+    # bounds and the latency SLO the sizing targets (w + r*w*c <= slo).
+    adaptive_batch_window: bool = True
+    batch_window_min_seconds: float = 0.005
+    batch_window_max_seconds: float = 0.25
+    batch_latency_slo_seconds: float = 0.5
     pod_initial_backoff_seconds: float = 1.0
     pod_max_backoff_seconds: float = 10.0
     assume_ttl_seconds: float = 30.0
@@ -129,6 +138,18 @@ class SchedulerConfiguration:
                 "batch_window_seconds must be within [0, 1] — the window "
                 "is pure queueing latency for every pod in the batch"
             )
+        if not (
+            0
+            <= self.batch_window_min_seconds
+            <= self.batch_window_max_seconds
+            <= 1.0
+        ):
+            raise ValueError(
+                "adaptive window bounds must satisfy "
+                "0 <= min <= max <= 1s (queueing-latency budget)"
+            )
+        if self.batch_latency_slo_seconds <= 0:
+            raise ValueError("batch_latency_slo_seconds must be positive")
         if self.pod_initial_backoff_seconds <= 0:
             raise ValueError("pod_initial_backoff_seconds must be positive")
         if self.pod_max_backoff_seconds < self.pod_initial_backoff_seconds:
@@ -158,6 +179,8 @@ _TOP_KEYS = {
     "podInitialBackoffSeconds", "podMaxBackoffSeconds", "profiles",
     "featureGates", "batchSize", "batchWindowSeconds", "assumeTTLSeconds",
     "unschedulableFlushSeconds", "maxPreemptionsPerCycle",
+    "adaptiveBatchWindow", "batchWindowMinSeconds", "batchWindowMaxSeconds",
+    "batchLatencySLOSeconds",
 }
 
 
@@ -202,6 +225,14 @@ def load_config(source: Any) -> SchedulerConfiguration:
         cfg.batch_size = int(doc["batchSize"])
     if "batchWindowSeconds" in doc:
         cfg.batch_window_seconds = float(doc["batchWindowSeconds"])
+    if "adaptiveBatchWindow" in doc:
+        cfg.adaptive_batch_window = bool(doc["adaptiveBatchWindow"])
+    if "batchWindowMinSeconds" in doc:
+        cfg.batch_window_min_seconds = float(doc["batchWindowMinSeconds"])
+    if "batchWindowMaxSeconds" in doc:
+        cfg.batch_window_max_seconds = float(doc["batchWindowMaxSeconds"])
+    if "batchLatencySLOSeconds" in doc:
+        cfg.batch_latency_slo_seconds = float(doc["batchLatencySLOSeconds"])
     if "assumeTTLSeconds" in doc:
         cfg.assume_ttl_seconds = float(doc["assumeTTLSeconds"])
     if "unschedulableFlushSeconds" in doc:

@@ -242,6 +242,26 @@ class Registry:
         # GRAFTLINT_SHAPES=1 test sessions); steady-state increments
         # mean a kernel argument escaped the pad-bucket lattice
         self.solve_retrace_total = Gauge("scheduler_solve_retrace_total")
+        # -- overload-protection surface (docs/robustness.md) -------------
+        # deepest per-watcher coalescing backlog at the last cycle mirror
+        self.watch_queue_depth = Gauge("scheduler_watch_queue_depth")
+        # events compacted away by per-watcher coalescing (latest-wins
+        # MODIFIED runs + ADDED/DELETED annihilation), store mirror
+        self.watch_coalesced_total = Gauge("scheduler_watch_coalesced_total")
+        # watchers expired (bookmark rv + forced relist) after their
+        # coalescing buffer overflowed — the survivable-overload path
+        self.watch_expired_total = Gauge("scheduler_watch_expired_total")
+        # legacy destructive slow-watcher kills, labeled per kind; the
+        # backpressured fan-out never performs them (benches assert 0)
+        self.watch_terminated_total = Gauge("scheduler_watch_terminated_total")
+        # the adaptive accumulation window currently in force
+        self.batch_window_ms = Gauge("scheduler_batch_window_ms")
+        # overload controller level: 0 healthy / 1 shed background /
+        # 2 severe (window pinned wide)
+        self.overload_level = Gauge("scheduler_overload_level")
+        # background work units (preemption dry-runs) the overload
+        # controller deferred instead of letting cycles pile up
+        self.overload_shed_total = Counter("scheduler_overload_shed_total")
         # schedule_attempts_total{result="scheduled|unschedulable|error"}
         self.schedule_attempts = Counter("scheduler_schedule_attempts_total")
         # pending_pods{queue="active|backoff|unschedulable|gated"}

@@ -63,6 +63,113 @@ def pod_key(pod: api.Pod) -> str:
     return f"{pod.meta.namespace}/{pod.meta.name}"
 
 
+class AdaptiveBatchWindow:
+    """Load-adaptive accumulation window for ``pop_batch``.
+
+    Two observed signals drive it:
+
+      * arrival rate ``r`` (pods/s) — EWMA over fixed sampling buckets,
+        fed by ``SchedulingQueue.add`` on every new pending pod;
+      * per-pod pipeline cost ``c`` (s/pod) — EWMAs of solve and commit
+        cost per pod, fed by the scheduler's completed cycles/waves.
+
+    Policy: the window plus the processing time of the batch it collects
+    must fit the latency SLO — ``w + (r*w)*c <= slo`` gives
+    ``w* = slo / (1 + r*c)``.  Sparse arrivals (fewer than ~2 expected
+    during ``w*``) make waiting pointless, so the window floors to
+    ``min_window``; sustained churn widens it (bigger batches amortize
+    encode/solve/commit) up to ``max_window``.  Overload level >= 2 from
+    the scheduler's OverloadController pins it at ``max_window``: the
+    cheapest load to shed is per-cycle fixed overhead — fewer, fuller
+    cycles.  With no signal yet the configured base window applies.
+    """
+
+    GUARDED_FIELDS = {
+        "_rate": "_lock",
+        "_solve_pp": "_lock",
+        "_commit_pp": "_lock",
+        "_bucket": "_lock",
+        "_bucket_start": "_lock",
+        "_overload": "_lock",
+    }
+
+    _SAMPLE_S = 0.25   # arrival-rate sampling bucket
+    _ALPHA = 0.3       # EWMA weight for new samples
+
+    def __init__(
+        self,
+        base_window: float = 0.05,
+        min_window: float = 0.005,
+        max_window: float = 0.25,
+        slo_seconds: float = 0.5,
+        clock=time.monotonic,
+    ):
+        self._clock = clock
+        self.base = base_window
+        self.min = min(min_window, max_window)
+        self.max = max_window
+        self.slo = slo_seconds
+        self._lock = threading.Lock()
+        self._rate = 0.0        # pods/s EWMA
+        self._solve_pp = 0.0    # solve seconds per pod EWMA
+        self._commit_pp = 0.0   # commit seconds per pod EWMA
+        self._bucket = 0
+        self._bucket_start = self._clock()
+        self._overload = 0
+
+    def _fold_locked(self) -> None:
+        now = self._clock()
+        periods = int((now - self._bucket_start) / self._SAMPLE_S)
+        if periods <= 0:
+            return
+        sample = self._bucket / (periods * self._SAMPLE_S)
+        for _ in range(min(periods, 50)):  # idle gaps decay toward 0
+            self._rate += self._ALPHA * (sample - self._rate)
+        self._bucket = 0
+        self._bucket_start += periods * self._SAMPLE_S
+
+    def note_arrival(self, n: int = 1) -> None:
+        with self._lock:
+            self._fold_locked()
+            self._bucket += n
+
+    def note_solve(self, pods: int, seconds: float) -> None:
+        if pods <= 0:
+            return
+        with self._lock:
+            self._solve_pp += self._ALPHA * (
+                max(seconds, 0.0) / pods - self._solve_pp
+            )
+
+    def note_commit(self, pods: int, seconds: float) -> None:
+        if pods <= 0:
+            return
+        with self._lock:
+            self._commit_pp += self._ALPHA * (
+                max(seconds, 0.0) / pods - self._commit_pp
+            )
+
+    def set_overload(self, level: int) -> None:
+        with self._lock:
+            self._overload = level
+
+    def window(self) -> float:
+        with self._lock:
+            self._fold_locked()
+            if self._overload >= 2:
+                return self.max
+            r = self._rate
+            c = self._solve_pp + self._commit_pp
+            if r <= 0.0 and c <= 0.0:
+                # no signal yet: the configured base window applies
+                return min(max(self.base, self.min), self.max)
+            w_star = self.slo / (1.0 + r * c)
+            if r * w_star < 2.0:
+                # sparse arrivals: waiting would not grow the batch
+                return self.min
+            return min(max(w_star, self.min), self.max)
+
+
 @dataclass
 class QueuedPodInfo:
     """scheduling_queue.go QueuedPodInfo."""
@@ -112,6 +219,7 @@ class SchedulingQueue:
         unschedulable_flush_after: float = 300.0,
         clock=time.monotonic,
         batch_window: float = 0.0,
+        window_ctl: Optional[AdaptiveBatchWindow] = None,
     ):
         self._clock = clock
         self._base = backoff_base
@@ -125,6 +233,11 @@ class SchedulingQueue:
         # attempt-latency budget: every pod in the batch pays the window
         # as queueing latency.
         self._batch_window = batch_window
+        # optional AdaptiveBatchWindow: when present, pop_batch derives
+        # its default window from observed arrival rate + cycle cost
+        # instead of the fixed value, and add() feeds the rate estimate.
+        # Read-only reference (the controller has its own lock).
+        self._window_ctl = window_ctl
         self._cond = threading.Condition()
         self._seq = itertools.count()
         self._active: List[tuple] = []           # (-prio, ts, seq, key)
@@ -206,6 +319,10 @@ class SchedulingQueue:
                     pod=pod, timestamp=now, initial_attempt_timestamp=now
                 )
                 self._infos[key] = info
+                if self._window_ctl is not None:
+                    # new pending pod: one arrival sample for the
+                    # adaptive window's rate estimate
+                    self._window_ctl.note_arrival()
             info.pod = pod
             if pod.spec.scheduling_gates:
                 info.gated = True
@@ -381,14 +498,18 @@ class SchedulingQueue:
         size, or inflight in another batch) is skipped whole and returned
         to active.
 
-        `window` (default: the queue's batch_window) is the bounded
+        `window` (default: the adaptive controller's current window when
+        one is wired, else the queue's fixed batch_window) is the bounded
         accumulation window: with at least one pod in hand but fewer than
         max_n, the pop keeps collecting arrivals for up to `window`
         seconds before returning.  Never exceeds `timeout` — a timeout=0
         (non-blocking) pop stays non-blocking."""
         deadline = None if timeout is None else self._clock() + timeout
         if window is None:
-            window = self._batch_window
+            if self._window_ctl is not None:
+                window = self._window_ctl.window()
+            else:
+                window = self._batch_window
         if timeout is not None:
             window = min(window, timeout)
         pullable = ("active", "backoff", "unsched")

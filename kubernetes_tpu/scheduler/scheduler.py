@@ -56,8 +56,57 @@ from .config import SchedulerConfiguration
 from .framework import Framework, FrameworkRegistry
 from .metrics import Registry
 from .preemption import PreemptionEvaluator
-from .queue import QueuedPodInfo, SchedulingQueue, pod_key
+from .queue import AdaptiveBatchWindow, QueuedPodInfo, SchedulingQueue, pod_key
 from .waitingpods import WaitingPod, WaitingPodsMap
+
+
+class OverloadController:
+    """Load-aware degradation ladder for the solve stage.
+
+    Tracks an EWMA of solve-stage cycle duration against the latency SLO
+    and exposes a shed level consumed each cycle:
+
+      0  healthy — full work;
+      1  overloaded (ewma > slo) — background work sheds first: the
+         PostFilter preemption dry-runs are deferred (counted in
+         scheduler_overload_shed_total), never the placement work itself;
+      2  severe (ewma > 2*slo) — additionally the adaptive batch window
+         pins at its max: fewer, fuller cycles shed per-cycle fixed
+         overhead without dropping pods.
+
+    Levels fall only when the EWMA drops below 80% of the rising
+    threshold (hysteresis), so one fast cycle doesn't flap the ladder.
+    """
+
+    GUARDED_FIELDS = {"_ewma": "_lock", "_level": "_lock"}
+
+    _ALPHA = 0.3
+
+    def __init__(self, slo_seconds: float = 0.5):
+        self.slo = slo_seconds
+        self._lock = threading.Lock()
+        self._ewma = 0.0
+        self._level = 0
+
+    def note_cycle(self, duration_s: float) -> int:
+        with self._lock:
+            self._ewma += self._ALPHA * (max(duration_s, 0.0) - self._ewma)
+            e, lvl = self._ewma, self._level
+            if e > 2 * self.slo:
+                lvl = 2
+            else:
+                if lvl == 2 and e < 0.8 * 2 * self.slo:
+                    lvl = 1
+                if e > self.slo:
+                    lvl = max(lvl, 1)
+                elif lvl == 1 and e < 0.8 * self.slo:
+                    lvl = 0
+            self._level = lvl
+            return lvl
+
+    def level(self) -> int:
+        with self._lock:
+            return self._level
 
 
 def _combine_transforms(transforms):
@@ -155,12 +204,30 @@ class Scheduler:
             ttl=assume_ttl or self.config.assume_ttl_seconds,
             clock=clock,
         )
+        # overload protection (docs/robustness.md): the adaptive window
+        # sizes pop_batch's accumulation from observed arrival rate and
+        # solve/commit cost; the overload controller sheds background
+        # work (preemption dry-runs) and widens the window when cycles
+        # overrun the latency SLO, instead of letting traces pile up
+        self.window_ctl: Optional[AdaptiveBatchWindow] = None
+        if self.config.adaptive_batch_window:
+            self.window_ctl = AdaptiveBatchWindow(
+                base_window=self.config.batch_window_seconds,
+                min_window=self.config.batch_window_min_seconds,
+                max_window=self.config.batch_window_max_seconds,
+                slo_seconds=self.config.batch_latency_slo_seconds,
+                clock=clock,
+            )
+        self.overload = OverloadController(
+            slo_seconds=self.config.batch_latency_slo_seconds
+        )
         self.queue = SchedulingQueue(
             backoff_base=self.config.pod_initial_backoff_seconds,
             backoff_max=self.config.pod_max_backoff_seconds,
             unschedulable_flush_after=self.config.unschedulable_flush_seconds,
             clock=clock,
             batch_window=self.config.batch_window_seconds,
+            window_ctl=self.window_ctl,
         )
         self.metrics = Registry()
         # pods parked at Permit (waiting_pods_map.go); coscheduling-style
@@ -583,6 +650,8 @@ class Scheduler:
         dt = self._clock() - t0
         self.metrics.commit_wave_duration.observe(dt)
         self.metrics.commit_wave_size.observe(float(len(wave)))
+        if self.window_ctl is not None:
+            self.window_ctl.note_commit(len(wave), dt)
         self.metrics.pipeline_overlap.observe(
             self._solve_overlap(t0, self._clock())
         )
@@ -862,6 +931,12 @@ class Scheduler:
         # so harness percentiles stay comparable with the reference's
         # per-ScheduleOne numbers.
         dt_exposed = encode_s + compile_s + decode_wait
+        if self.window_ctl is not None:
+            # compile walls are one-off; the steady per-pod solve cost
+            # the window should size against excludes them
+            self.window_ctl.note_solve(
+                len(group), encode_s + decode_wait
+            )
         self.metrics.batch_solve_duration.observe(dt_exposed)
         self.metrics.scheduling_algorithm_duration.observe(
             dt_exposed / max(len(group), 1), count=len(group)
@@ -911,18 +986,36 @@ class Scheduler:
             # priority first (handleSchedulingFailure ->
             # Evaluator.Preempt, schedule_one.go:1017, preemption.go:150).
             # Victim deletes emit AssignedPodDelete events that requeue
-            # the nominee.
+            # the nominee.  Under overload (level >= 1) the dry-runs are
+            # DEFERRED — background rescoring is the first work shed;
+            # the parked pods stay in unschedulable and a later healthy
+            # cycle (or the flush interval) retries them.
             cycle.failed.sort(key=lambda i: -i.pod.spec.priority)
-            for info in cycle.failed[: self.max_preemptions_per_cycle]:
+            budget = self.max_preemptions_per_cycle
+            if self.overload.level() >= 1:
+                budget = 0
+            eligible = cycle.failed[: self.max_preemptions_per_cycle]
+            for info in eligible[:budget]:
                 fwk = self.profiles.for_pod(info.pod)
                 if fwk is not None and fwk.run_post_filter(info.pod):
                     stats["preempted"] = stats.get("preempted", 0) + 1
+            if budget == 0 and eligible:
+                self.metrics.overload_shed_total.inc(by=float(len(eligible)))
             trace.step("postfilter")
             qs = self.queue.stats()
             for tier, v in qs.items():
                 self.metrics.pending_pods.set(v, tier)
         trace.log_if_long()
         self.metrics.schedule_batch_duration.observe(trace.total)
+        # overload ladder: feed the cycle duration, publish the level,
+        # and let the adaptive window react (level 2 pins it wide)
+        level = self.overload.note_cycle(trace.total)
+        self.metrics.overload_level.set(float(level))
+        if self.window_ctl is not None:
+            self.window_ctl.set_overload(level)
+            self.metrics.batch_window_ms.set(
+                self.window_ctl.window() * 1000.0
+            )
         # degraded-mode observability: mirror the breaker and journal
         # recovery state into the registry every cycle (cheap gauge sets)
         breaker = getattr(self.tpu, "breaker", None)
@@ -937,6 +1030,24 @@ class Scheduler:
         recovered = getattr(self.store, "journal_recovered_records", None)
         if recovered is not None:
             self.metrics.journal_recovered_records.set(float(recovered))
+        # watch fan-out health: mirror the store's backpressure counters
+        # (depth / coalesced / expired) and any legacy terminations
+        watch_stats = getattr(self.store, "watch_stats", None)
+        if watch_stats is not None:
+            ws = watch_stats()
+            self.metrics.watch_queue_depth.set(
+                float(ws["watch_queue_depth"])
+            )
+            self.metrics.watch_coalesced_total.set(
+                float(ws["watch_coalesced_total"])
+            )
+            self.metrics.watch_expired_total.set(
+                float(ws["watch_expired_total"])
+            )
+            for kind, n in dict(
+                getattr(self.store, "terminated_by_kind", {})
+            ).items():
+                self.metrics.watch_terminated_total.set(float(n), kind)
         self._inflight_cycle = None
         return stats
 

@@ -3,9 +3,10 @@ pipeline.
 
 The registry is the chaos suite's only lever: named fault points are
 threaded through the hot path (journal append/fsync, the wave
-transaction, watch fan-out, the device solve, the binder commit, lease
-renewal) and each point consults the armed registry through one
-module-level indirection.  Disarmed — the production state — the check
+transaction, watch fan-out and the consumer side of watch streams, the
+list/relist path, the device solve, the binder commit, lease renewal)
+and each point consults the armed registry through one module-level
+indirection.  Disarmed — the production state — the check
 is a single global load and an early return, so the hot path pays
 nothing measurable (BENCH_STRICT budgets hold with the points in
 place).
@@ -47,7 +48,9 @@ KNOWN_POINTS = frozenset({
     "store.journal.append",
     "store.journal.fsync",
     "store.update_wave",
+    "store.list",
     "watch.offer",
+    "watch.consume",
     "batch.solve",
     "binder.commit_wave",
     "leader.renew",

@@ -75,6 +75,13 @@ def test_rest_watch_stream(server):
     t.start()
     time.sleep(0.2)
     store.create(make_pod("w1").obj())
+    # wait for the ADDED to cross the wire before deleting: an
+    # un-consumed ADDED+DELETED pair legitimately annihilates in the
+    # watcher's coalescing buffer (docs/robustness.md) — the stream
+    # contract under test here is that both event TYPES flow through
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and len(got) < 1:
+        time.sleep(0.01)
     store.delete("Pod", "w1")
     t.join(timeout=5)
     assert got == [("ADDED", "w1"), ("DELETED", "w1")]

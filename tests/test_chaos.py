@@ -56,6 +56,8 @@ def _fault_plan(rng: random.Random) -> faults.FaultRegistry:
     reg.torn_write("store.journal.append", frac=rng.random(), n=1)
     reg.fail("store.journal.fsync", n=1)
     reg.drop("watch.offer", n=rng.randint(1, 3), probability=0.5)
+    reg.delay("watch.consume", seconds=0.002, n=5, probability=0.5)
+    reg.delay("store.list", seconds=0.005, n=3, probability=0.5)
     reg.fail("leader.renew", n=rng.randint(1, 2))
     return reg
 
@@ -203,3 +205,220 @@ def test_chaos_pipeline_invariants(seed, tmp_path):
             f"seed {seed}: journal binding {p.spec.node_name!r} "
             f"contradicts live {live[key]!r} for {key}"
         )
+
+
+# -- overload-protection chaos: slow consumers + relist storms ---------------
+#
+# These seeds drive the backpressured watch fan-out (per-watcher
+# coalescing, Expired-instead-of-terminate) and the relist-storm
+# containment (reflector backoff + shared RelistGate) and assert the
+# PR 3 invariants PLUS the overload ones: no watcher terminated, bounded
+# event staleness (caches converge on the store at quiesce), and
+# rv-monotonic delivery through coalescing.
+
+SLOW_CONSUMER_SEEDS = list(range(100, 105))
+RELIST_STORM_SEEDS = list(range(200, 205))
+
+
+def _overload_cluster(seed, store, n_pods, pace=0.0):
+    """Shared harness: nodes + scheduler + paced pod burst + quiesce.
+    Returns (sched, audit)."""
+    rng = random.Random(seed)
+    audit = _EventAudit(store)
+    for i in range(rng.randint(4, 8)):
+        store.create(
+            make_node(f"n{i}")
+            .capacity(cpu_milli=16000, mem=32 * GI, pods=110)
+            .zone(f"z{i % 3}")
+            .obj()
+        )
+    config = SchedulerConfiguration(
+        pod_initial_backoff_seconds=0.05,
+        pod_max_backoff_seconds=0.4,
+        batch_window_seconds=0.01,
+        batch_window_min_seconds=0.005,
+        batch_window_max_seconds=0.05,
+        unschedulable_flush_seconds=0.5,
+    )
+    sched = Scheduler(store, assume_ttl=1.0, config=config)
+    sched.start()
+    for i in range(n_pods):
+        store.create(
+            make_pod(f"p{i}")
+            .req(cpu_milli=rng.choice([50, 100]), mem=GI // 8)
+            .obj()
+        )
+        if pace and rng.random() < 0.5:
+            time.sleep(rng.random() * pace)
+    return sched, audit
+
+
+def _quiesce_all_bound(store, seed, deadline_s=90):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        pods, _ = store.list("Pod")
+        if pods and all(p.spec.node_name for p in pods):
+            return pods
+        time.sleep(0.1)
+    pods, _ = store.list("Pod")
+    unbound = [p.meta.name for p in pods if not p.spec.node_name]
+    assert not unbound, f"seed {seed}: pods unbound past quiesce: {unbound}"
+    return pods
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+@pytest.mark.parametrize("seed", SLOW_CONSUMER_SEEDS)
+def test_chaos_slow_consumer(seed):
+    """Injected consumer stalls + offer drops against a tight watch
+    capacity: coalescing and Expired-relist must carry the load — no pod
+    lost, no double bind, NO watcher terminated, delivery rv-monotonic,
+    and an independent slow reflector converges on the store's final
+    state (bounded staleness)."""
+    rng = random.Random(seed)
+    reg = faults.FaultRegistry(seed=rng.randint(0, 2 ** 31))
+    reg.delay("watch.consume", seconds=0.005, n=100, probability=0.3)
+    reg.drop("watch.offer", n=rng.randint(1, 3), probability=0.3)
+    reg.delay("store.list", seconds=0.01, n=10, probability=0.5)
+    store = st.Store(watch_capacity=64)
+
+    # an independent slow mini-reflector: consumes with delays, relists
+    # on Expired, and must end exactly consistent with the store
+    state = {}
+    state_lock = threading.Lock()
+    stop = threading.Event()
+    monotonic_violations = []
+
+    def consumer():
+        w = None
+        last_rv = 0
+        while not stop.is_set():
+            try:
+                if w is None:
+                    items, rv = store.list("Pod")
+                    with state_lock:
+                        state.clear()
+                        state.update(
+                            {p.meta.name: p.spec.node_name for p in items}
+                        )
+                    last_rv = rv
+                    w = store.watch("Pod", from_rv=rv)
+                ev = w.get(timeout=0.2)
+                if ev is None:
+                    if w.expired:
+                        w = None  # forced relist (the 410 path)
+                    continue
+                if ev.rv <= last_rv:
+                    monotonic_violations.append((ev.rv, last_rv))
+                last_rv = ev.rv
+                with state_lock:
+                    if ev.type == st.DELETED:
+                        state.pop(ev.obj.meta.name, None)
+                    else:
+                        state[ev.obj.meta.name] = ev.obj.spec.node_name
+                time.sleep(0.002)  # deliberately slow
+            except st.Expired:
+                w = None
+
+    t = threading.Thread(target=consumer, daemon=True)
+    sched = None
+    try:
+        with faults.armed(reg):
+            t.start()
+            sched, audit = _overload_cluster(
+                seed, store, n_pods=rng.randint(30, 50), pace=0.005
+            )
+            pods = _quiesce_all_bound(store, seed)
+        assert reg.fired, f"seed {seed}: no fault ever fired"
+        assert not audit.violations, f"seed {seed}: {audit.violations[:5]}"
+        rebound = {
+            k: v for k, v in audit.bound_nodes.items() if len(v) > 1
+        }
+        assert not rebound, f"seed {seed}: double binds {rebound}"
+        assert store.watchers_terminated == 0, (
+            f"seed {seed}: watcher terminated under backpressure"
+        )
+        assert not monotonic_violations, (
+            f"seed {seed}: rv regressions {monotonic_violations[:5]}"
+        )
+        # bounded staleness: once the event stream quiesces, the slow
+        # reflector's replayed state equals the store's final bindings
+        want = {p.meta.name: p.spec.node_name for p in pods
+                if p.meta.name.startswith("p")}
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            with state_lock:
+                got = {k: v for k, v in state.items()
+                       if k.startswith("p")}
+            if got == want:
+                break
+            time.sleep(0.1)
+        assert got == want, (
+            f"seed {seed}: stale consumer state "
+            f"(missing={set(want) - set(got)}, "
+            f"extra={set(got) - set(want)})"
+        )
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        faults.disarm()
+        if sched is not None:
+            sched.stop()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+@pytest.mark.parametrize("seed", RELIST_STORM_SEEDS)
+def test_chaos_relist_storm(seed):
+    """Repeated injected expiries across the scheduler's informers plus
+    list latency: the jittered backoff + shared RelistGate must contain
+    the storm — every informer converges, every pod binds, no watcher
+    terminated, no double bind."""
+    rng = random.Random(seed)
+    reg = faults.FaultRegistry(seed=rng.randint(0, 2 ** 31))
+    reg.drop("watch.offer", n=rng.randint(4, 8), probability=0.7)
+    reg.delay("store.list", seconds=0.02, n=40, probability=0.7)
+    reg.fail("store.update_wave", n=1)
+    store = st.Store(watch_capacity=32)
+    sched = None
+    try:
+        with faults.armed(reg):
+            sched, audit = _overload_cluster(
+                seed, store, n_pods=rng.randint(30, 50)
+            )
+            pods = _quiesce_all_bound(store, seed)
+        assert reg.fired.get("watch.offer"), (
+            f"seed {seed}: no expiry was ever injected"
+        )
+        assert not audit.violations, f"seed {seed}: {audit.violations[:5]}"
+        rebound = {
+            k: v for k, v in audit.bound_nodes.items() if len(v) > 1
+        }
+        assert not rebound, f"seed {seed}: double binds {rebound}"
+        assert store.watchers_terminated == 0, (
+            f"seed {seed}: watcher terminated under relist storm"
+        )
+        # bounded staleness: the Pod informer cache converges on the
+        # store after the storm (relist recovered every expiry)
+        want = {
+            (p.meta.name, p.spec.node_name) for p in pods
+        }
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            got = {
+                (p.meta.name, p.spec.node_name)
+                for p in sched.informers.informer("Pod").list()
+            }
+            if got == want:
+                break
+            time.sleep(0.1)
+        assert got == want, (
+            f"seed {seed}: informer cache stale after storm "
+            f"(missing={want - got}, extra={got - want})"
+        )
+    finally:
+        faults.disarm()
+        if sched is not None:
+            sched.stop()

@@ -464,17 +464,30 @@ def test_cycle_fault_salvages_popped_pods():
 # -- watch overflow → Expired → relist → resume -----------------------------
 
 
-def test_watch_overflow_relist_resume_no_loss_no_dupes():
-    """The overflow-kill path must compose with the relist contract:
-    stop → list → watch(from_rv=rv) resumes with every later event
-    exactly once, and a from_rv older than the buffer raises Expired."""
+def _wait_for(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def test_watch_overflow_expires_instead_of_terminates():
+    """Coalescing overflow (more DISTINCT pending objects than the
+    capacity) must EXPIRE the watcher — bookmark rv + forced relist —
+    never destructively terminate it: iteration raises Expired, and the
+    relist + watch(from_rv=rv) resume loses nothing and dupes nothing."""
     store = st.Store(watch_capacity=4)
     w = store.watch("Pod")
-    for i in range(8):  # overflow the un-drained watcher
+    for i in range(8):  # 8 distinct keys against a 4-entry buffer
         store.create(make_pod(f"p{i}").obj())
-    assert store.watchers_terminated == 1
-    drained = list(w)  # stream ends (sentinel), never hangs
-    assert len(drained) < 8
+    assert _wait_for(lambda: w.expired)  # fan-out thread expires it
+    assert store.watchers_terminated == 0
+    assert store.terminated_by_kind == {}
+    assert store.watch_stats()["watch_expired_total"] == 1
+    with pytest.raises(st.Expired):
+        list(w)  # the 410 signal, never a hang
     # the relist half: list gives a consistent snapshot + resume rv
     items, rv = store.list("Pod")
     assert {p.meta.name for p in items} == {f"p{i}" for i in range(8)}
@@ -514,9 +527,10 @@ def test_watch_replay_overflow_raises_expired_not_silent_loss():
     reg = faults.FaultRegistry().drop("watch.offer", n=1)
     with faults.armed(reg), pytest.raises(st.Expired):
         store.watch("Pod", from_rv=rv0)
-    # the refused stream counts as a termination (observability) and a
-    # fresh relist + watch works
-    assert store.watchers_terminated == 1
+    # the refused stream counts as an EXPIRY (observability), never a
+    # destructive termination, and a fresh relist + watch works
+    assert store.watchers_terminated == 0
+    assert store.watch_stats()["watch_expired_total"] == 1
     items, rv = store.list("Pod")
     assert [p.meta.name for p in items] == ["a"]
     w = store.watch("Pod", from_rv=rv)
@@ -525,13 +539,17 @@ def test_watch_replay_overflow_raises_expired_not_silent_loss():
     w.stop()
 
 
-def test_injected_watch_drop_kills_and_relist_recovers():
+def test_injected_watch_drop_expires_and_relist_recovers():
     store = st.Store()
     w = store.watch("Pod")
     reg = faults.FaultRegistry().drop("watch.offer", n=1)
     with faults.armed(reg):
         store.create(make_pod("dropped").obj())
-    assert store.watchers_terminated == 1
-    assert list(w) == []  # stream closed
+        # the drop fires on the fan-out thread: stay armed until it did
+        assert _wait_for(lambda: w.expired)
+    assert store.watchers_terminated == 0
+    assert store.watch_stats()["watch_expired_total"] == 1
+    with pytest.raises(st.Expired):
+        list(w)  # the 410 signal: relist
     items, rv = store.list("Pod")
     assert [p.meta.name for p in items] == ["dropped"]  # relist sees it

@@ -1,0 +1,364 @@
+"""Overload protection: backpressured watch fan-out, per-watcher
+coalescing, Expired-instead-of-terminate, reflector relist backoff +
+storm gating, and the adaptive batch window / overload controller.
+
+The chaos-grade randomized versions (slow-consumer and relist-storm
+seeds) live in tests/test_chaos.py; this file is the fast tier-1
+regression surface for the same contracts.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import store as st
+from kubernetes_tpu.client.informers import InformerFactory, RelistGate
+from kubernetes_tpu.scheduler.queue import AdaptiveBatchWindow, SchedulingQueue
+from kubernetes_tpu.scheduler.scheduler import OverloadController
+from kubernetes_tpu.testing import faults
+from kubernetes_tpu.testing.wrappers import GI, make_node, make_pod
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    yield
+    faults.disarm()
+
+
+def _wait_for(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def _settled(store, w):
+    """True once the fan-out thread delivered every committed event into
+    the watcher's buffer (its dedup horizon reached the store rv)."""
+    with w._mu:
+        return w._last_rv >= store.resource_version
+
+
+# -- per-watcher coalescing --------------------------------------------------
+
+
+def test_modified_run_coalesces_to_latest_with_monotonic_rv():
+    """A MODIFIED run on one key compacts latest-wins: the un-drained
+    consumer receives exactly ONE event carrying the newest object and
+    the final rv — never an intermediate revision."""
+    store = st.Store()
+    w = store.watch("Pod")
+    pod = store.create(make_pod("a").obj())
+    for i in range(10):
+        pod.meta.labels["v"] = str(i)
+        pod = store.update(pod)
+    assert _wait_for(lambda: _settled(store, w))
+    ev = w.get(timeout=2)
+    assert ev is not None
+    # the consumer never saw the create, so the compacted event is
+    # still the ADDED — with the latest object and the final rv
+    assert ev.type == st.ADDED
+    assert ev.obj.meta.labels["v"] == "9"
+    assert ev.rv == store.resource_version
+    assert w.get(timeout=0.05) is None  # exactly one event
+    assert store.watch_stats()["watch_coalesced_total"] >= 10
+    w.stop()
+
+
+def test_added_deleted_annihilation():
+    """An object created AND deleted while the consumer lagged is never
+    delivered at all — the pending pair annihilates."""
+    store = st.Store()
+    w = store.watch("Pod")
+    store.create(make_pod("ghost").obj())
+    store.delete("Pod", "ghost")
+    assert _wait_for(lambda: _settled(store, w))
+    assert w.get(timeout=0.05) is None
+    assert not w.expired and not w.stopped
+    w.stop()
+
+
+def test_delete_recreate_coalesces_to_modified():
+    """DELETED followed by a recreate compacts to MODIFIED with the new
+    object: cache-diffing consumers converge on the recreated state."""
+    store = st.Store()
+    w = store.watch("Pod")
+    store.create(make_pod("a").label("gen", "1").obj())
+    assert _wait_for(lambda: _settled(store, w))
+    assert w.get(timeout=2).type == st.ADDED  # consume the create
+    store.delete("Pod", "a")
+    store.create(make_pod("a").label("gen", "2").obj())
+    assert _wait_for(lambda: _settled(store, w))
+    ev = w.get(timeout=2)
+    assert ev.type == st.MODIFIED
+    assert ev.obj.meta.labels["gen"] == "2"
+    assert w.get(timeout=0.05) is None
+    w.stop()
+
+
+def test_delivery_rv_monotonic_through_compaction():
+    """Compaction re-sorts updated keys to the back, so the delivered
+    stream stays strictly rv-monotonic across interleaved keys."""
+    store = st.Store()
+    w = store.watch("Pod")
+    pods = [store.create(make_pod(f"p{i}").obj()) for i in range(6)]
+    for k in range(3):
+        for i in (0, 3, 5):
+            pods[i].meta.labels["k"] = str(k)
+            pods[i] = store.update(pods[i])
+    assert _wait_for(lambda: _settled(store, w))
+    last = 0
+    while True:
+        ev = w.get(timeout=0.1)
+        if ev is None:
+            break
+        assert ev.rv > last
+        last = ev.rv
+    w.stop()
+
+
+def test_slow_consumer_is_backpressured_not_terminated():
+    """A consumer that drains slowly while a writer churns one hot key
+    sees coalesced snapshots and is NEVER terminated — the write path
+    also never blocks on it (fan-out runs off the store lock)."""
+    store = st.Store(watch_capacity=8)
+    w = store.watch("Pod")
+    pod = store.create(make_pod("hot").obj())
+    stop = threading.Event()
+
+    def churn():
+        p = pod
+        while not stop.is_set():
+            p.meta.labels["t"] = str(time.monotonic())
+            p = store.update(p, force=True)
+
+    t = threading.Thread(target=churn, daemon=True)
+    t.start()
+    last = 0
+    for _ in range(20):  # slow consumer: 2ms per event
+        ev = w.get(timeout=2)
+        assert ev is not None
+        assert ev.rv > last
+        last = ev.rv
+        time.sleep(0.002)
+    stop.set()
+    t.join(timeout=2)
+    assert not w.expired
+    assert store.watchers_terminated == 0
+    w.stop()
+
+
+def test_informer_resynthesizes_delete_recreate_split():
+    """A delete + recreate the watch buffer compacted into one MODIFIED
+    must reach informer handlers as DELETED(old) then ADDED(new): uid-
+    sensitive consumers (PV controller's claimRef.UID check, scheduler
+    cache accounting) depend on seeing the true transition."""
+    store = st.Store()
+    factory = InformerFactory(store)
+    inf = factory.informer("Pod")
+    events = []
+    inf.add_handler(
+        lambda t, o, old: events.append((t, o.meta.uid))
+    )
+    inf.start()
+    assert inf.wait_for_sync(5)
+    first = store.create(make_pod("a").obj())
+    assert _wait_for(lambda: len(events) >= 1)
+    # stall the informer consumer so the DELETE + recreate compact into
+    # one MODIFIED event in its watch buffer
+    reg = faults.FaultRegistry().delay("watch.consume", seconds=0.3, n=1)
+    with faults.armed(reg):
+        store.delete("Pod", "a")
+        second = store.create(make_pod("a").obj())
+        assert _wait_for(lambda: len(events) >= 3, timeout=10)
+    assert events[0] == (st.ADDED, first.meta.uid)
+    assert (st.DELETED, first.meta.uid) in events
+    assert (st.ADDED, second.meta.uid) in events
+    factory.stop()
+
+
+# -- Expired semantics + reflector recovery ----------------------------------
+
+
+def test_overflow_expiry_bookmarks_and_informer_recovers():
+    """An informer whose watch the store expires relists (the 410 path)
+    and converges on the store's state — nothing lost, nothing dup'd."""
+    store = st.Store(watch_capacity=4)
+    factory = InformerFactory(store)
+    inf = factory.informer("Pod")
+    inf.start()
+    assert inf.wait_for_sync(5)
+    # stall the informer's consumer thread with injected consume latency
+    # while more distinct keys than the capacity commit
+    reg = faults.FaultRegistry().delay("watch.consume", seconds=0.3, n=2)
+    with faults.armed(reg):
+        for i in range(12):
+            store.create(make_pod(f"p{i}").obj())
+        assert _wait_for(
+            lambda: store.watch_stats()["watch_expired_total"] >= 1, timeout=10
+        )
+    assert store.watchers_terminated == 0
+    # bounded staleness: the relist converges the cache on the store
+    assert _wait_for(lambda: len(inf.list()) == 12, timeout=10)
+    factory.stop()
+
+
+def test_simultaneous_expiries_relist_through_bounded_gate():
+    """N informers expiring together must not synchronously hammer
+    Store.list: concurrent relists are capped by the factory's shared
+    RelistGate and the jittered backoff spreads the retries."""
+    kinds = [
+        "Pod", "Node", "PersistentVolume", "PersistentVolumeClaim",
+        "StorageClass", "ResourceClaim",
+    ]
+    mk = {
+        "Pod": lambda: make_pod("seed").obj(),
+        "Node": lambda: make_node("seed").capacity(
+            cpu_milli=1000, mem=GI
+        ).obj(),
+    }
+    store = st.Store()
+    concurrency = {"cur": 0, "max": 0}
+    mu = threading.Lock()
+    orig_list = store.list
+
+    def slow_list(kind, *a, **kw):
+        with mu:
+            concurrency["cur"] += 1
+            concurrency["max"] = max(concurrency["max"], concurrency["cur"])
+        try:
+            time.sleep(0.02)  # make overlap observable
+            return orig_list(kind, *a, **kw)
+        finally:
+            with mu:
+                concurrency["cur"] -= 1
+
+    store.list = slow_list
+    factory = InformerFactory(store)
+    infs = [factory.informer(k) for k in kinds]
+    factory.start()
+    assert factory.wait_for_sync(10)
+    concurrency["max"] = 0  # measure the storm, not the initial sync
+    # one drop per kind's watcher: every informer expires at once
+    reg = faults.FaultRegistry().drop("watch.offer", n=len(kinds))
+    with faults.armed(reg):
+        for kind in kinds:
+            if kind in mk:
+                store.create(mk[kind]())
+            else:
+                store._dispatch_wave(  # synthetic event: kind-only churn
+                    kind, [st.Event(st.ADDED, kind, make_pod("x").obj(),
+                                    store.resource_version + 1)],
+                )
+        assert _wait_for(
+            lambda: store.watch_stats()["watch_expired_total"] >= len(kinds),
+            timeout=10,
+        )
+        # every informer recovers (relist + rewatch)
+        assert _wait_for(
+            lambda: all(i.relists >= 2 for i in infs), timeout=10
+        )
+    assert concurrency["max"] <= factory.relist_gate.max_concurrent
+    assert store.watchers_terminated == 0
+    factory.stop()
+
+
+# -- adaptive batch window + overload controller -----------------------------
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_adaptive_window_widens_under_churn_and_floors_when_idle():
+    clk = _FakeClock()
+    ctl = AdaptiveBatchWindow(
+        base_window=0.05, min_window=0.005, max_window=0.25,
+        slo_seconds=0.5, clock=clk,
+    )
+    assert ctl.window() == pytest.approx(0.05)  # no signal: base
+    # sustained churn at ~1000 pods/s with ~1ms/pod pipeline cost
+    for _ in range(40):
+        ctl.note_arrival(250)
+        clk.t += 0.25
+    ctl.note_solve(1000, 0.5)
+    ctl.note_commit(1000, 0.5)
+    w = ctl.window()
+    assert 0.1 <= w <= 0.25  # slo/(1+r*c) capped at max
+    # idle decay: the rate EWMA falls and the window floors
+    clk.t += 30.0
+    assert ctl.window() == pytest.approx(0.005)
+
+
+def test_adaptive_window_respects_slo_as_cost_grows():
+    clk = _FakeClock()
+    ctl = AdaptiveBatchWindow(
+        min_window=0.005, max_window=0.5, slo_seconds=0.5, clock=clk
+    )
+    for _ in range(40):
+        ctl.note_arrival(500)  # 2000 pods/s
+        clk.t += 0.25
+    for _ in range(20):
+        ctl.note_solve(100, 0.2)   # 2ms/pod solve
+        ctl.note_commit(100, 0.2)  # 2ms/pod commit
+    w = ctl.window()
+    # w* = 0.5 / (1 + 2000*0.004) = 0.5/9 — batches sized so processing
+    # still fits the SLO
+    assert w == pytest.approx(0.5 / 9.0, rel=0.35)
+
+
+def test_adaptive_window_pinned_wide_under_severe_overload():
+    ctl = AdaptiveBatchWindow(max_window=0.25, clock=_FakeClock())
+    ctl.set_overload(2)
+    assert ctl.window() == 0.25
+    ctl.set_overload(0)
+    assert ctl.window() != 0.25 or ctl.window() == ctl.base
+
+
+def test_queue_uses_window_controller_default():
+    clk = _FakeClock()
+    ctl = AdaptiveBatchWindow(base_window=0.0, clock=clk)
+    q = SchedulingQueue(clock=clk, batch_window=99.0, window_ctl=ctl)
+    q.add(make_pod("a").obj())
+    # base window 0: pop returns immediately despite the fixed 99s
+    batch = q.pop_batch(8, timeout=0.0)
+    assert [i.pod.meta.name for i in batch] == ["a"]
+
+
+def test_overload_controller_ladder_and_hysteresis():
+    ctl = OverloadController(slo_seconds=0.1)
+    assert ctl.note_cycle(0.01) == 0
+    for _ in range(10):
+        lvl = ctl.note_cycle(0.15)  # > slo: shed background
+    assert lvl == 1
+    for _ in range(10):
+        lvl = ctl.note_cycle(0.5)   # > 2*slo: severe
+    assert lvl == 2
+    lvl = ctl.note_cycle(0.12)      # still above 80% of 2*slo? no — drops
+    for _ in range(10):
+        lvl = ctl.note_cycle(0.12)
+    assert lvl == 1                 # between slo and 2*slo: overloaded
+    for _ in range(20):
+        lvl = ctl.note_cycle(0.01)
+    assert lvl == 0                 # healthy again
+
+
+# -- bookkeeping + registry surfaces -----------------------------------------
+
+
+def test_terminated_kinds_is_bounded_counter_dict():
+    store = st.Store()
+    assert store.terminated_by_kind == {}
+    assert not hasattr(store, "terminated_kinds")  # the unbounded list
+
+
+def test_new_fault_points_registered():
+    assert "watch.consume" in faults.KNOWN_POINTS
+    assert "store.list" in faults.KNOWN_POINTS
