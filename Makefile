@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test chaos bench lint lint-shapes
+.PHONY: test chaos bench lint lint-shapes multichip
 
 # graftlint: the project-native static analysis suite (guarded-by,
 # hot-path purity, registry drift, lock-order, tensor-contract —
@@ -33,6 +33,15 @@ test:
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -m chaos -q \
 		-p no:cacheprovider
+
+# the sharded multichip suite on a FORCED 8-device host-platform mesh:
+# sharded-vs-single-chip parity (greedy/wavefront/auction + gang retry),
+# the mesh-sharded mirror, and mesh-mode pipeline/fallback behavior.
+# conftest.py forces the same device count for every pytest run; the
+# explicit flag keeps this target correct in any environment.
+multichip:
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+		$(PY) -m pytest tests/ -q -m multichip -p no:cacheprovider
 
 bench:
 	JAX_PLATFORMS=cpu BENCH_STRICT=1 $(PY) bench.py

@@ -17,6 +17,10 @@ informer-fed cache.  `extra` carries all five configs:
   c6    5k nodes /   2k pods  kubemark churn through the full loop
   c6s  50k nodes /   4k pods  SUSTAINED constant-rate arrival stream
        (strict budget: >= 1050 pods/s, watchers_terminated == 0)
+  c7  100k nodes /   2k pods  SHARDED solve on a forced 8-device host
+       mesh — a snapshot one chip cannot hold; gates: mesh/single-chip
+       assignment parity, steady_recompiles == 0, and steady host→device
+       transfer O(changed rows) via the mirror delta counters
 
 vs_baseline compares c5 against the upstream-folklore scheduler SLO of
 ~100 pods/s at 5k nodes (the reference publishes no in-tree absolute
@@ -24,7 +28,16 @@ numbers; see BASELINE.md): value = (10_000 / latency) / 100.
 """
 
 import json
+import os
 import time
+
+# c7 needs a multi-device host-platform mesh; the flag must land before
+# the first JAX backend init (tests/conftest.py forces the same 8)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 import numpy as np
 
@@ -66,10 +79,10 @@ class _Runner:
     encode/compile/solve split are reported separately so CI can gate on
     solve-half regressions without compile churn polluting the number."""
 
-    def __init__(self, nodes, mode):
+    def __init__(self, nodes, mode, mesh=None):
         from kubernetes_tpu.models.batch_scheduler import TPUBatchScheduler
 
-        self.sched = TPUBatchScheduler(mode=mode)
+        self.sched = TPUBatchScheduler(mode=mode, mesh=mesh)
         for nd in nodes:
             self.sched.add_node(nd)
 
@@ -541,8 +554,129 @@ def config6_sustained():
     }
 
 
+def config7():
+    """c7: 100k hollow nodes / 2048-pod batches solved SHARDED on a
+    forced 8-device host-platform mesh — the ≥100k-node scale the
+    single chip cannot hold (ROADMAP's structural unlock past 50k).
+
+    Measures the steady mesh-mode schedule_pending step (sharded
+    wavefront + NamedSharding-resident mirror), dirtying a bounded set
+    of rows between steps so the report can assert that steady-state
+    host→device transfer is O(changed rows), not O(N), via the mirror
+    delta/resync counters.  A small parity workload per solver family
+    (fit/greedy, spread/wavefront, gang/auction) checks mesh vs
+    single-chip assignment identity — BENCH_STRICT fails on any
+    divergence, on a steady recompile, or on unbounded mirror traffic."""
+    import jax
+
+    from kubernetes_tpu.analysis import retrace
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.parallel.sharded import make_mesh
+    from kubernetes_tpu.testing.wrappers import MI, make_pod
+
+    n_devices = len(jax.devices())
+    mesh = make_mesh(min(8, n_devices))
+    n_nodes, n_pods, dirty_rows = 100_000, 2_048, 16
+
+    # -- mesh vs single-chip parity, one workload per solver family ----
+    small_nodes = _mk_nodes(512, zones=8)
+
+    def fit_pods():
+        return _mk_basic_pods(256, seed=71, prefix="c7p-fit")
+
+    def spread_pods():
+        rng = np.random.default_rng(72)
+        return [
+            make_pod(f"c7p-sp-{i}")
+            .req(cpu_milli=int(rng.choice([100, 250, 500])), mem=256 * MI)
+            .label("app", f"svc-{i % 20}")
+            .spread(2, api.LABEL_ZONE, "DoNotSchedule", {"app": f"svc-{i % 20}"})
+            .obj()
+            for i in range(128)
+        ]
+
+    def gang_pods():
+        rng = np.random.default_rng(73)
+        return [
+            make_pod(f"c7p-g-{i}")
+            .req(cpu_milli=int(rng.choice([250, 500])), mem=256 * MI)
+            .group(f"gang-{i % 4}")
+            .obj()
+            for i in range(256)
+        ]
+
+    mesh_parity = {}
+    for label, mk_parity in (
+        ("fit", fit_pods), ("spread", spread_pods), ("gang", gang_pods),
+    ):
+        pods = mk_parity()
+        single = _Runner(small_nodes, mode="auto")
+        multi = _Runner(small_nodes, mode="auto", mesh=mesh)
+        mesh_parity[label] = (
+            single.sched.schedule_pending(pods)
+            == multi.sched.schedule_pending(pods)
+        )
+
+    # -- the 100k-node sharded steady step -----------------------------
+    nodes = _mk_nodes(n_nodes, zones=64)
+    runner = _Runner(nodes, mode="greedy", mesh=mesh)  # pinned: sharded wavefront
+    mirror = runner.sched._mirror
+
+    step = [0]
+
+    def mk(tag):
+        # dirty a bounded row set between steps: the steady-state mirror
+        # sync must move exactly these rows, not the 100k-node snapshot
+        base = step[0] * dirty_rows
+        for j in range(dirty_rows):
+            p = make_pod(f"c7-bind-{tag}-{j}").req(cpu_milli=10, mem=MI).obj()
+            runner.sched.assume(p, f"node-{(base + j * 97) % n_nodes}")
+        step[0] += 1
+        return [
+            make_pod(f"c7-{tag}-{i}")
+            .req(cpu_milli=100 + (i % 5) * 100, mem=256 * MI)
+            .obj()
+            for i in range(n_pods)
+        ]
+
+    retrace.clear_steady()
+    _, first_s, _ = runner.step(mk("warmup"))
+    retrace.mark_steady()
+    steady0 = retrace.steady_total()
+    resync0, delta0 = mirror.resync_total, mirror.delta_rows_total
+    names, dt, samples, best_t = None, None, [], {}
+    for k in range(_Runner.SAMPLES):
+        nms, d, lt = runner.step(mk(f"run{k}"))
+        samples.append(round(d, 4))
+        if dt is None or d < dt:
+            names, dt, best_t = nms, d, lt
+    steady_recompiles = retrace.steady_total() - steady0
+    retrace.clear_steady()
+    delta_rows = mirror.delta_rows_total - delta0
+    resyncs = mirror.resync_total - resync0
+    dirtied = _Runner.SAMPLES * dirty_rows
+    run = _Run(
+        names, sum(n is not None for n in names), dt, samples, first_s,
+        best_t, steady_recompiles,
+    )
+    return run.report(
+        n_nodes, n_pods,
+        solve_shard_count=int(mesh.devices.size),
+        mesh_parity=mesh_parity,
+        watchers_terminated=0,  # raw-solver config: no store in the loop
+        # steady host→device traffic: the delta path must have carried
+        # exactly the dirtied rows with zero full resyncs — O(changed
+        # rows), not O(N) (BENCH_STRICT gates on the bounded flag)
+        mirror_delta_rows=delta_rows,
+        mirror_resync_total=resyncs,
+        dirtied_rows=dirtied,
+        mirror_delta_bounded=bool(resyncs == 0 and delta_rows <= dirtied),
+        sharded_solve_fallbacks=runner.sched.sharded_fallbacks,
+        **_wave_stats(runner),
+    )
+
+
 def main() -> None:
-    import os
     import sys
 
     from kubernetes_tpu.analysis import retrace
@@ -567,6 +701,7 @@ def main() -> None:
             "c5_gang_50k": config5(),
             "c6_churn_5k": config6(),
             "c6s_sustained_50k": config6_sustained(),
+            "c7_sharded_100k": config7(),
         }
     # every over-threshold schedule_batch cycle, with its per-step share
     # (commit- and solve-share per step are readable straight off the
@@ -670,6 +805,26 @@ def main() -> None:
             failures.append(
                 f"sustained churn below budget: {c6s['pods_per_s']} < "
                 f"{STRICT_SUSTAINED_MIN_PODS_PER_S} pods/s"
+            )
+        # sharded-solve gates: mesh placements must be assignment-
+        # identical to single-chip, and steady mesh-mode host→device
+        # transfer must be O(changed rows) (zero resyncs, delta rows
+        # bounded by the dirtied set)
+        c7 = extra["c7_sharded_100k"]
+        bad_parity = sorted(
+            k for k, ok in c7["mesh_parity"].items() if not ok
+        )
+        if bad_parity:
+            failures.append(
+                "sharded solve diverged from single-chip on: "
+                + ", ".join(bad_parity)
+            )
+        if not c7["mirror_delta_bounded"]:
+            failures.append(
+                "c7 steady host→device transfer not O(changed rows): "
+                f"{c7['mirror_delta_rows']} delta rows / "
+                f"{c7['mirror_resync_total']} resyncs for "
+                f"{c7['dirtied_rows']} dirtied rows"
             )
         if failures:
             print("BENCH_STRICT: " + "; ".join(failures), file=sys.stderr)

@@ -571,6 +571,165 @@ def _check_gang_retry_closure(findings: List[Finding]) -> None:
             )
 
 
+def _check_mesh_kernels(byclass, findings: List[Finding]) -> None:
+    """Mesh-sharded solver twins driven through eval_shape across the
+    lattice: outputs must match the result contracts at every bucket,
+    the abstract signature set must be exactly one per (bucket, mesh
+    shape) — the mesh shape IS part of the executable key — and every
+    lattice node bucket must split evenly across the mesh (buckets and
+    mesh sizes are both powers of two; smaller-than-mesh buckets are
+    the counted single-chip fallback, not a compile surface).
+
+    The mesh uses the largest power-of-two device count available
+    (capped at 8): under the forced-host-platform test/bench
+    environment that is a real 8-way mesh; a bare 1-device run still
+    exercises the shard_map signatures."""
+    import jax
+
+    from ..ops import assign, schema
+    from ..parallel import sharded
+    from . import retrace
+
+    ndev = len(jax.devices())
+    size = 1
+    while size * 2 <= min(ndev, 8):
+        size *= 2
+    mesh = sharded.make_mesh(size)
+    mesh_sig = sharded.mesh_signature(mesh)
+    file = "kubernetes_tpu/parallel/sharded.py"
+
+    limits = schema.SnapshotLimits()
+    ff_off = assign.FeatureFlags()
+
+    def env_for(n, p):
+        return _class_env("ClusterTensors", limits, n, p, {})
+
+    signatures = {
+        "greedy-sharded": set(), "wavefront-sharded": set(),
+        "auction-sharded": set(),
+    }
+    calls = {"greedy-sharded": 0, "wavefront-sharded": 0,
+             "auction-sharded": 0}
+    from ..utils.vocab import pad_dim
+
+    for n, p in LATTICE:
+        if n % size:
+            findings.append(
+                Finding(
+                    CHECK, file, 1, "make_mesh",
+                    f"lattice node bucket {n} does not split across the "
+                    f"{size}-device mesh — pad buckets and mesh sizes "
+                    "must share the power-of-two family",
+                )
+            )
+            continue
+        snap = abstract_snapshot(byclass, limits, n=n, p=p)
+
+        calls["greedy-sharded"] += 1
+        signatures["greedy-sharded"].add(
+            retrace.signature(snap, (1, ff_off, 0, mesh_sig))
+        )
+        try:
+            res = jax.eval_shape(
+                lambda s: sharded.sharded_greedy_assign(
+                    s, mesh, topo_z=1, features=ff_off, n_groups=0
+                ),
+                snap,
+            )
+            _result_contract_check(
+                res, "SolveResult", byclass, env_for(n, p),
+                f"greedy-sharded[{n}x{p}]", findings, file,
+            )
+        except Exception as e:  # noqa: BLE001 — abstract eval failed
+            findings.append(
+                Finding(
+                    CHECK, file, 1, "sharded_greedy_assign",
+                    f"eval_shape failed at bucket {n}x{p}: {e}",
+                )
+            )
+
+        w_pad = pad_dim(max(-(-p // assign.DEFAULT_WAVE_CAP), 1), 8)
+        members = jax.ShapeDtypeStruct(
+            (w_pad, assign.DEFAULT_WAVE_CAP), "int32"
+        )
+        calls["wavefront-sharded"] += 1
+        signatures["wavefront-sharded"].add(
+            retrace.signature((snap, members), (1, ff_off, 0, mesh_sig))
+        )
+        try:
+            res = jax.eval_shape(
+                lambda s, m: sharded.sharded_wavefront_assign(
+                    s, m, mesh, topo_z=1, features=ff_off, n_groups=0
+                ),
+                snap, members,
+            )
+            _result_contract_check(
+                res, "SolveResult", byclass, env_for(n, p),
+                f"wavefront-sharded[{n}x{p}]", findings, file,
+            )
+        except Exception as e:  # noqa: BLE001
+            findings.append(
+                Finding(
+                    CHECK, file, 1, "sharded_wavefront_assign",
+                    f"eval_shape failed at bucket {n}x{p}: {e}",
+                )
+            )
+
+        tie_k = min(64, n)
+        calls["auction-sharded"] += 1
+        signatures["auction-sharded"].add(
+            retrace.signature(snap, (0, ff_off, (1, 1), tie_k, mesh_sig))
+        )
+        try:
+            res = jax.eval_shape(
+                lambda s: sharded.sharded_auction_assign(
+                    s, mesh, n_groups=0, features=ff_off, topo_z=(1, 1),
+                    tie_k=tie_k,
+                ),
+                snap,
+            )
+            _result_contract_check(
+                res, "AuctionResult", byclass, env_for(n, p),
+                f"auction-sharded[{n}x{p}]", findings, file,
+            )
+        except Exception as e:  # noqa: BLE001
+            findings.append(
+                Finding(
+                    CHECK, file, 1, "sharded_auction_assign",
+                    f"eval_shape failed at bucket {n}x{p}: {e}",
+                )
+            )
+
+    for label, sigs in signatures.items():
+        if len(sigs) != calls[label]:
+            findings.append(
+                Finding(
+                    CHECK, file, 1, label,
+                    f"{calls[label]} lattice points produced "
+                    f"{len(sigs)} distinct compile keys — the sharded "
+                    "signature set must be exactly one per (bucket, "
+                    "mesh shape)",
+                )
+            )
+
+    # the mesh shape must DISCRIMINATE: a sharded signature colliding
+    # with its single-chip twin would let one executable cache serve
+    # both layouts (prewarm/retrace keys carry the mesh for this reason)
+    n, p = LATTICE[0]
+    if n % size == 0:
+        snap = abstract_snapshot(byclass, limits, n=n, p=p)
+        if retrace.signature(snap, (1, ff_off, 0)) in signatures[
+            "greedy-sharded"
+        ]:
+            findings.append(
+                Finding(
+                    CHECK, file, 1, "mesh_signature",
+                    "sharded compile key collides with the single-chip "
+                    "key (mesh shape must be part of the signature)",
+                )
+            )
+
+
 def check(root: str, package: str = "kubernetes_tpu") -> List[Finding]:
     """Run the full recompile-discipline suite.  Imports JAX; callers
     wanting an import-light lint use run_all instead."""
@@ -578,6 +737,7 @@ def check(root: str, package: str = "kubernetes_tpu") -> List[Finding]:
     findings: List[Finding] = []
     _check_encode(byclass, findings)
     _check_kernels(byclass, findings)
+    _check_mesh_kernels(byclass, findings)
     _check_gang_retry_closure(findings)
     findings.sort(key=lambda f: (f.file, f.line, f.message))
     return findings

@@ -174,6 +174,7 @@ def _device_fill_shortcut(
     cache: Optional[dict] = None,
     no_bound_pods: bool = False,
     features=None,
+    put=None,
 ) -> schema.Snapshot:
     """Replace constant-filled pod/constraint tables with (cached)
     device-side fills before transfer.
@@ -188,18 +189,27 @@ def _device_fill_shortcut(
     serves every later snapshot — a fresh jnp.full per leaf per step
     costs a device dispatch each (~15 ms over a tunneled link), which
     at ~20 constant leaves would cancel the transfer win.  The cluster
-    half is skipped — it lives in the device mirror already."""
+    half is skipped — it lives in the device mirror already.
+
+    put: device placement for the fills and pre-wrapped transfers —
+    mesh mode passes a replicated-NamedSharding device_put so every
+    leaf lands on the same device set as the sharded mirror (mixing
+    single-device-committed and mesh-committed jit operands is a
+    placement error)."""
     import jax.numpy as jnp
+
+    if put is None:
+        put = jax.device_put
 
     def fill(shape, dtype, value):
         key = (shape, np.dtype(dtype).str, value)
         if cache is None:
-            return jnp.full(shape, value, dtype)
+            return put(jnp.full(shape, value, dtype))
         hit = cache.get(key)
         if hit is None:
             if len(cache) >= _FILL_CACHE_MAX:
                 cache.clear()
-            hit = cache[key] = jnp.full(shape, value, dtype)
+            hit = cache[key] = put(jnp.full(shape, value, dtype))
         return hit
 
     def shortcut(arr):
@@ -219,7 +229,7 @@ def _device_fill_shortcut(
             return arr
         if is_zero:
             return fill(a.shape, a.dtype, 0.0)
-        return jax.device_put(a)  # pre-wrap: skips shortcut's min/max
+        return put(a)  # pre-wrap: skips shortcut's min/max
 
     spread_z = terms_z = pref_z = no_bound_pods
     if features is not None and not no_bound_pods:
@@ -248,7 +258,7 @@ def _device_fill_shortcut(
     return rest._replace(cluster=snap.cluster)
 
 
-def _packed_device_put(tree, unpack_cache: dict):
+def _packed_device_put(tree, unpack_cache: dict, put=None):
     """device_put with all host leaves coalesced into ONE transfer.
 
     Over a tunneled device link each per-leaf transfer pays ~10 ms of
@@ -266,11 +276,20 @@ def _packed_device_put(tree, unpack_cache: dict):
     once shapes warm up.  Two alternating buffers make the reuse safe
     under JAX's async dispatch — a buffer is rewritten only after a full
     solve/decode cycle of the batch that used its sibling, by which time
-    the unpack program consumed it."""
+    the unpack program consumed it.
+
+    put: placement for the staging buffer (mesh mode passes a
+    replicated-NamedSharding device_put — see _device_fill_shortcut)."""
+    if put is None:
+        put = jax.device_put
     leaves, treedef = jax.tree.flatten(tree)
     host_idx = [i for i, l in enumerate(leaves) if not isinstance(l, jax.Array)]
     if len(host_idx) <= 2:
-        return jax.device_put(tree)
+        # put only the host leaves: re-putting the device-resident ones
+        # (the sharded mirror tensors under a mesh) would reshard them
+        for i in host_idx:
+            leaves[i] = put(leaves[i])
+        return jax.tree.unflatten(treedef, leaves)
     arrs = [np.ascontiguousarray(leaves[i]) for i in host_idx]
     offsets, off = [], 0
     for a in arrs:
@@ -306,7 +325,7 @@ def _packed_device_put(tree, unpack_cache: dict):
     for a, o in zip(arrs, offsets):
         buf[o : o + a.nbytes] = a.view(np.uint8).ravel()
     unpack = entry["unpack"]
-    outs = unpack(jax.device_put(buf[:nbytes]))
+    outs = unpack(put(buf[:nbytes]))
     # layout churn recompiles the unpack program: report it to the
     # recompile-discipline tracker like the solver dispatches (specs IS
     # the executable key here)
@@ -342,10 +361,14 @@ class DeviceSolve:
         self.deferred_s = 0.0      # dispatch -> decode-start gap (overlap)
 
     def ready(self) -> bool:
-        """Non-blocking: has the device finished the solve?"""
+        """Non-blocking: has the device finished the solve?  Mesh-mode
+        results are sharded jax Arrays and answer is_ready like any
+        other future — the sharded solve rides the same deferred
+        single-coalesced-readback path (decode overlap survives
+        sharding)."""
         try:
             return bool(self.result.assignment.is_ready())
-        except AttributeError:  # host numpy result (mesh path etc.)
+        except AttributeError:  # host numpy result (raw-kernel callers)
             return True
 
     def _decode(self):
@@ -568,17 +591,35 @@ class TPUBatchScheduler:
             atexit.register(self.prewarm_pool.close)
         if mesh is not None:
             # multi-chip: node axis sharded over the mesh (SURVEY §2.7
-            # row 8) — both solver families have sharded twins with
-            # placement parity (tests/test_sharded.py)
+            # row 8) — all three solver families have sharded twins with
+            # placement parity (tests/test_sharded.py,
+            # tests/test_sharded_pipeline.py)
+            from jax.sharding import NamedSharding, PartitionSpec
             from ..parallel import sharded as _sharded
 
             self._greedy_sharded = _sharded.sharded_greedy_jit(
                 mesh, score_config
             )
+            self._wavefront_sharded = _sharded.sharded_wavefront_jit(
+                mesh, score_config
+            )
             self._auction_sharded = _sharded.sharded_auction_jit(
                 mesh, score_config
             )
-        self._mirror = DeviceClusterMirror(self.state)
+            self._mesh_size = int(mesh.devices.size)
+            # every host→device transfer in mesh mode targets the mesh's
+            # replicated sharding: the solve jits consume the sharded
+            # mirror, and jit operands must share one device set
+            rep = NamedSharding(mesh, PartitionSpec())
+            self._put = lambda x: jax.device_put(x, rep)
+        else:
+            self._mesh_size = 0
+            self._put = jax.device_put
+        # batches a configured mesh could not solve sharded (padded node
+        # bucket smaller than the mesh) — mirrored into
+        # scheduler_sharded_solve_fallbacks
+        self.sharded_fallbacks = 0
+        self._mirror = DeviceClusterMirror(self.state, mesh=mesh)
         self.use_mirror = use_mirror
         # device-solve circuit breaker: XLA runtime/compile errors and
         # non-finite score tensors retry once, then trip every batch to
@@ -597,6 +638,12 @@ class TPUBatchScheduler:
         # encode half holds the cache lock (a concurrent wave commit
         # can't overlap it), only the device half truly pipelines
         self.last_timings: Dict[str, float] = {}
+
+    @property
+    def shard_count(self) -> int:
+        """Mesh size the solver shards over (0 = single chip) —
+        mirrored into scheduler_solve_shard_count."""
+        return self._mesh_size
 
     # -- incremental cluster state ---------------------------------------
 
@@ -651,13 +698,25 @@ class TPUBatchScheduler:
                     route = "auction"
         if route == "greedy" and (
             self.use_wavefront
-            and self.mesh is None
             and snap.pods.req.shape[0] >= self.WAVEFRONT_MIN_PODS
         ):
             # same semantics as the scan (ops.assign parity suite), P/W
-            # sequential steps instead of P
+            # sequential steps instead of P; mesh mode routes here too —
+            # the sharded wavefront is scan-identical across shards
             route = "wavefront"
         return route
+
+    def _sharded_ok(self, snap: schema.Snapshot) -> bool:
+        """True when this batch solves on the mesh: a mesh is configured
+        and the padded node bucket splits evenly across it.  A bucket
+        smaller than the mesh (tiny cluster under a wide mesh) falls
+        back to the single chip and counts a sharded_solve_fallback."""
+        if self.mesh is None:
+            return False
+        if snap.cluster.allocatable.shape[0] % self._mesh_size == 0:
+            return True
+        self.sharded_fallbacks += 1
+        return False
 
     @staticmethod
     def _shapes_of(tree):
@@ -716,10 +775,14 @@ class TPUBatchScheduler:
         )
 
     def _prewarm_neighbors(  # graftlint: disable=purity -- speculative compile bookkeeping; the pool mutex is uncontended and compiles run off-thread
-        self, snap, route, topo_z, features, n_groups, wave_shape=None
+        self, snap, route, topo_z, features, n_groups, wave_shape=None,
+        sharded: bool = False,
     ) -> None:
         """On a first-seen executable key, speculatively compile the keys
-        the workload will hit next (SolverPrewarmPool docstring)."""
+        the workload will hit next (SolverPrewarmPool docstring).  The
+        key carries the mesh size: sharded and single-chip solves of the
+        same bucket are DIFFERENT executables (shard_map is part of the
+        program), and a mesh-mode scheduler prewarms the sharded twin."""
         pool = self.prewarm_pool
         if pool is None or route == "auction":
             return
@@ -727,11 +790,23 @@ class TPUBatchScheduler:
 
         p_dim = snap.pods.req.shape[0]
         n_dim = snap.cluster.allocatable.shape[0]
-        key = (route, n_dim, p_dim, topo_z, features, n_groups, wave_shape)
+        mesh_key = self._mesh_size if sharded else 0
+        key = (
+            route, mesh_key, n_dim, p_dim, topo_z, features, n_groups,
+            wave_shape,
+        )
         if not pool.mark_seen(key):
             return
         shapes = self._shapes_of(snap)
-        fn = (self._wavefront if route == "wavefront" else self._greedy).jitted
+        if sharded:
+            fn = (
+                self._wavefront_sharded if route == "wavefront"
+                else self._greedy_sharded
+            ).jitted
+        else:
+            fn = (
+                self._wavefront if route == "wavefront" else self._greedy
+            ).jitted
 
         def offer(p_variant, feats):
             wshape = wave_shape
@@ -751,7 +826,10 @@ class TPUBatchScheduler:
                     self._shapes_with_pod_dim(shapes, p_variant)
                     if p_variant != p_dim else shapes,
                 )
-            nkey = (route, n_dim, p_variant, topo_z, feats, n_groups, wshape)
+            nkey = (
+                route, mesh_key, n_dim, p_variant, topo_z, feats, n_groups,
+                wshape,
+            )
 
             def compile_fn(args_shapes=args_shapes, feats=feats):
                 fn.lower(*args_shapes, topo_z, feats, n_groups).compile()
@@ -806,11 +884,9 @@ class TPUBatchScheduler:
             else schema.num_groups(snap)
         )
         route = meta.route or self._route(snap, features, topo_split, n_groups)
+        sharded = self._sharded_ok(snap)
         if route == "auction":
-            solver = (
-                self._auction_sharded if self.mesh is not None
-                else self._auction
-            )
+            solver = self._auction_sharded if sharded else self._auction
             self._prewarm_neighbors(snap, route, None, features, n_groups)
             return solver(
                 snap, features=features, topo_z=topo_split,
@@ -819,10 +895,6 @@ class TPUBatchScheduler:
         topo_z = (
             max(topo_split) if assign_ops.needs_topo(features) else 1
         )
-        if self.mesh is not None and n_groups == 0:
-            # sharded greedy has no gang post-pass; gang batches that
-            # fall off the auction route stay single-chip
-            return self._greedy_sharded(snap, topo_z, features)
         if route == "wavefront":
             plan = meta.wave_plan
             if plan is None:
@@ -833,14 +905,18 @@ class TPUBatchScheduler:
                 )
             self._prewarm_neighbors(
                 snap, route, topo_z, features, n_groups,
-                wave_shape=plan.members.shape,
+                wave_shape=plan.members.shape, sharded=sharded,
             )
-            return self._wavefront(
+            solver = self._wavefront_sharded if sharded else self._wavefront
+            return solver(
                 snap, wave_members=plan.members, topo_z=topo_z,
                 features=features, n_groups=n_groups,
             )
-        self._prewarm_neighbors(snap, route, topo_z, features, n_groups)
-        return self._greedy(snap, topo_z, features, n_groups=n_groups)
+        self._prewarm_neighbors(
+            snap, route, topo_z, features, n_groups, sharded=sharded
+        )
+        solver = self._greedy_sharded if sharded else self._greedy
+        return solver(snap, topo_z, features, n_groups=n_groups)
 
     def encode_pending(
         self,
@@ -908,21 +984,25 @@ class TPUBatchScheduler:
             # device-resident across steps; only dirty rows transfer
             # (models.mirror).  The pod/constraint tables are freshly
             # allocated per batch, so device_put cannot alias live state.
-            # Mesh mode hands host copies straight to the sharded jits
-            # (shard_map owns placement; a single-device-committed mirror
-            # would fight the mesh sharding).
-            if self.mesh is None and self.use_mirror:
+            # Under a mesh the mirror is NamedSharding-resident in the
+            # exact layout the sharded jits' shard_map specs expect, and
+            # the pod-table transfers replicate over the mesh (_put) —
+            # per-batch host→device traffic stays O(changed rows) in
+            # both layouts.
+            if self.use_mirror:
                 snap = snap._replace(cluster=self._mirror.sync())
                 snap = _device_fill_shortcut(
                     snap, self._fill_cache, no_bound_pods=no_bound,
-                    features=meta.features,
+                    features=meta.features, put=self._put,
                 )
-                snap = _packed_device_put(snap, self._unpack_cache)
+                snap = _packed_device_put(
+                    snap, self._unpack_cache, put=self._put
+                )
             else:
-                # mesh mode (shard_map owns placement) or the
-                # DeviceClusterMirror gate is off: full host copy +
+                # DeviceClusterMirror gate off: full host copy +
                 # transfer every step (the pre-mirror behavior — the
-                # rollback knob the gate exists for)
+                # rollback knob the gate exists for).  Mesh mode keeps
+                # the copies host-side and lets shard_map own placement.
                 snap = snap._replace(
                     cluster=jax.tree.map(np.array, snap.cluster)
                 )

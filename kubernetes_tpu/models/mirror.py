@@ -18,14 +18,28 @@ re-upload happens only when the backing arrays were reallocated
 (growth past the padded bucket, resource-axis widening — ClusterState
 .struct_generation) or the padded shape changed.
 
+Under a device mesh (mesh not None) the resident tensors carry a
+NamedSharding over the node axis — the same layout the sharded solvers'
+shard_map specs expect (parallel.sharded.CLUSTER_SPECS), so a mesh-mode
+solve consumes the mirror without any per-batch resharding.  Row deltas
+scatter into the owning shard: the bucketed index/value uploads are
+replicated (tiny) and the jitted scatter — pinned to the resident
+sharding via out_shardings so the executable key never drifts — lets
+GSPMD route each row to its shard.  Struct-generation changes trigger a
+full RESHARDED re-upload, exactly like the single-device case.
+
 Row updates are bucketed to powers of two and padded by repeating the
 first dirty row (duplicate scatter-set of identical values is a
 no-op), so the jit cache stays small and stable.
+
+`resync_total` / `delta_rows_total` / `delta_syncs` count full uploads
+and real (unbucketed) scattered rows — the scheduler mirrors them into
+`scheduler_mirror_resync_total` / `scheduler_mirror_delta_rows`, and
+bench's c7 gates on steady-state transfer being O(changed rows).
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -73,12 +87,62 @@ class DeviceClusterMirror:
     # itself once most rows move (e.g. right after a bulk node load).
     FULL_SYNC_FRACTION = 0.5
 
-    def __init__(self, state: schema.ClusterState):
+    def __init__(self, state: schema.ClusterState, mesh=None):
         self.state = state
+        self.mesh = mesh
         self._dev: Optional[schema.ClusterTensors] = None
         self._synced_gen = 0
         self._struct_gen = 0
         self._shape: Optional[Tuple] = None
+        # transfer accounting (read by the scheduler's metric mirror and
+        # bench c7's O(changed-rows) gate); mutated under the cache lock
+        # — sync() is called inside encode_pending's locked section
+        self.resync_total = 0      # full uploads (first sync included)
+        self.delta_rows_total = 0  # real dirty rows scattered
+        self.delta_syncs = 0       # syncs served by the delta path
+        # whether the resident copy is node-axis sharded (False when no
+        # mesh, or when the padded bucket doesn't split across it — the
+        # same batches TPUBatchScheduler solves single-chip)
+        self._resident_sharded = False
+        if mesh is None:
+            self._shardings = None
+            self._set = _set_rows
+            self._set_ax1 = _set_rows_ax1
+            self._put_small = jax.device_put
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            axis = mesh.axis_names[0]
+            row_sh = NamedSharding(mesh, P(axis))          # node axis = dim 0
+            ax1_sh = NamedSharding(mesh, P(None, axis))    # taint_bits
+            rep_sh = NamedSharding(mesh, P())
+            self._shardings = schema.ClusterTensors(
+                **{
+                    f: (ax1_sh if f == "taint_bits" else row_sh)
+                    for f in schema.ClusterTensors._fields
+                }
+            )
+            # replicated layout for buckets the mesh can't split (the
+            # single-chip fallback batches): still mesh-committed so
+            # every consumer sees one device set
+            self._rep_shardings = schema.ClusterTensors(
+                **{f: rep_sh for f in schema.ClusterTensors._fields}
+            )
+            # out_shardings pin the scatter results to the resident
+            # layout: without them GSPMD may pick a different output
+            # sharding, and a sharding flip is a fresh executable key on
+            # the NEXT delta — a steady-state recompile
+            self._set = jax.jit(
+                lambda a, i, v: a.at[i].set(v), out_shardings=row_sh
+            )
+            self._set_ax1 = jax.jit(
+                lambda a, i, v: a.at[:, i].set(v), out_shardings=ax1_sh
+            )
+            # index/value uploads replicate over the mesh: they are a
+            # few KB, and replication keeps every jit operand on the
+            # same device set (mixing single-device-committed arrays
+            # with mesh-committed ones is a placement error)
+            self._put_small = lambda x: jax.device_put(x, rep_sh)
 
     def sync(self) -> schema.ClusterTensors:
         """Return device-resident cluster tensors matching the state's
@@ -112,11 +176,32 @@ class DeviceClusterMirror:
         self._shape = shape
         return dev
 
+    def stats(self) -> dict:
+        return {
+            "resync_total": self.resync_total,
+            "delta_rows_total": self.delta_rows_total,
+            "delta_syncs": self.delta_syncs,
+        }
+
     def _full_upload(self, host: schema.ClusterTensors) -> schema.ClusterTensors:
         # host-copy before device_put: on the CPU backend device_put can
         # zero-copy a numpy view, which would alias live cache state
         # (see TPUBatchScheduler.encode_pending's aliasing note)
-        return jax.device_put(jax.tree.map(np.array, host))
+        self.resync_total += 1
+        copied = jax.tree.map(np.array, host)
+        if self._shardings is None:
+            return jax.device_put(copied)
+        # mesh: the upload lands already sharded over the node axis;
+        # buckets smaller than the mesh replicate instead (they solve
+        # single-chip anyway — TPUBatchScheduler._sharded_ok)
+        self._resident_sharded = (
+            copied.allocatable.shape[0] % self.mesh.devices.size == 0
+        )
+        return jax.device_put(
+            copied,
+            self._shardings if self._resident_sharded
+            else self._rep_shardings,
+        )
 
     def _apply_deltas(
         self,
@@ -125,22 +210,33 @@ class DeviceClusterMirror:
         usage_idx: np.ndarray,
     ) -> schema.ClusterTensors:
         dev = self._dev
+        self.delta_syncs += 1
+        self.delta_rows_total += int(static_idx.shape[0] + usage_idx.shape[0])
+        if self._shardings is not None and not self._resident_sharded:
+            # replicated resident copy (bucket smaller than the mesh):
+            # the pinned-sharding scatters don't apply — use the plain
+            # ones; operands are all mesh-replicated so placement agrees
+            set_rows, set_ax1 = _set_rows, _set_rows_ax1
+        else:
+            set_rows, set_ax1 = self._set, self._set_ax1
         updates = {}
         if static_idx.shape[0]:
             bucket = vb.pad_dim(static_idx.shape[0], 1)
             pidx = _pad_idx(static_idx, bucket)
-            idx_dev = jax.device_put(pidx)
+            idx_dev = self._put_small(pidx)
             for leaf in _STATIC_LEAVES:
-                vals = jax.device_put(np.asarray(getattr(host, leaf))[pidx])
-                updates[leaf] = _set_rows(getattr(dev, leaf), idx_dev, vals)
-            tvals = jax.device_put(np.asarray(host.taint_bits)[:, pidx])
-            updates["taint_bits"] = _set_rows_ax1(dev.taint_bits, idx_dev, tvals)
+                vals = self._put_small(np.asarray(getattr(host, leaf))[pidx])
+                updates[leaf] = set_rows(getattr(dev, leaf), idx_dev, vals)
+            tvals = self._put_small(np.asarray(host.taint_bits)[:, pidx])
+            updates["taint_bits"] = set_ax1(
+                dev.taint_bits, idx_dev, tvals
+            )
         if usage_idx.shape[0]:
             bucket = vb.pad_dim(usage_idx.shape[0], 1)
             pidx = _pad_idx(usage_idx, bucket)
-            idx_dev = jax.device_put(pidx)
+            idx_dev = self._put_small(pidx)
             base = dev._replace(**updates) if updates else dev
             for leaf in _USAGE_LEAVES:
-                vals = jax.device_put(np.asarray(getattr(host, leaf))[pidx])
-                updates[leaf] = _set_rows(getattr(base, leaf), idx_dev, vals)
+                vals = self._put_small(np.asarray(getattr(host, leaf))[pidx])
+                updates[leaf] = set_rows(getattr(base, leaf), idx_dev, vals)
         return dev._replace(**updates) if updates else dev

@@ -191,6 +191,73 @@ REASON_UNENCODABLE = 6  # spec exceeds encoder caps / unsupported field —
                         # only a pod UPDATE can help; no event wakes it
 
 
+def _axis_any(x: jnp.ndarray, axis_name: Optional[str]) -> jnp.ndarray:
+    """Global `.any()` over the node axis: local under a single chip, an
+    OR across shards (pmax of the local any) under shard_map."""
+    if axis_name is None:
+        return x.any()
+    return jax.lax.pmax(x.any().astype(jnp.int32), axis_name) > 0
+
+
+def _shard_layout(axis_name: Optional[str], n: int):
+    """Node-axis layout helpers shared by the greedy/wavefront solvers —
+    identity under a single chip, ownership-masked collectives under
+    shard_map (the ops.auction idiom: one implementation, two layouts).
+
+    Returns ``(offset, n_total, node_rows, node_col)``: `offset` is the
+    shard's first global row, `n_total` the GLOBAL node count (psum of a
+    constant folds to the static axis size, so it stays a Python int),
+    ``node_rows(mat, idx)`` gathers rows of a node-major tensor at
+    GLOBAL node ids (the owning shard contributes, psum replicates), and
+    ``node_col(mat, idx)`` broadcasts the column of a [R, N] tensor at
+    one GLOBAL id."""
+    if axis_name is None:
+        return 0, n, (lambda mat, idx: mat[idx]), (lambda mat, idx: mat[:, idx])
+    offset = jax.lax.axis_index(axis_name) * n
+    n_total = n * jax.lax.psum(1, axis_name)
+
+    def node_rows(mat, idx):
+        own = (idx >= offset) & (idx < offset + n)
+        loc = jnp.clip(idx - offset, 0, n - 1)
+        vals = mat[loc]
+        mask = own.reshape(own.shape + (1,) * (vals.ndim - own.ndim))
+        if vals.dtype == jnp.bool_:
+            return jax.lax.psum(
+                jnp.where(mask, vals, False).astype(jnp.int32), axis_name
+            ) > 0
+        return jax.lax.psum(
+            jnp.where(mask, vals, jnp.zeros_like(vals)), axis_name
+        )
+
+    def node_col(mat, idx):
+        own = (idx >= offset) & (idx < offset + n)
+        loc = jnp.clip(idx - offset, 0, n - 1)
+        col = mat[:, loc]
+        if col.dtype == jnp.bool_:
+            return jax.lax.psum(
+                jnp.where(own, col, False).astype(jnp.int32), axis_name
+            ) > 0
+        return jax.lax.psum(
+            jnp.where(own, col, jnp.zeros_like(col)), axis_name
+        )
+
+    return offset, n_total, node_rows, node_col
+
+
+def _elect(masked: jnp.ndarray, offset, axis_name: str):
+    """Global argmax election under shard_map: local champion, then a
+    pmax/pmin pair picks (best score, lowest global index) — the
+    first-max-index tie-break of the single-chip argmax, exactly.
+    Returns (global index i32, best value)."""
+    li = jnp.argmax(masked)
+    lv = masked[li]
+    best = jax.lax.pmax(lv, axis_name)
+    cand = jnp.where(
+        lv == best, (offset + li).astype(jnp.int32), jnp.int32(2 ** 31 - 1)
+    )
+    return jax.lax.pmin(cand, axis_name), best
+
+
 class SolveResult(NamedTuple):
     assignment: jnp.ndarray   # i32[P]: node index, or -1 unschedulable
     scores: jnp.ndarray       # f32[P]: winning node's score (-inf if none)
@@ -277,30 +344,37 @@ def _eval_pod(
     terms,
     features: FeatureFlags,
     cfg: ScoreConfig,
+    axis_name: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """The Filter+Score half of one scheduling step for pod i against the
     given carry state: (feas[N], masked_scores[N], found, reason,
     feasible_count).  Shared verbatim by the classic scan step, the
     wavefront pre-evaluation, and the wavefront's exact re-evaluation
-    fallback, so the three paths cannot drift apart."""
+    fallback, so the three paths cannot drift apart.
+
+    Under shard_map (axis_name set) the node tensors hold one shard:
+    feas/masked stay local while the per-stage anys, the feasible count,
+    and the score normalization maxima span shards — found/reason/count
+    come back replicated."""
     pod = pod_view(pods, i)
     s_static = sfeas_c[cls]
+    s_any = _axis_any(s_static, axis_name)
     feas = s_static & fits_resources(cl, pod)
-    a_res = feas.any()
+    a_res = _axis_any(feas, axis_name)
     if features.ports:
         feas = feas & ~((new_ports & pod.port_bits[None, :]).any(axis=-1))
-    a_ports = feas.any()
+    a_ports = _axis_any(feas, axis_name)
     if features.spread:
-        feas = feas & spread_filter(sp, spread, i)
-    a_spread = feas.any()
+        feas = feas & spread_filter(sp, spread, i, axis_name=axis_name)
+    a_spread = _axis_any(feas, axis_name)
     if features.interpod:
         feas = feas & interpod_filter(tm, terms, i)
-    found = feas.any()
+    found = _axis_any(feas, axis_name)
     # first stage whose filter emptied the candidate set
     reason = jnp.where(
         found, REASON_NONE,
         jnp.where(
-            ~s_static.any(), REASON_STATIC,
+            ~s_any, REASON_STATIC,
             jnp.where(
                 ~a_res, REASON_RESOURCES,
                 jnp.where(
@@ -311,22 +385,32 @@ def _eval_pod(
         ),
     ).astype(jnp.int32)
     sp_score = (
-        spread_score(sp, spread, i, feas) if features.soft_spread else None
+        spread_score(sp, spread, i, feas, axis_name=axis_name)
+        if features.soft_spread
+        else None
     )
     scores = score_from_raw(
-        cl, pod, feas, aff_c[cls], taint_c[cls], cfg, spread_score=sp_score,
+        cl, pod, feas, aff_c[cls], taint_c[cls], cfg, axis_name=axis_name,
+        spread_score=sp_score,
         extra=extra_c[cls] if extra_c is not None else None,
     )
     masked = jnp.where(feas, scores, NEG_INF)
-    return feas, masked, found, reason, feas.sum().astype(jnp.int32)
+    cnt = feas.sum().astype(jnp.int32)
+    if axis_name is not None:
+        cnt = jax.lax.psum(cnt, axis_name)
+    return feas, masked, found, reason, cnt
 
 
 def _solver_prep(
-    snapshot: Snapshot, cfg: ScoreConfig, topo_z: int, features: FeatureFlags
+    snapshot: Snapshot, cfg: ScoreConfig, topo_z: int, features: FeatureFlags,
+    axis_name: Optional[str] = None,
 ):
     """Per-batch device prep shared by the scan and wavefront solvers:
     materialized tensors, class-hoisted static tables, and the spread /
-    inter-pod prep states (the PreFilter/PreScore analogue)."""
+    inter-pod prep states (the PreFilter/PreScore analogue).  Under
+    shard_map the hoisted tables cover the local node shard; the
+    value-space count preps and normalizers span shards via psum/pmax
+    inside prep_spread/prep_terms/static_extra."""
     (cluster, pods, sel, pref, spread, terms, prefpod, images) = jax.tree.map(
         jnp.asarray, tuple(snapshot)
     )
@@ -350,7 +434,8 @@ def _solver_prep(
 
         pp = (
             prep_pref_pod(
-                cluster, prefpod, topo_z, has_bound=features.bound_pref
+                cluster, prefpod, topo_z, axis_name=axis_name,
+                has_bound=features.bound_pref,
             )
             if features.interpod_pref
             else None
@@ -358,12 +443,13 @@ def _solver_prep(
         reps_e = jnp.clip(pods.class_rep, 0, p - 1)
         extra_c = jax.vmap(
             lambda c, rep: static_extra(
-                cluster, prefpod, images, features, cfg, rep, sfeas_c[c], pp
+                cluster, prefpod, images, features, cfg, rep, sfeas_c[c], pp,
+                axis_name=axis_name,
             )
         )(jnp.arange(c_dim, dtype=jnp.int32), reps_e)
     sp0 = (
         prep_spread(
-            cluster, sel_mask, spread, topo_z,
+            cluster, sel_mask, spread, topo_z, axis_name=axis_name,
             has_bound=features.bound_spread,
         )
         if features.spread
@@ -371,8 +457,8 @@ def _solver_prep(
     )
     tm0 = (
         prep_terms(
-            cluster, terms, topo_z, slots=features.term_slots,
-            has_bound=features.bound_terms,
+            cluster, terms, topo_z, axis_name=axis_name,
+            slots=features.term_slots, has_bound=features.bound_terms,
         )
         if features.interpod
         else None
@@ -382,23 +468,31 @@ def _solver_prep(
 
 
 def _gang_release(
-    assignment, win_scores, reasons, requested, nonzero, pods, n_groups, n
+    assignment, win_scores, reasons, requested, nonzero, pods, n_groups, n,
+    offset=0,
 ):
     """All-or-nothing gang post-pass shared by the scan and wavefront
     solvers: release every placement of a group with an unplaced member.
     Only requested/nonzero need subtracting: ports and spread/interpod
     counts are rebuilt from *actually bound* pods at the next batch's
-    prep, and the host never assumes released members."""
+    prep, and the host never assumes released members.
+
+    `n` is the LOCAL node count and `offset` the shard's first global
+    row under shard_map (0 single-chip): each shard subtracts only the
+    released rows it owns — out-of-window scatter targets drop."""
     g = pods.group_id
     gc = jnp.clip(g, 0, n_groups - 1)
     incomplete = jnp.zeros(n_groups, bool).at[gc].max(
         (assignment < 0) & pods.valid & (g >= 0)
     )
     dropped = (g >= 0) & incomplete[gc] & (assignment >= 0)
-    nodes = jnp.clip(assignment, 0, n - 1)
+    tgt = jnp.where(
+        dropped & (assignment >= offset) & (assignment < offset + n),
+        assignment - offset, n,
+    )
     w = dropped[:, None].astype(jnp.float32)
-    requested = requested.at[nodes].add(-pods.req * w)
-    nonzero = nonzero.at[nodes].add(-pods.nonzero_req * w)
+    requested = requested.at[tgt].add(-pods.req * w)
+    nonzero = nonzero.at[tgt].add(-pods.nonzero_req * w)
     assignment = jnp.where(dropped, -1, assignment)
     win_scores = jnp.where(dropped, NEG_INF, win_scores)
     reasons = jnp.where(dropped, REASON_GANG, reasons)
@@ -413,6 +507,7 @@ def greedy_assign(
     topo_z: Optional[int] = None,
     features: Optional[FeatureFlags] = None,
     n_groups: int = 0,
+    axis_name: Optional[str] = None,
 ) -> SolveResult:
     """Sequential-greedy solve of the whole pending batch on device.
 
@@ -434,13 +529,28 @@ def greedy_assign(
     routing-away to a solver that drops them.  Later in-scan pods saw the
     released placements' resource/count impact (conservative: they may
     park and retry next batch); the released members return as
-    unschedulable (-1)."""
+    unschedulable (-1).
+
+    axis_name: mesh axis when called under shard_map with the NODE axis
+    sharded (parallel.sharded.sharded_greedy_assign) — one
+    implementation, two layouts, like ops.auction: pod-space state is
+    replicated, node-space state sharded, the per-step election is a
+    pmax/pmin pair, and constraint updates broadcast the winning node's
+    column from its owning shard.  Placements are bit-identical to the
+    single-chip scan (first-max-index resolves to the lowest global node
+    index in both layouts).  Keyed (tie_seed) solves are single-chip
+    only: reservoir sampling needs the full gumbel tie set per step."""
     if features is None:
         features = features_of(snapshot)
     if topo_z is None:
         topo_z = required_topo_z(snapshot)
+    if axis_name is not None and tie_seed is not None:
+        raise ValueError("keyed (tie_seed) solves are single-chip only")
     (cluster, pods, spread, terms, sfeas_c, aff_c, taint_c, extra_c,
-     sp0, tm0, c_dim, n, p) = _solver_prep(snapshot, cfg, topo_z, features)
+     sp0, tm0, c_dim, n, p) = _solver_prep(
+        snapshot, cfg, topo_z, features, axis_name=axis_name
+    )
+    offset, _n_total, node_rows, node_col = _shard_layout(axis_name, n)
     order = solve_order(pods)
     keys = (
         jax.random.split(jax.random.PRNGKey(tie_seed), p)
@@ -464,11 +574,16 @@ def greedy_assign(
         feas, masked, found, reason, feas_cnt = _eval_pod(
             cl, pods, i, cls, sfeas_c, aff_c, taint_c, extra_c,
             new_ports, sp, tm, spread, terms, features, cfg,
+            axis_name=axis_name,
         )
-        choice = _pick(masked, feas, keys[k] if keys is not None else None)
+        if axis_name is None:
+            choice = _pick(masked, feas, keys[k] if keys is not None else None)
+            win_val = masked[choice]
+        else:
+            choice, win_val = _elect(masked, offset, axis_name)
         idx = jnp.where(found, choice, -1).astype(jnp.int32)
 
-        onehot = (jnp.arange(n) == choice) & found
+        onehot = ((jnp.arange(n) + offset) == choice) & found
         requested = requested + onehot[:, None] * pod.req[None, :]
         nonzero = nonzero + onehot[:, None] * pod.nonzero_req[None, :]
         if features.ports:
@@ -477,18 +592,19 @@ def greedy_assign(
             )
         if features.spread:
             sp = spread_update(
-                sp, spread, i, sp.v[:, choice], sp.eligible[:, choice], found
+                sp, spread, i, node_col(sp.v, choice),
+                node_col(sp.eligible, choice), found,
             )
             sp_counts = sp.counts_node
         if features.interpod:
             tm = interpod_update(
-                tm, terms, i, cluster.topo_ids[choice], found,
+                tm, terms, i, node_rows(cluster.topo_ids, choice), found,
                 slots=features.term_slots,
             )
             tm_present, tm_blocked, tm_global = (
                 tm.present_bits, tm.blocked_bits, tm.global_any
             )
-        out = (i, idx, jnp.where(found, masked[choice], NEG_INF),
+        out = (i, idx, jnp.where(found, win_val, NEG_INF),
                feas_cnt, reason)
         carry = (requested, nonzero, new_ports, sp_counts, tm_present, tm_blocked, tm_global)
         return carry, out
@@ -517,7 +633,7 @@ def greedy_assign(
     if n_groups > 0:
         assignment, win_scores, reasons, requested, nonzero = _gang_release(
             assignment, win_scores, reasons, requested, nonzero,
-            pods, n_groups, n,
+            pods, n_groups, n, offset=offset,
         )
 
     final = cluster._replace(
@@ -773,10 +889,24 @@ def wavefront_assign(
     topo_z: Optional[int] = None,
     features: Optional[FeatureFlags] = None,
     n_groups: int = 0,
+    axis_name: Optional[str] = None,
 ) -> SolveResult:
     """Wave-parallel greedy solve with exact scan parity (see module
     section comment).  wave_members: i32[W, K] pod indices covering every
-    batch position in solve order (-1 pads), from plan_waves."""
+    batch position in solve order (-1 pads), from plan_waves.
+
+    axis_name: mesh axis when called under shard_map with the NODE axis
+    sharded (parallel.sharded.sharded_wavefront_assign).  The batched
+    [K, N] evaluation and the O(K) mini-scan both keep the node tensors
+    sharded: each shard pre-evaluates its node shard and takes a local
+    top-(K+1), an all_gather merges the per-shard candidate lists into
+    the global top-(K+1) (equal scores resolve to the lowest global
+    index in both layouts, so the merge is tie-stable), the mini-scan's
+    picked-node score corrections run on ownership-masked psum-gathered
+    rows (replicated, so every shard reaches the same choice with no
+    further election), and only the rare fit-flip / serialized-wave
+    fallbacks pay a per-pod pmax/pmin election.  Placements are
+    bit-identical to the single-chip scan."""
     from .scores import resource_score_parts
 
     if features is None:
@@ -784,10 +914,17 @@ def wavefront_assign(
     if topo_z is None:
         topo_z = required_topo_z(snapshot)
     (cluster, pods, spread, terms, sfeas_c, aff_c, taint_c, extra_c,
-     sp0, tm0, c_dim, n, p) = _solver_prep(snapshot, cfg, topo_z, features)
+     sp0, tm0, c_dim, n, p) = _solver_prep(
+        snapshot, cfg, topo_z, features, axis_name=axis_name
+    )
+    offset, n_total, node_rows, node_col = _shard_layout(axis_name, n)
     wave_members = jnp.asarray(wave_members, jnp.int32)
     k_dim = wave_members.shape[1]
+    # local and GLOBAL top-(K+1) widths: each shard's list must be wide
+    # enough that the merged global list still holds the best unpicked
+    # candidate after up to K in-wave picks
     kk = min(k_dim + 1, n)
+    kk_g = min(k_dim + 1, n_total)
     arange_k = jnp.arange(k_dim, dtype=jnp.int32)
 
     # per-pod coupling rows for the device-side wave-safety check
@@ -853,11 +990,24 @@ def wavefront_assign(
                 _, masked, found, reason, cnt = _eval_pod(
                     cl0, pods, i, cls, sfeas_c, aff_c, taint_c, extra_c,
                     new_ports, sp, tm, spread, terms, features, cfg,
+                    axis_name=axis_name,
                 )
                 return masked, found, reason, cnt
 
             masked_k, found_k, reason_k, cnt_k = jax.vmap(eval_one)(mk)
             topv, topi = jax.lax.top_k(masked_k, kk)
+            if axis_name is not None:
+                # merge the per-shard top-(K+1) lists into the global
+                # one: all_gather stacks shard-major, so the flattened
+                # candidate order is (shard, local rank) — equal values
+                # resolve to the lowest global node index, exactly the
+                # single-chip top_k tie order
+                vg = jax.lax.all_gather(topv, axis_name)           # [D, K, kk]
+                ig = jax.lax.all_gather(topi + offset, axis_name)  # [D, K, kk]
+                vg = jnp.moveaxis(vg, 0, 1).reshape(k_dim, -1)
+                ig = jnp.moveaxis(ig, 0, 1).reshape(k_dim, -1)
+                topv, pos = jax.lax.top_k(vg, kk_g)
+                topi = jnp.take_along_axis(ig, pos, axis=1)
 
             def fast(_):
                 def mini(mc, j):
@@ -867,17 +1017,24 @@ def wavefront_assign(
                     pod = pod_view(pods, i)
                     cls = jnp.clip(pods.class_id[i], 0, c_dim - 1)
                     prev = (arange_k < j) & (picked >= 0)
-                    pxc = jnp.clip(picked, 0, n - 1)
-                    cap_rows = cluster.allocatable[pxc]
+                    # picked holds GLOBAL node ids; sharded, the row
+                    # gathers below replicate the K picked rows to every
+                    # shard so the correction math (and the choice) is
+                    # identical everywhere — no per-pod election needed
+                    pxc = jnp.clip(picked, 0, n_total - 1)
+                    cap_rows = node_rows(cluster.allocatable, pxc)
+                    req0_rows = node_rows(req0, pxc)
+                    reqc_rows = node_rows(req_c, pxc)
                     skip = (pod.req[None, :] <= 0)
                     fits0 = (
-                        skip | (req0[pxc] + pod.req[None, :] <= cap_rows)
+                        skip | (req0_rows + pod.req[None, :] <= cap_rows)
                     ).all(-1)
                     fitsc = (
-                        skip | (req_c[pxc] + pod.req[None, :] <= cap_rows)
+                        skip | (reqc_rows + pod.req[None, :] <= cap_rows)
                     ).all(-1)
                     flip = (
-                        prev & sfeas_c[cls][pxc] & (fits0 != fitsc)
+                        prev & node_rows(sfeas_c[cls], pxc)
+                        & (fits0 != fitsc)
                     ).any() & valid_j
 
                     def full(_):
@@ -891,15 +1048,17 @@ def wavefront_assign(
                         _, masked, found, reason, cnt = _eval_pod(
                             clj, pods, i, cls, sfeas_c, aff_c, taint_c,
                             extra_c, new_ports, sp, tm, spread, terms,
-                            features, cfg,
+                            features, cfg, axis_name=axis_name,
                         )
                         found = found & valid_j
-                        choice = jnp.argmax(masked).astype(jnp.int32)
-                        return (
-                            choice,
-                            jnp.where(found, masked[choice], NEG_INF),
-                            cnt, reason, found, jnp.int32(1),
-                        )
+                        if axis_name is None:
+                            choice = jnp.argmax(masked).astype(jnp.int32)
+                            win = jnp.where(found, masked[choice], NEG_INF)
+                        else:
+                            choice, best = _elect(masked, offset, axis_name)
+                            win = jnp.where(found, best, NEG_INF)
+                        return (choice, win, cnt, reason, found,
+                                jnp.int32(1))
 
                     def cheap(_):
                         # sequential scores differ from the wave-start
@@ -907,18 +1066,20 @@ def wavefront_assign(
                         # (un-normalized) allocation parts — correct
                         # those entries in closed form
                         fit0, bal0 = resource_score_parts(
-                            _rows_cluster(cap_rows, req0[pxc], nz0[pxc]),
+                            _rows_cluster(cap_rows, req0_rows,
+                                          node_rows(nz0, pxc)),
                             pod, cfg,
                         )
                         fitc, balc = resource_score_parts(
-                            _rows_cluster(cap_rows, req_c[pxc], nz_c[pxc]),
+                            _rows_cluster(cap_rows, reqc_rows,
+                                          node_rows(nz_c, pxc)),
                             pod, cfg,
                         )
                         d_alloc = (
                             cfg.fit_weight * (fitc - fit0)
                             + cfg.balanced_weight * (balc - bal0)
                         )
-                        base = masked_k[j][pxc]
+                        base = node_rows(masked_k[j], pxc)
                         cand_ok = prev & (base > NEG_INF)
                         cand_val = base + d_alloc
                         tv, ti = topv[j], topi[j]
@@ -929,7 +1090,7 @@ def wavefront_assign(
                         first = jnp.argmax(un_ok)
                         has_un = un_ok.any()
                         bu_val = jnp.where(has_un, tv[first], NEG_INF)
-                        bu_idx = jnp.where(has_un, ti[first], n).astype(
+                        bu_idx = jnp.where(has_un, ti[first], n_total).astype(
                             jnp.int32
                         )
                         vals = jnp.concatenate(
@@ -943,7 +1104,7 @@ def wavefront_assign(
                         # first-max-index over the corrected [N] vector
                         choice = jnp.min(
                             jnp.where((vals >= best) & (vals > NEG_INF),
-                                      idxs, n)
+                                      idxs, n_total)
                         ).astype(jnp.int32)
                         return (
                             choice, jnp.where(found, best, NEG_INF),
@@ -953,10 +1114,17 @@ def wavefront_assign(
                     choice, win, cnt, reason, found, used_full = (
                         jax.lax.cond(flip, full, cheap, None)
                     )
-                    cc = jnp.clip(choice, 0, n - 1)
+                    cc = jnp.clip(choice, 0, n_total - 1)
+                    if axis_name is None:
+                        tgt = cc
+                    else:
+                        # the owning shard's local row; everyone else
+                        # scatters out of bounds (dropped)
+                        in_sh = (cc >= offset) & (cc < offset + n)
+                        tgt = jnp.where(in_sh, cc - offset, n)
                     wgt = found.astype(req_c.dtype)
-                    req_c = req_c.at[cc].add(pod.req * wgt)
-                    nz_c = nz_c.at[cc].add(pod.nonzero_req * wgt)
+                    req_c = req_c.at[tgt].add(pod.req * wgt)
+                    nz_c = nz_c.at[tgt].add(pod.nonzero_req * wgt)
                     picked = picked.at[j].set(jnp.where(found, cc, -1))
                     out = (jnp.where(found, cc, -1).astype(jnp.int32),
                            win, cnt, reason)
@@ -973,7 +1141,13 @@ def wavefront_assign(
                 ports2 = new_ports
                 if features.ports:
                     okp = picked >= 0
-                    tgt = jnp.where(okp, picked, n)  # OOB rows drop
+                    if axis_name is None:
+                        tgt = jnp.where(okp, picked, n)  # OOB rows drop
+                    else:
+                        own = okp & (picked >= offset) & (
+                            picked < offset + n
+                        )
+                        tgt = jnp.where(own, picked - offset, n)
                     bits = pods.port_bits[mk] * okp[:, None].astype(
                         jnp.uint32
                     )
@@ -984,10 +1158,10 @@ def wavefront_assign(
                     # pass over [C, N] instead of K carried array writes
                     st = sp0._replace(counts_node=sp_counts)
                     for j in range(k_dim):
-                        ch = jnp.clip(a_k[j], 0, n - 1)
+                        ch = jnp.clip(a_k[j], 0, n_total - 1)
                         st = spread_update(
-                            st, spread, mk[j], st.v[:, ch],
-                            st.eligible[:, ch], a_k[j] >= 0,
+                            st, spread, mk[j], node_col(st.v, ch),
+                            node_col(st.eligible, ch), a_k[j] >= 0,
                         )
                     spc2 = st.counts_node
                 pr2, bl2, ga2 = tm_present, tm_blocked, tm_global
@@ -997,9 +1171,9 @@ def wavefront_assign(
                         global_any=tm_global,
                     )
                     for j in range(k_dim):
-                        ch = jnp.clip(a_k[j], 0, n - 1)
+                        ch = jnp.clip(a_k[j], 0, n_total - 1)
                         st = interpod_update(
-                            st, terms, mk[j], cluster.topo_ids[ch],
+                            st, terms, mk[j], node_rows(cluster.topo_ids, ch),
                             a_k[j] >= 0, slots=features.term_slots,
                         )
                     pr2, bl2, ga2 = (
@@ -1030,37 +1204,44 @@ def wavefront_assign(
                     _, masked, found, reason, cnt = _eval_pod(
                         clj, pods, i, cls, sfeas_c, aff_c, taint_c,
                         extra_c, ports_c, spj, tmj, spread, terms,
-                        features, cfg,
+                        features, cfg, axis_name=axis_name,
                     )
                     found = found & valid_j
-                    choice = jnp.argmax(masked).astype(jnp.int32)
-                    cc = jnp.clip(choice, 0, n - 1)
+                    if axis_name is None:
+                        choice = jnp.argmax(masked).astype(jnp.int32)
+                        win = jnp.where(found, masked[choice], NEG_INF)
+                    else:
+                        choice, best = _elect(masked, offset, axis_name)
+                        win = jnp.where(found, best, NEG_INF)
+                    cc = jnp.clip(choice, 0, n_total - 1)
+                    onehot = ((jnp.arange(n) + offset) == cc) & found
                     wgt = found.astype(req_c.dtype)
-                    req_c = req_c.at[cc].add(pod.req * wgt)
-                    nz_c = nz_c.at[cc].add(pod.nonzero_req * wgt)
+                    req_c = req_c + onehot[:, None] * pod.req[None, :] * wgt
+                    nz_c = (
+                        nz_c + onehot[:, None] * pod.nonzero_req[None, :] * wgt
+                    )
                     if features.ports:
-                        row = jnp.where(
-                            found, ports_c[cc] | pod.port_bits, ports_c[cc]
+                        ports_c = jnp.where(
+                            onehot[:, None], ports_c | pod.port_bits[None, :],
+                            ports_c,
                         )
-                        ports_c = ports_c.at[cc].set(row)
                     if features.spread:
                         spj = spread_update(
-                            spj, spread, i, spj.v[:, cc],
-                            spj.eligible[:, cc], found,
+                            spj, spread, i, node_col(spj.v, cc),
+                            node_col(spj.eligible, cc), found,
                         )
                         spc = spj.counts_node
                     if features.interpod:
                         tmj = interpod_update(
-                            tmj, terms, i, cluster.topo_ids[cc], found,
-                            slots=features.term_slots,
+                            tmj, terms, i, node_rows(cluster.topo_ids, cc),
+                            found, slots=features.term_slots,
                         )
                         pr, bl, ga = (
                             tmj.present_bits, tmj.blocked_bits,
                             tmj.global_any,
                         )
                     out = (jnp.where(found, cc, -1).astype(jnp.int32),
-                           jnp.where(found, masked[choice], NEG_INF),
-                           cnt, reason)
+                           win, cnt, reason)
                     return (req_c, nz_c, ports_c, spc, pr, bl, ga), out
 
                 (req2, nz2, ports2, spc2, pr2, bl2, ga2), outs = (
@@ -1126,7 +1307,7 @@ def wavefront_assign(
     if n_groups > 0:
         assignment, win_scores, reasons, requested, nonzero = _gang_release(
             assignment, win_scores, reasons, requested, nonzero,
-            pods, n_groups, n,
+            pods, n_groups, n, offset=offset,
         )
 
     final = cluster._replace(

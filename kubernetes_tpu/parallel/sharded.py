@@ -1,4 +1,4 @@
-"""Node-axis-sharded greedy solve: the multi-chip scheduling step.
+"""Node-axis-sharded solves: the multi-chip scheduling step.
 
 The reference scales its hot loop with 16 goroutines and adaptive node
 sampling (parallelize/parallelism.go, schedule_one.go:662); the TPU-native
@@ -7,8 +7,17 @@ mesh with shard_map.  Each chip filters and scores its node shard, reduces
 its local champion, and a pmax/pmin pair elects the global winner — the
 ring-reduction analogue sketched in SURVEY.md section 5.7.  The winning
 shard applies the assume-update locally; per-pod state (requested, ports)
-never leaves its shard, so per-step communication is O(1) scalars on ICI,
-independent of cluster size.
+never leaves its shard, so per-step communication is O(1) scalars on ICI
+(plus the wavefront's O(K) merged candidate list per wave), independent
+of cluster size.
+
+All three solver families follow the ops.auction pattern — ONE
+implementation, two layouts: ops.assign.greedy_assign /
+wavefront_assign and ops.auction.auction_assign take an ``axis_name``
+and internally switch their node-axis boundary crossings to
+ownership-masked psums, pmax/pmin elections, and all_gather merges.
+The wrappers here only set up the shard_map specs, so the sharded
+solvers cannot drift from the single-chip ones.
 
 Tie-break parity with the single-chip path: lowest node index among
 max-score nodes (argmax-first-index locally, pmin on the winner index
@@ -41,16 +50,18 @@ def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
         check_rep=check_vma,
     )
 
+from ..analysis import retrace
 from ..ops.assign import (
-    NEG_INF,
+    DEFAULT_WAVE_CAP,
     FeatureFlags,
     SolveResult,
-    class_statics,
     features_of,
+    greedy_assign,
     needs_topo,
+    plan_waves,
     required_topo_z,
     required_topo_z_split,
-    solve_order,
+    wavefront_assign,
 )
 from ..ops.auction import (
     AuctionResult,
@@ -58,34 +69,15 @@ from ..ops.auction import (
     auction_features_ok,
     default_tie_k,
 )
-from ..ops.filters import (
-    fits_resources,
-    pod_view,
-    preferred_match,
-    selector_match,
-)
-from ..ops.interpod import (
-    interpod_filter,
-    interpod_update,
-    prep_pref_pod,
-    prep_terms,
-)
 from ..ops.schema import (
     ClusterTensors,
-    ImageTable,
     PrefPodTable,
     Snapshot,
     SpreadTable,
     TermTable,
     num_groups,
 )
-from ..ops.scores import (
-    DEFAULT_SCORE_CONFIG,
-    ScoreConfig,
-    score_from_raw,
-    static_extra,
-)
-from ..ops.topology import prep_spread, spread_filter, spread_score, spread_update
+from ..ops.scores import DEFAULT_SCORE_CONFIG, ScoreConfig
 
 AXIS = "nodes"
 
@@ -109,6 +101,12 @@ def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     if devices is None:
         devices = jax.devices()[: n_devices or len(jax.devices())]
     return Mesh(devices, (AXIS,))
+
+
+def mesh_signature(mesh: Mesh) -> tuple:
+    """Hashable mesh-shape component of a sharded executable key (the
+    retrace tracker's and the prewarm pool's mesh discriminator)."""
+    return ("mesh",) + tuple(int(d) for d in mesh.devices.shape)
 
 
 def _spread_specs(rep):
@@ -135,46 +133,13 @@ def _prefpod_specs(rep):
     )
 
 
-def _broadcast_column(matrix: jnp.ndarray, local_idx: jnp.ndarray, own: jnp.ndarray):
-    """Give every shard the owning shard's matrix[:, local_idx] column
-    (psum of a single masked contribution)."""
-    col = jnp.where(own, matrix[:, local_idx], 0)
-    return jax.lax.psum(col, AXIS)
-
-
-def sharded_greedy_assign(
-    snapshot: Snapshot,
-    mesh: Mesh,
-    cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,
-    topo_z: Optional[int] = None,
-    features: Optional[FeatureFlags] = None,
-) -> SolveResult:
-    """greedy_assign with the node axis sharded over `mesh`.
-
-    Placement semantics are identical to ops.assign.greedy_assign; only the
-    data layout differs.  Requires the padded node count to be divisible by
-    the mesh size (SnapshotBuilder pads to powers of two, mesh sizes are
-    powers of two, so this holds by construction).
-
-    Constraint count state ([C/T, Z]) is small and kept replicated: each
-    shard scatter-builds counts from its node shard, a psum replicates
-    them, and per-placement updates are broadcast from the winning shard.
-    """
-    if features is None:
-        features = features_of(snapshot)
-    if topo_z is None:
-        topo_z = required_topo_z(snapshot)
-    (cluster, pods, sel, pref, spread, terms, prefpod, images) = jax.tree.map(
-        jnp.asarray, tuple(snapshot)
-    )
-    n = cluster.allocatable.shape[0]
-    n_dev = mesh.devices.size
-    if n % n_dev:
-        raise ValueError(f"padded node count {n} not divisible by mesh size {n_dev}")
-    p = pods.req.shape[0]
-
+def _snapshot_in_specs(parts):
+    """shard_map in_specs for the 8 Snapshot components: cluster tensors
+    node-sharded, pod/constraint tables replicated except their [·, N]
+    per-node count matrices."""
     rep = P()
-    in_specs = (
+    (cluster, pods, sel, pref, spread, terms, prefpod, images) = parts
+    return (
         CLUSTER_SPECS,
         jax.tree.map(lambda _: rep, pods),
         jax.tree.map(lambda _: rep, sel),
@@ -184,157 +149,123 @@ def sharded_greedy_assign(
         _prefpod_specs(rep),
         jax.tree.map(lambda _: rep, images),
     )
+
+
+def _check_divisible(n: int, mesh: Mesh) -> None:
+    n_dev = mesh.devices.size
+    if n % n_dev:
+        raise ValueError(
+            f"padded node count {n} not divisible by mesh size {n_dev}"
+        )
+
+
+def sharded_greedy_assign(
+    snapshot: Snapshot,
+    mesh: Mesh,
+    cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,
+    topo_z: Optional[int] = None,
+    features: Optional[FeatureFlags] = None,
+    n_groups: int = 0,
+) -> SolveResult:
+    """greedy_assign with the node axis sharded over `mesh`.
+
+    Placement semantics are identical to ops.assign.greedy_assign; only
+    the data layout differs — this wrapper sets up shard_map specs and
+    calls greedy_assign(axis_name=...), which handles the elections and
+    constraint-state broadcasts internally.  Requires the padded node
+    count to be divisible by the mesh size (SnapshotBuilder pads to
+    powers of two, mesh sizes are powers of two, so this holds whenever
+    the cluster bucket is at least one row per chip;
+    TPUBatchScheduler._dispatch falls back to the single chip — counted
+    in `sharded_solve_fallbacks` — otherwise).
+
+    Constraint count state ([C/T, Z]) is small and kept replicated: each
+    shard scatter-builds counts from its node shard, a psum replicates
+    them, and per-placement updates are broadcast from the winning
+    shard.  Gang all-or-nothing (n_groups) runs the shared post-pass
+    with per-shard ownership masking."""
+    if features is None:
+        features = features_of(snapshot)
+    if topo_z is None:
+        topo_z = required_topo_z(snapshot)
+    parts = jax.tree.map(jnp.asarray, tuple(snapshot))
+    _check_divisible(parts[0].allocatable.shape[0], mesh)
+
+    rep = P()
     out_specs = SolveResult(
-        assignment=rep, scores=rep, feasible_counts=rep, cluster=CLUSTER_SPECS
+        assignment=rep, scores=rep, feasible_counts=rep,
+        cluster=CLUSTER_SPECS, reasons=rep,
     )
 
     @partial(
         _shard_map,
         mesh=mesh,
-        in_specs=in_specs,
+        in_specs=_snapshot_in_specs(parts),
         out_specs=out_specs,
         check_vma=False,
     )
-    def run(
-        cl: ClusterTensors, pods, sel, pref, spread, terms, prefpod, images
-    ) -> SolveResult:
-        n_local = cl.allocatable.shape[0]
-        offset = jax.lax.axis_index(AXIS) * n_local
-        sel_mask = selector_match(cl, sel)
-        pref_mask = preferred_match(cl, pref)
-        # Hoisted per-class statics over the local node shard ([C, N/k]);
-        # normalization maxima stay per-step (they span shards via pmax).
-        sfeas_c, aff_c, taint_c = class_statics(cl, pods, sel_mask, pref_mask)
-        c_dim = sfeas_c.shape[0]
-        order = solve_order(pods)
-
-        # Local scatter + psum => replicated counts over all shards;
-        # v/eligible/blocked stay node-sharded.
-        sp0 = tm0 = None
-        if features.spread:
-            sp0 = prep_spread(
-                cl, sel_mask, spread, topo_z, axis_name=AXIS,
-                has_bound=features.bound_spread,
-            )
-        if features.interpod:
-            tm0 = prep_terms(
-                cl, terms, topo_z, axis_name=AXIS, slots=features.term_slots,
-                has_bound=features.bound_terms,
-            )
-        extra_c = None
-        if features.interpod_pref or features.images:
-            # hoisted per-class extras over the LOCAL node shard; the
-            # preps/normalizers span shards via psum/pmax (same hoist as
-            # ops.assign's — shared scores.static_extra keeps them from
-            # drifting)
-            pp = (
-                prep_pref_pod(
-                    cl, prefpod, topo_z, axis_name=AXIS,
-                    has_bound=features.bound_pref,
-                )
-                if features.interpod_pref
-                else None
-            )
-            reps_e = jnp.clip(pods.class_rep, 0, p - 1)
-            extra_c = jax.vmap(
-                lambda c, rep: static_extra(
-                    cl, prefpod, images, features, cfg, rep, sfeas_c[c],
-                    pp, axis_name=AXIS,
-                )
-            )(jnp.arange(c_dim, dtype=jnp.int32), reps_e)
-
-        def step(carry, k):
-            requested, nonzero, new_ports, sp_counts, tm_present, tm_blocked, tm_global = carry
-            i = order[k]
-            cur = cl._replace(requested=requested, nonzero_requested=nonzero)
-            pod = pod_view(pods, i)
-            cls = jnp.clip(pods.class_id[i], 0, c_dim - 1)
-            feas = sfeas_c[cls] & fits_resources(cur, pod)
-            if features.ports:
-                feas = feas & ~((new_ports & pod.port_bits[None, :]).any(axis=-1))
-            sp = tm = None
-            if features.spread:
-                sp = sp0._replace(counts_node=sp_counts)
-                feas = feas & spread_filter(sp, spread, i, axis_name=AXIS)
-            if features.interpod:
-                tm = tm0._replace(
-                    present_bits=tm_present, blocked_bits=tm_blocked,
-                    global_any=tm_global,
-                )
-                feas = feas & interpod_filter(tm, terms, i)
-            sp_score = (
-                spread_score(sp, spread, i, feas, axis_name=AXIS)
-                if features.soft_spread
-                else None
-            )
-            scores = score_from_raw(
-                cur, pod, feas, aff_c[cls], taint_c[cls], cfg,
-                axis_name=AXIS, spread_score=sp_score,
-                extra=extra_c[cls] if extra_c is not None else None,
-            )
-            masked = jnp.where(feas, scores, NEG_INF)
-
-            # Local champion, then a 2-collective global election.
-            li = jnp.argmax(masked)
-            lv = masked[li]
-            gi = (offset + li).astype(jnp.int32)
-            best = jax.lax.pmax(lv, AXIS)
-            cand = jnp.where(lv == best, gi, jnp.int32(2**31 - 1))
-            winner = jax.lax.pmin(cand, AXIS)
-            found = best > NEG_INF
-            idx = jnp.where(found, winner, -1).astype(jnp.int32)
-
-            onehot = ((jnp.arange(n_local) + offset) == winner) & found
-            requested = requested + onehot[:, None] * pod.req[None, :]
-            nonzero = nonzero + onehot[:, None] * pod.nonzero_req[None, :]
-            if features.ports:
-                new_ports = jnp.where(
-                    onehot[:, None], new_ports | pod.port_bits[None, :], new_ports
-                )
-
-            own = found & (winner >= offset) & (winner < offset + n_local)
-            wli = jnp.clip(winner - offset, 0, n_local - 1)
-            if features.spread:
-                sp_v = _broadcast_column(sp.v, wli, own)
-                sp_elig = _broadcast_column(sp.eligible.astype(jnp.int32), wli, own) > 0
-                sp = spread_update(sp, spread, i, sp_v, sp_elig, found)
-                sp_counts = sp.counts_node
-            if features.interpod:
-                topo_at = _broadcast_column(cl.topo_ids.T, wli, own)
-                tm = interpod_update(
-                    tm, terms, i, topo_at, found, slots=features.term_slots
-                )
-                tm_present, tm_blocked, tm_global = (
-                    tm.present_bits, tm.blocked_bits, tm.global_any
-                )
-
-            n_feas = jax.lax.psum(feas.sum().astype(jnp.int32), AXIS)
-            carry = (requested, nonzero, new_ports, sp_counts, tm_present, tm_blocked, tm_global)
-            return carry, (i, idx, jnp.where(found, best, NEG_INF), n_feas)
-
-        zero = jnp.zeros(())
-        init = (
-            cl.requested, cl.nonzero_requested,
-            jnp.zeros_like(cl.port_bits) if features.ports else zero,
-            sp0.counts_node if features.spread else zero,
-            tm0.present_bits if features.interpod else zero,
-            tm0.blocked_bits if features.interpod else zero,
-            tm0.global_any if features.interpod else zero,
+    def run(cl, pods, sel, pref, spread, terms, prefpod, images):
+        local = Snapshot(cl, pods, sel, pref, spread, terms, prefpod, images)
+        return greedy_assign(
+            local, cfg, topo_z=topo_z, features=features,
+            n_groups=n_groups, axis_name=AXIS,
         )
-        (requested, nonzero, new_ports, *_rest), (pod_is, assign_o, win_o, nf_o) = (
-            jax.lax.scan(step, init, jnp.arange(p))
-        )
-        assignment = jnp.full(p, -1, jnp.int32).at[pod_is].set(assign_o)
-        win = jnp.full(p, NEG_INF).at[pod_is].set(win_o)
-        nf = jnp.zeros(p, jnp.int32).at[pod_is].set(nf_o)
-        final = cl._replace(
-            requested=requested,
-            nonzero_requested=nonzero,
-            port_bits=(cl.port_bits | new_ports) if features.ports else cl.port_bits,
-        )
-        return SolveResult(assignment, win, nf, final)
 
-    return run(cluster, pods, sel, pref, spread, terms, prefpod, images)
+    return run(*parts)
+
+
+def sharded_wavefront_assign(
+    snapshot: Snapshot,
+    wave_members,
+    mesh: Mesh,
+    cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,
+    topo_z: Optional[int] = None,
+    features: Optional[FeatureFlags] = None,
+    n_groups: int = 0,
+) -> SolveResult:
+    """wavefront_assign with the node axis sharded over `mesh` — the
+    production mesh route for large greedy batches: ~P/W wave steps
+    instead of P, each wave evaluated on all chips in parallel.
+
+    The wave plan stays a replicated host-side device argument
+    (plan_waves — pod-space only), the batched [K, N] evaluation runs
+    per shard, the top-(K+1) candidate lists merge through one
+    all_gather per wave, and the O(K) mini-scan corrections are computed
+    on psum-replicated picked rows so every shard reaches the same
+    choice without per-pod elections (see wavefront_assign's axis_name
+    docstring).  Placements — and the serialized-wave / fit-flip
+    fallback counters — are bit-identical to the single-chip wavefront,
+    which is itself scan-identical."""
+    if features is None:
+        features = features_of(snapshot)
+    if topo_z is None:
+        topo_z = required_topo_z(snapshot)
+    parts = jax.tree.map(jnp.asarray, tuple(snapshot))
+    _check_divisible(parts[0].allocatable.shape[0], mesh)
+    members = jnp.asarray(wave_members, jnp.int32)
+
+    rep = P()
+    out_specs = SolveResult(
+        assignment=rep, scores=rep, feasible_counts=rep,
+        cluster=CLUSTER_SPECS, reasons=rep, wave_count=rep,
+        wave_fallbacks=rep,
+    )
+
+    @partial(
+        _shard_map,
+        mesh=mesh,
+        in_specs=_snapshot_in_specs(parts) + (rep,),
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    def run(cl, pods, sel, pref, spread, terms, prefpod, images, mem):
+        local = Snapshot(cl, pods, sel, pref, spread, terms, prefpod, images)
+        return wavefront_assign(
+            local, mem, cfg, topo_z=topo_z, features=features,
+            n_groups=n_groups, axis_name=AXIS,
+        )
+
+    return run(*parts, members)
 
 
 def sharded_auction_assign(
@@ -371,29 +302,15 @@ def sharded_auction_assign(
         topo_z = required_topo_z_split(snapshot)
     if tie_k is None:
         tie_k = default_tie_k(snapshot)
-    (cluster, pods, sel, pref, spread, terms, prefpod, images) = jax.tree.map(
-        jnp.asarray, tuple(snapshot)
-    )
-    n = cluster.allocatable.shape[0]
-    n_dev = mesh.devices.size
-    if n % n_dev:
-        raise ValueError(f"padded node count {n} not divisible by mesh size {n_dev}")
+    parts = jax.tree.map(jnp.asarray, tuple(snapshot))
+    n = parts[0].allocatable.shape[0]
+    _check_divisible(n, mesh)
     # tie_k bounds the GLOBAL tie list; each shard's local top_k clamps
     # to its shard size inside auction_assign and the all_gather merge
     # restores the global length
     tie_k = min(tie_k, n)
 
     rep = P()
-    in_specs = (
-        CLUSTER_SPECS,
-        jax.tree.map(lambda _: rep, pods),
-        jax.tree.map(lambda _: rep, sel),
-        jax.tree.map(lambda _: rep, pref),
-        _spread_specs(rep),
-        _term_specs(rep),
-        _prefpod_specs(rep),
-        jax.tree.map(lambda _: rep, images),
-    )
     out_specs = AuctionResult(
         assignment=rep, scores=rep, rounds=rep, gang_dropped=rep,
         cluster=CLUSTER_SPECS, reasons=rep,
@@ -403,7 +320,7 @@ def sharded_auction_assign(
     @partial(
         _shard_map,
         mesh=mesh,
-        in_specs=in_specs,
+        in_specs=_snapshot_in_specs(parts),
         out_specs=out_specs,
         check_vma=False,
     )
@@ -415,10 +332,120 @@ def sharded_auction_assign(
             tie_k=tie_k, axis_name=AXIS,
         )
 
-    return run(cluster, pods, sel, pref, spread, terms, prefpod, images)
+    return run(*parts)
+
+
+# -- jitted wrappers ---------------------------------------------------------
+#
+# Mirrors of ops.assign's *_jit closures for the mesh layout: one
+# executable per (shape bucket, statics, MESH SHAPE).  Every dispatch
+# reports to the recompile-discipline tracker (analysis/retrace.py) with
+# the mesh shape folded into the signature — a mesh-mode batch must
+# never silently compile a fresh executable in steady state.  `.jitted`
+# exposes the raw jit for the prewarm pool's AOT lower().compile().
+
+
+def sharded_greedy_jit(mesh: Mesh, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
+    mesh_sig = mesh_signature(mesh)
+
+    @partial(jax.jit, static_argnums=(1, 2, 3))
+    def run(
+        snapshot: Snapshot, topo_z: int, features: FeatureFlags,
+        n_groups: int,
+    ) -> SolveResult:
+        return sharded_greedy_assign(
+            snapshot, mesh, cfg, topo_z=topo_z, features=features,
+            n_groups=n_groups,
+        )
+
+    def call(
+        snapshot: Snapshot,
+        topo_z: Optional[int] = None,
+        features: Optional[FeatureFlags] = None,
+        n_groups: Optional[int] = None,
+    ) -> SolveResult:
+        if features is None:
+            features = features_of(snapshot)
+        if topo_z is None:
+            topo_z = (
+                required_topo_z(snapshot) if needs_topo(features) else 1
+            )
+        if n_groups is None:
+            n_groups = num_groups(snapshot)
+        if n_groups > 0:
+            from ..utils.vocab import pad_dim
+
+            n_groups = pad_dim(n_groups, 1)
+        out = run(snapshot, topo_z, features, n_groups)
+        retrace.note(
+            "greedy-sharded", run,
+            lambda: retrace.signature(
+                snapshot, (topo_z, features, n_groups, mesh_sig)
+            ),
+        )
+        return out
+
+    call.jitted = run  # raw jit, for AOT prewarm (lower().compile())
+    return call
+
+
+def sharded_wavefront_jit(mesh: Mesh, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
+    """Jitted sharded wavefront: one executable per (shape bucket,
+    topo_z, features, n_groups, wave shape, mesh shape).  The wave plan
+    stays a device argument so repartitions reuse the executable."""
+    mesh_sig = mesh_signature(mesh)
+
+    @partial(jax.jit, static_argnums=(2, 3, 4))
+    def run(
+        snapshot: Snapshot, wave_members, topo_z: int,
+        features: FeatureFlags, n_groups: int,
+    ) -> SolveResult:
+        return sharded_wavefront_assign(
+            snapshot, wave_members, mesh, cfg, topo_z=topo_z,
+            features=features, n_groups=n_groups,
+        )
+
+    def call(
+        snapshot: Snapshot,
+        wave_members=None,
+        topo_z: Optional[int] = None,
+        features: Optional[FeatureFlags] = None,
+        n_groups: Optional[int] = None,
+        wave_cap: int = DEFAULT_WAVE_CAP,
+    ) -> SolveResult:
+        if features is None:
+            features = features_of(snapshot)
+        if topo_z is None:
+            topo_z = (
+                required_topo_z(snapshot) if needs_topo(features) else 1
+            )
+        if n_groups is None:
+            n_groups = num_groups(snapshot)
+        if n_groups > 0:
+            from ..utils.vocab import pad_dim
+
+            n_groups = pad_dim(n_groups, 1)
+        if wave_members is None:
+            wave_members = plan_waves(
+                snapshot, features=features, wave_cap=wave_cap
+            ).members
+        members = jnp.asarray(wave_members, jnp.int32)
+        out = run(snapshot, members, topo_z, features, n_groups)
+        retrace.note(
+            "wavefront-sharded", run,
+            lambda: retrace.signature(
+                (snapshot, members), (topo_z, features, n_groups, mesh_sig)
+            ),
+        )
+        return out
+
+    call.jitted = run  # raw jit, for AOT prewarm (lower().compile())
+    return call
 
 
 def sharded_auction_jit(mesh: Mesh, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
+    mesh_sig = mesh_signature(mesh)
+
     @partial(jax.jit, static_argnums=(1, 2, 3, 4))
     def run(snapshot, n_groups, features, topo_z, tie_k):
         return sharded_auction_assign(
@@ -441,29 +468,14 @@ def sharded_auction_jit(mesh: Mesh, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
             topo_z = required_topo_z_split(snapshot)
         if tie_k is None:
             tie_k = default_tie_k(snapshot)
-        return run(snapshot, n_groups, features, topo_z, tie_k)
-
-    return call
-
-
-def sharded_greedy_jit(mesh: Mesh, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
-    @partial(jax.jit, static_argnums=(1, 2))
-    def run(snapshot: Snapshot, topo_z: int, features: FeatureFlags) -> SolveResult:
-        return sharded_greedy_assign(
-            snapshot, mesh, cfg, topo_z=topo_z, features=features
+        out = run(snapshot, n_groups, features, topo_z, tie_k)
+        retrace.note(
+            "auction-sharded", run,
+            lambda: retrace.signature(
+                snapshot, (n_groups, features, topo_z, tie_k, mesh_sig)
+            ),
         )
+        return out
 
-    def call(
-        snapshot: Snapshot,
-        topo_z: Optional[int] = None,
-        features: Optional[FeatureFlags] = None,
-    ) -> SolveResult:
-        if features is None:
-            features = features_of(snapshot)
-        if topo_z is None:
-            topo_z = (
-                required_topo_z(snapshot) if needs_topo(features) else 1
-            )
-        return run(snapshot, topo_z, features)
-
+    call.jitted = run  # raw jit, for AOT prewarm (lower().compile())
     return call

@@ -189,6 +189,13 @@ class MetricsCollector:
         "scheduler_solve_fallback_total",
         # solver XLA traces seen by the retrace tracker (armed runs only)
         "scheduler_solve_retrace_total",
+        # sharded multichip solve: mesh size, device-mirror transfer
+        # accounting (resyncs / delta rows), and single-chip fallbacks
+        # (docs/scheduler_loop.md mesh mode)
+        "scheduler_solve_shard_count",
+        "scheduler_mirror_resync_total",
+        "scheduler_mirror_delta_rows",
+        "scheduler_sharded_solve_fallbacks",
         "scheduler_binder_restarts_total",
         "scheduler_binder_poison_waves_total",
         "scheduler_journal_recovered_records",

@@ -79,6 +79,12 @@ class SchedulerConfiguration:
     assume_ttl_seconds: float = 30.0
     unschedulable_flush_seconds: float = 300.0
     max_preemptions_per_cycle: int = 16
+    # sharded multichip solve (docs/scheduler_loop.md mesh mode): shard
+    # the node axis of every solve across this many devices.  0 (the
+    # default) stays single-chip; mesh sizes must be powers of two so
+    # padded node buckets split evenly.  Consulted at registry build
+    # time together with the ShardedSolve feature gate.
+    mesh_devices: int = 0
     # parity-only knobs (see module docstring)
     parallelism: int = 16
     percentage_of_nodes_to_score: int = 100
@@ -160,6 +166,16 @@ class SchedulerConfiguration:
             raise ValueError("percentage_of_nodes_to_score must be 0..100")
         if self.max_preemptions_per_cycle < 0:
             raise ValueError("max_preemptions_per_cycle must be >= 0")
+        if self.mesh_devices < 0:
+            raise ValueError("mesh_devices must be >= 0")
+        if self.mesh_devices and (
+            self.mesh_devices & (self.mesh_devices - 1)
+        ):
+            raise ValueError(
+                "mesh_devices must be a power of two: padded node "
+                "buckets are powers of two, and the node axis must "
+                "split evenly across the mesh (parallel/sharded.py)"
+            )
         self.gate()  # unknown/locked gate overrides raise here
         return self
 
@@ -180,7 +196,7 @@ _TOP_KEYS = {
     "featureGates", "batchSize", "batchWindowSeconds", "assumeTTLSeconds",
     "unschedulableFlushSeconds", "maxPreemptionsPerCycle",
     "adaptiveBatchWindow", "batchWindowMinSeconds", "batchWindowMaxSeconds",
-    "batchLatencySLOSeconds",
+    "batchLatencySLOSeconds", "meshDevices",
 }
 
 
@@ -239,6 +255,8 @@ def load_config(source: Any) -> SchedulerConfiguration:
         cfg.unschedulable_flush_seconds = float(doc["unschedulableFlushSeconds"])
     if "maxPreemptionsPerCycle" in doc:
         cfg.max_preemptions_per_cycle = int(doc["maxPreemptionsPerCycle"])
+    if "meshDevices" in doc:
+        cfg.mesh_devices = int(doc["meshDevices"])
     if "featureGates" in doc:
         cfg.feature_gates = {
             str(k): bool(v) for k, v in (doc["featureGates"] or {}).items()

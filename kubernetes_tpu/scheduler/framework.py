@@ -186,6 +186,25 @@ class FrameworkRegistry:
         # plugin registry (plugins/registry.go:58-70)
         mode = "auto" if self.gate.enabled("AuctionSolver") else "greedy"
         use_mirror = self.gate.enabled("DeviceClusterMirror")
+        # meshDevices + the ShardedSolve gate make mesh mode a
+        # config-constructible production configuration: one mesh shared
+        # by every profile, node axis sharded in all three solver
+        # families (parallel/sharded.py)
+        mesh = None
+        if config.mesh_devices and self.gate.enabled("ShardedSolve"):
+            import jax
+
+            from ..parallel.sharded import make_mesh
+
+            avail = len(jax.devices())
+            if avail < config.mesh_devices:
+                raise ValueError(
+                    f"meshDevices={config.mesh_devices} but only {avail} "
+                    "JAX devices are visible (set "
+                    "XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                    "for a forced host-platform mesh)"
+                )
+            mesh = make_mesh(config.mesh_devices)
         first: Optional[TPUBatchScheduler] = None
         self.frameworks: Dict[str, Framework] = {}
         for profile in config.profiles:
@@ -195,6 +214,7 @@ class FrameworkRegistry:
                 state=first.state if first is not None else state,
                 mode=mode,
                 use_mirror=use_mirror,
+                mesh=mesh,
             )
             if first is None:
                 first = tpu

@@ -242,6 +242,22 @@ class Registry:
         # GRAFTLINT_SHAPES=1 test sessions); steady-state increments
         # mean a kernel argument escaped the pad-bucket lattice
         self.solve_retrace_total = Gauge("scheduler_solve_retrace_total")
+        # -- sharded-solve surface (docs/scheduler_loop.md mesh mode) ------
+        # mesh size the solver shards the node axis over (0 single-chip)
+        self.solve_shard_count = Gauge("scheduler_solve_shard_count")
+        # full mirror re-uploads (struct-generation changes, shape
+        # changes, over-fraction deltas) — mirrored from
+        # DeviceClusterMirror; steady state should not move
+        self.mirror_resync_total = Gauge("scheduler_mirror_resync_total")
+        # real dirty rows scattered by mirror delta syncs (running
+        # total) — per-batch host→device transfer is O(this delta), not
+        # O(N); bench c7 gates on it
+        self.mirror_delta_rows = Gauge("scheduler_mirror_delta_rows")
+        # batches a configured mesh could not solve sharded (padded node
+        # bucket smaller than the mesh) and routed single-chip instead
+        self.sharded_solve_fallbacks = Gauge(
+            "scheduler_sharded_solve_fallbacks"
+        )
         # -- overload-protection surface (docs/robustness.md) -------------
         # deepest per-watcher coalescing backlog at the last cycle mirror
         self.watch_queue_depth = Gauge("scheduler_watch_queue_depth")

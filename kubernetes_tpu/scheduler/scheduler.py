@@ -1027,6 +1027,20 @@ class Scheduler:
         # solver executable traces, when the recompile-discipline
         # runtime tracker is armed (bench / GRAFTLINT_SHAPES=1 runs)
         self.metrics.solve_retrace_total.set(float(_retrace.total()))
+        # sharded-solve surface: mesh size in use, device-mirror
+        # host→device transfer accounting, and single-chip fallbacks
+        self.metrics.solve_shard_count.set(
+            float(getattr(self.tpu, "shard_count", 0))
+        )
+        self.metrics.sharded_solve_fallbacks.set(
+            float(getattr(self.tpu, "sharded_fallbacks", 0))
+        )
+        mirror = getattr(self.tpu, "_mirror", None)
+        if mirror is not None:
+            self.metrics.mirror_resync_total.set(float(mirror.resync_total))
+            self.metrics.mirror_delta_rows.set(
+                float(mirror.delta_rows_total)
+            )
         recovered = getattr(self.store, "journal_recovered_records", None)
         if recovered is not None:
             self.metrics.journal_recovered_records.set(float(recovered))

@@ -32,6 +32,10 @@ DEFAULT_FEATURES: Dict[str, FeatureSpec] = {
     "AuctionSolver": FeatureSpec(True, BETA),
     # device-resident cluster mirror with delta sync (models/mirror.py)
     "DeviceClusterMirror": FeatureSpec(True, BETA),
+    # node-axis-sharded multichip solve when the config names a mesh
+    # (SchedulerConfiguration.mesh_devices; parallel/sharded.py) — off
+    # pins every profile to the single chip regardless of meshDevices
+    "ShardedSolve": FeatureSpec(True, BETA),
     # PV/PVC topology + attach limits in scheduling
     # (scheduler/volumebinding.py)
     "VolumeBinding": FeatureSpec(True, BETA),

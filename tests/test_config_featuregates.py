@@ -139,6 +139,57 @@ def test_validate_catches_bad_gates_in_config():
         cfg.validate()
 
 
+def test_mesh_devices_knob_loads_and_validates():
+    """meshDevices: YAML key -> SchedulerConfiguration.mesh_devices,
+    power-of-two validated (padded node buckets must split across the
+    mesh), gated by ShardedSolve."""
+    cfg = load_config(
+        """
+apiVersion: kubescheduler.config.k8s.io/v1
+kind: KubeSchedulerConfiguration
+meshDevices: 8
+"""
+    )
+    assert cfg.mesh_devices == 8
+    assert SchedulerConfiguration().mesh_devices == 0  # default: single chip
+    with pytest.raises(ValueError, match="power of two"):
+        SchedulerConfiguration(mesh_devices=3).validate()
+    with pytest.raises(ValueError, match=">= 0"):
+        SchedulerConfiguration(mesh_devices=-1).validate()
+    # the gate itself is a known, overridable BETA feature
+    g = FeatureGate()
+    assert g.enabled("ShardedSolve")
+    assert not FeatureGate(
+        overrides={"ShardedSolve": False}
+    ).enabled("ShardedSolve")
+
+
+def test_mesh_registry_build_respects_gate():
+    """Registry build consults meshDevices + ShardedSolve: on -> every
+    profile shares one mesh; off -> single chip.  An oversubscribed
+    mesh (more devices than visible) is rejected loudly."""
+    import jax
+
+    from kubernetes_tpu.scheduler.framework import FrameworkRegistry
+
+    n_dev = len(jax.devices())
+    if n_dev >= 8:
+        reg = FrameworkRegistry(SchedulerConfiguration(mesh_devices=8))
+        tpus = [f.tpu for f in reg]
+        assert all(t.mesh is not None and t.shard_count == 8 for t in tpus)
+        assert len({id(t.mesh) for t in tpus}) == 1  # one shared mesh
+    off = FrameworkRegistry(
+        SchedulerConfiguration(
+            mesh_devices=8, feature_gates={"ShardedSolve": False}
+        )
+    )
+    assert off.default.tpu.mesh is None
+    with pytest.raises(ValueError, match="JAX devices"):
+        FrameworkRegistry(
+            SchedulerConfiguration(mesh_devices=max(n_dev * 2, 16))
+        )
+
+
 def test_mirror_gate_off_still_schedules():
     """DeviceClusterMirror=false routes encode through the full-copy
     path (the rollback knob) with identical placements."""

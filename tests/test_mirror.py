@@ -128,6 +128,84 @@ def test_two_mirrors_one_state():
     _assert_mirror_matches(m2, state)
 
 
+def _mesh():
+    from kubernetes_tpu.parallel.sharded import make_mesh
+
+    return make_mesh(8)
+
+
+@pytest.mark.multichip
+def test_mesh_mirror_parity_and_sharding():
+    """Under a mesh the resident tensors must (a) stay value-identical
+    to a full re-encode of the state (the oracle) across every mutation
+    family, and (b) carry the node-axis NamedSharding the sharded
+    solvers' shard_map specs expect — no per-batch resharding."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh()
+    state = _mk_state(12)
+    mirror = DeviceClusterMirror(state, mesh=mesh)
+    _assert_mirror_matches(mirror, state)
+    dev = mirror.sync()
+    assert dev.allocatable.sharding == NamedSharding(mesh, P("nodes"))
+    assert dev.taint_bits.sharding == NamedSharding(mesh, P(None, "nodes"))
+
+    # usage deltas scatter into the owning shard
+    pods = [
+        make_pod(f"p-{i}").req(cpu_milli=500, mem=256 * MI).obj()
+        for i in range(5)
+    ]
+    for i, p in enumerate(pods):
+        state.add_pod(p, f"n-{i % 3}")
+    _assert_mirror_matches(mirror, state)
+    assert mirror.delta_syncs >= 1 and mirror.delta_rows_total >= 3
+    # the delta result keeps the sharded layout (a sharding flip would
+    # retrace the scatter AND reshard the next solve)
+    assert mirror.sync().requested.sharding == NamedSharding(
+        mesh, P("nodes")
+    )
+
+    # static deltas + node lifecycle
+    state.update_node(
+        make_node("n-1").capacity(cpu_milli=32000, mem=64 * GI, pods=200)
+        .zone("z-9").label("disk", "ssd").obj()
+    )
+    state.remove_node("n-2")
+    _assert_mirror_matches(mirror, state)
+
+    # growth across buckets forces a full RESHARDED re-upload
+    resyncs0 = mirror.resync_total
+    for i in range(200):
+        state.add_node(
+            make_node(f"g-{i}").capacity(cpu_milli=4000, mem=8 * GI, pods=50)
+            .obj()
+        )
+    _assert_mirror_matches(mirror, state)
+    assert mirror.resync_total > resyncs0
+    assert mirror.sync().allocatable.sharding == NamedSharding(
+        mesh, P("nodes")
+    )
+
+
+@pytest.mark.multichip
+def test_mesh_mirror_small_bucket_replicates():
+    """A padded bucket smaller than the mesh cannot shard: the mirror
+    replicates it (these batches solve single-chip anyway) and still
+    matches the re-encode oracle."""
+    state = schema.ClusterState(
+        schema.SnapshotBuilder(schema.SnapshotLimits(min_nodes=4))
+    )
+    for i in range(3):
+        state.add_node(
+            make_node(f"n-{i}").capacity(cpu_milli=8000, mem=16 * GI, pods=110)
+            .obj()
+        )
+    mirror = DeviceClusterMirror(state, mesh=_mesh())
+    _assert_mirror_matches(mirror, state)
+    state.add_pod(make_pod("p").req(cpu_milli=100, mem=MI).obj(), "n-0")
+    _assert_mirror_matches(mirror, state)
+
+
 def test_scheduler_steps_use_mirror():
     """End-to-end: repeated schedule_pending steps with assumes between
     them stay correct (the steady-state loop the mirror accelerates)."""

@@ -12,6 +12,8 @@ from kubernetes_tpu.ops import assign, schema
 from kubernetes_tpu.parallel import sharded
 from kubernetes_tpu.testing.wrappers import GI, MI, make_node, make_pod
 
+pytestmark = pytest.mark.multichip
+
 
 def _workload(seed, n_nodes=32, n_pods=40):
     rng = np.random.default_rng(seed)
@@ -165,6 +167,141 @@ def test_sharded_greedy_scores_prefpod_and_images():
     np.testing.assert_array_equal(
         np.asarray(single.assignment), np.asarray(multi.assignment)
     )
+
+
+def _wavefront_workload(seed, n_nodes=32, n_pods=80):
+    """Wavefront-shaped batch: every dynamic-coupling family active
+    (ports, spread, anti-affinity) so the wave partition, the mini-scan
+    corrections, and the serialized fallback all exercise."""
+    rng = np.random.default_rng(seed)
+    zones = ["z1", "z2", "z3"]
+    nodes = [
+        make_node(f"n{i}")
+        .capacity(
+            cpu_milli=int(rng.choice([4000, 8000, 16000])),
+            mem=int(rng.choice([8, 16, 32])) * GI,
+            pods=110,
+        )
+        .zone(str(rng.choice(zones)))
+        .obj()
+        for i in range(n_nodes)
+    ]
+    pods = []
+    for i in range(n_pods):
+        pw = make_pod(f"p{i}").req(
+            cpu_milli=int(rng.choice([100, 500, 1000])),
+            mem=int(rng.choice([128, 512])) * MI,
+        ).labels(app=f"a{i % 3}")
+        if i % 4 == 0:
+            pw.spread(1, api.LABEL_ZONE, "DoNotSchedule", {"app": f"a{i % 3}"})
+        elif i % 4 == 1:
+            pw.pod_anti_affinity({"app": f"a{i % 3}"}, api.LABEL_HOSTNAME)
+        elif i % 4 == 2:
+            pw.host_port(8000 + (i % 5))
+        pods.append(pw.obj())
+    return nodes, pods
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_sharded_wavefront_matches_scan_and_single_chip(seed):
+    """The sharded wavefront must equal BOTH the single-chip wavefront
+    (bit-identical, including the fallback counters) and the classic
+    scan (the wavefront's own parity contract) — the full chain the
+    mesh hot path rests on."""
+    nodes, pods = _wavefront_workload(seed)
+    snap, meta = schema.SnapshotBuilder().build(nodes, pods)
+    plan = assign.plan_waves(snap)
+    scan = assign.greedy_assign(snap)
+    single = assign.wavefront_assign(snap, plan.members)
+    mesh = sharded.make_mesh(8)
+    multi = sharded.sharded_wavefront_assign(snap, plan.members, mesh)
+    np.testing.assert_array_equal(
+        np.asarray(scan.assignment), np.asarray(single.assignment)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(single.assignment), np.asarray(multi.assignment)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(single.reasons), np.asarray(multi.reasons)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(single.feasible_counts),
+        np.asarray(multi.feasible_counts),
+    )
+    np.testing.assert_allclose(
+        np.asarray(single.cluster.requested),
+        np.asarray(multi.cluster.requested),
+        rtol=0, atol=0,
+    )
+    assert int(single.wave_count) == int(multi.wave_count)
+    assert int(single.wave_fallbacks) == int(multi.wave_fallbacks)
+
+
+def test_sharded_wavefront_serialized_waves_parity():
+    """A hand-built COUPLED partition (naive contiguous 32-chunks of the
+    solve order) forces the device-side safety check to serialize waves:
+    any contiguous partition is scan-identical, on both layouts."""
+    nodes, pods = _wavefront_workload(5)
+    snap, _ = schema.SnapshotBuilder().build(nodes, pods)
+    p = np.asarray(snap.pods.req).shape[0]
+    order = np.argsort(
+        -np.asarray(snap.pods.priority), kind="stable"
+    ).astype(np.int32)
+    n_waves = (p + 31) // 32
+    members = np.full((max(8, n_waves), 32), -1, np.int32)
+    for w in range(n_waves):
+        chunk = order[w * 32:(w + 1) * 32]
+        members[w, : len(chunk)] = chunk
+    scan = assign.greedy_assign(snap)
+    single = assign.wavefront_assign(snap, members)
+    multi = sharded.sharded_wavefront_assign(
+        snap, members, sharded.make_mesh(8)
+    )
+    assert int(single.wave_fallbacks) > 0  # coupling actually fired
+    np.testing.assert_array_equal(
+        np.asarray(scan.assignment), np.asarray(single.assignment)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(single.assignment), np.asarray(multi.assignment)
+    )
+    assert int(single.wave_fallbacks) == int(multi.wave_fallbacks)
+
+
+def test_sharded_wavefront_and_greedy_gang_release_parity():
+    """Gang all-or-nothing releases identically across shards: the
+    shared post-pass subtracts only owned rows per shard."""
+    nodes = [
+        make_node(f"n{i}").capacity(cpu_milli=2000, mem=4 * GI, pods=4).obj()
+        for i in range(8)
+    ]
+    pods = [
+        make_pod(f"g{i}").req(cpu_milli=1500, mem=GI).group("g", size=70).obj()
+        for i in range(70)
+    ] + [
+        make_pod(f"s{i}").req(cpu_milli=100, mem=MI).obj() for i in range(10)
+    ]
+    snap, _ = schema.SnapshotBuilder().build(nodes, pods)
+    ng = schema.num_groups(snap)
+    plan = assign.plan_waves(snap)
+    mesh = sharded.make_mesh(8)
+    scan = assign.greedy_assign(snap, n_groups=ng)
+    wf_multi = sharded.sharded_wavefront_assign(
+        snap, plan.members, mesh, n_groups=ng
+    )
+    gr_multi = sharded.sharded_greedy_assign(snap, mesh, n_groups=ng)
+    assert (np.asarray(scan.assignment)[:70] == -1).all()  # gang released
+    for got in (wf_multi, gr_multi):
+        np.testing.assert_array_equal(
+            np.asarray(scan.assignment), np.asarray(got.assignment)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(scan.reasons), np.asarray(got.reasons)
+        )
+        np.testing.assert_allclose(
+            np.asarray(scan.cluster.requested),
+            np.asarray(got.cluster.requested),
+            rtol=0, atol=0,
+        )
 
 
 def _auction_parity(nodes, pods, tie_k=64, n_dev=8):
