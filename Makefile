@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test chaos bench lint lint-shapes multichip
+.PHONY: test chaos chaos-restart bench lint lint-shapes multichip
 
 # graftlint: the project-native static analysis suite (guarded-by,
 # hot-path purity, registry drift, lock-order, tensor-contract —
@@ -32,6 +32,15 @@ test:
 # byte-identically via FaultRegistry(seed)
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -m chaos -q \
+		-p no:cacheprovider
+
+# the kill-restart subset only (RESTART_SEEDS = range(300, 310)): tear a
+# component down at a registered crash point (store mid-fsync, binder
+# mid-wave, leader mid-pop-window), restart it, and prove no pod lost,
+# no double bind, rv monotonic across the restart, and snapshot+suffix
+# recovery bit-identical to a full-journal-replay oracle
+chaos-restart:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -m restart -q \
 		-p no:cacheprovider
 
 # the sharded multichip suite on a FORCED 8-device host-platform mesh:

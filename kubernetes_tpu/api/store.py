@@ -40,7 +40,9 @@ import weakref
 import zlib
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+from typing import (
+    Any, Callable, Dict, Iterator, List, NamedTuple, Optional, Tuple,
+)
 
 from ..testing import faults
 from . import types as api
@@ -65,6 +67,25 @@ class Conflict(ValueError):
 
 class Expired(ValueError):
     """Watch start revision fell out of the event buffer (410 Gone)."""
+
+
+class Fenced(ValueError):
+    """A fenced write's leadership lease is stale: the caller was
+    deposed between staging the wave and committing it.  The etcd
+    analogue is a txn whose lease-ownership compare fails — the late
+    wave of a dead leader must never double-bind."""
+
+
+class FenceToken(NamedTuple):
+    """Leadership proof threaded into ``Store.update_wave``: the wave
+    commits only while `identity` still holds the named Lease at the
+    same acquisition `generation` (lease_transitions when the caller
+    acquired).  Minted by ``LeaderElector.fence_token()``."""
+
+    name: str
+    namespace: str
+    identity: str
+    generation: Optional[int] = None
 
 
 @dataclass
@@ -256,7 +277,19 @@ class Store:
     (storage/etcd3/store.go; SURVEY §5.4).  Replay re-applies writes
     without re-journaling and leaves the event buffer empty — watchers
     attach after recovery and relist, exactly like a reflector hitting a
-    fresh apiserver."""
+    fresh apiserver.
+
+    Checkpointing bounds replay: ``checkpoint()`` (also triggered by
+    journal growth and, optionally, a wall-clock interval) writes a
+    point-in-time snapshot of every live object via write-temp + fsync +
+    atomic-rename and truncates the journal past the checkpoint rv, so
+    recovery = load snapshot + replay the journal SUFFIX instead of
+    replaying history from byte zero (the etcd snapshot + WAL-rotation
+    discipline).  A corrupt snapshot falls back to replaying whatever
+    the journal holds; ``update_wave`` records are replayed atomically
+    (a torn final wave is dropped whole, never half-applied).  Recovery
+    observability: ``recovery_duration_ms`` / ``snapshot_records`` /
+    ``journal_suffix_records``, mirrored into the scheduler Registry."""
 
     # graftlint guarded-by declarations: object maps, version counters,
     # the event ring, watcher fan-out lists, and all journal state share
@@ -279,15 +312,26 @@ class Store:
         "_watch_coalesced_closed": "_lock",
         "_dispatch_thread": "_lock",
         "_dispatch_backlog": "_dispatch_cv",
+        "_dispatch_inflight": "_dispatch_cv",
         "journal_recovered_records": "_lock",
         "journal_tail_truncations": "_lock",
         "journal_write_errors": "_lock",
+        "journal_torn_waves": "_lock",
+        "_snapshot_rv": "_lock",
+        "_wave_seq": "_lock",
+        "_last_checkpoint": "_lock",
+        "checkpoints_total": "_lock",
+        "snapshot_fallbacks": "_lock",
+        "snapshot_records": "_lock",
+        "journal_suffix_records": "_lock",
+        "recovery_duration_ms": "_lock",
+        "fenced_writes_total": "_lock",
     }
-    # reviewed lock-free: replay/compaction run from __init__ before the
-    # store is shared; the rest document "caller holds the lock"
+    # reviewed lock-free: replay/snapshot-load run from __init__ before
+    # the store is shared; the rest document "caller holds the lock"
     LOCKED_METHODS = frozenset({
         "_replay_journal",
-        "_compact_journal",
+        "_load_snapshot",
         "_flush_journal",
         "_journal_commit",
         "_append_journal",
@@ -306,6 +350,13 @@ class Store:
         journal_path: Optional[str] = None,
         admission=None,
         journal_sync: str = "write",  # "write" | "interval"
+        snapshot_path: Optional[str] = None,
+        # journal records (post-checkpoint suffix) that trigger an
+        # automatic checkpoint; None = max(1024, 8 * live objects)
+        checkpoint_records: Optional[int] = None,
+        # wall-clock checkpoint cadence; 0 disables periodic checkpoints
+        # (growth-triggered ones still run)
+        checkpoint_interval_seconds: float = 0.0,
     ):
         self._lock = threading.RLock()
         self._rv = 0
@@ -335,6 +386,7 @@ class Store:
         # OFF the lock — a slow consumer can never stall writers
         self._dispatch_cv = threading.Condition()
         self._dispatch_backlog: deque = deque()
+        self._dispatch_inflight = False
         self._dispatch_thread: Optional[threading.Thread] = None
         # optional api.admission.AdmissionChain: mutate-then-validate on
         # every create/update before the commit (the apiserver admission
@@ -360,6 +412,32 @@ class Store:
         self.journal_recovered_records = 0
         self.journal_tail_truncations = 0
         self.journal_write_errors = 0
+        # checkpoint / recovery state (docs/robustness.md recovery
+        # contract): the snapshot sits next to the journal; recovery
+        # loads it and replays only the journal suffix past its rv.
+        self._snapshot_path = snapshot_path or (
+            journal_path + ".snap" if journal_path else None
+        )
+        self._snapshot_rv = 0       # rv the current snapshot covers
+        self._wave_seq = 0          # update_wave journal grouping id
+        self._checkpoint_records = checkpoint_records
+        self._checkpoint_interval = checkpoint_interval_seconds
+        self._last_checkpoint = time.monotonic()
+        self.checkpoints_total = 0
+        # recoveries that found the snapshot corrupt/unreadable and fell
+        # back to replaying the full journal instead
+        self.snapshot_fallbacks = 0
+        # update_wave suffixes dropped whole at replay (torn final wave
+        # — atomicity preserved, never half-applied)
+        self.journal_torn_waves = 0
+        # last recovery's cost split: objects loaded from the snapshot,
+        # journal records replayed past it, and the wall time both took
+        self.snapshot_records = 0
+        self.journal_suffix_records = 0
+        self.recovery_duration_ms = 0.0
+        # update_wave commits rejected because the caller's FenceToken
+        # no longer matched the Lease (a deposed leader's late wave)
+        self.fenced_writes_total = 0
         # "write": flush per record — every acknowledged write is on
         # disk (etcd's ack-after-fsync contract; the replay test's
         # kill-anywhere guarantee).  "interval": group-commit with a
@@ -368,17 +446,32 @@ class Store:
         # way; our window trades the ack barrier for throughput).
         self._journal_sync = journal_sync
         if journal_path:
-            replayed = self._replay_journal(journal_path)
+            t_rec = time.monotonic()
+            snap_n = self._load_snapshot()
+            applied, lines = self._replay_journal(
+                journal_path, min_rv=self._snapshot_rv
+            )
+            self.snapshot_records = snap_n or 0
+            self.journal_suffix_records = applied
+            self.recovery_duration_ms = (
+                time.monotonic() - t_rec
+            ) * 1000.0
             live = sum(len(objs) for objs in self._objects.values())
-            if replayed > max(1024, 4 * live):
-                # compaction: rewrite history as one ADDED per live object
-                # (the etcd-compaction analogue) — otherwise churny
-                # writers (lease renewals every few seconds) grow the file
-                # and replay time without bound
-                self._compact_journal(journal_path)
-            else:
-                self._journal = open(journal_path, "a")
-                self._journal_records = replayed
+            self._journal = open(journal_path, "a")
+            self._journal_records = lines
+            if lines > max(1024, 4 * live):
+                # replay-time bound: a journal whose suffix dwarfs the
+                # live set (churny writers — lease renewals every few
+                # seconds) is checkpointed right away, so the NEXT
+                # restart pays snapshot + near-empty suffix instead of
+                # replaying history (the etcd-compaction analogue)
+                try:
+                    self._checkpoint_locked()
+                except Exception:  # noqa: BLE001 — durability degradation
+                    self.journal_write_errors += 1
+                    logging.getLogger(__name__).exception(
+                        "post-recovery checkpoint failed; journal kept"
+                    )
             if journal_sync == "interval":
                 # bounds the crash window left by batched flushing: any
                 # record older than _JOURNAL_FLUSH_S is on disk
@@ -428,17 +521,59 @@ class Store:
             return True  # pre-CRC journal line: accept (upgrade path)
         return zlib.crc32(json.dumps(rec).encode()) == crc
 
-    def _replay_journal(self, path: str) -> int:
+    def _replay_journal(
+        self, path: str, min_rv: int = 0
+    ) -> Tuple[int, int]:
+        """Replay the journal; records at or below `min_rv` (covered by
+        the loaded snapshot) are skipped.  update_wave records carry a
+        wave id and a terminator: a wave is buffered and applied only
+        when its terminator arrives, so a torn final wave is dropped
+        WHOLE (truncated like a torn tail — it was never acknowledged
+        durable) and a wave holed by mid-file corruption is skipped
+        whole, never half-applied.  Returns (applied, good_lines)."""
         import json
         import os
 
         from . import wire
 
         if not os.path.exists(path):
-            return 0
+            return 0, 0
         replayed = 0
+        lines = 0
         good_offset = 0
         size = os.path.getsize(path)
+        # wave buffering: (op, rv, kind, key, obj) per pending record
+        pending: List[tuple] = []
+        pending_wid = None
+        pending_offset = 0       # byte offset where the pending wave began
+        dead_waves: set = set()  # wave ids dropped by corruption holes
+
+        def apply(op, rv, kind, key, obj) -> None:
+            nonlocal replayed
+            objs = self._objects.setdefault(kind, {})
+            vers = self._versions.setdefault(kind, {})
+            if op == DELETED:
+                objs.pop(key, None)
+                vers.pop(key, None)
+            else:
+                objs[key] = obj
+                vers[key] = rv
+            self._rv = max(self._rv, rv)
+            replayed += 1
+
+        def drop_pending(why: str) -> None:
+            nonlocal pending, pending_wid
+            if pending:
+                self.journal_torn_waves += 1
+                logging.getLogger(__name__).error(
+                    "journal %s: dropping incomplete wave %s whole "
+                    "(%d records; %s)", path, pending_wid, len(pending),
+                    why,
+                )
+            if pending_wid is not None:
+                dead_waves.add(pending_wid)
+            pending, pending_wid = [], None
+
         with open(path, "rb") as f:
             for raw in f:
                 line = raw.decode(errors="replace").strip()
@@ -468,14 +603,23 @@ class Store:
                         # nothing valid after it): the process died
                         # mid-append; the record was never acknowledged
                         # durable — stop replay and truncate so appends
-                        # continue from the last good line
+                        # continue from the last good line.  A wave the
+                        # torn record belonged to is dropped whole: the
+                        # truncation point backs up to the wave's start.
                         self.journal_tail_truncations += 1
+                        cut = (
+                            pending_offset if pending else good_offset
+                        )
+                        drop_pending("torn tail inside the wave")
                         with open(path, "r+b") as t:
-                            t.truncate(good_offset)
+                            t.truncate(cut)
                         break
                     # mid-file corruption (partial page write): records
                     # AFTER it were acknowledged durable — skip the bad
-                    # line, keep replaying, do NOT truncate them away
+                    # line, keep replaying, do NOT truncate them away.
+                    # A wave holed by the corruption loses its atomicity
+                    # guarantee, so the whole wave is dropped instead.
+                    drop_pending("mid-file corruption inside the wave")
                     logging.getLogger(__name__).error(
                         "journal %s: corrupt record at offset %d "
                         "(not tail); skipping it and keeping later "
@@ -483,47 +627,51 @@ class Store:
                     )
                     good_offset += len(raw)
                     continue
-                objs = self._objects.setdefault(kind, {})
-                vers = self._versions.setdefault(kind, {})
-                if op == DELETED:
-                    objs.pop(key, None)
-                    vers.pop(key, None)
+                lines += 1
+                wid = rec.get("w")
+                if wid is not None:
+                    self._wave_seq = max(self._wave_seq, int(wid))
+                if wid is not None and wid in dead_waves:
+                    good_offset += len(raw)
+                    continue  # straggler of a dropped wave
+                if wid is None:
+                    # a plain record while a wave is open means the wave
+                    # never terminated (should not happen: waves append
+                    # contiguously under the lock) — atomicity wins
+                    drop_pending("unterminated wave before plain record")
+                    if rv > min_rv:
+                        apply(op, rv, kind, key, obj)
                 else:
-                    objs[key] = obj
-                    vers[key] = rv
-                self._rv = max(self._rv, rv)
-                replayed += 1
+                    if pending_wid is not None and wid != pending_wid:
+                        drop_pending("unterminated wave before next wave")
+                    if not pending:
+                        pending_offset = good_offset
+                    pending_wid = wid
+                    if rv > min_rv:
+                        pending.append((op, rv, kind, key, obj))
+                    if rec.get("wz"):
+                        # terminator: the whole wave is on disk — commit
+                        for entry in pending:
+                            apply(*entry)
+                        pending, pending_wid = [], None
                 good_offset += len(raw)
-        return replayed
+            else:
+                if pending:
+                    # EOF with an open wave: the terminator never made
+                    # it to disk — drop the wave whole and truncate so
+                    # appends continue from before it
+                    drop_pending("torn final wave (no terminator)")
+                    self.journal_tail_truncations += 1
+                    with open(path, "r+b") as t:
+                        t.truncate(pending_offset)
+        return replayed, lines
 
-    def _compact_journal(self, path: str) -> None:
-        """Rewrite history as one ADDED per live object, crash-safely:
-        write-temp, flush+fsync the temp, then atomic rename — a crash
-        at ANY point leaves either the old journal or the complete new
-        one, never a half-written mix (the etcd snapshot+WAL-rotation
-        discipline)."""
+    @staticmethod
+    def _fsync_dir(path: str) -> None:
+        """fsync the directory holding `path` so a rename into it is
+        itself durable."""
         import os
 
-        from . import wire
-
-        tmp = path + ".compact"
-        n = 0
-        with open(tmp, "w") as f:
-            for kind, objs in self._objects.items():
-                for key, obj in objs.items():
-                    rec = {
-                        "op": ADDED,
-                        "rv": self._versions[kind][key],
-                        "kind": kind,
-                        "key": key,
-                        "obj": wire.to_wire(obj),
-                    }
-                    f.write(self._encode_record(rec))
-                    n += 1
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-        # fsync the directory so the rename itself is durable
         try:
             dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
             try:
@@ -532,8 +680,132 @@ class Store:
                 os.close(dfd)
         except OSError:
             pass  # platform without directory fsync
-        self._journal = open(path, "a")
-        self._journal_records = n
+
+    def _load_snapshot(self) -> Optional[int]:
+        """Load the checkpoint snapshot into empty object maps; returns
+        the record count, or None when the snapshot is absent OR corrupt
+        (any CRC/parse failure, a record-count mismatch against the
+        header, a missing header).  Corruption rolls the maps back to
+        empty and counts `snapshot_fallbacks` — the caller falls back to
+        replaying the full journal, so a damaged snapshot degrades
+        recovery time, never correctness.  Runs from __init__ before the
+        store is shared."""
+        import json
+        import os
+
+        from . import wire
+
+        path = self._snapshot_path
+        if path is None or not os.path.exists(path):
+            return None
+        objects: Dict[str, Dict[str, Any]] = {}
+        versions: Dict[str, Dict[str, int]] = {}
+        header = None
+        n = 0
+        max_rv = 0
+        try:
+            with open(path, "rb") as f:
+                for raw in f:
+                    line = raw.decode(errors="replace").strip()
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    if not isinstance(rec, dict):
+                        raise ValueError("snapshot record is not an object")
+                    crc = rec.pop("crc", None)
+                    if not self._record_crc_ok(rec, crc):
+                        raise ValueError("snapshot record crc mismatch")
+                    if header is None:
+                        if "snapshot_rv" not in rec:
+                            raise ValueError("snapshot header missing")
+                        header = rec
+                        continue
+                    rv, kind, key = rec["rv"], rec["kind"], rec["key"]
+                    obj = wire.from_wire(rec["obj"])
+                    objects.setdefault(kind, {})[key] = obj
+                    versions.setdefault(kind, {})[key] = rv
+                    max_rv = max(max_rv, rv)
+                    n += 1
+            if header is None or n != header["records"]:
+                raise ValueError(
+                    f"snapshot truncated: {n} records, header says "
+                    f"{header['records'] if header else '?'}"
+                )
+        except Exception:  # noqa: BLE001 — recovery containment
+            self.snapshot_fallbacks += 1
+            logging.getLogger(__name__).exception(
+                "snapshot %s corrupt; falling back to full journal "
+                "replay", path,
+            )
+            return None
+        self._objects = objects
+        self._versions = versions
+        self._rv = max(int(header["snapshot_rv"]), max_rv)
+        self._snapshot_rv = int(header["snapshot_rv"])
+        return n
+
+    def checkpoint(self, truncate: bool = True) -> int:
+        """Write a point-in-time snapshot of every live object and (by
+        default) truncate the journal past the checkpoint rv, bounding
+        the next recovery to snapshot + journal suffix.  Crash-safe by
+        construction: the snapshot is written to a temp file, flushed,
+        fsynced, then atomically renamed over the old one (directory
+        fsynced too) — a crash at ANY point leaves the previous snapshot
+        or the complete new one; the journal is only truncated AFTER the
+        snapshot is durable, so history is never lost to a half-written
+        checkpoint.  ``truncate=False`` keeps the journal (full-replay
+        oracle mode — the chaos suite's bit-parity check; recovery
+        skips journal records the snapshot already covers).  Returns the
+        snapshot's record count."""
+        with self._lock:
+            return self._checkpoint_locked(truncate=truncate)
+
+    def _checkpoint_locked(self, truncate: bool = True) -> int:
+        import os
+
+        from . import wire
+
+        path = self._journal_path
+        if path is None or self._snapshot_path is None:
+            return 0
+        faults.fire("store.checkpoint")
+        tmp = self._snapshot_path + ".tmp"
+        n = sum(len(objs) for objs in self._objects.values())
+        with open(tmp, "w") as f:
+            f.write(self._encode_record(
+                {"snapshot_rv": self._rv, "records": n}
+            ))
+            for kind, objs in self._objects.items():
+                for key, obj in objs.items():
+                    f.write(self._encode_record({
+                        "op": ADDED,
+                        "rv": self._versions[kind][key],
+                        "kind": kind,
+                        "key": key,
+                        "obj": wire.to_wire(obj),
+                    }))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._snapshot_path)
+        self._fsync_dir(self._snapshot_path)
+        self._snapshot_rv = self._rv
+        self.snapshot_records = n
+        self.checkpoints_total += 1
+        self._last_checkpoint = time.monotonic()
+        if truncate:
+            # everything at or below the snapshot rv is covered by the
+            # durable snapshot; the journal restarts empty
+            if self._journal is not None:
+                try:
+                    self._journal.close()
+                except (OSError, ValueError):
+                    pass
+            with open(path, "w") as jf:
+                jf.flush()
+                os.fsync(jf.fileno())
+            self._journal = open(path, "a")
+            self._journal_records = 0
+        return n
 
     def _flush_journal(self) -> None:
         # caller holds the lock
@@ -575,14 +847,19 @@ class Store:
             return
         self._journal_records += len(lines)
         live = sum(len(objs) for objs in self._objects.values())
-        if self._journal_records > max(1024, 8 * max(live, 1)):
+        threshold = self._checkpoint_records or max(1024, 8 * max(live, 1))
+        due = (
+            self._checkpoint_interval > 0
+            and time.monotonic() - self._last_checkpoint
+            >= self._checkpoint_interval
+        )
+        if self._journal_records > threshold or due:
             try:
-                self._journal.close()
-                self._compact_journal(self._journal_path)
+                self._checkpoint_locked()
             except Exception:  # noqa: BLE001
                 self.journal_write_errors += 1
                 logging.getLogger(__name__).exception(
-                    "journal compaction failed; reopening for append"
+                    "checkpoint failed; reopening journal for append"
                 )
                 if self._journal is None or self._journal.closed:
                     self._journal = open(self._journal_path, "a")
@@ -785,6 +1062,7 @@ class Store:
         updates: List[Tuple[str, str, Callable[[Any], None]]],
         *,
         admit: bool = True,
+        fence: Optional[FenceToken] = None,
     ) -> Tuple[List[str], Dict[str, Exception]]:
         """Commit a wave of read-modify-write updates as ONE transaction.
 
@@ -807,13 +1085,41 @@ class Store:
         copy): stored objects are never mutated in place after commit and
         watch consumers already share one Event payload across every
         watcher, so the alias adds no new mutability hazard — it removes
-        the single biggest per-pod cost of a 1k-pod bind wave."""
+        the single biggest per-pod cost of a 1k-pod bind wave.
+
+        `fence` (a FenceToken) makes the wave a LEADERSHIP-CONDITIONAL
+        transaction: under the store lock, the named Lease must still be
+        held by the token's identity at the token's acquisition
+        generation, or the whole wave is rejected with `Fenced` (counted
+        in `fenced_writes_total`) — a deposed leader's late bind wave
+        can never double-bind behind its successor's back (the etcd
+        lease-ownership txn compare)."""
         faults.fire("store.update_wave", kind=kind, updates=len(updates))
         applied: List[str] = []
         errors: Dict[str, Exception] = {}
         events: List[Event] = []
         records: List[Tuple[str, str, Any, int]] = []
         with self._lock:
+            if fence is not None:
+                lease = self._objects.get("Lease", {}).get(
+                    _key(fence.namespace, fence.name)
+                )
+                spec = getattr(lease, "spec", None)
+                if (
+                    spec is None
+                    or spec.holder_identity != fence.identity
+                    or (
+                        fence.generation is not None
+                        and spec.lease_transitions != fence.generation
+                    )
+                ):
+                    self.fenced_writes_total += 1
+                    holder = getattr(spec, "holder_identity", None)
+                    raise Fenced(
+                        f"wave fenced: lease {fence.namespace}/"
+                        f"{fence.name} held by {holder!r}, caller "
+                        f"{fence.identity!r} gen {fence.generation}"
+                    )
             objs = self._objects.get(kind, {})
             vers = self._versions.setdefault(kind, {})
             for name, namespace, mutate in updates:
@@ -858,14 +1164,22 @@ class Store:
     def _append_journal_wave(
         self, kind: str, records: List[Tuple[str, str, Any, int]]
     ) -> None:
-        # caller holds the lock; one write + one flush for the wave
+        # caller holds the lock; one write + one flush for the wave.
+        # Every record carries the wave id ("w") and the last one the
+        # terminator ("wz"): replay applies the wave atomically — a tail
+        # torn anywhere inside it drops the WHOLE wave, so a recovered
+        # store never holds half a bind wave.
         if self._journal is None:
             return
         from . import wire
 
+        self._wave_seq += 1
+        wid = self._wave_seq
         lines = []
-        for op, key, obj, rv in records:
-            rec = {"op": op, "rv": rv, "kind": kind, "key": key}
+        for i, (op, key, obj, rv) in enumerate(records):
+            rec = {"op": op, "rv": rv, "kind": kind, "key": key, "w": wid}
+            if i == len(records) - 1:
+                rec["wz"] = 1
             if op != DELETED:
                 rec["obj"] = wire.to_wire(obj)
             lines.append(self._encode_record(rec))
@@ -1012,6 +1326,59 @@ class Store:
                 "watchers_terminated": self.watchers_terminated,
             }
 
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Graceful shutdown: drain the watch-dispatch backlog (pending
+        committed batches reach their watchers), then flush AND fsync
+        the journal before returning — under ``journal_sync="interval"``
+        the final dirty group-commit batch would otherwise sit in the
+        userspace buffer and die with the process.  The store stops
+        journaling afterwards; reads keep working (tests inspect closed
+        stores)."""
+        import os
+
+        deadline = time.monotonic() + timeout
+        with self._dispatch_cv:
+            while (
+                (self._dispatch_backlog or self._dispatch_inflight)
+                and time.monotonic() < deadline
+            ):
+                self._dispatch_cv.wait(0.05)
+        with self._lock:
+            j, self._journal = self._journal, None
+            self._journal_dirty = False
+        if j is not None:
+            try:
+                j.flush()
+                os.fsync(j.fileno())
+                j.close()
+            except (OSError, ValueError):
+                logging.getLogger(__name__).exception(
+                    "journal close flush failed; tail durability degraded"
+                )
+
+    def state_fingerprint(self) -> Dict[str, Any]:
+        """A stable, comparison-friendly serialization of the full
+        committed state: store rv plus (kind, key) -> (rv, wire(obj)).
+        Two stores with equal fingerprints hold bit-identical state —
+        the chaos suite compares snapshot+suffix recovery against a
+        full-replay oracle with this."""
+        from . import wire
+
+        with self._lock:
+            return {
+                "rv": self._rv,
+                "objects": {
+                    kind: {
+                        key: (self._versions[kind][key], wire.to_wire(obj))
+                        for key, obj in sorted(objs.items())
+                    }
+                    for kind, objs in sorted(self._objects.items())
+                    if objs
+                },
+            }
+
     # -- convenience -------------------------------------------------------
 
     @property
@@ -1040,6 +1407,9 @@ def _watch_dispatch_loop(store_ref: "weakref.ref[Store]") -> None:
                 store._dispatch_cv.wait(0.2)
             if store._dispatch_backlog:
                 batch = store._dispatch_backlog.popleft()
+                # close() waits for backlog-empty AND not-inflight, so a
+                # batch mid-fan-out still blocks a graceful shutdown
+                store._dispatch_inflight = True
         if batch is not None:
             try:
                 store._fan_out(*batch)
@@ -1047,6 +1417,10 @@ def _watch_dispatch_loop(store_ref: "weakref.ref[Store]") -> None:
                 logging.getLogger(__name__).exception(
                     "watch fan-out batch failed; continuing"
                 )
+            finally:
+                with store._dispatch_cv:
+                    store._dispatch_inflight = False
+                    store._dispatch_cv.notify_all()
         # drop the strong reference before sleeping so GC can collect
         # an otherwise-abandoned store
         store = None

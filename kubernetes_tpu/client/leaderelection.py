@@ -49,6 +49,12 @@ class LeaderElector:
         # renew attempts that raised (store fault, injected failure) and
         # were treated as a failed renew rather than killing the loop
         self.renew_errors = 0
+        # lease_transitions observed when THIS identity last acquired:
+        # the write-fencing generation (Store.update_wave fence=...).
+        # Written only by the elector thread; read cross-thread as one
+        # atomic int (a stale read just means a fenced commit, which is
+        # the safe direction).  -1 = never acquired.
+        self._generation = -1
 
     # -- the tryAcquireOrRenew step ----------------------------------------
 
@@ -71,6 +77,7 @@ class LeaderElector:
             )
             try:
                 self.store.create(lease)
+                self._generation = 0  # first acquisition of a new lease
                 return True
             except st.AlreadyExists:
                 return False  # raced; retry next period
@@ -88,9 +95,26 @@ class LeaderElector:
             spec.lease_transitions += 1
         try:
             self.store.update(lease)
+            self._generation = spec.lease_transitions
             return True
         except (st.Conflict, st.NotFound):
             return False  # raced with another candidate; retry
+
+    def fence_token(self) -> Optional[st.FenceToken]:
+        """The write-fencing proof for Store.update_wave: this
+        identity's lease coordinates at its LAST acquisition.  Returned
+        even after leadership is lost — a deposed leader's late wave
+        must carry its stale token so the store can reject it (no token
+        would mean no fencing at all).  None only before the first
+        acquisition."""
+        if self._generation < 0:
+            return None
+        return st.FenceToken(
+            name=self.lease_name,
+            namespace=self.namespace,
+            identity=self.identity,
+            generation=self._generation,
+        )
 
     # -- run loop ----------------------------------------------------------
 

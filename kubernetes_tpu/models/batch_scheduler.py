@@ -135,6 +135,16 @@ class SolveCircuitBreaker:
             self.state = self.OPEN
             self._open_until = self._clock() + self.cooldown
 
+    def reset(self) -> None:
+        """Snap the breaker to closed with no cooldown pending.
+        Leadership reconciliation uses this on takeover/restart: the
+        open state belongs to the predecessor's device history — the new
+        leader re-probes the device instead of inheriting a cooldown it
+        never observed (worst case is one retry + re-trip)."""
+        with self._lock:
+            self.state = self.CLOSED
+            self._open_until = 0.0
+
 
 class HostSolve:
     """A completed host-fallback solve quacking like DeviceSolve: names

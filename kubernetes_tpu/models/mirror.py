@@ -183,6 +183,19 @@ class DeviceClusterMirror:
             "delta_syncs": self.delta_syncs,
         }
 
+    def invalidate(self) -> None:
+        """Drop the resident copy so the next sync() performs a full
+        (RESHARDED, under a mesh) re-upload.  Leadership reconciliation
+        calls this on takeover/restart: the delta protocol assumes the
+        resident tensors match some past generation of THIS state's
+        history, which a rebuilt or reconciled cache no longer
+        guarantees.  Caller holds the cache lock (same contract as
+        sync())."""
+        self._dev = None
+        self._synced_gen = 0
+        self._struct_gen = 0
+        self._shape = None
+
     def _full_upload(self, host: schema.ClusterTensors) -> schema.ClusterTensors:
         # host-copy before device_put: on the CPU backend device_put can
         # zero-copy a numpy view, which would alias live cache state

@@ -199,6 +199,15 @@ class MetricsCollector:
         "scheduler_binder_restarts_total",
         "scheduler_binder_poison_waves_total",
         "scheduler_journal_recovered_records",
+        # crash-restart recovery: store snapshot/suffix recovery cost,
+        # checkpoint count, stale-leader fenced waves, and leadership
+        # reconciliations (docs/robustness.md recovery contract)
+        "scheduler_store_recovery_duration_ms",
+        "scheduler_store_snapshot_records",
+        "scheduler_store_journal_suffix_records",
+        "scheduler_store_checkpoints_total",
+        "scheduler_fenced_writes_total",
+        "scheduler_leader_reconcile_total",
         # overload protection: watch fan-out backpressure + adaptive
         # batch window (docs/robustness.md)
         "scheduler_watch_queue_depth",

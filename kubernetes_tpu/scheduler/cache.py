@@ -174,6 +174,24 @@ class SchedulerCache:
         with self._lock:
             return pod_key(pod) in self._assumed
 
+    def assumed_nodes(self) -> Dict[str, str]:
+        """Snapshot of the assume set: pod key -> assumed node (the
+        leadership-reconciliation sweep walks this against the store)."""
+        with self._lock:
+            return {k: a.node for k, a in self._assumed.items()}
+
+    def forget_key(self, key: str, node: Optional[str] = None) -> bool:
+        """forget() by key — with `node`, only when the entry still
+        points at that node (a confirm that raced the reconcile sweep
+        must win).  Returns True when an entry was released."""
+        with self._lock:
+            a = self._assumed.get(key)
+            if a is None or (node is not None and a.node != node):
+                return False
+            self._assumed.pop(key)
+            self.state.remove_pod(a.pod)
+            return True
+
     # -- bound pods (informer-fed) ----------------------------------------
 
     def _account(self, pod: api.Pod) -> None:

@@ -236,6 +236,32 @@ class Registry:
         self.journal_recovered_records = Gauge(
             "scheduler_journal_recovered_records"
         )
+        # -- crash-restart recovery surface (docs/robustness.md) ----------
+        # wall time the store's last recovery took (snapshot load +
+        # journal suffix replay), mirrored from the store
+        self.store_recovery_duration_ms = Gauge(
+            "scheduler_store_recovery_duration_ms"
+        )
+        # objects the last recovery loaded from the checkpoint snapshot
+        self.store_snapshot_records = Gauge(
+            "scheduler_store_snapshot_records"
+        )
+        # journal records the last recovery replayed past the snapshot
+        self.store_journal_suffix_records = Gauge(
+            "scheduler_store_journal_suffix_records"
+        )
+        # checkpoints the store has taken (growth/interval/manual)
+        self.store_checkpoints_total = Gauge(
+            "scheduler_store_checkpoints_total"
+        )
+        # bind waves the store rejected because the committing leader's
+        # fence token was stale (a deposed leader's late wave)
+        self.fenced_writes_total = Gauge("scheduler_fenced_writes_total")
+        # leadership/restart reconciliations the scheduler ran (start,
+        # takeover, reacquisition)
+        self.leader_reconcile_total = Counter(
+            "scheduler_leader_reconcile_total"
+        )
         # XLA traces of the solver executables observed by the
         # recompile-discipline runtime tracker (analysis/retrace.py),
         # mirrored each cycle when the tracker is armed (bench runs,

@@ -702,6 +702,13 @@ class SchedulingQueue:
         with self._cond:
             return len(self._infos)
 
+    def contains(self, key: str) -> bool:
+        """True when the pod is known to the queue in ANY tier (incl.
+        gated/staged/inflight) — the leadership-reconciliation sweep
+        uses this to find pods a crashed predecessor stranded."""
+        with self._cond:
+            return key in self._infos
+
     def close(self) -> None:
         with self._cond:
             self._closed = True

@@ -291,6 +291,24 @@ class Scheduler:
         # replicated schedulers, single active) — standbys keep informers
         # warm so takeover is immediate.
         self.leader_elector = leader_elector
+        # Leadership/restart reconciliation (docs/robustness.md): the
+        # flag starts SET so the first leading pass of the hot loop
+        # reconciles local pipeline state against the store — covering
+        # process restart AND an elector that acquired before this
+        # scheduler attached; every later acquisition re-sets it.  The
+        # reconcile itself runs on the scheduling thread (never the
+        # elector thread, whose renew cadence it must not delay).
+        self._reconcile_needed = threading.Event()
+        self._reconcile_needed.set()
+        if leader_elector is not None:
+            prev_cb = leader_elector.on_started_leading
+
+            def _on_started_leading():
+                self._reconcile_needed.set()
+                if prev_cb:
+                    prev_cb()
+
+            leader_elector.on_started_leading = _on_started_leading
         self._clock = clock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -452,6 +470,100 @@ class Scheduler:
         self._bind_thread.join(timeout=10)
         self.informers.stop()
         self.events.stop()
+
+    def kill(self) -> None:
+        """Ungraceful teardown — the chaos harness's process-death
+        analogue.  Nothing drains: staged bind waves are dropped on the
+        floor and assumed pods are abandoned, exactly what a SIGKILL'd
+        scheduler leaves behind (the successor's reconciliation and the
+        store's durable state are what recover them).  Never use outside
+        crash-restart tests; stop() is the graceful path."""
+        self._stop.set()
+        self.queue.close()
+        with self._wave_cv:
+            self._binder_stop = True
+            self._waves.clear()
+            self._wave_cv.notify_all()
+        if self._thread:
+            self._thread.join(timeout=10)
+        self._bind_thread.join(timeout=5)
+        self.informers.stop()
+        self.events.stop()
+
+    # -- leadership / restart reconciliation -------------------------------
+
+    def _reconcile_leadership(self) -> None:
+        """Make local pipeline state agree with the STORE before the
+        first post-acquisition dispatch (on_started_leading's analogue
+        of the reference's WaitForCacheSync + queue flush).  A new
+        leader — fresh process after a crash, or a warm standby taking
+        over — must not trust caches built under someone else's
+        leadership:
+
+          * every assumed entry is checked against the store: a pod the
+            predecessor (or this process, pre-crash) assumed but never
+            durably committed is forgotten and re-queued; a pod the
+            store says landed elsewhere is forgotten (the informer
+            re-accounts it); a matching bind is kept for the informer to
+            confirm;
+          * unbound pods missing from the queue entirely (an informer
+            gap across the handoff) are swept from the store into it —
+            the no-pod-lost floor does not depend on event delivery
+            across a leadership boundary;
+          * the device mirror is invalidated (next solve performs a full
+            RESHARDED re-upload — the delta protocol's resident copy
+            belongs to the predecessor's generation history) and the
+            solve breaker resets to closed (the cooldown belonged to the
+            predecessor's device, not ours).
+
+        Bound-exactly-once across the boundary needs no work here: the
+        store is the source of truth, bound pods arrive through the
+        informer as bound (never queued), and the wave mutator + write
+        fencing reject any late commit that disagrees."""
+        log = logging.getLogger(__name__)
+        requeued = 0
+        try:
+            pods, _ = self.store.list("Pod")
+        except Exception:  # noqa: BLE001 — retry next cycle
+            log.exception("leadership reconcile: store list failed")
+            self._reconcile_needed.set()
+            return
+        by_key = {pod_key(p): p for p in pods}
+        for key, node in self.cache.assumed_nodes().items():
+            cur = by_key.get(key)
+            if cur is not None and cur.spec.node_name == node:
+                continue  # durably bound where assumed; informer confirms
+            self.cache.forget_key(key, node)
+            if cur is not None and not cur.spec.node_name:
+                # assumed but never committed: give it back to the queue
+                self.queue.add(cur)
+                requeued += 1
+        # store sweep: unbound pods the queue does not know (popped by a
+        # crashed predecessor, or an event lost across the handoff)
+        for key, pod in by_key.items():
+            if pod.spec.node_name or self.profiles.for_pod(pod) is None:
+                continue
+            if self.cache.is_assumed(pod):
+                continue
+            if not self.queue.contains(key):
+                self.queue.add(pod)
+                requeued += 1
+        # device-side state: full mirror re-upload + breaker to closed
+        for fwk in self.profiles:
+            tpu = fwk.tpu
+            mirror = getattr(tpu, "_mirror", None)
+            if mirror is not None:
+                with self.cache.lock:
+                    mirror.invalidate()
+            breaker = getattr(tpu, "breaker", None)
+            if breaker is not None:
+                breaker.reset()
+        self.metrics.leader_reconcile_total.inc()
+        if requeued:
+            log.info(
+                "leadership reconcile: re-queued %d uncommitted pod(s)",
+                requeued,
+            )
 
     # -- binding stage (the dedicated bind worker) -------------------------
 
@@ -629,8 +741,26 @@ class Scheduler:
                  bind_mutator(node_name))
                 for _, info, node_name, _ in binds
             ]
+            # stale-leader write fencing: the wave commits only while
+            # our lease acquisition is still current (a deposed
+            # leader's late wave is rejected inside the transaction —
+            # the Fenced path below requeues; the pods belong to the
+            # successor now)
+            fence = None
+            if self.leader_elector is not None:
+                token = getattr(self.leader_elector, "fence_token", None)
+                if token is not None:
+                    fence = token()
             try:
-                _, errors = self.store.update_wave("Pod", updates)
+                _, errors = self.store.update_wave(
+                    "Pod", updates, fence=fence
+                )
+            except st.Fenced:
+                logging.getLogger(__name__).warning(
+                    "bind wave fenced (leadership lost since staging); "
+                    "requeueing %d pod(s) for the new leader", len(binds),
+                )
+                errors = None  # whole wave requeued, no retry value
             except Exception:  # noqa: BLE001
                 logging.getLogger(__name__).exception(
                     "wave transaction failed; splitting to per-pod requeue"
@@ -684,6 +814,16 @@ class Scheduler:
                 cycle = self._finish_contained(cycle)
                 time.sleep(0.05)
                 continue
+            if self._reconcile_needed.is_set():
+                # first pass after start or (re)acquired leadership:
+                # reconcile local state against the store BEFORE popping
+                self._reconcile_needed.clear()
+                try:
+                    self._reconcile_leadership()
+                except Exception:  # noqa: BLE001 — containment
+                    logging.getLogger(__name__).exception(
+                        "leadership reconcile failed; continuing"
+                    )
             try:
                 # with a solve in flight, the pop is the OVERLAP window —
                 # bound it by the accumulation window so staging of the
@@ -1044,6 +1184,21 @@ class Scheduler:
         recovered = getattr(self.store, "journal_recovered_records", None)
         if recovered is not None:
             self.metrics.journal_recovered_records.set(float(recovered))
+        # crash-restart recovery surface: the store's last recovery cost
+        # split, checkpoint count, and fenced late-leader waves
+        for attr, gauge in (
+            ("recovery_duration_ms", self.metrics.store_recovery_duration_ms),
+            ("snapshot_records", self.metrics.store_snapshot_records),
+            (
+                "journal_suffix_records",
+                self.metrics.store_journal_suffix_records,
+            ),
+            ("checkpoints_total", self.metrics.store_checkpoints_total),
+            ("fenced_writes_total", self.metrics.fenced_writes_total),
+        ):
+            v = getattr(self.store, attr, None)
+            if v is not None:
+                gauge.set(float(v))
         # watch fan-out health: mirror the store's backpressure counters
         # (depth / coalesced / expired) and any legacy terminations
         watch_stats = getattr(self.store, "watch_stats", None)

@@ -3,8 +3,9 @@ pipeline.
 
 The registry is the chaos suite's only lever: named fault points are
 threaded through the hot path (journal append/fsync, the wave
-transaction, watch fan-out and the consumer side of watch streams, the
-list/relist path, the device solve, the binder commit, lease renewal)
+transaction, the checkpoint writer, watch fan-out and the consumer side
+of watch streams, the list/relist path, the device solve, the binder
+commit, lease renewal)
 and each point consults the armed registry through one module-level
 indirection.  Disarmed — the production state — the check
 is a single global load and an early return, so the hot path pays
@@ -48,6 +49,7 @@ KNOWN_POINTS = frozenset({
     "store.journal.append",
     "store.journal.fsync",
     "store.update_wave",
+    "store.checkpoint",
     "store.list",
     "watch.offer",
     "watch.consume",
@@ -247,3 +249,37 @@ def fire(point: str, **ctx):
     if reg is None:
         return None
     return reg.fire(point, **ctx)
+
+
+# -- crash-restart harness ---------------------------------------------------
+#
+# The kill-restart chaos suite simulates process death WITHOUT fd
+# hackery on the live store: a SIGKILL's disk image is exactly "the
+# filesystem's bytes right now, minus whatever still sits in userspace
+# buffers" — and copying the journal/snapshot files through the
+# filesystem reproduces that by construction (a copy reads what the OS
+# has, never what the dying process buffered).  The restarted store
+# opens the image; the original store object is torn down ungracefully
+# (Scheduler.kill(), no Store.close()) and abandoned.
+
+
+def crash_disk_image(journal_path: str, dest_dir: str) -> str:
+    """Capture the post-SIGKILL on-disk state of a journaled store:
+    copy the journal and its checkpoint snapshot (if present) into
+    `dest_dir` as they exist on the filesystem RIGHT NOW.  Returns the
+    copied journal path — hand it to ``Store(journal_path=...)`` to
+    'restart' the killed store.  Call while the victim is still live
+    (or already abandoned); the copy never touches its file handles."""
+    import os
+    import shutil
+
+    os.makedirs(dest_dir, exist_ok=True)
+    dest = os.path.join(dest_dir, os.path.basename(journal_path))
+    if os.path.exists(journal_path):
+        shutil.copyfile(journal_path, dest)
+    else:
+        open(dest, "w").close()
+    snap = journal_path + ".snap"
+    if os.path.exists(snap):
+        shutil.copyfile(snap, dest + ".snap")
+    return dest

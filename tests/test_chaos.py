@@ -367,6 +367,326 @@ def test_chaos_slow_consumer(seed):
             sched.stop()
 
 
+# -- kill-restart chaos: crash a component, restart it, prove parity ---------
+#
+# Each seed tears a component down at one of the registered crash
+# families and brings the control plane back:
+#
+#   family 0 (store)  — FaultCrash mid-fsync (plus an optional torn wave
+#       append): the whole control plane is killed ungracefully, the
+#       store "restarts" from its post-SIGKILL disk image
+#       (faults.crash_disk_image), and recovery = snapshot + journal
+#       suffix;
+#   family 1 (binder) — FaultCrash mid-bind-wave, then the same full
+#       kill + disk-image restart;
+#   family 2 (leader) — renew failures while the LEADER scheduler is
+#       killed mid-pop-window; a warm standby takes over on the live
+#       store (no restart) and reconciles.
+#
+# Invariants on top of the PR 3 set: no pod lost (a create whose ack
+# died with the process is retried by the client, as a real writer
+# would), no durable bind ever moves across the boundary, rv stays
+# monotonic across the restart, recovered state never contradicts the
+# acked state, and snapshot+suffix recovery is BIT-IDENTICAL to a
+# full-journal-replay oracle over the same disk image.
+
+RESTART_SEEDS = list(range(300, 310))
+
+
+def _restart_fault_plan(rng: random.Random, family: int) -> faults.FaultRegistry:
+    reg = faults.FaultRegistry(seed=rng.randint(0, 2 ** 31))
+    if family == 0:
+        reg.crash("store.journal.fsync", n=1)
+        if rng.random() < 0.5:
+            reg.torn_write("store.journal.append", frac=rng.random(), n=1)
+    elif family == 1:
+        reg.crash("binder.commit_wave", n=1)
+        reg.delay("binder.commit_wave", seconds=0.005, n=2)
+    else:
+        reg.fail("leader.renew", n=rng.randint(1, 2))
+        reg.delay("binder.commit_wave", seconds=0.005, n=2)
+    return reg
+
+
+def _restart_config():
+    return SchedulerConfiguration(
+        pod_initial_backoff_seconds=0.05,
+        pod_max_backoff_seconds=0.4,
+        batch_window_seconds=0.01,
+        unschedulable_flush_seconds=0.5,
+    )
+
+
+def _create_pods(store, rng, names, pace=0.01):
+    """Paced creates; a FaultCrash landing on the creating thread (the
+    injected process death) stops the stream — the caller kills the
+    control plane and the restarted run's client retries the remainder."""
+    created = []
+    for name in names:
+        try:
+            store.create(
+                make_pod(name).req(
+                    cpu_milli=rng.choice([50, 100, 200]),
+                    mem=rng.choice([GI // 4, GI // 2]),
+                ).obj()
+            )
+        except BaseException:  # noqa: BLE001 — injected crash/fault
+            break
+        created.append(name)
+        if rng.random() < 0.3:
+            time.sleep(rng.random() * pace)
+    return created
+
+
+def _retry_missing_pods(store, rng, names):
+    """The client half of ack-loss recovery: re-create any pod whose
+    acknowledged create did not survive the crash (a real writer's
+    retry-on-timeout loop)."""
+    have = {p.meta.name for p in store.list("Pod")[0]}
+    for name in names:
+        if name not in have:
+            store.create(
+                make_pod(name).req(
+                    cpu_milli=rng.choice([50, 100, 200]),
+                    mem=rng.choice([GI // 4, GI // 2]),
+                ).obj()
+            )
+
+
+def _wait_all_bound(store, seed, deadline_s=90, label=""):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        pods, _ = store.list("Pod")
+        if pods and all(p.spec.node_name for p in pods):
+            return pods
+        time.sleep(0.1)
+    pods, _ = store.list("Pod")
+    unbound = [p.meta.name for p in pods if not p.spec.node_name]
+    assert not unbound, (
+        f"seed {seed}: pods unbound past quiesce{label}: {unbound}"
+    )
+    return pods
+
+
+def _fingerprint_json(store):
+    import json
+
+    return json.dumps(store.state_fingerprint(), sort_keys=True)
+
+
+def _wait_reconciled(sched, seed, timeout=10.0):
+    """The takeover reconcile runs on the scheduling thread's first
+    LEADING pass — an instantly-quiescent cluster can reach the
+    assertions before that pass happens, so poll."""
+    deadline = time.monotonic() + timeout
+    while (
+        sched.metrics.leader_reconcile_total.total < 1.0
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.05)
+    assert sched.metrics.leader_reconcile_total.total >= 1.0, (
+        f"seed {seed}: takeover reconciliation never ran"
+    )
+
+
+@pytest.mark.restart
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+@pytest.mark.parametrize("seed", RESTART_SEEDS)
+def test_chaos_kill_restart(seed, tmp_path):
+    rng = random.Random(seed)
+    family = seed % 3
+    reg = _restart_fault_plan(rng, family)
+    path = str(tmp_path / "journal.jsonl")
+    store = st.Store(journal_path=path)
+    audit = _EventAudit(store)
+    for i in range(rng.randint(4, 8)):
+        store.create(
+            make_node(f"n{i}")
+            .capacity(cpu_milli=8000, mem=16 * GI, pods=110)
+            .zone(f"z{i % 3}")
+            .obj()
+        )
+    elector = LeaderElector(
+        store, "restart-sched", f"holder-{seed}-a",
+        lease_duration=0.8, renew_period=0.05,
+    ).start()
+    sched = Scheduler(
+        store, assume_ttl=1.0, leader_elector=elector,
+        config=_restart_config(),
+    )
+    n_pods = rng.randint(24, 40)
+    all_names = [f"p{i}" for i in range(n_pods)]
+    cut = rng.randint(8, n_pods - 8)
+    standby = standby_elector = None
+    try:
+        sched.start()
+        assert elector.wait_for_leadership(10)
+        # phase 1 (unarmed): a healthy prefix binds, then a checkpoint
+        # so recovery exercises snapshot + suffix (truncate=False keeps
+        # the full journal for the bit-parity oracle)
+        _create_pods(store, rng, all_names[:cut])
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            pods, _ = store.list("Pod")
+            if pods and all(p.spec.node_name for p in pods):
+                break
+            time.sleep(0.05)
+        store.checkpoint(truncate=False)
+        # phase 2 (armed): the crash schedule fires somewhere in the
+        # second half of the stream
+        with faults.armed(reg):
+            created = _create_pods(store, rng, all_names[cut:])
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and not reg.fired:
+                time.sleep(0.05)
+            # give the wounded pipeline a beat so the kill lands on
+            # mid-flight state (popped batches, staged waves)
+            time.sleep(rng.random() * 0.3)
+
+            if family == 2:
+                # warm leader failover: standby on the SAME store; kill
+                # the leader mid-pop-window (creates still arriving)
+                standby_elector = LeaderElector(
+                    store, "restart-sched", f"holder-{seed}-b",
+                    lease_duration=0.8, renew_period=0.05,
+                ).start()
+                standby = Scheduler(
+                    store, assume_ttl=1.0, leader_elector=standby_elector,
+                    config=_restart_config(),
+                )
+                standby.start()
+                durable = {
+                    f"{p.meta.namespace}/{p.meta.name}": p.spec.node_name
+                    for p in store.list("Pod")[0]
+                    if p.spec.node_name
+                }
+                sched.kill()
+                elector.stop(release=False)
+                assert standby_elector.wait_for_leadership(15), (
+                    f"seed {seed}: standby never took over"
+                )
+                _retry_missing_pods(store, rng, all_names)
+                pods = _wait_all_bound(store, seed, label=" (failover)")
+                final = {
+                    f"{p.meta.namespace}/{p.meta.name}": p.spec.node_name
+                    for p in pods
+                }
+                for key, node in durable.items():
+                    assert final.get(key) == node, (
+                        f"seed {seed}: durable bind moved across "
+                        f"failover: {key} {node} -> {final.get(key)}"
+                    )
+                _wait_reconciled(standby, seed)
+                assert not audit.violations, (
+                    f"seed {seed}: {audit.violations[:5]}"
+                )
+                rebound = {
+                    k: v for k, v in audit.bound_nodes.items()
+                    if len(v) > 1
+                }
+                assert not rebound, f"seed {seed}: double binds {rebound}"
+                return
+
+        # families 0/1: full kill + disk-image restart -------------------
+        sched.kill()
+        elector.stop(release=False)
+        # the control plane is dead: the acked in-memory state is now
+        # frozen — capture it for the never-contradicts check
+        acked = store.state_fingerprint()
+        acked_rv = store.resource_version
+        img = faults.crash_disk_image(path, str(tmp_path / "img"))
+        oracle_img = faults.crash_disk_image(
+            path, str(tmp_path / "oracle")
+        )
+        import os as _os
+
+        recovered = st.Store(journal_path=img)
+        # bit-parity oracle: same disk image, full-journal replay
+        _os.remove(oracle_img + ".snap")
+        oracle = st.Store(journal_path=oracle_img)
+        assert oracle.snapshot_records == 0
+        assert recovered.snapshot_records > 0, (
+            f"seed {seed}: recovery never used the snapshot"
+        )
+        assert _fingerprint_json(recovered) == _fingerprint_json(oracle), (
+            f"seed {seed}: snapshot+suffix recovery diverged from the "
+            f"full-replay oracle"
+        )
+        # recovered state never contradicts the acked state: rv bounded,
+        # recovered bindings (when present) match the ack
+        assert recovered.resource_version <= acked_rv
+        acked_bindings = {
+            kind_key: rec[1]["spec"]["node_name"]
+            for kind_key, rec in acked["objects"].get("Pod", {}).items()
+            if rec[1]["spec"].get("node_name")
+        }
+        recovered_initial = {
+            f"{p.meta.namespace}/{p.meta.name}": p.spec.node_name
+            for p in recovered.list("Pod")[0]
+            if p.spec.node_name
+        }
+        for key, node in recovered_initial.items():
+            assert acked_bindings.get(key) == node, (
+                f"seed {seed}: recovery invented binding {key}->{node}"
+            )
+        # restart the control plane on the recovered store
+        audit2 = _EventAudit(recovered)
+        audit2._last_rv = recovered.resource_version
+        for key, node in recovered_initial.items():
+            audit2.bound_nodes[key].add(node)
+        elector2 = LeaderElector(
+            recovered, "restart-sched", f"holder-{seed}-r",
+            lease_duration=0.8, renew_period=0.05,
+        ).start()
+        sched2 = Scheduler(
+            recovered, assume_ttl=1.0, leader_elector=elector2,
+            config=_restart_config(),
+        )
+        try:
+            sched2.start()
+            assert elector2.wait_for_leadership(10)
+            _retry_missing_pods(recovered, rng, all_names)
+            pods = _wait_all_bound(recovered, seed, label=" (restart)")
+            assert len(pods) == n_pods, (
+                f"seed {seed}: {n_pods - len(pods)} pod(s) lost"
+            )
+            assert not audit2.violations, (
+                f"seed {seed}: rv regressed across restart: "
+                f"{audit2.violations[:5]}"
+            )
+            rebound = {
+                k: v for k, v in audit2.bound_nodes.items()
+                if len(v) > 1
+            }
+            assert not rebound, (
+                f"seed {seed}: double binds across restart {rebound}"
+            )
+            # durable pre-kill binds that SURVIVED recovery never move
+            final = {
+                f"{p.meta.namespace}/{p.meta.name}": p.spec.node_name
+                for p in pods
+            }
+            for key, node in recovered_initial.items():
+                assert final[key] == node, (
+                    f"seed {seed}: recovered bind moved: {key} "
+                    f"{node} -> {final[key]}"
+                )
+            _wait_reconciled(sched2, seed)
+        finally:
+            sched2.stop()
+            elector2.stop()
+            recovered.close()
+        del created
+    finally:
+        faults.disarm()
+        if standby is not None:
+            standby.stop()
+        if standby_elector is not None:
+            standby_elector.stop()
+
+
 @pytest.mark.filterwarnings(
     "ignore::pytest.PytestUnhandledThreadExceptionWarning"
 )

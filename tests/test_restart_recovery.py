@@ -1,0 +1,538 @@
+"""Crash-restart recovery: store checkpoints + journal-suffix replay,
+wave atomicity, stale-leader write fencing, graceful close semantics,
+and warm leader-failover reconciliation (ISSUE 8).
+
+Tier-1 (fast) coverage; the randomized kill-restart schedules live in
+tests/test_chaos.py (`restart` marker, `make chaos-restart`).
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from kubernetes_tpu.api import store as st
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client.leaderelection import LeaderElector
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.scheduler.config import SchedulerConfiguration
+from kubernetes_tpu.testing import faults
+from kubernetes_tpu.testing.wrappers import GI, make_node, make_pod
+
+
+def _fp(store):
+    return json.dumps(store.state_fingerprint(), sort_keys=True)
+
+
+# -- snapshot + suffix recovery ----------------------------------------------
+
+
+def test_checkpoint_snapshot_suffix_recovery(tmp_path):
+    """checkpoint() writes a snapshot and truncates the journal; a
+    restart recovers snapshot + suffix to the exact pre-restart state
+    and reports the recovery split."""
+    path = str(tmp_path / "j.jsonl")
+    s1 = st.Store(journal_path=path)
+    for i in range(6):
+        s1.create(make_pod(f"pre{i}").req(cpu_milli=100).obj())
+    assert s1.checkpoint() == 6
+    assert os.path.exists(path + ".snap")
+    assert os.path.getsize(path) == 0  # journal truncated past the rv
+    for i in range(3):
+        s1.create(make_pod(f"post{i}").req(cpu_milli=100).obj())
+    want = _fp(s1)
+
+    s2 = st.Store(journal_path=path)
+    assert _fp(s2) == want
+    assert s2.snapshot_records == 6
+    assert s2.journal_suffix_records == 3
+    assert s2.recovery_duration_ms >= 0.0
+    assert s2.snapshot_fallbacks == 0
+    # writes continue and survive another restart
+    s2.create(make_pod("after").obj())
+    s3 = st.Store(journal_path=path)
+    assert {p.meta.name for p in s3.list("Pod")[0]} == (
+        {f"pre{i}" for i in range(6)}
+        | {f"post{i}" for i in range(3)}
+        | {"after"}
+    )
+
+
+def test_snapshot_suffix_bit_identical_to_full_replay_oracle(tmp_path):
+    """The acceptance-criterion oracle: with the journal retained
+    (checkpoint(truncate=False)), recovery through snapshot+suffix must
+    be BIT-IDENTICAL to a full-journal replay of the same history."""
+    path = str(tmp_path / "j.jsonl")
+    s1 = st.Store(journal_path=path)
+    s1.create(make_node("n0").capacity(cpu_milli=8000, mem=16 * GI).obj())
+    for i in range(8):
+        s1.create(make_pod(f"p{i}").req(cpu_milli=100).obj())
+    s1.checkpoint(truncate=False)
+    # post-checkpoint suffix: wave binds + a delete + an update
+    s1.update_wave(
+        "Pod",
+        [(f"p{i}", "default", _binder("n0")) for i in range(4)],
+    )
+    s1.delete("Pod", "p7")
+    fresh = s1.get("Pod", "p6")
+    fresh.spec.node_name = "n0"
+    s1.update(fresh)
+
+    img = str(tmp_path / "copy")
+    j2 = faults.crash_disk_image(path, img)
+    recovered = st.Store(journal_path=j2)       # snapshot + suffix
+    assert recovered.snapshot_records > 0
+    oracle_dir = str(tmp_path / "oracle")
+    j3 = faults.crash_disk_image(path, oracle_dir)
+    os.remove(j3 + ".snap")
+    oracle = st.Store(journal_path=j3)          # full journal replay
+    assert oracle.snapshot_records == 0
+    assert _fp(recovered) == _fp(oracle)
+    assert recovered.resource_version == s1.resource_version
+
+
+def test_auto_checkpoint_bounds_journal_growth(tmp_path):
+    """The growth trigger checkpoints instead of rewriting the journal:
+    churny single-object writers leave a snapshot + tiny suffix."""
+    path = str(tmp_path / "j.jsonl")
+    s = st.Store(journal_path=path, checkpoint_records=64)
+    lease = api.Lease(meta=api.ObjectMeta(name="l", namespace="kube-system"))
+    s.create(lease)
+    for _ in range(500):
+        fresh = s.get("Lease", "l", "kube-system")
+        fresh.spec.renew_time += 1
+        s.update(fresh)
+    assert s.checkpoints_total >= 1
+    with open(path) as f:
+        assert sum(1 for _ in f) <= 64
+    s2 = st.Store(journal_path=path)
+    assert s2.get("Lease", "l", "kube-system").spec.renew_time >= 499
+    assert s2.snapshot_records == 1
+
+
+def test_periodic_checkpoint_interval(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    s = st.Store(journal_path=path, checkpoint_interval_seconds=0.05)
+    s.create(make_pod("a").obj())
+    time.sleep(0.08)
+    s.create(make_pod("b").obj())  # commit past the interval triggers
+    assert s.checkpoints_total >= 1
+
+
+# -- wave atomicity -----------------------------------------------------------
+
+
+def _binder(node):
+    def mutate(pod):
+        pod.spec.node_name = node
+        pod.status.phase = "Running"
+
+    return mutate
+
+
+def _setup_wave_journal(path, n_pods=4):
+    s = st.Store(journal_path=path)
+    s.create(make_node("n0").capacity(cpu_milli=8000, mem=16 * GI).obj())
+    for i in range(n_pods):
+        s.create(make_pod(f"p{i}").req(cpu_milli=100).obj())
+    applied, errors = s.update_wave(
+        "Pod", [(f"p{i}", "default", _binder("n0")) for i in range(n_pods)]
+    )
+    assert len(applied) == n_pods and not errors
+    return s
+
+
+def test_torn_final_wave_dropped_whole(tmp_path):
+    """A wave whose tail is torn mid-record replays as if it never
+    happened: no half-applied binds, journal truncated to the wave's
+    start, and appends continue cleanly."""
+    path = str(tmp_path / "j.jsonl")
+    _setup_wave_journal(path)
+    raw = open(path, "rb").read()
+    # tear INSIDE the final wave: cut the last record in half, leaving
+    # the wave's earlier records as valid CRC'd lines
+    lines = raw.splitlines(keepends=True)
+    torn = b"".join(lines[:-1]) + lines[-1][: len(lines[-1]) // 2]
+    with open(path, "wb") as f:
+        f.write(torn)
+    s2 = st.Store(journal_path=path)
+    bound = [p.meta.name for p in s2.list("Pod")[0] if p.spec.node_name]
+    assert bound == [], f"half-applied wave: {bound}"
+    assert s2.journal_torn_waves == 1
+    # the wave's valid-prefix records were truncated away too
+    s2.create(make_pod("later").obj())
+    s3 = st.Store(journal_path=path)
+    assert s3.journal_torn_waves == 0
+    assert "later" in {p.meta.name for p in s3.list("Pod")[0]}
+
+
+def test_wave_without_terminator_dropped_whole(tmp_path):
+    """Losing ONLY the wave's final (terminator) record — every
+    remaining line valid — still drops the whole wave: atomicity comes
+    from the terminator, not from line-level CRCs."""
+    path = str(tmp_path / "j.jsonl")
+    _setup_wave_journal(path)
+    lines = open(path, "rb").read().splitlines(keepends=True)
+    with open(path, "wb") as f:
+        f.writelines(lines[:-1])  # drop the "wz" terminator record
+    s2 = st.Store(journal_path=path)
+    assert all(not p.spec.node_name for p in s2.list("Pod")[0])
+    assert s2.journal_torn_waves == 1
+
+
+def test_wave_holed_mid_file_dropped_whole(tmp_path):
+    """Corruption INSIDE a wave that is followed by later valid records
+    (mid-file, not tail) drops the wave whole but keeps the later
+    acknowledged records."""
+    path = str(tmp_path / "j.jsonl")
+    s = _setup_wave_journal(path)
+    s.create(make_pod("after").obj())  # valid record AFTER the wave
+    lines = open(path, "rb").read().splitlines(keepends=True)
+    # corrupt a record in the middle of the wave (lines: node, 4 pods,
+    # then 4 wave records, then "after")
+    lines[-3] = b'{"op": "MODIFIED", "rv": 0, "corrupt\xff\n'
+    with open(path, "wb") as f:
+        f.writelines(lines)
+    s2 = st.Store(journal_path=path)
+    names = {p.meta.name for p in s2.list("Pod")[0]}
+    assert "after" in names, "record after the holed wave was lost"
+    assert all(not p.spec.node_name for p in s2.list("Pod")[0]), (
+        "holed wave was half-applied"
+    )
+    assert s2.journal_torn_waves == 1
+
+
+def test_complete_waves_replay_applied(tmp_path):
+    """The non-degraded case: intact update_wave journals replay fully
+    (terminator present), including delete-completing waves."""
+    path = str(tmp_path / "j.jsonl")
+    s1 = _setup_wave_journal(path)
+    want = _fp(s1)
+    s2 = st.Store(journal_path=path)
+    assert _fp(s2) == want
+    assert s2.journal_torn_waves == 0
+    assert all(p.spec.node_name == "n0" for p in s2.list("Pod")[0])
+
+
+# -- corrupt snapshot fallback ------------------------------------------------
+
+
+def test_corrupt_snapshot_falls_back_to_full_journal(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    s1 = st.Store(journal_path=path)
+    for i in range(5):
+        s1.create(make_pod(f"p{i}").obj())
+    s1.checkpoint(truncate=False)  # journal retains full history
+    s1.create(make_pod("tail").obj())
+    want = _fp(s1)
+    # flip bytes inside the snapshot: CRC must catch it
+    raw = bytearray(open(path + ".snap", "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    with open(path + ".snap", "wb") as f:
+        f.write(raw)
+    s2 = st.Store(journal_path=path)
+    assert s2.snapshot_fallbacks == 1
+    assert s2.snapshot_records == 0
+    assert _fp(s2) == want, "fallback replay lost state"
+
+
+def test_truncated_snapshot_falls_back(tmp_path):
+    """A snapshot missing records (count mismatch vs header) is treated
+    as corrupt even when every remaining line is CRC-valid."""
+    path = str(tmp_path / "j.jsonl")
+    s1 = st.Store(journal_path=path)
+    for i in range(4):
+        s1.create(make_pod(f"p{i}").obj())
+    s1.checkpoint(truncate=False)
+    want = _fp(s1)
+    lines = open(path + ".snap", "rb").read().splitlines(keepends=True)
+    with open(path + ".snap", "wb") as f:
+        f.writelines(lines[:-1])
+    s2 = st.Store(journal_path=path)
+    assert s2.snapshot_fallbacks == 1
+    assert _fp(s2) == want
+
+
+# -- graceful close -----------------------------------------------------------
+
+
+def test_close_interval_sync_flushes_final_batch(tmp_path):
+    """journal_sync="interval" group-commits with a bounded loss
+    window; Store.close() must flush+fsync the final dirty batch so a
+    GRACEFUL shutdown loses nothing."""
+    path = str(tmp_path / "j.jsonl")
+    s = st.Store(journal_path=path, journal_sync="interval")
+    for i in range(5):
+        s.create(make_pod(f"p{i}").obj())
+    s.close()
+    s2 = st.Store(journal_path=path)
+    assert {p.meta.name for p in s2.list("Pod")[0]} == {
+        f"p{i}" for i in range(5)
+    }
+
+
+def test_close_drains_watch_dispatch_backlog(tmp_path):
+    """close() returns only after pending committed event batches have
+    fanned out to their watchers."""
+    s = st.Store(journal_path=str(tmp_path / "j.jsonl"))
+    w = s.watch("Pod")
+    for i in range(20):
+        s.create(make_pod(f"p{i}").obj())
+    s.close()
+    got = []
+    while True:
+        ev = w.get(timeout=0.2)
+        if ev is None:
+            break
+        got.append(ev.obj.meta.name)
+    assert set(got) == {f"p{i}" for i in range(20)}
+
+
+# -- stale-leader write fencing ----------------------------------------------
+
+
+def _acquire(store, lease, ident):
+    e = LeaderElector(store, lease, ident, lease_duration=0.4,
+                      renew_period=0.05)
+    assert e.try_acquire_or_renew()
+    e._leading.set()
+    return e
+
+
+def test_fenced_wave_rejected_after_takeover(tmp_path):
+    """A deposed leader's late bind wave is rejected whole (Fenced,
+    counted) instead of silently double-binding."""
+    store = st.Store()
+    store.create(make_node("n0").capacity(cpu_milli=8000, mem=16 * GI).obj())
+    store.create(make_pod("p0").req(cpu_milli=100).obj())
+    a = _acquire(store, "sched-lease", "holder-a")
+    token_a = a.fence_token()
+    assert token_a is not None and token_a.generation == 0
+    # b takes over after a's lease lapses (clock: zero the renew time)
+    lease = store.get("Lease", "sched-lease", "kube-system")
+    lease.spec.renew_time = -1e9
+    store.update(lease, force=True)
+    b = _acquire(store, "sched-lease", "holder-b")
+    assert b.fence_token().generation == 1
+    # a's late wave carries the stale token -> fenced, nothing applied
+    with pytest.raises(st.Fenced):
+        store.update_wave(
+            "Pod", [("p0", "default", _binder("n0"))], fence=token_a
+        )
+    assert store.fenced_writes_total == 1
+    assert store.get("Pod", "p0").spec.node_name == ""
+    # b's wave commits under its own token
+    applied, errors = store.update_wave(
+        "Pod", [("p0", "default", _binder("n0"))], fence=b.fence_token()
+    )
+    assert applied == ["default/p0"] and not errors
+
+
+def test_fence_token_refreshes_on_reacquisition(tmp_path):
+    """An identity that is deposed and later REACQUIRES mints a fresh
+    generation; its new waves commit while pre-deposition waves stay
+    fenced."""
+    store = st.Store()
+    store.create(make_node("n0").capacity(cpu_milli=8000, mem=16 * GI).obj())
+    store.create(make_pod("p0").req(cpu_milli=100).obj())
+    a = _acquire(store, "l", "a")
+    stale = a.fence_token()
+    lease = store.get("Lease", "l", "kube-system")
+    lease.spec.renew_time = -1e9
+    store.update(lease, force=True)
+    _acquire(store, "l", "b")
+    lease = store.get("Lease", "l", "kube-system")
+    lease.spec.renew_time = -1e9
+    store.update(lease, force=True)
+    assert a.try_acquire_or_renew()  # a reacquires: generation 2
+    assert a.fence_token().generation == 2
+    with pytest.raises(st.Fenced):
+        store.update_wave(
+            "Pod", [("p0", "default", _binder("n0"))], fence=stale
+        )
+    applied, errors = store.update_wave(
+        "Pod", [("p0", "default", _binder("n0"))], fence=a.fence_token()
+    )
+    assert applied and not errors
+
+
+# -- scheduler reconciliation -------------------------------------------------
+
+
+def _mk_scheduler(store, **kw):
+    s = Scheduler(store, **kw)
+    s.informers.informer("Node").start()
+    s.informers.informer("Pod").start()
+    assert s.informers.wait_for_sync(10)
+    return s
+
+
+def test_reconcile_requeues_uncommitted_assume_and_resets_device_state():
+    """_reconcile_leadership: an assume with no durable bind behind it
+    is forgotten and the pod re-queued; the breaker snaps closed and
+    the mirror is invalidated for a full re-upload."""
+    store = st.Store()
+    store.create(
+        make_node("n0").capacity(cpu_milli=8000, mem=16 * GI, pods=10).obj()
+    )
+    store.create(make_pod("ghost").req(cpu_milli=100).obj())
+    sched = _mk_scheduler(store)
+    try:
+        pod = store.get("Pod", "ghost")
+        # the crashed predecessor's footprint: assumed, never committed
+        sched.cache.assume(pod, "n0")
+        sched.queue.done(pod)  # and gone from the queue
+        sched.tpu.breaker.record_failure()
+        assert sched.tpu.breaker.state == sched.tpu.breaker.OPEN
+        mirror = getattr(sched.tpu, "_mirror", None)
+        sched._reconcile_leadership()
+        assert sched.cache.assumed_count() == 0
+        assert sched.queue.contains("default/ghost")
+        assert sched.tpu.breaker.state == sched.tpu.breaker.CLOSED
+        if mirror is not None:
+            assert mirror._dev is None
+        assert sched.metrics.leader_reconcile_total.total == 1.0
+        # the requeued pod schedules normally afterwards
+        stats = sched.schedule_batch(timeout=2)
+        assert stats["scheduled"] == 1
+        assert sched.flush_binds(30)
+        assert store.get("Pod", "ghost").spec.node_name == "n0"
+    finally:
+        sched.stop()
+
+
+def test_reconcile_keeps_assume_matching_durable_bind():
+    """An assume the store already confirms (the predecessor's wave DID
+    commit) survives reconciliation — no spurious forget/requeue."""
+    store = st.Store()
+    store.create(
+        make_node("n0").capacity(cpu_milli=8000, mem=16 * GI, pods=10).obj()
+    )
+    store.create(make_pod("done").req(cpu_milli=100).obj())
+    sched = _mk_scheduler(store)
+    try:
+        pod = store.get("Pod", "done")
+        sched.cache.assume(pod, "n0")
+        sched.queue.done(pod)
+        bound = store.get("Pod", "done")
+        bound.spec.node_name = "n0"
+        store.update(bound)
+        sched._reconcile_leadership()
+        assert sched.cache.assumed_count() == 1  # informer will confirm
+        assert not sched.queue.contains("default/done")
+    finally:
+        sched.stop()
+
+
+def test_warm_failover_standby_takes_over_and_binds(tmp_path):
+    """Two schedulers, one store: kill the leader ungracefully mid-run;
+    the standby acquires within the lease window, reconciles, and every
+    pod still binds exactly once."""
+    store = st.Store(journal_path=str(tmp_path / "j.jsonl"))
+    for i in range(4):
+        store.create(
+            make_node(f"n{i}")
+            .capacity(cpu_milli=8000, mem=16 * GI, pods=110)
+            .obj()
+        )
+    config = SchedulerConfiguration(
+        pod_initial_backoff_seconds=0.05,
+        pod_max_backoff_seconds=0.4,
+        batch_window_seconds=0.01,
+        unschedulable_flush_seconds=0.5,
+    )
+    ea = LeaderElector(store, "ha", "holder-a",
+                       lease_duration=0.6, renew_period=0.05).start()
+    a = Scheduler(store, assume_ttl=1.0, leader_elector=ea, config=config)
+    a.start()
+    assert ea.wait_for_leadership(10)
+    for i in range(6):
+        store.create(make_pod(f"w1-{i}").req(cpu_milli=100).obj())
+    eb = None
+    b = None
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            pods, _ = store.list("Pod")
+            if all(p.spec.node_name for p in pods):
+                break
+            time.sleep(0.05)
+        first = {
+            p.meta.name: p.spec.node_name for p in store.list("Pod")[0]
+        }
+        assert all(first.values())
+        # the standby is warm before the leader dies
+        eb = LeaderElector(store, "ha", "holder-b",
+                           lease_duration=0.6, renew_period=0.05).start()
+        b = Scheduler(store, assume_ttl=1.0, leader_elector=eb,
+                      config=config)
+        b.start()
+        a.kill()
+        ea.stop(release=False)  # death, not a graceful release
+        assert eb.wait_for_leadership(10), "standby never took over"
+        for i in range(6):
+            store.create(make_pod(f"w2-{i}").req(cpu_milli=100).obj())
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            pods, _ = store.list("Pod")
+            if all(p.spec.node_name for p in pods):
+                break
+            time.sleep(0.05)
+        final = {
+            p.meta.name: p.spec.node_name for p in store.list("Pod")[0]
+        }
+        assert all(final.values()), (
+            f"pods unbound after failover: "
+            f"{[k for k, v in final.items() if not v]}"
+        )
+        # bound-exactly-once across the handoff: the first leader's
+        # durable binds never move
+        for name, node in first.items():
+            assert final[name] == node, (
+                f"{name} moved {node} -> {final[name]} across failover"
+            )
+        assert b.metrics.leader_reconcile_total.total >= 1.0
+    finally:
+        if b is not None:
+            b.stop()
+        if eb is not None:
+            eb.stop()
+
+
+@pytest.mark.multichip
+def test_restart_under_mesh_mirror_resync():
+    """Mesh mode survives a leadership reconcile: the mirror performs a
+    full RESHARDED re-upload (resync counter) and subsequent sharded
+    solves still place every pod."""
+    from kubernetes_tpu.models.batch_scheduler import TPUBatchScheduler
+    from kubernetes_tpu.parallel.sharded import make_mesh
+
+    store = st.Store()
+    for i in range(16):
+        store.create(
+            make_node(f"n{i}")
+            .capacity(cpu_milli=16000, mem=32 * GI, pods=110)
+            .obj()
+        )
+    tpu = TPUBatchScheduler(mesh=make_mesh(8))
+    sched = _mk_scheduler(store, tpu=tpu)
+    try:
+        for i in range(8):
+            store.create(make_pod(f"a{i}").req(cpu_milli=100).obj())
+        assert sched.schedule_batch(timeout=2)["scheduled"] == 8
+        assert sched.flush_binds(30)
+        mirror = tpu._mirror
+        resyncs0 = mirror.resync_total
+        sched._reconcile_leadership()
+        assert mirror._dev is None  # invalidated: next sync re-uploads
+        for i in range(8):
+            store.create(make_pod(f"b{i}").req(cpu_milli=100).obj())
+        assert sched.schedule_batch(timeout=2)["scheduled"] == 8
+        assert sched.flush_binds(30)
+        assert mirror.resync_total == resyncs0 + 1, (
+            "reconcile did not force a full mirror re-upload"
+        )
+        assert all(p.spec.node_name for p in store.list("Pod")[0])
+    finally:
+        sched.stop()
