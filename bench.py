@@ -24,6 +24,16 @@ informer-fed cache.  `extra` carries all five configs:
        mesh — a snapshot one chip cannot hold; gates: mesh/single-chip
        assignment parity, steady_recompiles == 0, and steady host→device
        transfer O(changed rows) via the mirror delta counters
+  c8  100k hollow nodes       the kubemark FLEET harness on the 8-shard
+       store: batched wave-committed heartbeats + a sustained
+       pod-lifecycle soak across namespaces (concurrent per-shard bind
+       sub-waves), p50/p90/p99 lifecycle latency, zero lost/double-bound
+       pods, watchers_terminated == 0, and per-shard snapshot+suffix
+       recovery under STRICT_RECOVERY_BUDGET_MS
+
+Every scenario reports step-latency p50/p90/p99 (the windowed sampler:
+attempt-duration percentiles for the loop configs, timed-sample
+percentiles for the solver configs) plus its commit share per step.
 
 vs_baseline compares c5 against the upstream-folklore scheduler SLO of
 ~100 pods/s at 5k nodes (the reference publishes no in-tree absolute
@@ -139,12 +149,23 @@ class _Run:
         self.steady_recompiles = steady_recompiles
 
     def report(self, nodes, pods, **extra):
+        from kubernetes_tpu.kubemark import percentiles
+
         t = self.timings
+        pct = percentiles(list(self.samples))
         out = {
             "nodes": nodes, "pods": pods, "placed": self.placed,
             "latency_s": round(self.dt, 4),
             "pods_per_s": round(pods / self.dt, 1),
             "samples_s": self.samples,
+            # windowed-sampler surface (every scenario): step-latency
+            # percentiles over the timed samples; solver-only configs
+            # have no store in the loop, so their commit share is 0 by
+            # construction (the loop configs report the real split)
+            "latency_p50_s": round(pct["p50"], 4),
+            "latency_p90_s": round(pct["p90"], 4),
+            "latency_p99_s": round(pct["p99"], 4),
+            "commit_share_per_step": 0.0,
             # first-of-shape step (compile included) vs the steady split
             "first_step_s": round(self.first_s, 4),
             "steady_encode_s": round(t.get("encode_s", 0.0), 4),
@@ -361,7 +382,7 @@ def config6():
     from kubernetes_tpu.testing.wrappers import MI, make_pod
 
     n_nodes, n_measured, n_churn = 5_000, 2_000, 600
-    store = st.Store()
+    store = st.Store(shards=8)
     hollow = kubemark.HollowCluster(
         store, n_nodes, heartbeat_interval=5.0
     ).start()
@@ -443,7 +464,15 @@ def config6():
         "nodes": n_nodes, "pods": n_measured, "placed": bound,
         "latency_s": round(dt, 4),
         "pods_per_s": round(bound / dt, 1) if dt else 0.0,
+        "attempt_p50_ms": round(win.percentile(0.50) * 1000, 2),
+        "attempt_p90_ms": round(win.percentile(0.90) * 1000, 2),
         "attempt_p99_ms": round(win.percentile(0.99) * 1000, 2),
+        "store_shard_count": store.shard_count,
+        "commit_subwaves": m.commit_subwave_duration.n,
+        "commit_subwave_s_total": round(m.commit_subwave_duration.total, 4),
+        "commit_subwave_overlap_s": round(
+            m.commit_subwave_overlap.total, 4
+        ),
         "watchers_terminated": store.watchers_terminated - terminated0,
         # overload-protection surface: events compacted by per-watcher
         # coalescing, watchers expired to relist, and the adaptive
@@ -475,10 +504,12 @@ def config6():
 
 
 # Sustained-churn budget, enforced under BENCH_STRICT=1: the control
-# plane must hold >= 2x the BENCH_r05 churn throughput (526 pods/s) on
-# a CONSTANT arrival stream with zero destructively-terminated watchers
-# (ISSUE 6 acceptance).
-STRICT_SUSTAINED_MIN_PODS_PER_S = 1050.0
+# plane must hold a CONSTANT arrival stream with zero destructively-
+# terminated watchers.  Raised from the pre-sharding 1050 floor (2x the
+# BENCH_r05 526 pods/s): with the (kind, namespace)-sharded store the
+# bind waves, hollow heartbeats and informer relists no longer contend
+# on one lock/journal, so the sustained gate tightens to ~2.5x.
+STRICT_SUSTAINED_MIN_PODS_PER_S = 1300.0
 # Crash-restart budget (ISSUE 8): after the sustained run the store is
 # restarted from its journal+snapshot and must recover the full 50k-node
 # / 4k-pod state — snapshot load + journal-suffix replay — inside this
@@ -508,10 +539,14 @@ def config6_sustained():
     from kubernetes_tpu.scheduler import Scheduler
     from kubernetes_tpu.testing.wrappers import MI, make_pod
 
+    from kubernetes_tpu.perf.collectors import histogram_baseline
+
     n_nodes, n_measured, arrival_rate = 50_000, 4_000, 2_000.0
     journal_dir = tempfile.mkdtemp(prefix="bench_c6s_")
     journal = os.path.join(journal_dir, "journal.jsonl")
-    store = st.Store(journal_path=journal, journal_sync="interval")
+    store = st.Store(
+        journal_path=journal, journal_sync="interval", shards=8
+    )
     hollow = kubemark.HollowCluster(
         store, n_nodes, heartbeat_interval=10.0
     ).start()
@@ -532,6 +567,7 @@ def config6_sustained():
     store.checkpoint()
 
     terminated0 = store.watchers_terminated
+    baseline = histogram_baseline(sched.metrics)
     t0 = time.perf_counter()
     # the constant arrival stream: pace creates at arrival_rate instead
     # of dumping a burst — the batch window must adapt to the stream
@@ -558,6 +594,16 @@ def config6_sustained():
     hollow.stop()
     m = sched.metrics
     ws = store.watch_stats()
+    from kubernetes_tpu.perf.collectors import MetricsCollector
+
+    win = MetricsCollector(m, baseline=baseline)._windowed(
+        "scheduler_scheduling_attempt_duration_seconds",
+        m.scheduling_attempt_duration,
+    )
+    commit_s = m.commit_wave_duration.total
+    overlap_s = m.pipeline_overlap.total
+    exposed = max(commit_s - overlap_s, 0.0)
+    step_s = m.schedule_batch_duration.total
     # crash-restart phase: graceful close (interval-sync's final dirty
     # batch flushes), then recover a fresh store from the same files —
     # the BENCH_STRICT recovery gate
@@ -579,6 +625,9 @@ def config6_sustained():
         "recovery_lost_pods": bound - rec_bound,
         "latency_s": round(dt, 4),
         "pods_per_s": round(bound / dt, 1) if dt else 0.0,
+        "attempt_p50_ms": round(win.percentile(0.50) * 1000, 2),
+        "attempt_p90_ms": round(win.percentile(0.90) * 1000, 2),
+        "attempt_p99_ms": round(win.percentile(0.99) * 1000, 2),
         "watchers_terminated": store.watchers_terminated - terminated0,
         "watch_coalesced_total": ws["watch_coalesced_total"],
         "watch_expired_total": ws["watch_expired_total"],
@@ -587,7 +636,17 @@ def config6_sustained():
         "overload_level": m.overload_level.total,
         "overload_shed_total": m.overload_shed_total.total,
         "commit_waves": m.commit_wave_size.n,
-        "commit_s_total": round(m.commit_wave_duration.total, 4),
+        "commit_s_total": round(commit_s, 4),
+        "commit_overlap_s": round(overlap_s, 4),
+        "commit_share_per_step": round(
+            exposed / (step_s + exposed), 4
+        ) if step_s + exposed > 0 else 0.0,
+        "store_shard_count": store.shard_count,
+        "commit_subwaves": m.commit_subwave_duration.n,
+        "commit_subwave_s_total": round(m.commit_subwave_duration.total, 4),
+        "commit_subwave_overlap_s": round(
+            m.commit_subwave_overlap.total, 4
+        ),
         "solve_s_total": round(m.batch_solve_duration.total, 4),
     }
 
@@ -714,6 +773,72 @@ def config7():
     )
 
 
+# c8 fleet gates (BENCH_STRICT=1): the 100k-node hollow fleet's
+# sustained lifecycle soak must lose no pod, double-bind no pod,
+# terminate no watcher, and the post-soak kill-free recovery (per-shard
+# snapshot + journal suffix) must land inside the shared budget.
+STRICT_FLEET_NODES = 100_000
+STRICT_FLEET_SOAK_PODS = 12_288
+
+
+def config8():
+    """c8: the kubemark fleet harness as a first-class store benchmark —
+    100k hollow nodes on an 8-shard JOURNALED store (interval group
+    commit), batched wave-committed heartbeats, and a sustained
+    pod-lifecycle soak (create → concurrent per-shard bind sub-waves →
+    hollow kubelets run → delete) across 8 namespaces so every round
+    spreads over the shards.  Reports SLO-style p50/p90/p99 lifecycle
+    latency and ends with the crash-restart phase: graceful close, then
+    a fresh store recovers all 8 shards (snapshot + suffix) under the
+    STRICT_RECOVERY_BUDGET_MS gate.  No solver in the loop: this is the
+    control-plane ceiling the solve bench can't see."""
+    import tempfile
+
+    from kubernetes_tpu import kubemark
+    from kubernetes_tpu.api import store as st
+
+    n_nodes, soak_pods = STRICT_FLEET_NODES, STRICT_FLEET_SOAK_PODS
+    journal_dir = tempfile.mkdtemp(prefix="bench_c8_")
+    journal = os.path.join(journal_dir, "journal.jsonl")
+    store = st.Store(
+        journal_path=journal, journal_sync="interval", shards=8
+    )
+    fleet = kubemark.FleetHarness(
+        store, n_nodes, namespaces=8, heartbeat_interval=60.0,
+        bind_concurrency=4,
+    )
+    t_reg = time.perf_counter()
+    fleet.start()
+    register_s = time.perf_counter() - t_reg
+    # checkpoint the registered fleet so the recovery phase measures
+    # per-shard snapshot + SOAK-WINDOW suffix, not registration history
+    store.checkpoint()
+    terminated0 = store.watchers_terminated
+    report = fleet.soak(total_pods=soak_pods, round_pods=2_048)
+    fleet.stop()
+    ws = store.watch_stats()
+    nodes_before = len(store.list("Node")[0])
+    store.close()
+    t_rec = time.perf_counter()
+    recovered = st.Store(journal_path=journal)
+    recovery_wall_ms = (time.perf_counter() - t_rec) * 1000.0
+    report.update({
+        "register_s": round(register_s, 2),
+        "store_shard_count": store.shard_count,
+        "watchers_terminated": store.watchers_terminated - terminated0,
+        "watch_coalesced_total": ws["watch_coalesced_total"],
+        "watch_expired_total": ws["watch_expired_total"],
+        "recovery_ms": round(recovery_wall_ms, 1),
+        "recovery_shards": recovered.shard_count,
+        "recovery_snapshot_records": recovered.snapshot_records,
+        "recovery_suffix_records": recovered.journal_suffix_records,
+        "recovery_lost_nodes": nodes_before - len(
+            recovered.list("Node")[0]
+        ),
+    })
+    return report
+
+
 def main() -> None:
     import sys
 
@@ -740,6 +865,7 @@ def main() -> None:
             "c6_churn_5k": config6(),
             "c6s_sustained_50k": config6_sustained(),
             "c7_sharded_100k": config7(),
+            "c8_store_100k": config8(),
         }
     # every over-threshold schedule_batch cycle, with its per-step share
     # (commit- and solve-share per step are readable straight off the
@@ -876,6 +1002,25 @@ def main() -> None:
                 f"{c7['mirror_delta_rows']} delta rows / "
                 f"{c7['mirror_resync_total']} resyncs for "
                 f"{c7['dirtied_rows']} dirtied rows"
+            )
+        # fleet-harness gates: the 100k-node soak must be lossless
+        # (every created pod ran exactly once on exactly one node) and
+        # the 8-shard recovery must fit the shared restart budget
+        c8 = extra["c8_store_100k"]
+        if c8["lost_pods"]:
+            failures.append(f"c8 fleet lost {c8['lost_pods']} pod(s)")
+        if c8["double_bound_pods"]:
+            failures.append(
+                f"c8 fleet double-bound {c8['double_bound_pods']} pod(s)"
+            )
+        if c8["recovery_lost_nodes"]:
+            failures.append(
+                f"c8 recovery lost {c8['recovery_lost_nodes']} node(s)"
+            )
+        if c8["recovery_ms"] > STRICT_RECOVERY_BUDGET_MS:
+            failures.append(
+                f"c8 per-shard recovery over budget: {c8['recovery_ms']}ms"
+                f" > {STRICT_RECOVERY_BUDGET_MS}ms"
             )
         if failures:
             print("BENCH_STRICT: " + "; ".join(failures), file=sys.stderr)

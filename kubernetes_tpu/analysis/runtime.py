@@ -22,10 +22,15 @@ Locks created while the tracker is installed are wrapped in a
 :class:`TrackedLock` proxy named after their allocation site.  Edges
 are keyed per lock OBJECT (two-object AB/BA inversions are the
 deadlock shape; site-level aggregation would false-positive on
-sibling instances of the same class).  Reentrant re-acquisition is
-ignored.  The proxy forwards the private ``_is_owned`` /
-``_release_save`` / ``_acquire_restore`` hooks so ``threading.
-Condition`` built on a tracked (R)Lock keeps working.
+sibling instances of the same class).  The tracker pins a strong
+reference to every lock it has seen: edge keys are ``id()``s, and a
+garbage-collected lock's id being REUSED by a fresh lock would
+otherwise stitch two unrelated objects into one phantom AB/BA cycle
+(tests construct thousands of short-lived stores and watches — the
+few bytes per pinned lock are the price of sound identities).
+Reentrant re-acquisition is ignored.  The proxy forwards the private
+``_is_owned`` / ``_release_save`` / ``_acquire_restore`` hooks so
+``threading.Condition`` built on a tracked (R)Lock keeps working.
 """
 
 from __future__ import annotations
@@ -48,6 +53,10 @@ class LockOrderTracker:
         # BEFORE install() patches the factories, so it is never tracked.
         self._mu = threading.Lock()
         self._edges: Dict[Tuple[int, int], Tuple[str, str, str]] = {}
+        # id -> the lock object itself: pinning every seen lock keeps
+        # its id from being reused by a later allocation (see module
+        # docstring — unpinned ids produced phantom cross-object cycles)
+        self._refs: Dict[int, object] = {}
         self._tl = threading.local()
         self.inversions: List[str] = []
 
@@ -59,11 +68,15 @@ class LockOrderTracker:
             stack = self._tl.stack = []
         return stack
 
-    def before_acquire(self, lock_id: int, name: str) -> None:
+    def before_acquire(
+        self, lock_id: int, name: str, ref: object = None
+    ) -> None:
         held = self._held()
         if any(lid == lock_id for lid, _ in held):
             return  # reentrant
         with self._mu:
+            if ref is not None:
+                self._refs.setdefault(lock_id, ref)
             for held_id, held_name in held:
                 edge = (held_id, lock_id)
                 back = (lock_id, held_id)
@@ -73,10 +86,11 @@ class LockOrderTracker:
                         f"lock-order inversion: acquiring '{name}' while "
                         f"holding '{held_name}', but '{b_name}' was "
                         f"previously acquired while holding '{a_name}' "
-                        f"(first order seen at {where})"
+                        f"(first order seen at {where}; now at "
+                        f"{_caller_site(3, frames=6)})"
                     )
                 self._edges.setdefault(
-                    edge, (held_name, name, _caller_site(3))
+                    edge, (held_name, name, _caller_site(3, frames=6))
                 )
 
     def on_acquired(self, lock_id: int, name: str) -> None:
@@ -107,12 +121,25 @@ class LockOrderTracker:
             )
 
 
-def _caller_site(depth: int) -> str:
+def _caller_site(depth: int, frames: int = 1) -> str:
+    """`frames` == 1 gives the allocation-site label locks are named
+    with; inversion reports pass more to capture the calling chain —
+    'watch_stats <- test_helper' localizes an AB/BA pair in one read
+    where a bare file:line pointing into a lock proxy cannot."""
+    out = []
     try:
         f = sys._getframe(depth)
-        return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+        for _ in range(frames):
+            if f is None:
+                break
+            out.append(
+                f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+                + (f":{f.f_code.co_name}" if frames > 1 else "")
+            )
+            f = f.f_back
     except ValueError:
-        return "<unknown>"
+        pass
+    return " <- ".join(out) or "<unknown>"
 
 
 class TrackedLock:
@@ -125,7 +152,7 @@ class TrackedLock:
         self._tracker = tracker
 
     def acquire(self, blocking: bool = True, timeout: float = -1):
-        self._tracker.before_acquire(id(self), self._name)
+        self._tracker.before_acquire(id(self), self._name, ref=self)
         got = self._inner.acquire(blocking, timeout)
         if got:
             self._tracker.on_acquired(id(self), self._name)
@@ -164,7 +191,7 @@ class TrackedLock:
         return None
 
     def _acquire_restore(self, state):
-        self._tracker.before_acquire(id(self), self._name)
+        self._tracker.before_acquire(id(self), self._name, ref=self)
         if hasattr(self._inner, "_acquire_restore"):
             self._inner._acquire_restore(state)
         else:

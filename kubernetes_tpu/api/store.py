@@ -1,4 +1,4 @@
-"""In-memory versioned object store with watch streams.
+"""In-memory versioned object store with watch streams — SHARDED.
 
 The control-plane data path of the reference collapses into one process:
 etcd revisions + the apiserver's generic registry + the watch cache
@@ -13,21 +13,55 @@ Semantics kept from the reference:
   * optimistic concurrency: update with a stale resource_version fails
     with Conflict (GuaranteedUpdate's retry trigger);
   * list returns (items, rv) so a watch can resume from that rv
-    (reflector's ListAndWatch contract, reflector.go:340);
+    (reflector's ListAndWatch contract, reflector.go:340) — the item
+    set is a POINT-IN-TIME-CONSISTENT cut across every shard (taken
+    under the publish lock; sub-waves are all-or-nothing in it);
   * watch(from_rv) replays buffered events after from_rv, then streams;
     a from_rv older than the buffer raises Expired — the client relists
     (the 410 Gone path).
 
-Threading: writes hold one lock and only append the committed events to
-a dispatch backlog; a dedicated fan-out thread delivers them to
-per-watcher bounded COALESCING buffers off the lock, so a slow consumer
-can never stall writers.  A watcher that falls behind has its MODIFIED
-runs compacted latest-wins and its ADDED+DELETED pairs annihilated;
-only when the coalesced backlog itself overflows (more *distinct
-objects* pending than the capacity) is the watcher marked Expired —
-bookmark rv + forced relist, the 410 path — never silently terminated
-(the survivable-overload replacement for the cacher's
+Sharding (the etcd-concurrent-MVCC analogue): objects hash by
+``(kind, namespace)`` into N ``_StoreShard``s, each owning its own
+lock, object maps, journal + checkpoint snapshot (PR 8 semantics per
+shard: CRC'd snapshot + wave-atomic journal-suffix replay), and
+watch-dispatch backlog + fan-out thread.  Writes take only their
+shard's lock for the expensive work (deep copies, mutation, admission,
+wire encode, journal fsync); resourceVersion allocation and the
+in-memory publish (map update + ring append + backlog handoff) happen
+under ONE small global ``_rv_lock`` so rvs stay globally monotonic, the
+event ring stays globally rv-ordered, and ``watch(from_rv)`` replay is
+unchanged.  ``update_wave`` is a PER-SHARD transaction: a wave spanning
+shards commits as one atomic sub-wave per shard (each journaled with
+its own wave id, each fence-checked at publish), which is what lets the
+scheduler's binder commit sub-waves concurrently and overlap store
+fan-out with the next solve.
+
+Lock order (fixed; the graftlint runtime tracker enforces it):
+``_admission_lock`` (admission-armed writers only) -> ``shard._lock``
+-> ``Store._rv_lock`` -> ``shard._dispatch_cv`` / ``Watch._mu``.
+Shard locks are never nested with each other.
+
+Threading: writes hold their shard lock and only append the committed
+events to that shard's dispatch backlog (under the publish lock); each
+shard's dedicated fan-out thread delivers them to per-watcher bounded
+COALESCING buffers off every lock, so a slow consumer can never stall
+writers.  A watcher that falls behind has its MODIFIED runs compacted
+latest-wins and its ADDED+DELETED pairs annihilated; only when the
+coalesced backlog itself overflows (more *distinct objects* pending
+than the capacity) is the watcher marked Expired — bookmark rv + forced
+relist, the 410 path — never silently terminated (the
+survivable-overload replacement for the cacher's
 terminate-blocked-watcher behaviour; see docs/robustness.md).
+
+Delivery ordering with N fan-out threads: per OBJECT (and per shard)
+delivery is strictly rv-monotonic — an object lives on exactly one
+shard and one thread drains that shard's backlog in commit order.
+Events of one kind that span namespaces on different shards may
+interleave across shards while both fan-outs are in flight; cache-
+diffing consumers (SharedInformer, the poll-style agents) are per-key
+and relists resume from the list rv, so no consumer observes the skew.
+A single-shard stream (one kind, one namespace — every existing
+consumer) is totally ordered exactly as before.
 """
 
 from __future__ import annotations
@@ -39,7 +73,8 @@ import time
 import weakref
 import zlib
 from collections import OrderedDict, deque
-from dataclasses import dataclass, field
+from contextlib import nullcontext
+from dataclasses import dataclass
 from typing import (
     Any, Callable, Dict, Iterator, List, NamedTuple, Optional, Tuple,
 )
@@ -51,6 +86,12 @@ ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
 BOOKMARK = "BOOKMARK"
+
+# default shard count for new stores: enough to split the hot kinds
+# (Pod traffic per namespace, Node heartbeats, Lease renewals) onto
+# independent locks/journals without paying thread overhead — shard
+# fan-out threads start lazily, so small test stores stay cheap
+DEFAULT_SHARDS = 4
 
 
 class NotFound(KeyError):
@@ -80,7 +121,9 @@ class FenceToken(NamedTuple):
     """Leadership proof threaded into ``Store.update_wave``: the wave
     commits only while `identity` still holds the named Lease at the
     same acquisition `generation` (lease_transitions when the caller
-    acquired).  Minted by ``LeaderElector.fence_token()``."""
+    acquired).  Minted by ``LeaderElector.fence_token()``.  With the
+    sharded store the check runs per SUB-wave, under the publish lock,
+    atomically with that sub-wave's commit."""
 
     name: str
     namespace: str
@@ -92,7 +135,7 @@ class FenceToken(NamedTuple):
 class Event:
     type: str          # ADDED | MODIFIED | DELETED
     kind: str
-    obj: Any           # deep copy at dispatch time
+    obj: Any           # committed object (immutable after publish)
     rv: int
 
 
@@ -100,7 +143,14 @@ def _key(namespace: str, name: str) -> str:
     return f"{namespace}/{name}" if namespace else name
 
 
-# Watch._offer verdicts (read by the fan-out thread)
+def _shard_hash(kind: str, namespace: str) -> int:
+    """Stable (kind, namespace) hash — crc32 so the shard map survives
+    process restarts and interpreter hash randomization (recovery must
+    route every journaled object back to the shard that owns it)."""
+    return zlib.crc32(f"{kind}\x00{namespace}".encode())
+
+
+# Watch._offer verdicts (read by the fan-out threads)
 OFFER_OK = "ok"
 OFFER_STOPPED = "stopped"
 OFFER_EXPIRED = "expired"
@@ -124,7 +174,15 @@ class Watch:
         cache-diffing consumers (SharedInformer) synthesize the right
         local transition either way;
       * compaction always keeps the LATEST rv and re-sorts the entry to
-        the back, so delivery stays strictly rv-monotonic.
+        the back, so delivery stays strictly rv-monotonic per shard
+        (and totally ordered for single-shard streams).
+
+    With the sharded store, offers arrive from one fan-out thread per
+    shard; the exactly-once dedup horizon is therefore PER SHARD
+    (``_horizons``): each shard's offers are ascending in rv, so "at or
+    below the shard's horizon" still means "already replayed at
+    registration or already delivered".  ``_last_rv`` keeps the max
+    across shards for observability and the expiry bookmark.
 
     Only when the number of distinct pending objects would exceed the
     capacity is the stream EXPIRED: pending events are dropped, the
@@ -138,6 +196,7 @@ class Watch:
     GUARDED_FIELDS = {
         "_pending": "_mu",
         "_last_rv": "_mu",
+        "_horizons": "_mu",
         "stopped": "_mu",
         "expired": "_mu",
         "expired_rv": "_mu",
@@ -149,12 +208,15 @@ class Watch:
         self._capacity = capacity
         self._mu = threading.Condition()
         # object key -> coalesced Event, insertion/compaction order ==
-        # ascending rv (every insert/replace carries the current max rv
-        # and moves to the back)
+        # ascending rv per shard (every insert/replace carries the
+        # shard's current max rv and moves to the back)
         self._pending: "OrderedDict[str, Event]" = OrderedDict()
-        # highest rv delivered into (or compacted through) this buffer:
-        # the fan-out thread's offers dedup against it, which makes the
-        # replay-at-registration + async-backlog seam exactly-once
+        # per-shard dedup horizon: highest rv this shard has delivered
+        # into (or compacted through) this buffer — the fan-out threads'
+        # offers dedup against it, which makes the replay-at-registration
+        # + async-backlog seam exactly-once per shard
+        self._horizons: List[int] = [0] * store.shard_count
+        # max horizon across shards (observability + expiry bookmark)
         self._last_rv = 0
         self.stopped = False
         self.expired = False
@@ -167,6 +229,17 @@ class Watch:
             self.stopped = True
             self._mu.notify_all()
 
+    def _pin_locked(self, rv: int) -> None:
+        # registration pin (caller holds _mu): the dedup horizon of
+        # EVERY shard moves to the commit the registration is consistent
+        # with — backlog stragglers at or below it were covered by the
+        # ring replay (or predate a from-now watch)
+        for i, h in enumerate(self._horizons):
+            if rv > h:
+                self._horizons[i] = rv
+        if rv > self._last_rv:
+            self._last_rv = rv
+
     def _offer(self, ev: Event) -> str:
         # hot path (per event per watcher): the disarmed check is one
         # module-attribute load, not a function call
@@ -176,14 +249,17 @@ class Watch:
             with self._mu:
                 self._expire_locked()
             return OFFER_EXPIRED
+        sid = self._store._hash_index(ev.kind, ev.obj.meta.namespace)
         with self._mu:
             if self.expired:
                 return OFFER_EXPIRED
             if self.stopped:
                 return OFFER_STOPPED
-            if ev.rv <= self._last_rv:
+            if ev.rv <= self._horizons[sid]:
                 # already replayed at registration (or re-offered by the
-                # backlog after a replay covered it): exactly-once dedup
+                # shard backlog after a replay covered it): exactly-once
+                # dedup — per shard, because each shard's offers arrive
+                # in its own ascending commit order
                 return OFFER_OK
             key = _key(ev.obj.meta.namespace, ev.obj.meta.name)
             cur = self._pending.get(key)
@@ -205,7 +281,9 @@ class Watch:
                 self._pending[key] = Event(typ, ev.kind, ev.obj, ev.rv)
                 self._pending.move_to_end(key)
                 self.coalesced += 1
-            self._last_rv = ev.rv
+            self._horizons[sid] = ev.rv
+            if ev.rv > self._last_rv:
+                self._last_rv = ev.rv
             self._mu.notify_all()
             return OFFER_OK
 
@@ -267,56 +345,73 @@ class Watch:
                 self._mu.wait(remaining)
 
 
-class Store:
-    """The single-process control-plane store (see module docstring).
+# -- journal record codec (shared by every shard) ---------------------------
 
-    With `journal_path`, every committed write appends one JSON line
-    (op, rv, type-tagged object — api.wire codec) and construction
-    replays the file: the crash-only resume property whose reference
-    counterpart is every component rebuilding from etcd on restart
-    (storage/etcd3/store.go; SURVEY §5.4).  Replay re-applies writes
-    without re-journaling and leaves the event buffer empty — watchers
-    attach after recovery and relist, exactly like a reflector hitting a
-    fresh apiserver.
 
-    Checkpointing bounds replay: ``checkpoint()`` (also triggered by
-    journal growth and, optionally, a wall-clock interval) writes a
-    point-in-time snapshot of every live object via write-temp + fsync +
-    atomic-rename and truncates the journal past the checkpoint rv, so
-    recovery = load snapshot + replay the journal SUFFIX instead of
-    replaying history from byte zero (the etcd snapshot + WAL-rotation
-    discipline).  A corrupt snapshot falls back to replaying whatever
-    the journal holds; ``update_wave`` records are replayed atomically
-    (a torn final wave is dropped whole, never half-applied).  Recovery
-    observability: ``recovery_duration_ms`` / ``snapshot_records`` /
-    ``journal_suffix_records``, mirrored into the scheduler Registry."""
+def _encode_record(rec: dict) -> str:
+    """One journal line: the record JSON with a trailing crc32 over
+    the crc-less serialization.  Replay re-serializes the parsed
+    record (key order and value round-trips are stable under
+    json.dumps) and compares — a partial page write or bit flip
+    anywhere in the line fails the check even when the damage still
+    parses as JSON."""
+    import json
 
-    # graftlint guarded-by declarations: object maps, version counters,
-    # the event ring, watcher fan-out lists, and all journal state share
-    # the store mutex; the fan-out backlog has its own condition (writers
-    # append under _lock -> _dispatch_cv, the dispatcher pops under
-    # _dispatch_cv alone — one lock-order direction, never a cycle)
+    s = json.dumps(rec)
+    return '%s, "crc": %d}\n' % (s[:-1], zlib.crc32(s.encode()))
+
+
+def _record_crc_ok(rec: dict, crc) -> bool:
+    import json
+
+    if crc is None:
+        return True  # pre-CRC journal line: accept (upgrade path)
+    return zlib.crc32(json.dumps(rec).encode()) == crc
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the directory holding `path` so a rename into it is
+    itself durable."""
+    import os
+
+    try:
+        dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass  # platform without directory fsync
+
+
+class _StoreShard:
+    """One shard of the store: its own lock, object maps, journal +
+    checkpoint snapshot, and watch-dispatch backlog/thread.
+
+    The shard owns every EXPENSIVE half of the write path — deep
+    copies, mutation, admission output, wire encode, journal append +
+    fsync, checkpoint I/O — so shards commit concurrently; only the
+    tiny publish step (rv allocation + map update + ring/backlog
+    append) serializes through the facade's ``_rv_lock``.  Recovery is
+    per shard: load the shard's CRC'd snapshot, replay its journal
+    suffix with PR 8 wave atomicity (a torn final wave is dropped
+    whole), exactly the single-store contract scaled down to one
+    shard's keyspace.
+    """
+
+    # graftlint guarded-by declarations: object maps and all journal /
+    # checkpoint state share the shard mutex; the fan-out backlog has
+    # its own condition (publishers append under Store._rv_lock ->
+    # _dispatch_cv, the dispatcher pops under _dispatch_cv alone — one
+    # lock-order direction, never a cycle)
     GUARDED_FIELDS = {
-        "_rv": "_lock",
         "_objects": "_lock",
         "_versions": "_lock",
-        "_buffer": "_lock",
-        "_watchers": "_lock",
+        "_last_rv": "_lock",
         "_journal": "_lock",
         "_journal_records": "_lock",
         "_journal_dirty": "_lock",
         "_journal_flushed_at": "_lock",
-        "watchers_terminated": "_lock",
-        "terminated_by_kind": "_lock",
-        "watch_expired_total": "_lock",
-        "_watch_coalesced_closed": "_lock",
-        "_dispatch_thread": "_lock",
-        "_dispatch_backlog": "_dispatch_cv",
-        "_dispatch_inflight": "_dispatch_cv",
-        "journal_recovered_records": "_lock",
-        "journal_tail_truncations": "_lock",
-        "journal_write_errors": "_lock",
-        "journal_torn_waves": "_lock",
         "_snapshot_rv": "_lock",
         "_wave_seq": "_lock",
         "_last_checkpoint": "_lock",
@@ -324,213 +419,127 @@ class Store:
         "snapshot_fallbacks": "_lock",
         "snapshot_records": "_lock",
         "journal_suffix_records": "_lock",
-        "recovery_duration_ms": "_lock",
-        "fenced_writes_total": "_lock",
+        "journal_recovered_records": "_lock",
+        "journal_tail_truncations": "_lock",
+        "journal_write_errors": "_lock",
+        "journal_torn_waves": "_lock",
+        "_dispatch_backlog": "_dispatch_cv",
+        "_dispatch_inflight": "_dispatch_cv",
+        "_dispatch_thread": "_dispatch_cv",
     }
-    # reviewed lock-free: replay/snapshot-load run from __init__ before
-    # the store is shared; the rest document "caller holds the lock"
+    # reviewed lock-free: recovery runs from Store.__init__ before the
+    # store is shared; the rest document "caller holds the shard lock"
     LOCKED_METHODS = frozenset({
+        "_recover",
         "_replay_journal",
         "_load_snapshot",
+        "_open_journal",
         "_flush_journal",
         "_journal_commit",
         "_append_journal",
         "_append_journal_wave",
-        "_dispatch",
-        "_dispatch_wave",
     })
 
     def __init__(
         self,
-        buffer_size: int = 4096,
-        # per-watcher queue matches the event buffer: a watcher that
-        # can't hold buffer_size events couldn't relist-recover either,
-        # and a 4k bind wave must not kill the scheduler's own informer
-        watch_capacity: int = 4096,
-        journal_path: Optional[str] = None,
-        admission=None,
-        journal_sync: str = "write",  # "write" | "interval"
-        snapshot_path: Optional[str] = None,
-        # journal records (post-checkpoint suffix) that trigger an
-        # automatic checkpoint; None = max(1024, 8 * live objects)
-        checkpoint_records: Optional[int] = None,
-        # wall-clock checkpoint cadence; 0 disables periodic checkpoints
-        # (growth-triggered ones still run)
-        checkpoint_interval_seconds: float = 0.0,
+        index: int,
+        journal_path: Optional[str],
+        snapshot_path: Optional[str],
+        journal_sync: str,
+        checkpoint_records: Optional[int],
+        checkpoint_interval_seconds: float,
     ):
+        self.index = index
         self._lock = threading.RLock()
-        self._rv = 0
         self._objects: Dict[str, Dict[str, Any]] = {}   # kind -> key -> obj
         self._versions: Dict[str, Dict[str, int]] = {}  # kind -> key -> rv
-        self._buffer: List[Event] = []                  # ring of recent events
-        self._buffer_size = buffer_size
-        self._watch_capacity = watch_capacity
-        self._watchers: Dict[str, List[Watch]] = {}     # kind -> watches
-        # destructive slow-watcher kills — the backpressured fan-out
-        # never performs them, so churn benches assert this stays 0
-        self.watchers_terminated = 0
-        self.terminated_by_kind: Dict[str, int] = {}    # bounded: one key/kind
-        # overload-protection observability (mirrored into the scheduler
-        # Registry as scheduler_watch_* each cycle):
-        #   expired — watchers converted to bookmark+relist after their
-        #       coalescing buffer overflowed (or a replay overflowed);
-        #   coalesced (closed) — compacted-event counts folded in from
-        #       watchers that have since expired or stopped (live
-        #       watchers keep their own counters; watch_stats() sums).
-        self.watch_expired_total = 0
-        self._watch_coalesced_closed = 0
-        # fan-out backlog: writers append committed event batches under
-        # the store lock; the dedicated dispatch thread (started lazily
-        # with the first watcher, weakly referenced so abandoned stores
-        # don't leak pollers) delivers them to the coalescing buffers
-        # OFF the lock — a slow consumer can never stall writers
+        # highest rv this shard has committed (snapshot header rv; the
+        # facade's recovered _rv is the max across shards)
+        self._last_rv = 0
+        # fan-out backlog: publishers append committed event batches
+        # under the publish lock; this shard's dispatch thread (started
+        # lazily with the first delivery, weakly referenced so abandoned
+        # stores don't leak pollers) delivers them to the coalescing
+        # buffers OFF every lock
         self._dispatch_cv = threading.Condition()
         self._dispatch_backlog: deque = deque()
         self._dispatch_inflight = False
         self._dispatch_thread: Optional[threading.Thread] = None
-        # optional api.admission.AdmissionChain: mutate-then-validate on
-        # every create/update before the commit (the apiserver admission
-        # chain's position in the write path, server/config.go:983)
-        self._admission = admission
-        if admission is not None and getattr(admission, "store", None) is None:
-            admission.store = self  # plugin initializer (wants_store)
         self._journal = None
         self._journal_path = journal_path
+        self._journal_sync = journal_sync
         self._journal_records = 0
         self._journal_dirty = False
         self._journal_flushed_at = time.monotonic()
-        # journal health/recovery counters (surfaced as
-        # scheduler_journal_recovered_records by the perf collectors):
-        #   recovered — corrupt records replay survived (skipped mid-file
-        #       lines + truncated tails), i.e. every time the CRC path
-        #       saved a restart;
-        #   tail truncations — torn final appends cut back to the last
-        #       good record;
-        #   write errors — appends/flushes that failed and were contained
-        #       (the store keeps serving; durability is degraded until
-        #       appends succeed again).
+        # journal health/recovery counters (the facade sums them across
+        # shards; surfaced as scheduler_journal_recovered_records etc.):
+        #   recovered — corrupt records replay survived;
+        #   tail truncations — torn final appends cut back;
+        #   write errors — appends/flushes contained (durability
+        #       degraded, store keeps serving).
         self.journal_recovered_records = 0
         self.journal_tail_truncations = 0
         self.journal_write_errors = 0
+        self.journal_torn_waves = 0
         # checkpoint / recovery state (docs/robustness.md recovery
-        # contract): the snapshot sits next to the journal; recovery
-        # loads it and replays only the journal suffix past its rv.
-        self._snapshot_path = snapshot_path or (
-            journal_path + ".snap" if journal_path else None
-        )
+        # contract): the snapshot sits next to the shard's journal;
+        # recovery loads it and replays only the journal suffix past
+        # its rv.
+        self._snapshot_path = snapshot_path
         self._snapshot_rv = 0       # rv the current snapshot covers
         self._wave_seq = 0          # update_wave journal grouping id
         self._checkpoint_records = checkpoint_records
         self._checkpoint_interval = checkpoint_interval_seconds
         self._last_checkpoint = time.monotonic()
         self.checkpoints_total = 0
-        # recoveries that found the snapshot corrupt/unreadable and fell
-        # back to replaying the full journal instead
         self.snapshot_fallbacks = 0
-        # update_wave suffixes dropped whole at replay (torn final wave
-        # — atomicity preserved, never half-applied)
-        self.journal_torn_waves = 0
-        # last recovery's cost split: objects loaded from the snapshot,
-        # journal records replayed past it, and the wall time both took
         self.snapshot_records = 0
         self.journal_suffix_records = 0
-        self.recovery_duration_ms = 0.0
-        # update_wave commits rejected because the caller's FenceToken
-        # no longer matched the Lease (a deposed leader's late wave)
-        self.fenced_writes_total = 0
-        # "write": flush per record — every acknowledged write is on
-        # disk (etcd's ack-after-fsync contract; the replay test's
-        # kill-anywhere guarantee).  "interval": group-commit with a
-        # bounded <=_JOURNAL_FLUSH_S loss window for write-heavy
-        # deployments (etcd batches proposals into one fsync the same
-        # way; our window trades the ack barrier for throughput).
-        self._journal_sync = journal_sync
-        if journal_path:
-            t_rec = time.monotonic()
-            snap_n = self._load_snapshot()
-            applied, lines = self._replay_journal(
-                journal_path, min_rv=self._snapshot_rv
-            )
-            self.snapshot_records = snap_n or 0
-            self.journal_suffix_records = applied
-            self.recovery_duration_ms = (
-                time.monotonic() - t_rec
-            ) * 1000.0
-            live = sum(len(objs) for objs in self._objects.values())
-            self._journal = open(journal_path, "a")
-            self._journal_records = lines
-            if lines > max(1024, 4 * live):
-                # replay-time bound: a journal whose suffix dwarfs the
-                # live set (churny writers — lease renewals every few
-                # seconds) is checkpointed right away, so the NEXT
-                # restart pays snapshot + near-empty suffix instead of
-                # replaying history (the etcd-compaction analogue)
-                try:
-                    self._checkpoint_locked()
-                except Exception:  # noqa: BLE001 — durability degradation
-                    self.journal_write_errors += 1
-                    logging.getLogger(__name__).exception(
-                        "post-recovery checkpoint failed; journal kept"
-                    )
-            if journal_sync == "interval":
-                # bounds the crash window left by batched flushing: any
-                # record older than _JOURNAL_FLUSH_S is on disk
-                t = threading.Thread(
-                    target=self._journal_flusher,
-                    name="journal-flush",
-                    daemon=True,
+
+    # -- recovery (runs from Store.__init__, pre-sharing) ------------------
+
+    def _recover(self) -> None:
+        """Load snapshot + replay the journal suffix + open the journal
+        for append; checkpoints immediately when the replayed suffix
+        dwarfs the live set (the etcd-compaction analogue)."""
+        path = self._journal_path
+        if path is None:
+            return
+        snap_n = self._load_snapshot()
+        applied, lines = self._replay_journal(path, min_rv=self._snapshot_rv)
+        self.snapshot_records = snap_n or 0
+        self.journal_suffix_records = applied
+        live = sum(len(objs) for objs in self._objects.values())
+        self._journal = open(path, "a")
+        self._journal_records = lines
+        if lines > max(1024, 4 * live):
+            # replay-time bound: a journal whose suffix dwarfs the
+            # live set (churny writers — lease renewals every few
+            # seconds) is checkpointed right away, so the NEXT
+            # restart pays snapshot + near-empty suffix instead of
+            # replaying history
+            try:
+                self._checkpoint_locked()
+            except Exception:  # noqa: BLE001 — durability degradation
+                self.journal_write_errors += 1
+                logging.getLogger(__name__).exception(
+                    "post-recovery checkpoint failed; journal kept"
                 )
-                t.start()
 
-    _JOURNAL_FLUSH_S = 0.05
-
-    def _journal_flusher(self) -> None:
-        while True:
-            time.sleep(self._JOURNAL_FLUSH_S)
-            with self._lock:
-                if self._journal is None:
-                    return
-                if self._journal_dirty:
-                    try:
-                        self._journal.flush()
-                    except ValueError:  # closed mid-compaction race
-                        pass
-                    self._journal_dirty = False
-                    self._journal_flushed_at = time.monotonic()
-
-    # -- journal (crash-only durability) -----------------------------------
-
-    @staticmethod
-    def _encode_record(rec: dict) -> str:
-        """One journal line: the record JSON with a trailing crc32 over
-        the crc-less serialization.  Replay re-serializes the parsed
-        record (key order and value round-trips are stable under
-        json.dumps) and compares — a partial page write or bit flip
-        anywhere in the line fails the check even when the damage still
-        parses as JSON."""
-        import json
-
-        s = json.dumps(rec)
-        return '%s, "crc": %d}\n' % (s[:-1], zlib.crc32(s.encode()))
-
-    @staticmethod
-    def _record_crc_ok(rec: dict, crc) -> bool:
-        import json
-
-        if crc is None:
-            return True  # pre-CRC journal line: accept (upgrade path)
-        return zlib.crc32(json.dumps(rec).encode()) == crc
+    def _open_journal(self) -> None:
+        if self._journal_path is not None and self._journal is None:
+            self._journal = open(self._journal_path, "a")
 
     def _replay_journal(
         self, path: str, min_rv: int = 0
     ) -> Tuple[int, int]:
-        """Replay the journal; records at or below `min_rv` (covered by
-        the loaded snapshot) are skipped.  update_wave records carry a
-        wave id and a terminator: a wave is buffered and applied only
-        when its terminator arrives, so a torn final wave is dropped
-        WHOLE (truncated like a torn tail — it was never acknowledged
-        durable) and a wave holed by mid-file corruption is skipped
-        whole, never half-applied.  Returns (applied, good_lines)."""
+        """Replay the shard journal; records at or below `min_rv`
+        (covered by the loaded snapshot) are skipped.  update_wave
+        records carry a wave id and a terminator: a wave is buffered and
+        applied only when its terminator arrives, so a torn final wave
+        is dropped WHOLE (truncated like a torn tail — it was never
+        acknowledged durable) and a wave holed by mid-file corruption is
+        skipped whole, never half-applied.  Returns (applied, good_lines)."""
         import json
         import os
 
@@ -558,7 +567,7 @@ class Store:
             else:
                 objs[key] = obj
                 vers[key] = rv
-            self._rv = max(self._rv, rv)
+            self._last_rv = max(self._last_rv, rv)
             replayed += 1
 
         def drop_pending(why: str) -> None:
@@ -585,7 +594,7 @@ class Store:
                     if not isinstance(rec, dict):
                         raise ValueError("journal record is not an object")
                     crc = rec.pop("crc", None)
-                    if not self._record_crc_ok(rec, crc):
+                    if not _record_crc_ok(rec, crc):
                         raise ValueError("journal record crc mismatch")
                     op, rv, kind = rec["op"], rec["rv"], rec["kind"]
                     key = rec["key"]
@@ -666,21 +675,6 @@ class Store:
                         t.truncate(pending_offset)
         return replayed, lines
 
-    @staticmethod
-    def _fsync_dir(path: str) -> None:
-        """fsync the directory holding `path` so a rename into it is
-        itself durable."""
-        import os
-
-        try:
-            dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
-            try:
-                os.fsync(dfd)
-            finally:
-                os.close(dfd)
-        except OSError:
-            pass  # platform without directory fsync
-
     def _load_snapshot(self) -> Optional[int]:
         """Load the checkpoint snapshot into empty object maps; returns
         the record count, or None when the snapshot is absent OR corrupt
@@ -713,7 +707,7 @@ class Store:
                     if not isinstance(rec, dict):
                         raise ValueError("snapshot record is not an object")
                     crc = rec.pop("crc", None)
-                    if not self._record_crc_ok(rec, crc):
+                    if not _record_crc_ok(rec, crc):
                         raise ValueError("snapshot record crc mismatch")
                     if header is None:
                         if "snapshot_rv" not in rec:
@@ -740,25 +734,11 @@ class Store:
             return None
         self._objects = objects
         self._versions = versions
-        self._rv = max(int(header["snapshot_rv"]), max_rv)
+        self._last_rv = max(int(header["snapshot_rv"]), max_rv)
         self._snapshot_rv = int(header["snapshot_rv"])
         return n
 
-    def checkpoint(self, truncate: bool = True) -> int:
-        """Write a point-in-time snapshot of every live object and (by
-        default) truncate the journal past the checkpoint rv, bounding
-        the next recovery to snapshot + journal suffix.  Crash-safe by
-        construction: the snapshot is written to a temp file, flushed,
-        fsynced, then atomically renamed over the old one (directory
-        fsynced too) — a crash at ANY point leaves the previous snapshot
-        or the complete new one; the journal is only truncated AFTER the
-        snapshot is durable, so history is never lost to a half-written
-        checkpoint.  ``truncate=False`` keeps the journal (full-replay
-        oracle mode — the chaos suite's bit-parity check; recovery
-        skips journal records the snapshot already covers).  Returns the
-        snapshot's record count."""
-        with self._lock:
-            return self._checkpoint_locked(truncate=truncate)
+    # -- checkpoint --------------------------------------------------------
 
     def _checkpoint_locked(self, truncate: bool = True) -> int:
         import os
@@ -768,16 +748,16 @@ class Store:
         path = self._journal_path
         if path is None or self._snapshot_path is None:
             return 0
-        faults.fire("store.checkpoint")
+        faults.fire("store.checkpoint", shard=self.index)
         tmp = self._snapshot_path + ".tmp"
         n = sum(len(objs) for objs in self._objects.values())
         with open(tmp, "w") as f:
-            f.write(self._encode_record(
-                {"snapshot_rv": self._rv, "records": n}
+            f.write(_encode_record(
+                {"snapshot_rv": self._last_rv, "records": n}
             ))
             for kind, objs in self._objects.items():
                 for key, obj in objs.items():
-                    f.write(self._encode_record({
+                    f.write(_encode_record({
                         "op": ADDED,
                         "rv": self._versions[kind][key],
                         "kind": kind,
@@ -787,8 +767,8 @@ class Store:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._snapshot_path)
-        self._fsync_dir(self._snapshot_path)
-        self._snapshot_rv = self._rv
+        _fsync_dir(self._snapshot_path)
+        self._snapshot_rv = self._last_rv
         self.snapshot_records = n
         self.checkpoints_total += 1
         self._last_checkpoint = time.monotonic()
@@ -807,9 +787,12 @@ class Store:
             self._journal_records = 0
         return n
 
+    # -- journal (crash-only durability; caller holds the shard lock) ------
+
+    _JOURNAL_FLUSH_S = 0.05
+
     def _flush_journal(self) -> None:
-        # caller holds the lock
-        faults.fire("store.journal.fsync")
+        faults.fire("store.journal.fsync", shard=self.index)
         self._journal.flush()
 
     def _journal_commit(self, lines: List[str]) -> None:
@@ -820,6 +803,11 @@ class Store:
         fail-stop; replay's CRC path handles whatever landed)."""
         try:
             act = faults.fire("store.journal.append", records=len(lines))
+            act2 = faults.fire(
+                "store.shard.journal.append",
+                shard=self.index, records=len(lines),
+            )
+            act = act if act is not None else act2
             data = "".join(lines)
             if isinstance(act, faults.TornWrite):
                 cut = max(1, int(len(data) * act.frac))
@@ -865,7 +853,7 @@ class Store:
                     self._journal = open(self._journal_path, "a")
 
     def _append_journal(self, op: str, kind: str, key: str, obj, rv: int) -> None:
-        # caller holds the lock; called after the in-memory commit
+        # caller holds the shard lock; called after the publish
         if self._journal is None:
             return
         from . import wire
@@ -873,7 +861,371 @@ class Store:
         rec = {"op": op, "rv": rv, "kind": kind, "key": key}
         if op != DELETED:
             rec["obj"] = wire.to_wire(obj)
-        self._journal_commit([self._encode_record(rec)])
+        self._journal_commit([_encode_record(rec)])
+
+    def _append_journal_wave(
+        self, kind: str, records: List[Tuple[str, str, Any, int]]
+    ) -> None:
+        # caller holds the shard lock; one write + one flush for the
+        # sub-wave.  Every record carries the shard-local wave id ("w")
+        # and the last one the terminator ("wz"): replay applies the
+        # wave atomically — a tail torn anywhere inside it drops the
+        # WHOLE wave, so a recovered shard never holds half a bind wave.
+        if self._journal is None:
+            return
+        from . import wire
+
+        self._wave_seq += 1
+        wid = self._wave_seq
+        lines = []
+        for i, (op, key, obj, rv) in enumerate(records):
+            rec = {"op": op, "rv": rv, "kind": kind, "key": key, "w": wid}
+            if i == len(records) - 1:
+                rec["wz"] = 1
+            if op != DELETED:
+                rec["obj"] = wire.to_wire(obj)
+            lines.append(_encode_record(rec))
+        self._journal_commit(lines)
+
+
+class Store:
+    """The single-process control-plane store, sharded by
+    (kind, namespace) — see the module docstring for the concurrency
+    contract.
+
+    With `journal_path`, every committed write appends one JSON line to
+    its SHARD's journal (``<path>`` for a 1-shard store, ``<path>.s<i>``
+    otherwise) and construction replays every shard: the crash-only
+    resume property whose reference counterpart is every component
+    rebuilding from etcd on restart (storage/etcd3/store.go; SURVEY
+    §5.4).  Replay re-applies writes without re-journaling and leaves
+    the event ring empty — watchers attach after recovery and relist,
+    exactly like a reflector hitting a fresh apiserver.  The shard
+    count of an existing on-disk layout is inferred from the files, so
+    ``Store(journal_path=...)`` restarts any layout; an EXPLICIT
+    `shards` that disagrees triggers a reshard (replay old layout,
+    re-route every object by the current hash, checkpoint the new
+    shards, drop the old files).
+
+    Checkpointing bounds replay PER SHARD: ``checkpoint()`` writes each
+    shard's point-in-time snapshot via write-temp + fsync +
+    atomic-rename and truncates that shard's journal past its
+    checkpoint rv, so recovery = N × (load snapshot + replay journal
+    SUFFIX), shards independently.  A corrupt snapshot falls back to
+    replaying that shard's whole journal; ``update_wave`` records
+    replay atomically per shard.  Recovery observability:
+    ``recovery_duration_ms`` / ``snapshot_records`` /
+    ``journal_suffix_records`` (summed across shards), mirrored into
+    the scheduler Registry."""
+
+    # graftlint guarded-by declarations: the rv counter, the global
+    # event ring, the watcher registry and its counters all share the
+    # small publish lock (shard-owned state is annotated on _StoreShard)
+    GUARDED_FIELDS = {
+        "_rv": "_rv_lock",
+        "_buffer": "_rv_lock",
+        "_watchers": "_rv_lock",
+        "watchers_terminated": "_rv_lock",
+        "terminated_by_kind": "_rv_lock",
+        "watch_expired_total": "_rv_lock",
+        "_watch_coalesced_closed": "_rv_lock",
+        "fenced_writes_total": "_rv_lock",
+    }
+    # reviewed lock-free / caller-holds-the-publish-lock helpers
+    LOCKED_METHODS = frozenset({
+        "_dispatch",
+        "_dispatch_wave",
+        "_queue_fanout_locked",
+        "_check_fence_locked",
+        "_publish_one_locked",
+        "_reshard",
+    })
+
+    def __init__(
+        self,
+        buffer_size: int = 4096,
+        # per-watcher queue matches the event buffer: a watcher that
+        # can't hold buffer_size events couldn't relist-recover either,
+        # and a 4k bind wave must not kill the scheduler's own informer
+        watch_capacity: int = 4096,
+        journal_path: Optional[str] = None,
+        admission=None,
+        journal_sync: str = "write",  # "write" | "interval"
+        snapshot_path: Optional[str] = None,
+        # journal records (post-checkpoint suffix) that trigger an
+        # automatic checkpoint, PER SHARD; None = max(1024, 8 * live)
+        checkpoint_records: Optional[int] = None,
+        # wall-clock checkpoint cadence; 0 disables periodic checkpoints
+        # (growth-triggered ones still run)
+        checkpoint_interval_seconds: float = 0.0,
+        # store shards (per-shard lock/journal/checkpoint/fan-out);
+        # None = infer from an existing journal layout, else
+        # DEFAULT_SHARDS.  1 reproduces the legacy single-lock layout
+        # (journal at `journal_path` itself).
+        shards: Optional[int] = None,
+    ):
+        inferred = (
+            self._infer_shards(journal_path) if journal_path else None
+        )
+        n = shards or inferred or DEFAULT_SHARDS
+        if n < 1:
+            raise ValueError("shards must be >= 1")
+        # the one small global rv lock: allocation + publish only — all
+        # expensive write work runs under the owning shard's lock
+        self._rv_lock = threading.RLock()
+        self._rv = 0
+        self._buffer: List[Event] = []      # global ring of recent events
+        self._buffer_size = buffer_size
+        self._watch_capacity = watch_capacity
+        self._watchers: Dict[str, List[Watch]] = {}     # kind -> watches
+        # destructive slow-watcher kills — the backpressured fan-out
+        # never performs them, so churn benches assert this stays 0
+        self.watchers_terminated = 0
+        self.terminated_by_kind: Dict[str, int] = {}    # bounded: one key/kind
+        # overload-protection observability (mirrored into the scheduler
+        # Registry as scheduler_watch_* each cycle):
+        #   expired — watchers converted to bookmark+relist after their
+        #       coalescing buffer overflowed (or a replay overflowed);
+        #   coalesced (closed) — compacted-event counts folded in from
+        #       watchers that have since expired or stopped (live
+        #       watchers keep their own counters; watch_stats() sums).
+        self.watch_expired_total = 0
+        self._watch_coalesced_closed = 0
+        # update_wave sub-waves rejected because the caller's FenceToken
+        # no longer matched the Lease (a deposed leader's late wave)
+        self.fenced_writes_total = 0
+        # optional api.admission.AdmissionChain: mutate-then-validate on
+        # every create/update before the commit (the apiserver admission
+        # chain's position in the write path, server/config.go:983).
+        # Admission-armed writes serialize on _admission_lock (held
+        # through the commit) so store-reading plugins (quota validator,
+        # ClusterIP allocation) stay check-then-act-safe across shards.
+        self._admission = admission
+        self._admission_lock = threading.RLock()
+        if admission is not None and getattr(admission, "store", None) is None:
+            admission.store = self  # plugin initializer (wants_store)
+        self._journal_path = journal_path
+        self._journal_sync = journal_sync
+        # last recovery's wall time (snapshot loads + suffix replays,
+        # all shards); set once at construction
+        self.recovery_duration_ms = 0.0
+        self._shards: List[_StoreShard] = [
+            _StoreShard(
+                i,
+                self._shard_journal_path(journal_path, i, n),
+                self._shard_snapshot_path(
+                    journal_path, snapshot_path, i, n
+                ),
+                journal_sync,
+                checkpoint_records,
+                checkpoint_interval_seconds,
+            )
+            for i in range(n)
+        ]
+        if journal_path:
+            t_rec = time.monotonic()
+            if inferred is not None and shards and inferred != shards:
+                # explicit shard count disagrees with the on-disk layout:
+                # replay the OLD layout and re-route every object
+                self._reshard(inferred, journal_path, snapshot_path)
+            else:
+                for shard in self._shards:
+                    shard._recover()
+            with self._rv_lock:
+                self._rv = max(
+                    [shard._last_rv for shard in self._shards] + [0]
+                )
+            self.recovery_duration_ms = (
+                time.monotonic() - t_rec
+            ) * 1000.0
+            if journal_sync == "interval":
+                # bounds the crash window left by batched flushing: any
+                # record older than _JOURNAL_FLUSH_S is on disk
+                t = threading.Thread(
+                    target=self._journal_flusher,
+                    name="journal-flush",
+                    daemon=True,
+                )
+                t.start()
+
+    # -- shard plumbing ----------------------------------------------------
+
+    @property
+    def shard_count(self) -> int:
+        return len(self._shards)
+
+    def _hash_index(self, kind: str, namespace: str) -> int:
+        # raw (kind, namespace) hash — callers that accept caller-typed
+        # namespaces go through shard_index() for scope normalization
+        return _shard_hash(kind, namespace) % len(self._shards)
+
+    def shard_index(self, kind: str, namespace: str = "default") -> int:
+        """The shard owning (kind, namespace) — the scheduler's binder
+        partitions bind waves with this so sub-waves commit per shard."""
+        if kind in api.CLUSTER_SCOPED_KINDS:
+            namespace = ""
+        return self._hash_index(kind, namespace)
+
+    @staticmethod
+    def _shard_journal_path(
+        base: Optional[str], index: int, n: int
+    ) -> Optional[str]:
+        if base is None:
+            return None
+        return base if n == 1 else f"{base}.s{index}"
+
+    @classmethod
+    def _shard_snapshot_path(
+        cls,
+        base: Optional[str],
+        snapshot_path: Optional[str],
+        index: int,
+        n: int,
+    ) -> Optional[str]:
+        if snapshot_path is not None and n == 1:
+            return snapshot_path
+        jp = cls._shard_journal_path(base, index, n)
+        return jp + ".snap" if jp else None
+
+    @staticmethod
+    def _infer_shards(journal_path: str) -> Optional[int]:
+        """Shard count of an existing on-disk layout: ``<path>.s<i>``
+        files (or their snapshots) win; a bare ``<path>``/``.snap`` is
+        the 1-shard (legacy) layout; nothing on disk means no layout."""
+        import glob
+        import os
+        import re
+
+        found = -1
+        pat = re.compile(
+            re.escape(journal_path) + r"\.s(\d+)(\.snap)?$"
+        )
+        for p in glob.glob(glob.escape(journal_path) + ".s*"):
+            m = pat.match(p)
+            if m:
+                found = max(found, int(m.group(1)))
+        if found >= 0:
+            return found + 1
+        if (
+            os.path.exists(journal_path)
+            or os.path.exists(journal_path + ".snap")
+        ):
+            return 1
+        return None
+
+    def _reshard(
+        self,
+        old_n: int,
+        journal_path: str,
+        snapshot_path: Optional[str],
+    ) -> None:
+        """Re-route an on-disk layout of `old_n` shards into the current
+        shard set: replay the old layout (full PR 8 recovery per old
+        shard), hash every live object to its new shard, checkpoint the
+        new shards (their journals start empty past the snapshot), then
+        drop the old files.  Runs from __init__ before sharing."""
+        import os
+
+        old = [
+            _StoreShard(
+                i,
+                self._shard_journal_path(journal_path, i, old_n),
+                self._shard_snapshot_path(
+                    journal_path, snapshot_path, i, old_n
+                ),
+                self._journal_sync,
+                None,
+                0.0,
+            )
+            for i in range(old_n)
+        ]
+        rv = 0
+        for osh in old:
+            osh._recover()
+            rv = max(rv, osh._last_rv)
+            for kind, objs in osh._objects.items():
+                for key, obj in objs.items():
+                    tgt = self._shards[
+                        self._hash_index(kind, obj.meta.namespace)
+                    ]
+                    tgt._objects.setdefault(kind, {})[key] = obj
+                    tgt._versions.setdefault(kind, {})[key] = (
+                        osh._versions[kind][key]
+                    )
+            if osh._journal is not None:
+                try:
+                    osh._journal.close()
+                except (OSError, ValueError):
+                    pass
+        old_files = []
+        for osh in old:
+            old_files += [osh._journal_path, osh._snapshot_path]
+        for shard in self._shards:
+            shard._last_rv = rv
+            shard._open_journal()
+            shard._checkpoint_locked(truncate=True)
+        keep = set()
+        for shard in self._shards:
+            keep.update({shard._journal_path, shard._snapshot_path})
+        for path in old_files:
+            if path and path not in keep and os.path.exists(path):
+                os.remove(path)
+
+    def _journal_flusher(self) -> None:
+        while True:
+            time.sleep(_StoreShard._JOURNAL_FLUSH_S)
+            live = False
+            for shard in self._shards:
+                with shard._lock:
+                    if shard._journal is None:
+                        continue
+                    live = True
+                    if shard._journal_dirty:
+                        try:
+                            shard._journal.flush()
+                        except ValueError:  # closed mid-compaction race
+                            pass
+                        shard._journal_dirty = False
+                        shard._journal_flushed_at = time.monotonic()
+            if not live:
+                return
+
+    # -- aggregated shard counters (legacy single-store surface) -----------
+
+    def _sum(self, field: str) -> int:
+        return sum(getattr(shard, field) for shard in self._shards)
+
+    @property
+    def journal_recovered_records(self) -> int:
+        return self._sum("journal_recovered_records")
+
+    @property
+    def journal_tail_truncations(self) -> int:
+        return self._sum("journal_tail_truncations")
+
+    @property
+    def journal_write_errors(self) -> int:
+        return self._sum("journal_write_errors")
+
+    @property
+    def journal_torn_waves(self) -> int:
+        return self._sum("journal_torn_waves")
+
+    @property
+    def snapshot_fallbacks(self) -> int:
+        return self._sum("snapshot_fallbacks")
+
+    @property
+    def checkpoints_total(self) -> int:
+        return self._sum("checkpoints_total")
+
+    @property
+    def snapshot_records(self) -> int:
+        return self._sum("snapshot_records")
+
+    @property
+    def journal_suffix_records(self) -> int:
+        return self._sum("journal_suffix_records")
 
     # -- helpers -----------------------------------------------------------
 
@@ -887,47 +1239,79 @@ class Store:
             raise TypeError(f"object {obj!r} has no KIND")
         return kind
 
+    def _write_guard(self):
+        """Admission-armed writes hold the admission lock THROUGH the
+        commit (check-then-act atomicity across shards — two concurrent
+        creates must not both pass quota or allocate one ClusterIP);
+        plain stores pay nothing."""
+        if self._admission is not None:
+            return self._admission_lock
+        return nullcontext()
+
     def _dispatch(self, ev: Event) -> None:
-        # caller holds the lock: ring append + backlog handoff only —
-        # the fan-out itself runs on the dispatch thread off the lock
+        # caller holds the publish lock: global ring append + backlog
+        # handoff to the owning shard only — the fan-out itself runs on
+        # that shard's dispatch thread off every lock
         self._buffer.append(ev)
         if len(self._buffer) > self._buffer_size:
             del self._buffer[: self._buffer_size // 4]
-        self._queue_fanout_locked(ev.kind, [ev])
+        self._queue_fanout_locked(
+            self._hash_index(ev.kind, ev.obj.meta.namespace),
+            ev.kind, [ev],
+        )
 
-    def _queue_fanout_locked(self, kind: str, events: List[Event]) -> None:
-        # caller holds the lock.  No watchers for the kind means no
-        # delivery obligation: a watcher registered later replays from
-        # the ring (watch(from_rv)) or starts from-now with _last_rv
-        # pinned to the current rv, so skipping the backlog is exact.
+    def _dispatch_wave(self, kind: str, events: List[Event]) -> None:
+        # caller holds the publish lock; one ring extend + ONE backlog
+        # handoff for the whole sub-wave (the shard's fan-out thread
+        # delivers it as a batch)
+        self._buffer.extend(events)
+        excess = len(self._buffer) - self._buffer_size
+        if excess > 0:
+            del self._buffer[: excess + self._buffer_size // 4]
+        self._queue_fanout_locked(
+            self._hash_index(kind, events[0].obj.meta.namespace),
+            kind, events,
+        )
+
+    def _queue_fanout_locked(
+        self, sid: int, kind: str, events: List[Event]
+    ) -> None:
+        # caller holds the publish lock.  No watchers for the kind means
+        # no delivery obligation: a watcher registered later replays
+        # from the ring (watch(from_rv)) or starts from-now with its
+        # horizons pinned to the current rv, so skipping the backlog is
+        # exact.
         if not self._watchers.get(kind):
             return
-        self._ensure_dispatcher_locked()
-        with self._dispatch_cv:
-            self._dispatch_backlog.append((kind, events))
-            self._dispatch_cv.notify_all()
+        shard = self._shards[sid]
+        with shard._dispatch_cv:
+            self._ensure_dispatcher_cv_held(shard)
+            shard._dispatch_backlog.append((kind, events))
+            shard._dispatch_cv.notify_all()
 
-    def _ensure_dispatcher_locked(self) -> None:
-        # caller holds the lock.  Lazy + self-healing: the thread starts
-        # with the first watcher and is restarted here if an injected
-        # crash killed it (every dispatch passes through this check).
-        t = self._dispatch_thread
+    def _ensure_dispatcher_cv_held(self, shard: _StoreShard) -> None:
+        # caller holds the shard's dispatch condition.  Lazy +
+        # self-healing: the thread starts with the first delivery and is
+        # restarted here if an injected crash killed it (every handoff
+        # passes through this check).
+        t = shard._dispatch_thread
         if t is not None and t.is_alive():
             return
         t = threading.Thread(
             target=_watch_dispatch_loop,
-            args=(weakref.ref(self),),
-            name="watch-dispatch",
+            args=(weakref.ref(self), shard.index),
+            name=f"watch-dispatch-{shard.index}",
             daemon=True,
         )
-        self._dispatch_thread = t
+        shard._dispatch_thread = t
         t.start()
 
     def _fan_out(self, kind: str, events: List[Event]) -> None:
-        """Deliver one committed batch to every watcher of `kind` — the
-        dispatch thread's half of the watch path, running OFF the store
-        lock so per-watcher coalescing work never blocks writers."""
-        with self._lock:
+        """Deliver one committed batch to every watcher of `kind` — a
+        shard dispatch thread's half of the watch path, running OFF
+        every store lock so per-watcher coalescing work never blocks
+        writers."""
+        with self._rv_lock:
             watchers = list(self._watchers.get(kind, ()))
         expired: List[Watch] = []
         for w in watchers:
@@ -942,64 +1326,100 @@ class Store:
             self._retire_expired_watch(w, kind)
 
     def _retire_expired_watch(self, w: Watch, kind: str) -> None:
-        with self._lock:
+        with self._rv_lock:
             ws = self._watchers.get(kind)
             if ws is not None and w in ws:
                 ws.remove(w)
             self.watch_expired_total += 1
-            with w._mu:  # Store._lock -> Watch._mu (same order as replay)
+            with w._mu:  # _rv_lock -> Watch._mu (same order as replay)
                 self._watch_coalesced_closed += w.coalesced
                 w.coalesced = 0
 
     # -- CRUD --------------------------------------------------------------
 
     def create(self, obj: Any) -> Any:
-        with self._lock:
+        with self._write_guard():
             admitted = False
             if self._admission is not None:
                 # admit a server-side COPY: mutators must never edit the
                 # caller's object (a rejected or conflicting write would
-                # leave the caller's template silently modified — every other
-                # store path deep-copies for exactly this isolation).
-                # Admission runs UNDER the store lock: store-reading
-                # plugins (quota validator, ClusterIP allocation) are
-                # check-then-act otherwise — two concurrent creates could
-                # both pass quota or allocate the same ClusterIP.  The
-                # reference enforces these inside a storage transaction;
-                # the lock is reentrant, so plugin reads are fine.
+                # leave the caller's template silently modified — every
+                # other store path deep-copies for exactly this
+                # isolation).  The admission lock is held through the
+                # commit, so store-reading plugins stay
+                # check-then-act-safe (see _write_guard).
                 obj = self._admission.admit(copy.deepcopy(obj), "CREATE")
                 admitted = True
             kind = self._kind_of(obj)
             meta = self._meta(obj)
             if kind in api.CLUSTER_SCOPED_KINDS and meta.namespace:
-                # resource scope normalization: cluster-scoped objects live
-                # at namespace "" regardless of what the caller set (the
-                # apiserver rejects these; normalizing keeps every
-                # convenience-default caller working)
+                # resource scope normalization: cluster-scoped objects
+                # live at namespace "" regardless of what the caller set
                 meta.namespace = ""
             key = _key(meta.namespace, meta.name)
-            objs = self._objects.setdefault(kind, {})
-            if key in objs:
-                raise AlreadyExists(f"{kind} {key} exists")
-            self._rv += 1
-            if not admitted:  # the admitted copy is already unaliased
-                obj = copy.deepcopy(obj)
-            obj.meta.resource_version = self._rv
-            if not obj.meta.creation_timestamp:
-                obj.meta.creation_timestamp = time.time()
+            shard = self._shards[self._hash_index(kind, meta.namespace)]
+            with shard._lock:
+                objs = shard._objects.setdefault(kind, {})
+                if key in objs:
+                    raise AlreadyExists(f"{kind} {key} exists")
+                if not admitted:  # the admitted copy is already unaliased
+                    obj = copy.deepcopy(obj)
+                if not obj.meta.creation_timestamp:
+                    obj.meta.creation_timestamp = time.time()
+                with self._rv_lock:
+                    rv = self._publish_one_locked(
+                        shard, ADDED, kind, key, obj
+                    )
+                shard._append_journal(ADDED, kind, key, obj, rv)
+                return copy.deepcopy(obj)
+
+    def _publish_one_locked(
+        self,
+        shard: _StoreShard,
+        op: str,
+        kind: str,
+        key: str,
+        obj: Any,
+        set_rv: bool = True,
+        event_copy: bool = False,
+    ) -> int:
+        """The tiny global publish step (caller holds the shard lock AND
+        the publish lock): allocate the rv, install/remove the object in
+        the shard maps, append the event to the ring and the shard
+        backlog.  The dispatched Event aliases the committed object by
+        default (no defensive copy): committed objects are never mutated
+        in place — an update replaces the map entry — and watch
+        consumers already share one Event payload across every watcher.
+        `set_rv=False` leaves the object's meta untouched (delete() of a
+        STORED object: mutating its rv would break the immutability the
+        lock-free list() cut depends on); `event_copy=True` deep-copies
+        the event payload (paths that hand the same object back to the
+        caller, who may mutate it while the fan-out is in flight)."""
+        self._rv += 1
+        rv = self._rv
+        if set_rv:
+            obj.meta.resource_version = rv
+        objs = shard._objects.setdefault(kind, {})
+        vers = shard._versions.setdefault(kind, {})
+        if op == DELETED:
+            objs.pop(key, None)
+            vers.pop(key, None)
+        else:
             objs[key] = obj
-            self._versions.setdefault(kind, {})[key] = self._rv
-            self._append_journal(ADDED, kind, key, obj, self._rv)
-            self._dispatch(Event(ADDED, kind, copy.deepcopy(obj), self._rv))
-            return copy.deepcopy(obj)
+            vers[key] = rv
+        shard._last_rv = rv
+        ev_obj = copy.deepcopy(obj) if event_copy else obj
+        self._dispatch(Event(op, kind, ev_obj, rv))
+        return rv
 
     def get(self, kind: str, name: str, namespace: str = "default") -> Any:
         if kind in api.CLUSTER_SCOPED_KINDS:
             namespace = ""
         key = _key(namespace, name)
-        with self._lock:
+        shard = self._shards[self._hash_index(kind, namespace)]
+        with shard._lock:
             try:
-                return copy.deepcopy(self._objects[kind][key])
+                return copy.deepcopy(shard._objects[kind][key])
             except KeyError:
                 raise NotFound(f"{kind} {key}") from None
 
@@ -1011,13 +1431,10 @@ class Store:
         loop's compare step).  copy_result=False skips the defensive
         deep copy of the return value for hot-path callers that discard
         it (the scheduler's bind wave) — the returned object is then the
-        STORED one and must not be mutated."""
-        with self._lock:
+        COMMITTED one and must not be mutated."""
+        with self._write_guard():
             admitted = False
             if self._admission is not None:
-                # under the lock for the same check-then-act reason as
-                # create(): store-reading validators must see a state no
-                # concurrent write can invalidate before the commit
                 obj = self._admission.admit(copy.deepcopy(obj), "UPDATE")
                 admitted = True
             kind = self._kind_of(obj)
@@ -1025,36 +1442,38 @@ class Store:
             if kind in api.CLUSTER_SCOPED_KINDS and meta.namespace:
                 meta.namespace = ""
             key = _key(meta.namespace, meta.name)
-            objs = self._objects.get(kind, {})
-            if key not in objs:
-                raise NotFound(f"{kind} {key}")
-            current_rv = self._versions[kind][key]
-            if not force and meta.resource_version != current_rv:
-                raise Conflict(
-                    f"{kind} {key}: rv {meta.resource_version} != {current_rv}"
-                )
-            self._rv += 1
-            if not admitted:
-                obj = copy.deepcopy(obj)
-            obj.meta.resource_version = self._rv
-            if (
-                obj.meta.deletion_timestamp is not None
-                and not obj.meta.finalizers
-            ):
-                # last finalizer dropped on a deleting object: the update
-                # completes the two-phase delete (store.go:1176)
-                objs.pop(key)
-                self._versions[kind].pop(key)
-                self._append_journal(DELETED, kind, key, None, self._rv)
-                self._dispatch(
-                    Event(DELETED, kind, copy.deepcopy(obj), self._rv)
-                )
-                return obj
-            objs[key] = obj
-            self._versions[kind][key] = self._rv
-            self._append_journal(MODIFIED, kind, key, obj, self._rv)
-            self._dispatch(Event(MODIFIED, kind, copy.deepcopy(obj), self._rv))
-            return copy.deepcopy(obj) if copy_result else obj
+            shard = self._shards[self._hash_index(kind, meta.namespace)]
+            with shard._lock:
+                objs = shard._objects.get(kind, {})
+                if key not in objs:
+                    raise NotFound(f"{kind} {key}")
+                current_rv = shard._versions[kind][key]
+                if not force and meta.resource_version != current_rv:
+                    raise Conflict(
+                        f"{kind} {key}: rv {meta.resource_version} != "
+                        f"{current_rv}"
+                    )
+                if not admitted:
+                    obj = copy.deepcopy(obj)
+                if (
+                    obj.meta.deletion_timestamp is not None
+                    and not obj.meta.finalizers
+                ):
+                    # last finalizer dropped on a deleting object: the
+                    # update completes the two-phase delete (store.go:1176)
+                    with self._rv_lock:
+                        rv = self._publish_one_locked(
+                            shard, DELETED, kind, key, obj,
+                            event_copy=True,  # obj is handed back below
+                        )
+                    shard._append_journal(DELETED, kind, key, None, rv)
+                    return obj
+                with self._rv_lock:
+                    rv = self._publish_one_locked(
+                        shard, MODIFIED, kind, key, obj
+                    )
+                shard._append_journal(MODIFIED, kind, key, obj, rv)
+                return copy.deepcopy(obj) if copy_result else obj
 
     def update_wave(
         self,
@@ -1064,14 +1483,21 @@ class Store:
         admit: bool = True,
         fence: Optional[FenceToken] = None,
     ) -> Tuple[List[str], Dict[str, Exception]]:
-        """Commit a wave of read-modify-write updates as ONE transaction.
+        """Commit a wave of read-modify-write updates as per-shard
+        transactions.
 
         `updates` is a list of (name, namespace, mutate) where mutate(obj)
-        edits a private copy of the stored object in place.  The whole
-        wave runs under one lock acquisition with ONE coalesced journal
-        append (a single write + flush for every record) and ONE watch
-        fan-out pass — the scheduler's bind wave pays per-pod costs only
-        for the copy and the mutation, not for lock/journal/dispatch.
+        edits a private copy of the stored object in place.  The wave is
+        partitioned by shard; each SUB-wave runs under one shard-lock
+        acquisition with ONE coalesced journal append (a single write +
+        flush for every record of that shard) and ONE watch fan-out
+        handoff — the scheduler's bind wave pays per-pod costs only for
+        the copy and the mutation, not for lock/journal/dispatch.  A
+        single-shard wave (one kind, one namespace — every bind sub-wave
+        the scheduler commits) is exactly the PR 1 single-transaction
+        contract; a wave SPANNING shards is atomic per shard, not across
+        them (callers that need cross-shard atomicity — none in-tree —
+        must partition with ``shard_index`` themselves).
 
         Failure splits per object, never per wave: a missing object, a
         mutate() exception, or an admission rejection lands in the
@@ -1081,50 +1507,62 @@ class Store:
         Each committed object still gets its own resourceVersion and its
         own watch Event, so watch/informer semantics are byte-identical
         to per-object update(); only the write-path overhead is shared.
-        The dispatched Event aliases the stored object (no defensive
-        copy): stored objects are never mutated in place after commit and
-        watch consumers already share one Event payload across every
-        watcher, so the alias adds no new mutability hazard — it removes
-        the single biggest per-pod cost of a 1k-pod bind wave.
 
-        `fence` (a FenceToken) makes the wave a LEADERSHIP-CONDITIONAL
-        transaction: under the store lock, the named Lease must still be
-        held by the token's identity at the token's acquisition
-        generation, or the whole wave is rejected with `Fenced` (counted
-        in `fenced_writes_total`) — a deposed leader's late bind wave
-        can never double-bind behind its successor's back (the etcd
-        lease-ownership txn compare)."""
+        `fence` (a FenceToken) makes every sub-wave a LEADERSHIP-
+        CONDITIONAL transaction: under the publish lock, the named Lease
+        must still be held by the token's identity at the token's
+        acquisition generation, or the sub-wave is rejected whole with
+        `Fenced` (counted in `fenced_writes_total`) — a deposed leader's
+        late bind wave can never double-bind behind its successor's back
+        (the etcd lease-ownership txn compare).  The fence is also
+        pre-checked before the first sub-wave so an already-stale wave
+        commits nothing."""
         faults.fire("store.update_wave", kind=kind, updates=len(updates))
         applied: List[str] = []
         errors: Dict[str, Exception] = {}
-        events: List[Event] = []
-        records: List[Tuple[str, str, Any, int]] = []
-        with self._lock:
+        # partition by shard, preserving caller order within each shard
+        groups: "OrderedDict[int, List[tuple]]" = OrderedDict()
+        for name, namespace, mutate in updates:
+            if kind in api.CLUSTER_SCOPED_KINDS:
+                namespace = ""
+            sid = self._hash_index(kind, namespace)
+            groups.setdefault(sid, []).append((name, namespace, mutate))
+        with self._write_guard():
             if fence is not None:
-                lease = self._objects.get("Lease", {}).get(
-                    _key(fence.namespace, fence.name)
+                # pre-flight: a wave staged by an already-deposed leader
+                # commits NOTHING (matches the single-store contract for
+                # empty and single-shard waves alike)
+                with self._rv_lock:
+                    self._check_fence_locked(fence)
+            for sid, group in groups.items():
+                a, e = self._update_subwave(
+                    self._shards[sid], kind, group, admit, fence
                 )
-                spec = getattr(lease, "spec", None)
-                if (
-                    spec is None
-                    or spec.holder_identity != fence.identity
-                    or (
-                        fence.generation is not None
-                        and spec.lease_transitions != fence.generation
-                    )
-                ):
-                    self.fenced_writes_total += 1
-                    holder = getattr(spec, "holder_identity", None)
-                    raise Fenced(
-                        f"wave fenced: lease {fence.namespace}/"
-                        f"{fence.name} held by {holder!r}, caller "
-                        f"{fence.identity!r} gen {fence.generation}"
-                    )
-            objs = self._objects.get(kind, {})
-            vers = self._versions.setdefault(kind, {})
-            for name, namespace, mutate in updates:
-                if kind in api.CLUSTER_SCOPED_KINDS:
-                    namespace = ""
+                applied.extend(a)
+                errors.update(e)
+        return applied, errors
+
+    def _update_subwave(
+        self,
+        shard: _StoreShard,
+        kind: str,
+        group: List[tuple],
+        admit: bool,
+        fence: Optional[FenceToken],
+    ) -> Tuple[List[str], Dict[str, Exception]]:
+        """One shard's sub-wave: prepare (copy + mutate + admit) under
+        the shard lock, publish atomically under the publish lock
+        (fence-checked), then ONE journal append for the sub-wave."""
+        faults.fire(
+            "store.shard.update_wave",
+            shard=shard.index, kind=kind, updates=len(group),
+        )
+        applied: List[str] = []
+        errors: Dict[str, Exception] = {}
+        with shard._lock:
+            objs = shard._objects.get(kind, {})
+            prepared: List[Tuple[str, Any]] = []   # (key, mutated copy)
+            for name, namespace, mutate in group:
                 key = _key(namespace, name)
                 cur = objs.get(key)
                 if cur is None:
@@ -1138,61 +1576,65 @@ class Store:
                 except Exception as e:  # noqa: BLE001 — per-object split
                     errors[key] = e
                     continue
-                self._rv += 1
-                obj.meta.resource_version = self._rv
-                if (
-                    obj.meta.deletion_timestamp is not None
-                    and not obj.meta.finalizers
-                ):
-                    # mirror update(): dropping the last finalizer on a
-                    # deleting object completes the two-phase delete
-                    objs.pop(key)
-                    vers.pop(key, None)
-                    records.append((DELETED, key, None, self._rv))
-                    events.append(Event(DELETED, kind, obj, self._rv))
-                else:
-                    objs[key] = obj
-                    vers[key] = self._rv
-                    records.append((MODIFIED, key, obj, self._rv))
-                    events.append(Event(MODIFIED, kind, obj, self._rv))
-                applied.append(key)
-            if records:
-                self._append_journal_wave(kind, records)
+                prepared.append((key, obj))
+            if not prepared:
+                return applied, errors
+            records: List[Tuple[str, str, Any, int]] = []
+            events: List[Event] = []
+            with self._rv_lock:
+                if fence is not None:
+                    self._check_fence_locked(fence)
+                vers = shard._versions.setdefault(kind, {})
+                for key, obj in prepared:
+                    self._rv += 1
+                    rv = self._rv
+                    obj.meta.resource_version = rv
+                    if (
+                        obj.meta.deletion_timestamp is not None
+                        and not obj.meta.finalizers
+                    ):
+                        # mirror update(): dropping the last finalizer on
+                        # a deleting object completes the two-phase delete
+                        objs.pop(key, None)
+                        vers.pop(key, None)
+                        records.append((DELETED, key, None, rv))
+                        events.append(Event(DELETED, kind, obj, rv))
+                    else:
+                        objs[key] = obj
+                        vers[key] = rv
+                        records.append((MODIFIED, key, obj, rv))
+                        events.append(Event(MODIFIED, kind, obj, rv))
+                    applied.append(key)
+                shard._last_rv = self._rv
                 self._dispatch_wave(kind, events)
+            shard._append_journal_wave(kind, records)
         return applied, errors
 
-    def _append_journal_wave(
-        self, kind: str, records: List[Tuple[str, str, Any, int]]
-    ) -> None:
-        # caller holds the lock; one write + one flush for the wave.
-        # Every record carries the wave id ("w") and the last one the
-        # terminator ("wz"): replay applies the wave atomically — a tail
-        # torn anywhere inside it drops the WHOLE wave, so a recovered
-        # store never holds half a bind wave.
-        if self._journal is None:
-            return
-        from . import wire
-
-        self._wave_seq += 1
-        wid = self._wave_seq
-        lines = []
-        for i, (op, key, obj, rv) in enumerate(records):
-            rec = {"op": op, "rv": rv, "kind": kind, "key": key, "w": wid}
-            if i == len(records) - 1:
-                rec["wz"] = 1
-            if op != DELETED:
-                rec["obj"] = wire.to_wire(obj)
-            lines.append(self._encode_record(rec))
-        self._journal_commit(lines)
-
-    def _dispatch_wave(self, kind: str, events: List[Event]) -> None:
-        # caller holds the lock; one buffer extend + ONE backlog handoff
-        # for the whole wave (the fan-out thread delivers it as a batch)
-        self._buffer.extend(events)
-        excess = len(self._buffer) - self._buffer_size
-        if excess > 0:
-            del self._buffer[: excess + self._buffer_size // 4]
-        self._queue_fanout_locked(kind, events)
+    def _check_fence_locked(self, fence: FenceToken) -> None:
+        # caller holds the publish lock — the Lease cannot change while
+        # the sub-wave publishes, so the compare-and-commit is atomic
+        lease_shard = self._shards[
+            self._hash_index("Lease", fence.namespace)
+        ]
+        lease = lease_shard._objects.get("Lease", {}).get(
+            _key(fence.namespace, fence.name)
+        )
+        spec = getattr(lease, "spec", None)
+        if (
+            spec is None
+            or spec.holder_identity != fence.identity
+            or (
+                fence.generation is not None
+                and spec.lease_transitions != fence.generation
+            )
+        ):
+            self.fenced_writes_total += 1
+            holder = getattr(spec, "holder_identity", None)
+            raise Fenced(
+                f"wave fenced: lease {fence.namespace}/"
+                f"{fence.name} held by {holder!r}, caller "
+                f"{fence.identity!r} gen {fence.generation}"
+            )
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> Any:
         """Remove an object.  Objects carrying finalizers get the
@@ -1204,8 +1646,9 @@ class Store:
         if kind in api.CLUSTER_SCOPED_KINDS:
             namespace = ""
         key = _key(namespace, name)
-        with self._lock:
-            objs = self._objects.get(kind, {})
+        shard = self._shards[self._hash_index(kind, namespace)]
+        with shard._lock:
+            objs = shard._objects.get(kind, {})
             if key not in objs:
                 raise NotFound(f"{kind} {key}")
             obj = objs[key]
@@ -1217,20 +1660,21 @@ class Store:
             if obj.meta.finalizers and obj.meta.deletion_timestamp is None:
                 obj = copy.deepcopy(obj)
                 obj.meta.deletion_timestamp = time.time()
-                self._rv += 1
-                obj.meta.resource_version = self._rv
-                objs[key] = obj
-                self._versions[kind][key] = self._rv
-                self._append_journal(MODIFIED, kind, key, obj, self._rv)
-                self._dispatch(
-                    Event(MODIFIED, kind, copy.deepcopy(obj), self._rv)
-                )
+                with self._rv_lock:
+                    rv = self._publish_one_locked(
+                        shard, MODIFIED, kind, key, obj
+                    )
+                shard._append_journal(MODIFIED, kind, key, obj, rv)
                 return copy.deepcopy(obj)
-            objs.pop(key)
-            self._versions[kind].pop(key)
-            self._rv += 1
-            self._append_journal(DELETED, kind, key, None, self._rv)
-            self._dispatch(Event(DELETED, kind, copy.deepcopy(obj), self._rv))
+            with self._rv_lock:
+                # the STORED object: its meta stays at its committed rv
+                # (set_rv=False) and the event payload is a copy — the
+                # raw object is returned to the caller below
+                rv = self._publish_one_locked(
+                    shard, DELETED, kind, key, obj,
+                    set_rv=False, event_copy=True,
+                )
+            shard._append_journal(DELETED, kind, key, None, rv)
             return obj
 
     def list(
@@ -1239,34 +1683,76 @@ class Store:
         namespace: Optional[str] = None,
         selector: Optional[Callable[[Any], bool]] = None,
     ) -> Tuple[List[Any], int]:
-        """(items, resource_version) — the ListAndWatch handoff point."""
+        """(items, resource_version) — the ListAndWatch handoff point.
+
+        The cut is POINT-IN-TIME CONSISTENT across shards: object
+        references and the rv are captured under the publish lock (all
+        publishes serialize through it, so a sub-wave is all-or-nothing
+        in the cut), and the defensive deep copies happen OUTSIDE the
+        lock — committed objects are immutable, an update replaces the
+        map entry — so the snapshot path no longer blocks writers for
+        the O(items) copy cost."""
         if faults._registry is not None:
             # relist-storm chaos: injected list latency models a control
             # plane whose snapshot path is the contended resource
             faults.fire("store.list", kind=kind)
-        with self._lock:
-            items = [
-                copy.deepcopy(o)
-                for o in self._objects.get(kind, {}).values()
-                if (namespace is None or o.meta.namespace == namespace)
-                and (selector is None or selector(o))
+        with self._rv_lock:
+            refs = [
+                o
+                for shard in self._shards
+                for o in shard._objects.get(kind, {}).values()
             ]
-            return items, self._rv
+            rv = self._rv
+        items = [
+            copy.deepcopy(o)
+            for o in refs
+            if (namespace is None or o.meta.namespace == namespace)
+            and (selector is None or selector(o))
+        ]
+        return items, rv
 
     def kinds(self) -> List[str]:
         """Object kinds the store currently holds (the GC/namespace
         controllers sweep every kind, like the reference's
         RESTMapper-driven resource discovery)."""
-        with self._lock:
-            return [k for k, objs in self._objects.items() if objs]
+        with self._rv_lock:
+            out: List[str] = []
+            for shard in self._shards:
+                for k, objs in shard._objects.items():
+                    if objs and k not in out:
+                        out.append(k)
+            return out
+
+    # -- checkpoint --------------------------------------------------------
+
+    def checkpoint(self, truncate: bool = True) -> int:
+        """Checkpoint every shard: each writes a point-in-time snapshot
+        of its live objects and (by default) truncates its journal past
+        the checkpoint rv, bounding the next recovery to N × (snapshot +
+        journal suffix).  Crash-safe by construction per shard
+        (write-temp + fsync + atomic-rename + dir fsync; the journal is
+        only truncated AFTER the snapshot is durable).  Shards
+        checkpoint one at a time — a crash between shards leaves some
+        shards on the old snapshot + full journal, which recovery
+        handles per shard.  ``truncate=False`` keeps the journals
+        (full-replay oracle mode — the chaos suite's bit-parity check).
+        Returns the total snapshot record count."""
+        total = 0
+        for shard in self._shards:
+            with shard._lock:
+                total += shard._checkpoint_locked(truncate=truncate)
+        return total
 
     # -- watch -------------------------------------------------------------
 
     def watch(self, kind: str, from_rv: Optional[int] = None) -> Watch:
         """Stream events for `kind` after `from_rv` (exclusive).  None
         means 'from now'.  Raises Expired when from_rv predates the event
-        buffer — relist and retry (reflector.go 410 handling)."""
-        with self._lock:
+        buffer — relist and retry (reflector.go 410 handling).  The ring
+        is GLOBAL and rv-ordered (appends happen under the publish
+        lock), so replay across shards is exactly the single-store
+        replay."""
+        with self._rv_lock:
             w = Watch(self, self._watch_capacity)
             if from_rv is not None:
                 oldest_known = self._buffer[0].rv if self._buffer else self._rv + 1
@@ -1287,17 +1773,16 @@ class Store:
                                 "watch buffer; relist"
                             )
             with w._mu:
-                # pin the dedup horizon to the commit the registration
+                # pin the dedup horizons to the commit the registration
                 # is consistent with: backlog stragglers at or below it
                 # were covered by the replay (or predate a from-now
                 # watch) and must not be re-delivered
-                w._last_rv = max(w._last_rv, self._rv)
+                w._pin_locked(self._rv)
             self._watchers.setdefault(kind, []).append(w)
-            self._ensure_dispatcher_locked()
             return w
 
     def _drop_watch(self, w: Watch) -> None:
-        with self._lock:
+        with self._rv_lock:
             for ws in self._watchers.values():
                 if w in ws:
                     ws.remove(w)
@@ -1311,7 +1796,7 @@ class Store:
         backlog, total compacted events, expiries, and (legacy)
         destructive terminations — mirrored into the scheduler Registry
         as scheduler_watch_* gauges every cycle."""
-        with self._lock:
+        with self._rv_lock:
             depth = 0
             coalesced = self._watch_coalesced_closed
             for ws in self._watchers.values():
@@ -1329,53 +1814,64 @@ class Store:
     # -- lifecycle ---------------------------------------------------------
 
     def close(self, timeout: float = 5.0) -> None:
-        """Graceful shutdown: drain the watch-dispatch backlog (pending
-        committed batches reach their watchers), then flush AND fsync
-        the journal before returning — under ``journal_sync="interval"``
-        the final dirty group-commit batch would otherwise sit in the
-        userspace buffer and die with the process.  The store stops
-        journaling afterwards; reads keep working (tests inspect closed
-        stores)."""
+        """Graceful shutdown: drain every shard's watch-dispatch backlog
+        (pending committed batches reach their watchers), then flush AND
+        fsync every shard journal before returning — under
+        ``journal_sync="interval"`` the final dirty group-commit batch
+        would otherwise sit in the userspace buffer and die with the
+        process.  The store stops journaling afterwards; reads keep
+        working (tests inspect closed stores)."""
         import os
 
         deadline = time.monotonic() + timeout
-        with self._dispatch_cv:
-            while (
-                (self._dispatch_backlog or self._dispatch_inflight)
-                and time.monotonic() < deadline
-            ):
-                self._dispatch_cv.wait(0.05)
-        with self._lock:
-            j, self._journal = self._journal, None
-            self._journal_dirty = False
-        if j is not None:
-            try:
-                j.flush()
-                os.fsync(j.fileno())
-                j.close()
-            except (OSError, ValueError):
-                logging.getLogger(__name__).exception(
-                    "journal close flush failed; tail durability degraded"
-                )
+        for shard in self._shards:
+            with shard._dispatch_cv:
+                while (
+                    (shard._dispatch_backlog or shard._dispatch_inflight)
+                    and time.monotonic() < deadline
+                ):
+                    shard._dispatch_cv.wait(0.05)
+        for shard in self._shards:
+            with shard._lock:
+                j, shard._journal = shard._journal, None
+                shard._journal_dirty = False
+            if j is not None:
+                try:
+                    j.flush()
+                    os.fsync(j.fileno())
+                    j.close()
+                except (OSError, ValueError):
+                    logging.getLogger(__name__).exception(
+                        "journal close flush failed; tail durability "
+                        "degraded"
+                    )
 
     def state_fingerprint(self) -> Dict[str, Any]:
         """A stable, comparison-friendly serialization of the full
-        committed state: store rv plus (kind, key) -> (rv, wire(obj)).
-        Two stores with equal fingerprints hold bit-identical state —
-        the chaos suite compares snapshot+suffix recovery against a
-        full-replay oracle with this."""
+        committed state: store rv plus (kind, key) -> (rv, wire(obj)),
+        merged across shards (shard topology is invisible — a 1-shard
+        and an 8-shard store holding the same objects fingerprint
+        identically).  Two stores with equal fingerprints hold
+        bit-identical state — the chaos suite compares snapshot+suffix
+        recovery against a full-replay oracle with this."""
         from . import wire
 
-        with self._lock:
+        with self._rv_lock:
+            merged: Dict[str, Dict[str, tuple]] = {}
+            for shard in self._shards:
+                for kind, objs in shard._objects.items():
+                    if not objs:
+                        continue
+                    out = merged.setdefault(kind, {})
+                    for key, obj in objs.items():
+                        out[key] = (
+                            shard._versions[kind][key], wire.to_wire(obj)
+                        )
             return {
                 "rv": self._rv,
                 "objects": {
-                    kind: {
-                        key: (self._versions[kind][key], wire.to_wire(obj))
-                        for key, obj in sorted(objs.items())
-                    }
-                    for kind, objs in sorted(self._objects.items())
-                    if objs
+                    kind: dict(sorted(entries.items()))
+                    for kind, entries in sorted(merged.items())
                 },
             }
 
@@ -1383,33 +1879,35 @@ class Store:
 
     @property
     def resource_version(self) -> int:
-        with self._lock:
+        with self._rv_lock:
             return self._rv
 
 
-def _watch_dispatch_loop(store_ref: "weakref.ref[Store]") -> None:
-    """The fan-out worker: drains the store's dispatch backlog and
-    delivers each committed batch to its watchers off the store lock.
+def _watch_dispatch_loop(store_ref: "weakref.ref[Store]", sid: int) -> None:
+    """One shard's fan-out worker: drains that shard's dispatch backlog
+    and delivers each committed batch to its watchers off every store
+    lock.
 
     Holds the store only through a weakref between iterations, so an
-    abandoned store's dispatcher exits instead of leaking one polling
-    thread per Store (tests construct thousands).  Fault-schedule
+    abandoned store's dispatchers exit instead of leaking polling
+    threads per Store (tests construct thousands).  Fault-schedule
     exceptions escaping a delivery are contained — a poisoned offer must
-    not take the whole fan-out path down (and _ensure_dispatcher_locked
+    not take the shard's fan-out path down (and the handoff path
     restarts the thread if something interpreter-grade does)."""
     while True:
         store = store_ref()
         if store is None:
             return
+        shard = store._shards[sid]
         batch = None
-        with store._dispatch_cv:
-            if not store._dispatch_backlog:
-                store._dispatch_cv.wait(0.2)
-            if store._dispatch_backlog:
-                batch = store._dispatch_backlog.popleft()
+        with shard._dispatch_cv:
+            if not shard._dispatch_backlog:
+                shard._dispatch_cv.wait(0.2)
+            if shard._dispatch_backlog:
+                batch = shard._dispatch_backlog.popleft()
                 # close() waits for backlog-empty AND not-inflight, so a
                 # batch mid-fan-out still blocks a graceful shutdown
-                store._dispatch_inflight = True
+                shard._dispatch_inflight = True
         if batch is not None:
             try:
                 store._fan_out(*batch)
@@ -1418,10 +1916,11 @@ def _watch_dispatch_loop(store_ref: "weakref.ref[Store]") -> None:
                     "watch fan-out batch failed; continuing"
                 )
             finally:
-                with store._dispatch_cv:
-                    store._dispatch_inflight = False
-                    store._dispatch_cv.notify_all()
-        # drop the strong reference before sleeping so GC can collect
+                with shard._dispatch_cv:
+                    shard._dispatch_inflight = False
+                    shard._dispatch_cv.notify_all()
+        # drop the strong references before sleeping so GC can collect
         # an otherwise-abandoned store
         store = None
+        shard = None
         batch = None

@@ -84,6 +84,12 @@ class SharedInformer:
         self._expired_streak = 0  # consecutive Expired relists
         self._rng = random.Random()
         self.relists = 0          # observability (tests assert recovery)
+        # rv of the most recent relist cut.  With the sharded store a
+        # list() is a point-in-time-consistent cut across every shard
+        # (taken under the publish lock: sub-waves are all-or-nothing in
+        # it, and every item's rv is <= this value) — tests assert the
+        # cut contract through this bookmark.
+        self.last_relist_rv = 0
 
     # -- wiring ------------------------------------------------------------
 
@@ -172,6 +178,7 @@ class SharedInformer:
         with self._gate:  # bounded concurrent relists (storm containment)
             items, rv = self._store.list(self.kind)
         self.relists += 1
+        self.last_relist_rv = rv
         with self._lock:
             fresh = {self._obj_key(o): o for o in items}
             stale = set(self._cache) - set(fresh)

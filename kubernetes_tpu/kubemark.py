@@ -7,18 +7,54 @@ registers Node objects, heartbeats them through the API (MODIFIED events
 status half: bound pods transition to Running, so Jobs and controllers
 see lifecycle progress.
 
-This drives the FULL store/informer/queue path — the thing the solver
-bench can't see (VERDICT missing #10)."""
+Two layers:
+
+  HollowCluster  the hollow kubelet fleet.  Heartbeats are BATCHED —
+                 each tick commits one ``Store.update_wave`` over its
+                 node slice (one lock acquisition, one coalesced journal
+                 append, one watch fan-out handoff on the Node shard)
+                 instead of O(batch) single-object writes, and the tick
+                 is jittered so a 100k-node fleet doesn't monopolize the
+                 Node shard in phase-locked bursts.
+  FleetHarness   the first-class fleet driver (bench ``c8_store_100k``):
+                 registers up to 100k hollow nodes, runs a SUSTAINED
+                 pod-lifecycle soak (create → bind via per-shard
+                 update_wave sub-waves committed concurrently → hollow
+                 kubelets run them → delete) across many namespaces so
+                 the waves exercise the sharded store, and reports
+                 SLO-style p50/p90/p99 lifecycle latency plus
+                 lost/double-bound counts.
+
+This drives the FULL store/watch/journal path at fleet scale — the
+thing the solver bench can't see (VERDICT missing #10; ROADMAP's
+"heavy traffic from millions of users" axis)."""
 
 from __future__ import annotations
 
+import random
 import threading
 import time
-from typing import List, Optional
+from collections import defaultdict
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
 
 from .api import store as st
 from .api import types as api
-from .testing.wrappers import GI, make_node
+from .testing.wrappers import GI, MI, make_node, make_pod
+
+
+def percentiles(samples: List[float]) -> Dict[str, float]:
+    """SLO-style latency summary: p50/p90/p99 by nearest-rank over the
+    sample list (empty list reports zeros)."""
+    if not samples:
+        return {"p50": 0.0, "p90": 0.0, "p99": 0.0}
+    s = sorted(samples)
+    n = len(s)
+
+    def rank(q: float) -> float:
+        return s[min(n - 1, max(0, int(q * n + 0.5) - 1))]
+
+    return {"p50": rank(0.50), "p90": rank(0.90), "p99": rank(0.99)}
 
 
 class HollowCluster:
@@ -32,14 +68,23 @@ class HollowCluster:
         pods_cap: int = 110,
         heartbeat_interval: float = 10.0,
         run_pods: bool = True,
+        # fraction of the tick period each sleep is jittered by (±):
+        # de-phases heartbeat waves so the fleet never lands on the Node
+        # shard in lockstep with the binder's sub-waves
+        heartbeat_jitter: float = 0.2,
     ):
         self.store = store
         self.n_nodes = n_nodes
         self.heartbeat_interval = heartbeat_interval
+        self.heartbeat_jitter = heartbeat_jitter
         self.run_pods = run_pods
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self.node_names = [f"hollow-{i}" for i in range(n_nodes)]
+        # observability: wave-committed heartbeat batches (tests assert
+        # the loop batches instead of issuing per-node writes)
+        self.heartbeat_waves = 0
+        self.heartbeats = 0
         self._specs = [
             make_node(name)
             .capacity(cpu_milli=cpu_milli, mem=mem, pods=pods_cap)
@@ -79,22 +124,36 @@ class HollowCluster:
     # -- loops -------------------------------------------------------------
 
     def _heartbeat_loop(self) -> None:
-        """Round-robin status heartbeats (nodeStatusUpdateFrequency):
-        each tick re-writes one batch of Node objects so the control
-        plane sees steady NodeUpdate churn like a real cluster."""
+        """Round-robin status heartbeats (nodeStatusUpdateFrequency),
+        BATCHED: each jittered tick commits its node slice through ONE
+        ``update_wave`` — one lock acquisition, one coalesced journal
+        append and one fan-out handoff on the Node shard, instead of
+        O(batch) single-object writes — so the harness itself never
+        monopolizes the shard it shares with real Node traffic."""
         i = 0
         per_tick = max(1, self.n_nodes // 10)
         tick = self.heartbeat_interval / 10
-        while not self._stop.wait(tick):
-            for _ in range(per_tick):
-                name = self.node_names[i % self.n_nodes]
-                i += 1
-                try:
-                    node = self.store.get("Node", name, namespace="")
-                    node.meta.annotations["hollow/heartbeat"] = str(time.time())
-                    self.store.update(node, force=True)
-                except st.NotFound:
-                    pass
+        rng = random.Random(0x5EED ^ self.n_nodes)
+        j = self.heartbeat_jitter
+        while not self._stop.wait(tick * (1.0 + rng.uniform(-j, j))):
+            batch = [
+                self.node_names[(i + k) % self.n_nodes]
+                for k in range(min(per_tick, self.n_nodes))
+            ]
+            i = (i + per_tick) % self.n_nodes
+            now = str(time.time())
+
+            def beat(node) -> None:
+                node.meta.annotations["hollow/heartbeat"] = now
+
+            try:
+                applied, _ = self.store.update_wave(
+                    "Node", [(name, "", beat) for name in batch]
+                )
+            except Exception:  # noqa: BLE001 — heartbeat best-effort
+                continue
+            self.heartbeat_waves += 1
+            self.heartbeats += len(applied)
 
     def _pod_runner(self) -> None:
         """The kubelet status half: bound Pending pods become Running
@@ -139,3 +198,226 @@ class HollowCluster:
                     self.store.update(fresh, force=True)
             except st.NotFound:
                 pass
+
+
+class _LifecycleAudit:
+    """Watches the Pod stream and records, per pod key: the node(s) it
+    was ever bound to (double-bind detection) and the instant it was
+    first observed Running (lifecycle-latency half).  Poll-style
+    consumer: an Expired stream relists and resumes, so audit coverage
+    survives overload."""
+
+    def __init__(self, store: st.Store):
+        self.store = store
+        self.bound_nodes: Dict[str, set] = defaultdict(set)
+        self.running_at: Dict[str, float] = {}
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="fleet-audit", daemon=True
+        )
+        self._thread.start()
+
+    def _note(self, pod) -> None:
+        key = f"{pod.meta.namespace}/{pod.meta.name}"
+        with self._mu:
+            if pod.spec.node_name:
+                self.bound_nodes[key].add(pod.spec.node_name)
+            if pod.status.phase == "Running" and key not in self.running_at:
+                self.running_at[key] = time.perf_counter()
+
+    def _run(self) -> None:
+        w = self.store.watch("Pod")
+        try:
+            while not self._stop.is_set():
+                if w.stopped:
+                    w.stop()
+                    pods, rv = self.store.list("Pod")
+                    for pod in pods:
+                        self._note(pod)
+                    w = self.store.watch("Pod", from_rv=rv)
+                    continue
+                ev = w.get(timeout=0.2)
+                if ev is None:
+                    continue
+                if ev.type in (st.ADDED, st.MODIFIED):
+                    self._note(ev.obj)
+        finally:
+            w.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def double_bound(self) -> Dict[str, set]:
+        with self._mu:
+            return {
+                k: set(v) for k, v in self.bound_nodes.items() if len(v) > 1
+            }
+
+    def first_running(self, key: str) -> Optional[float]:
+        with self._mu:
+            return self.running_at.get(key)
+
+
+class FleetHarness:
+    """The first-class hollow-node fleet driver: a HollowCluster plus a
+    sustained pod-lifecycle soak with SLO-style reporting.
+
+    ``soak`` runs rounds of: create `round_pods` pods spread across
+    `namespaces` (so they hash across store shards), bind each
+    namespace's slice through its own ``update_wave`` sub-wave — the
+    sub-waves commit CONCURRENTLY, the binder-overlap shape the sharded
+    store exists for — wait for the hollow kubelets to run every pod
+    (recording per-pod create→Running latency), then delete the round.
+    The audit watcher independently verifies no pod is ever bound to
+    two nodes and no created pod is lost."""
+
+    def __init__(
+        self,
+        store: st.Store,
+        n_nodes: int,
+        namespaces: int = 8,
+        heartbeat_interval: float = 30.0,
+        bind_concurrency: int = 4,
+        zones: int = 16,
+    ):
+        self.store = store
+        self.namespaces = [f"fleet-{i}" for i in range(namespaces)]
+        self.hollow = HollowCluster(
+            store, n_nodes,
+            zones=zones,
+            heartbeat_interval=heartbeat_interval,
+            run_pods=True,
+        )
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, bind_concurrency),
+            thread_name_prefix="fleet-bind",
+        )
+        self.audit: Optional[_LifecycleAudit] = None
+
+    def start(self) -> "FleetHarness":
+        self.audit = _LifecycleAudit(self.store)
+        self.hollow.start()
+        return self
+
+    def stop(self) -> None:
+        self.hollow.stop()
+        if self.audit is not None:
+            self.audit.stop()
+        self._pool.shutdown(wait=False)
+
+    # -- the sustained lifecycle soak --------------------------------------
+
+    def _bind_round(self, keys: List[tuple]) -> int:
+        """Bind one round's pods round-robin onto hollow nodes: one
+        update_wave sub-wave per namespace (each a single-shard atomic
+        transaction), committed concurrently on the pool."""
+        n_nodes = self.hollow.n_nodes
+        by_ns: Dict[str, List[tuple]] = defaultdict(list)
+        for idx, (name, ns) in enumerate(keys):
+            by_ns[ns].append((name, f"hollow-{(idx * 131) % n_nodes}"))
+
+        def bind_ns(ns, entries):
+            def mutator(node_name):
+                def mutate(pod) -> None:
+                    if pod.spec.node_name and pod.spec.node_name != node_name:
+                        raise st.Conflict(
+                            f"pod already bound to {pod.spec.node_name}"
+                        )
+                    pod.spec.node_name = node_name
+                return mutate
+
+            applied, errors = self.store.update_wave(
+                "Pod",
+                [(name, ns, mutator(node)) for name, node in entries],
+            )
+            return len(applied)
+
+        futures = [
+            self._pool.submit(bind_ns, ns, entries)
+            for ns, entries in by_ns.items()
+        ]
+        return sum(f.result() for f in futures)
+
+    def soak(
+        self,
+        total_pods: int,
+        round_pods: int = 1024,
+        cpu_milli: int = 50,
+        round_timeout: float = 60.0,
+    ) -> Dict[str, object]:
+        """Run the sustained lifecycle soak; returns the SLO report."""
+        assert self.audit is not None, "start() the harness first"
+        latencies: List[float] = []
+        lost: List[str] = []
+        created = 0
+        rounds = 0
+        bind_s = 0.0
+        t0 = time.perf_counter()
+        while created < total_pods:
+            n = min(round_pods, total_pods - created)
+            keys = []
+            t_create = time.perf_counter()
+            for k in range(n):
+                i = created + k
+                ns = self.namespaces[i % len(self.namespaces)]
+                pod = (
+                    make_pod(f"soak-{i}")
+                    .req(cpu_milli=cpu_milli, mem=8 * MI)
+                    .obj()
+                )
+                pod.meta.namespace = ns
+                self.store.create(pod)
+                keys.append((f"soak-{i}", ns))
+            created += n
+            rounds += 1
+            t_bind = time.perf_counter()
+            self._bind_round(keys)
+            bind_s += time.perf_counter() - t_bind
+            # wait for the hollow kubelets: every pod of the round must
+            # reach Running inside the round budget or count as lost
+            deadline = time.monotonic() + round_timeout
+            pending = {f"{ns}/{name}" for name, ns in keys}
+            while pending and time.monotonic() < deadline:
+                done = {
+                    k for k in pending
+                    if self.audit.first_running(k) is not None
+                }
+                pending -= done
+                if pending:
+                    time.sleep(0.01)
+            for name, ns in keys:
+                key = f"{ns}/{name}"
+                at = self.audit.first_running(key)
+                if at is None:
+                    lost.append(key)
+                else:
+                    latencies.append(at - t_create)
+            # the delete half of the lifecycle: the round leaves the
+            # store (sustained churn, not unbounded growth)
+            for name, ns in keys:
+                try:
+                    self.store.delete("Pod", name, ns)
+                except st.NotFound:
+                    pass
+        wall = time.perf_counter() - t0
+        pct = percentiles(latencies)
+        return {
+            "nodes": self.hollow.n_nodes,
+            "pods": created,
+            "rounds": rounds,
+            "soak_wall_s": round(wall, 4),
+            "lifecycle_pods_per_s": round(created / wall, 1) if wall else 0.0,
+            "lifecycle_p50_ms": round(pct["p50"] * 1000, 2),
+            "lifecycle_p90_ms": round(pct["p90"] * 1000, 2),
+            "lifecycle_p99_ms": round(pct["p99"] * 1000, 2),
+            "lost_pods": len(lost),
+            "double_bound_pods": len(self.audit.double_bound()),
+            # wall share each round spent inside the concurrent
+            # per-shard bind sub-waves (the commit half of the step)
+            "bind_s_total": round(bind_s, 4),
+            "commit_share_per_step": round(bind_s / wall, 4) if wall else 0.0,
+            "heartbeat_waves": self.hollow.heartbeat_waves,
+            "heartbeats": self.hollow.heartbeats,
+        }

@@ -170,6 +170,10 @@ class MetricsCollector:
         "scheduler_schedule_batch_duration_seconds",
         "scheduler_commit_wave_duration_seconds",
         "scheduler_pipeline_overlap_seconds",
+        # sharded-store commit fan-out: per-shard sub-wave durations and
+        # the realized cross-shard commit overlap (docs/scheduler_loop.md)
+        "scheduler_commit_subwave_duration_seconds",
+        "scheduler_commit_subwave_overlap_seconds",
     )
 
     # count-unit histograms: reported as raw percentiles (no ms scaling —
@@ -206,6 +210,7 @@ class MetricsCollector:
         "scheduler_store_snapshot_records",
         "scheduler_store_journal_suffix_records",
         "scheduler_store_checkpoints_total",
+        "scheduler_store_shard_count",
         "scheduler_fenced_writes_total",
         "scheduler_leader_reconcile_total",
         # overload protection: watch fan-out backpressure + adaptive

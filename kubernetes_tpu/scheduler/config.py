@@ -85,6 +85,13 @@ class SchedulerConfiguration:
     # padded node buckets split evenly.  Consulted at registry build
     # time together with the ShardedSolve feature gate.
     mesh_devices: int = 0
+    # sharded-store commit fan-out (docs/scheduler_loop.md): a bind wave
+    # is partitioned into per-store-shard sub-waves and the binder
+    # commits up to this many concurrently, so shard A's journal fsync /
+    # watch fan-out overlaps shard B's (and the next solve).  1
+    # serializes sub-waves; the effective width is min(this, store
+    # shards).
+    commit_subwave_concurrency: int = 4
     # parity-only knobs (see module docstring)
     parallelism: int = 16
     percentage_of_nodes_to_score: int = 100
@@ -166,6 +173,8 @@ class SchedulerConfiguration:
             raise ValueError("percentage_of_nodes_to_score must be 0..100")
         if self.max_preemptions_per_cycle < 0:
             raise ValueError("max_preemptions_per_cycle must be >= 0")
+        if self.commit_subwave_concurrency < 1:
+            raise ValueError("commit_subwave_concurrency must be >= 1")
         if self.mesh_devices < 0:
             raise ValueError("mesh_devices must be >= 0")
         if self.mesh_devices and (
@@ -196,7 +205,7 @@ _TOP_KEYS = {
     "featureGates", "batchSize", "batchWindowSeconds", "assumeTTLSeconds",
     "unschedulableFlushSeconds", "maxPreemptionsPerCycle",
     "adaptiveBatchWindow", "batchWindowMinSeconds", "batchWindowMaxSeconds",
-    "batchLatencySLOSeconds", "meshDevices",
+    "batchLatencySLOSeconds", "meshDevices", "commitSubwaveConcurrency",
 }
 
 
@@ -257,6 +266,8 @@ def load_config(source: Any) -> SchedulerConfiguration:
         cfg.max_preemptions_per_cycle = int(doc["maxPreemptionsPerCycle"])
     if "meshDevices" in doc:
         cfg.mesh_devices = int(doc["meshDevices"])
+    if "commitSubwaveConcurrency" in doc:
+        cfg.commit_subwave_concurrency = int(doc["commitSubwaveConcurrency"])
     if "featureGates" in doc:
         cfg.feature_gates = {
             str(k): bool(v) for k, v in (doc["featureGates"] or {}).items()

@@ -184,6 +184,18 @@ class Registry:
         self.pipeline_overlap = Histogram(
             "scheduler_pipeline_overlap_seconds"
         )
+        # one observation per per-store-shard sub-wave the binder
+        # commits (the sharded store's per-shard commit durations)
+        self.commit_subwave_duration = Histogram(
+            "scheduler_commit_subwave_duration_seconds"
+        )
+        # seconds of sub-wave commit work that ran CONCURRENTLY with
+        # another sub-wave of the same wave (sum of sub-wave durations
+        # minus the wave's commit wall time) — the realized cross-shard
+        # commit overlap; 0 means sub-waves serialized
+        self.commit_subwave_overlap = Histogram(
+            "scheduler_commit_subwave_overlap_seconds"
+        )
         # OUR solve-side pipeline metrics (no reference analogue):
         # waves per wavefront-routed greedy solve (ops.assign wavefront:
         # the scan's P sequential steps collapse to ~P/W)
@@ -254,6 +266,9 @@ class Registry:
         self.store_checkpoints_total = Gauge(
             "scheduler_store_checkpoints_total"
         )
+        # (kind, namespace)-hash shards the store splits its
+        # locks/journals/watch fan-out across (1 = unsharded legacy)
+        self.store_shard_count = Gauge("scheduler_store_shard_count")
         # bind waves the store rejected because the committing leader's
         # fence token was stale (a deposed leader's late wave)
         self.fenced_writes_total = Gauge("scheduler_fenced_writes_total")

@@ -63,6 +63,15 @@ def pod_key(pod: api.Pod) -> str:
     return f"{pod.meta.namespace}/{pod.meta.name}"
 
 
+def gang_key(pod: api.Pod) -> Optional[str]:
+    """The queue's gang identity: "namespace/group", or None for
+    ungrouped pods.  Same-named groups in different namespaces are
+    distinct gangs (the PodGroup is a namespaced object in the
+    reference; CoschedulingPermit quorums are per namespace too)."""
+    group = pod.spec.scheduling_group
+    return f"{pod.meta.namespace}/{group}" if group else None
+
+
 class AdaptiveBatchWindow:
     """Load-adaptive accumulation window for ``pop_batch``.
 
@@ -247,11 +256,18 @@ class SchedulingQueue:
         self._infos: Dict[str, QueuedPodInfo] = {}   # all known pending pods
         self._tier: Dict[str, str] = {}          # key -> active|backoff|unsched|gated|gangstage|inflight
         # Gang bookkeeping (the coscheduling PodGroup PreEnqueue pattern):
-        # _group_keys tracks every pending member per group (for atomic
-        # draining in pop_batch); _group_size is the group's declared
+        # _group_keys tracks every pending member per gang (for atomic
+        # draining in pop_batch); _group_size is the gang's declared
         # member count (max over members — one member declaring it is
         # enough); _gang_staged holds members of gangs that have not yet
-        # reached that size.
+        # reached that size.  Gangs are keyed "namespace/group"
+        # (_gang_of): same-named groups in different namespaces are
+        # DISTINCT gangs — pooling them inflated whole-gang counts and,
+        # worse, let one namespace's inflight member park another
+        # namespace's half-gang in pop_batch's gang pull forever (the
+        # per-namespace quorum the CoschedulingPermit r4 fix already
+        # established; the store's per-shard fan-out surfaced the queue
+        # half of the same bug by skewing cross-namespace pop timing).
         self._group_keys: Dict[str, set] = {}
         self._group_size: Dict[str, int] = {}
         self._gang_staged: Dict[str, QueuedPodInfo] = {}
@@ -342,7 +358,7 @@ class SchedulingQueue:
         a solve), otherwise push to active — releasing any members that
         were staged waiting for it.  Callers hold self._cond."""
         key = pod_key(info.pod)
-        group = info.pod.spec.scheduling_group
+        group = gang_key(info.pod)
         if group:
             self._group_keys.setdefault(group, set()).add(key)
             declared = info.pod.spec.scheduling_group_size
@@ -384,8 +400,8 @@ class SchedulingQueue:
             if info is None:
                 self.add(pod)
                 return
-            old_group = info.pod.spec.scheduling_group
-            new_group = pod.spec.scheduling_group
+            old_group = gang_key(info.pod)
+            new_group = gang_key(pod)
             info.pod = pod
             tier = self._tier.get(key)
             if old_group != new_group:
@@ -452,7 +468,7 @@ class SchedulingQueue:
             self._tier.pop(key, None)
             self._drop_group_member(pod, key)
             # lazy heap deletion: stale keys skipped on pop
-            group = pod.spec.scheduling_group
+            group = gang_key(pod)
             if group and group in self._group_keys:
                 size = self._group_size.get(group, 0)
                 if size and len(self._group_keys[group]) < size:
@@ -470,7 +486,7 @@ class SchedulingQueue:
             self._cond.notify_all()
 
     def _drop_group_member(self, pod: api.Pod, key: str) -> None:
-        group = pod.spec.scheduling_group
+        group = gang_key(pod)
         if group and group in self._group_keys:
             self._group_keys[group].discard(key)
             if not self._group_keys[group]:
@@ -540,7 +556,7 @@ class SchedulingQueue:
                         or key in skipped
                     ):
                         continue
-                    group = info.pod.spec.scheduling_group
+                    group = gang_key(info.pod)
                     if not group:
                         take(key)
                         continue

@@ -38,6 +38,7 @@ import logging
 import threading
 import time
 from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -331,6 +332,23 @@ class Scheduler:
         self._solve_lock = threading.Lock()
         self._solve_windows: deque = deque(maxlen=64)  # (start, end)
         self._solve_open: Optional[float] = None
+        # sharded-store commit fan-out: the binder partitions each wave
+        # into per-store-shard sub-waves and commits up to this many
+        # concurrently (shard A's journal fsync / watch fan-out overlaps
+        # shard B's and the next solve).  A 1-shard store keeps the
+        # serial single-transaction path and pays for no pool.
+        subwave_width = min(
+            self.config.commit_subwave_concurrency,
+            getattr(store, "shard_count", 1),
+        )
+        self._commit_pool: Optional[ThreadPoolExecutor] = (
+            ThreadPoolExecutor(
+                max_workers=subwave_width,
+                thread_name_prefix="commit-subwave",
+            )
+            if subwave_width > 1
+            else None
+        )
         self._bind_thread = threading.Thread(
             target=self._bind_worker, name="bind-wave", daemon=True
         )
@@ -468,6 +486,8 @@ class Scheduler:
             self._binder_stop = True
             self._wave_cv.notify_all()
         self._bind_thread.join(timeout=10)
+        if self._commit_pool is not None:
+            self._commit_pool.shutdown(wait=True)
         self.informers.stop()
         self.events.stop()
 
@@ -487,6 +507,8 @@ class Scheduler:
         if self._thread:
             self._thread.join(timeout=10)
         self._bind_thread.join(timeout=5)
+        if self._commit_pool is not None:
+            self._commit_pool.shutdown(wait=False)
         self.informers.stop()
         self.events.stop()
 
@@ -736,39 +758,20 @@ class Scheduler:
                     pod.status.phase = "Running"
                 return mutate
 
-            updates = [
-                (info.pod.meta.name, info.pod.meta.namespace,
-                 bind_mutator(node_name))
-                for _, info, node_name, _ in binds
-            ]
-            # stale-leader write fencing: the wave commits only while
-            # our lease acquisition is still current (a deposed
-            # leader's late wave is rejected inside the transaction —
-            # the Fenced path below requeues; the pods belong to the
+            # stale-leader write fencing: every sub-wave commits only
+            # while our lease acquisition is still current (a deposed
+            # leader's late sub-wave is rejected inside its transaction
+            # — the Fenced path below requeues; the pods belong to the
             # successor now)
             fence = None
             if self.leader_elector is not None:
                 token = getattr(self.leader_elector, "fence_token", None)
                 if token is not None:
                     fence = token()
-            try:
-                _, errors = self.store.update_wave(
-                    "Pod", updates, fence=fence
-                )
-            except st.Fenced:
-                logging.getLogger(__name__).warning(
-                    "bind wave fenced (leadership lost since staging); "
-                    "requeueing %d pod(s) for the new leader", len(binds),
-                )
-                errors = None  # whole wave requeued, no retry value
-            except Exception:  # noqa: BLE001
-                logging.getLogger(__name__).exception(
-                    "wave transaction failed; splitting to per-pod requeue"
-                )
-                errors = None  # whole-wave failure: requeue everyone
+            failed = self._commit_subwaves(binds, bind_mutator, fence)
             done: List[api.Pod] = []
             for fwk, info, node_name, t_attempt in binds:
-                if errors is None or pod_key(info.pod) in errors:
+                if pod_key(info.pod) in failed:
                     self._fail_bind(fwk, info)
                     continue
                 done.append(info.pod)
@@ -785,6 +788,77 @@ class Scheduler:
         self.metrics.pipeline_overlap.observe(
             self._solve_overlap(t0, self._clock())
         )
+
+    def _commit_subwaves(self, binds, bind_mutator, fence) -> set:
+        """Commit one bind wave as per-store-shard SUB-waves — each an
+        atomic ``update_wave`` transaction on its shard, committed
+        CONCURRENTLY (up to commit_subwave_concurrency) so shard A's
+        journal append / watch fan-out overlaps shard B's and the next
+        solve.  A 1-shard store (or a wave whose pods all live on one
+        shard) keeps the single-transaction path.  Returns the set of
+        pod keys that must requeue (per-object errors, a fenced
+        sub-wave, or a whole-sub-wave failure)."""
+        shard_of = getattr(self.store, "shard_index", None)
+        groups: "Dict[int, List[tuple]]" = {}
+        for entry in binds:
+            sid = (
+                shard_of("Pod", entry[1].pod.meta.namespace)
+                if shard_of is not None else 0
+            )
+            groups.setdefault(sid, []).append(entry)
+
+        def commit_group(group):
+            updates = [
+                (info.pod.meta.name, info.pod.meta.namespace,
+                 bind_mutator(node_name))
+                for _, info, node_name, _ in group
+            ]
+            t_g = self._clock()
+            try:
+                _, errs = self.store.update_wave(
+                    "Pod", updates, fence=fence
+                )
+                bad = set(errs)
+            except st.Fenced:
+                logging.getLogger(__name__).warning(
+                    "bind sub-wave fenced (leadership lost since "
+                    "staging); requeueing %d pod(s) for the new leader",
+                    len(group),
+                )
+                bad = {pod_key(info.pod) for _, info, _, _ in group}
+            except Exception:  # noqa: BLE001 — sub-wave containment
+                logging.getLogger(__name__).exception(
+                    "sub-wave transaction failed; requeueing its pods"
+                )
+                bad = {pod_key(info.pod) for _, info, _, _ in group}
+            return bad, self._clock() - t_g
+
+        failed: set = set()
+        durations: List[float] = []
+        t_all = self._clock()
+        if len(groups) > 1 and self._commit_pool is not None:
+            futures = [
+                self._commit_pool.submit(commit_group, g)
+                for g in groups.values()
+            ]
+            for f in futures:
+                bad, dt = f.result()
+                failed |= bad
+                durations.append(dt)
+        else:
+            for g in groups.values():
+                bad, dt = commit_group(g)
+                failed |= bad
+                durations.append(dt)
+        wall = self._clock() - t_all
+        for dt in durations:
+            self.metrics.commit_subwave_duration.observe(dt)
+        # realized cross-shard commit concurrency: sub-wave work that
+        # ran while another sub-wave of this wave was also committing
+        self.metrics.commit_subwave_overlap.observe(
+            max(sum(durations) - wall, 0.0)
+        )
+        return failed
 
     def _fail_bind(self, fwk: Framework, info: QueuedPodInfo) -> None:
         """The binding stage's per-pod failure tail: forget the assume,
@@ -1194,6 +1268,7 @@ class Scheduler:
                 self.metrics.store_journal_suffix_records,
             ),
             ("checkpoints_total", self.metrics.store_checkpoints_total),
+            ("shard_count", self.metrics.store_shard_count),
             ("fenced_writes_total", self.metrics.fenced_writes_total),
         ):
             v = getattr(self.store, attr, None)

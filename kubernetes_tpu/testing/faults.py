@@ -49,6 +49,12 @@ KNOWN_POINTS = frozenset({
     "store.journal.append",
     "store.journal.fsync",
     "store.update_wave",
+    # per-shard twins of the journal/wave points: fired with the shard
+    # index in ctx so a schedule lands on the FIRST shard that reaches
+    # the point — the crash-one-shard chaos family (surviving shards
+    # must stay consistent while the crashed one recovers)
+    "store.shard.journal.append",
+    "store.shard.update_wave",
     "store.checkpoint",
     "store.list",
     "watch.offer",
@@ -99,6 +105,7 @@ class FaultRegistry:
         "_rng": "_lock",
         "fired": "_lock",
         "log": "_lock",
+        "last_ctx": "_lock",
     }
     # schedule registration precedes arm(): the builder-style fail()/
     # crash()/... calls run single-threaded before any hot-path thread
@@ -113,6 +120,10 @@ class FaultRegistry:
         # observability for the suite's coverage assertions
         self.fired: Dict[str, int] = {}
         self.log: List[tuple] = []  # (point, mode)
+        # fire-site context of the LAST schedule that fired per point
+        # (e.g. {"shard": 2} from the store's per-shard points) — the
+        # crash-one-shard chaos family reads which shard it killed
+        self.last_ctx: Dict[str, dict] = {}
 
     # -- schedule registration -------------------------------------------
 
@@ -197,6 +208,7 @@ class FaultRegistry:
                     sched.remaining -= 1
                 self.fired[point] = self.fired.get(point, 0) + 1
                 self.log.append((point, sched.mode))
+                self.last_ctx[point] = dict(ctx)
                 if sched.mode == "delay":
                     delay_s = sched.seconds
                     continue  # latency composes with a later failure
@@ -265,21 +277,46 @@ def fire(point: str, **ctx):
 
 def crash_disk_image(journal_path: str, dest_dir: str) -> str:
     """Capture the post-SIGKILL on-disk state of a journaled store:
-    copy the journal and its checkpoint snapshot (if present) into
-    `dest_dir` as they exist on the filesystem RIGHT NOW.  Returns the
-    copied journal path — hand it to ``Store(journal_path=...)`` to
-    'restart' the killed store.  Call while the victim is still live
-    (or already abandoned); the copy never touches its file handles."""
+    copy the journal(s) and checkpoint snapshot(s) (if present) into
+    `dest_dir` as they exist on the filesystem RIGHT NOW — the 1-shard
+    layout (``<path>`` + ``<path>.snap``) and the sharded layout
+    (``<path>.s<i>`` + ``<path>.s<i>.snap``) both.  Returns the copied
+    journal base path — hand it to ``Store(journal_path=...)`` to
+    'restart' the killed store (the shard count is inferred from the
+    copied layout).  Call while the victim is still live (or already
+    abandoned); the copy never touches its file handles."""
+    import glob
     import os
     import shutil
 
     os.makedirs(dest_dir, exist_ok=True)
     dest = os.path.join(dest_dir, os.path.basename(journal_path))
-    if os.path.exists(journal_path):
-        shutil.copyfile(journal_path, dest)
-    else:
+    copied = False
+    for src in [journal_path, journal_path + ".snap"] + sorted(
+        glob.glob(glob.escape(journal_path) + ".s*")
+    ):
+        if os.path.exists(src):
+            suffix = src[len(journal_path):]
+            shutil.copyfile(src, dest + suffix)
+            copied = copied or not suffix.endswith(".snap")
+    if not copied:
         open(dest, "w").close()
-    snap = journal_path + ".snap"
-    if os.path.exists(snap):
-        shutil.copyfile(snap, dest + ".snap")
     return dest
+
+
+def remove_snapshots(journal_path: str) -> int:
+    """Delete every checkpoint snapshot of a store's on-disk layout
+    (1-shard and sharded alike) — the full-journal-replay ORACLE mode
+    the chaos suite compares snapshot+suffix recovery against.  Returns
+    the number of snapshots removed."""
+    import glob
+    import os
+
+    n = 0
+    for p in [journal_path + ".snap"] + glob.glob(
+        glob.escape(journal_path) + ".s*.snap"
+    ):
+        if os.path.exists(p):
+            os.remove(p)
+            n += 1
+    return n

@@ -80,18 +80,18 @@ def test_update_wave_splits_failures_per_object():
 
 def test_update_wave_single_journal_append(tmp_path):
     path = str(tmp_path / "journal.jsonl")
-    store = st.Store(journal_path=path)
+    store = st.Store(journal_path=path, shards=1)
     for i in range(3):
         store.create(make_pod(f"p{i}").req(cpu_milli=100).obj())
 
     flushes = {"n": 0}
-    orig_flush = store._journal.flush
+    orig_flush = store._shards[0]._journal.flush
 
     def counting_flush():
         flushes["n"] += 1
         orig_flush()
 
-    store._journal.flush = counting_flush
+    store._shards[0]._journal.flush = counting_flush
 
     def set_node(pod):
         pod.spec.node_name = "n0"
@@ -102,7 +102,7 @@ def test_update_wave_single_journal_append(tmp_path):
     # one coalesced append: a single flush covers the whole wave
     assert flushes["n"] == 1
     # ... and the journal replays to the committed state
-    store2 = st.Store(journal_path=path)
+    store2 = st.Store(journal_path=path, shards=1)
     assert all(
         store2.get("Pod", f"p{i}").spec.node_name == "n0" for i in range(3)
     )

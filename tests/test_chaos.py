@@ -55,6 +55,11 @@ def _fault_plan(rng: random.Random) -> faults.FaultRegistry:
     reg.fail("store.journal.append", n=rng.randint(1, 2), probability=0.5)
     reg.torn_write("store.journal.append", frac=rng.random(), n=1)
     reg.fail("store.journal.fsync", n=1)
+    # the per-shard twins: land on whichever shard reaches the point
+    # first (the store is sharded by default, so every base seed also
+    # exercises single-shard fault containment)
+    reg.fail("store.shard.update_wave", n=1, probability=0.5)
+    reg.fail("store.shard.journal.append", n=1, probability=0.5)
     reg.drop("watch.offer", n=rng.randint(1, 3), probability=0.5)
     reg.delay("watch.consume", seconds=0.002, n=5, probability=0.5)
     reg.delay("store.list", seconds=0.005, n=3, probability=0.5)
@@ -600,11 +605,10 @@ def test_chaos_kill_restart(seed, tmp_path):
         oracle_img = faults.crash_disk_image(
             path, str(tmp_path / "oracle")
         )
-        import os as _os
-
         recovered = st.Store(journal_path=img)
         # bit-parity oracle: same disk image, full-journal replay
-        _os.remove(oracle_img + ".snap")
+        # (every shard's snapshot removed — full history per shard)
+        faults.remove_snapshots(oracle_img)
         oracle = st.Store(journal_path=oracle_img)
         assert oracle.snapshot_records == 0
         assert recovered.snapshot_records > 0, (
@@ -742,3 +746,150 @@ def test_chaos_relist_storm(seed):
         faults.disarm()
         if sched is not None:
             sched.stop()
+
+
+# -- sharded-store kill-restart: crash ONE shard mid-fsync -------------------
+#
+# The store is sharded (per-shard locks/journals/checkpoints, ISSUE 9);
+# these seeds crash the journal path of whichever shard reaches it first
+# — a FaultCrash out of store.journal.fsync (and, on some seeds, a torn
+# store.shard.journal.append) kills the writer mid-commit — then the
+# whole store is abandoned and restarted from its post-SIGKILL disk
+# image.  Invariants: the SURVIVING shards (no tail truncation) recover
+# every acked object bit-identically; the crashed shard recovers its
+# snapshot + journal suffix BIT-IDENTICAL to a full-replay oracle over
+# the same image; nothing recovered ever contradicts the acked state.
+
+SHARD_RESTART_SEEDS = list(range(310, 315))
+
+
+@pytest.mark.restart
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+@pytest.mark.parametrize("seed", SHARD_RESTART_SEEDS)
+def test_chaos_shard_crash_restart(seed, tmp_path):
+    rng = random.Random(seed)
+    reg = faults.FaultRegistry(seed=rng.randint(0, 2 ** 31))
+    reg.crash("store.journal.fsync", n=1)
+    if rng.random() < 0.5:
+        reg.torn_write(
+            "store.shard.journal.append", frac=rng.random(), n=1
+        )
+    path = str(tmp_path / "journal.jsonl")
+    store = st.Store(journal_path=path, shards=4)
+    namespaces = [f"ns-{i}" for i in range(8)]
+
+    def mk(name, ns):
+        pod = make_pod(name).req(cpu_milli=rng.choice([50, 100])).obj()
+        pod.meta.namespace = ns
+        return pod
+
+    # phase 1 (unarmed): a healthy prefix lands on every shard, then a
+    # checkpoint so recovery exercises per-shard snapshot + suffix
+    # (truncate=False keeps full journals for the bit-parity oracle)
+    for i in range(24):
+        store.create(mk(f"warm-{i}", namespaces[i % 8]))
+
+    def bind(node):
+        def mutate(pod):
+            pod.spec.node_name = node
+        return mutate
+
+    store.update_wave(
+        "Pod",
+        [(f"warm-{i}", namespaces[i % 8], bind(f"n{i % 4}"))
+         for i in range(24)],
+    )
+    store.checkpoint(truncate=False)
+
+    # phase 2 (armed): concurrent writers over every namespace; the
+    # crash kills one writer mid-commit on one shard — the rest of the
+    # store keeps serving until the harness stops the survivors
+    crashed = threading.Event()
+
+    def writer(t):
+        for i in range(200):
+            if crashed.is_set():
+                return
+            try:
+                store.create(mk(f"hot-{t}-{i}", namespaces[t]))
+                if i % 5 == 4:
+                    store.update_wave(
+                        "Pod",
+                        [(f"hot-{t}-{k}", namespaces[t], bind("nx"))
+                         for k in range(i - 4, i + 1)],
+                    )
+            except BaseException:  # noqa: BLE001 — the injected death
+                crashed.set()
+                return
+            time.sleep(rng.random() * 0.002)
+
+    with faults.armed(reg):
+        threads = [
+            threading.Thread(target=writer, args=(t,)) for t in range(8)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not reg.fired.get(
+            "store.journal.fsync"
+        ):
+            time.sleep(0.01)
+        crashed.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert reg.fired.get("store.journal.fsync"), (
+        f"seed {seed}: the shard crash never fired"
+    )
+
+    # the control plane is dead: freeze the acked in-memory state and
+    # capture the post-SIGKILL disk image (userspace buffers excluded
+    # by construction)
+    acked = store.state_fingerprint()
+    acked_rv = store.resource_version
+    img = faults.crash_disk_image(path, str(tmp_path / "img"))
+    oracle_img = faults.crash_disk_image(path, str(tmp_path / "oracle"))
+    faults.remove_snapshots(oracle_img)
+
+    recovered = st.Store(journal_path=img)
+    oracle = st.Store(journal_path=oracle_img)
+    assert recovered.shard_count == 4
+    assert oracle.snapshot_records == 0
+    assert recovered.snapshot_records > 0, (
+        f"seed {seed}: recovery never used the shard snapshots"
+    )
+    # bit-parity: snapshot+suffix recovery == full-replay oracle,
+    # crashed shard included
+    assert _fingerprint_json(recovered) == _fingerprint_json(oracle), (
+        f"seed {seed}: sharded recovery diverged from the oracle"
+    )
+    # recovered never contradicts acked: rv bounded, every recovered
+    # object matches the acked copy exactly (the lost tail is the only
+    # permitted difference)
+    assert recovered.resource_version <= acked_rv
+    acked_objs = acked["objects"]
+    rec = recovered.state_fingerprint()["objects"]
+    for kind, entries in rec.items():
+        for key, (rv, wire_obj) in entries.items():
+            assert acked_objs.get(kind, {}).get(key) == (rv, wire_obj), (
+                f"seed {seed}: recovery invented/altered {kind} {key}"
+            )
+    # surviving shards are CONSISTENT: every shard the fault schedule
+    # never touched (the crash ctx names the fsync victim; a torn
+    # append names its shard too) recovered every acked object it owns
+    wounded = {
+        reg.last_ctx.get(point, {}).get("shard")
+        for point in ("store.journal.fsync", "store.shard.journal.append")
+    }
+    for i in range(recovered.shard_count):
+        if i in wounded:
+            continue  # the crashed shard: its lost tail is legitimate
+        for kind, entries in acked_objs.items():
+            for key, (rv, wire_obj) in entries.items():
+                ns = wire_obj.get("meta", {}).get("namespace", "")
+                if recovered.shard_index(kind, ns or "") != i:
+                    continue
+                assert rec.get(kind, {}).get(key) == (rv, wire_obj), (
+                    f"seed {seed}: surviving shard {i} lost {kind} {key}"
+                )

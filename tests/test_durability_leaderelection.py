@@ -50,7 +50,7 @@ def test_store_journal_replay(tmp_path):
     """Kill-and-restart: a journaled store resumes with identical objects
     and resourceVersion."""
     path = str(tmp_path / "journal.jsonl")
-    s1 = st.Store(journal_path=path)
+    s1 = st.Store(journal_path=path, shards=1)
     s1.create(make_node("n0").capacity(cpu_milli=4000, mem=8 * GI).obj())
     s1.create(make_pod("keep").req(cpu_milli=100).obj())
     doomed = s1.create(make_pod("gone").req(cpu_milli=100).obj())
@@ -61,7 +61,7 @@ def test_store_journal_replay(tmp_path):
     rv = s1.resource_version
 
     # "crash": drop the instance, rebuild from the journal alone
-    s2 = st.Store(journal_path=path)
+    s2 = st.Store(journal_path=path, shards=1)
     assert s2.resource_version == rv
     pods, _ = s2.list("Pod")
     assert [p.meta.name for p in pods] == ["keep"]
@@ -69,7 +69,7 @@ def test_store_journal_replay(tmp_path):
     assert s2.get("Node", "n0", namespace="").status.allocatable[api.CPU] == 4000
     # writes continue after recovery and journal further restarts
     s2.create(make_pod("after").obj())
-    s3 = st.Store(journal_path=path)
+    s3 = st.Store(journal_path=path, shards=1)
     assert {p.meta.name for p in s3.list("Pod")[0]} == {"keep", "after"}
     # optimistic concurrency still enforced post-replay
     stale = s3.get("Pod", "keep")
@@ -261,15 +261,15 @@ def test_journal_tolerates_torn_tail(tmp_path):
     """A crash mid-append leaves a truncated last line; replay must stop
     at the last good record and keep working (review finding)."""
     path = str(tmp_path / "j.jsonl")
-    s1 = st.Store(journal_path=path)
+    s1 = st.Store(journal_path=path, shards=1)
     s1.create(make_pod("a").obj())
     s1.create(make_pod("b").obj())
     with open(path, "a") as f:
         f.write('{"op": "ADDED", "rv": 99, "kind": "Pod", "ke')  # torn
-    s2 = st.Store(journal_path=path)
+    s2 = st.Store(journal_path=path, shards=1)
     assert {p.meta.name for p in s2.list("Pod")[0]} == {"a", "b"}
     s2.create(make_pod("c").obj())  # appends continue cleanly
-    s3 = st.Store(journal_path=path)
+    s3 = st.Store(journal_path=path, shards=1)
     assert {p.meta.name for p in s3.list("Pod")[0]} == {"a", "b", "c"}
 
 
@@ -278,7 +278,7 @@ def test_journal_mid_file_corruption_keeps_later_records(tmp_path):
     the acknowledged-durable records after it — only a torn tail may be
     truncated (advisor finding r3)."""
     path = str(tmp_path / "j.jsonl")
-    s1 = st.Store(journal_path=path)
+    s1 = st.Store(journal_path=path, shards=1)
     s1.create(make_pod("a").obj())
     s1.create(make_pod("b").obj())
     s1.create(make_pod("c").obj())
@@ -287,12 +287,12 @@ def test_journal_mid_file_corruption_keeps_later_records(tmp_path):
     lines[1] = b'{"op": "ADDED", "rv": 2, "kind": "Pod", "ke\xff\xfe\n'
     with open(path, "wb") as f:
         f.writelines(lines)
-    s2 = st.Store(journal_path=path)
+    s2 = st.Store(journal_path=path, shards=1)
     names = {p.meta.name for p in s2.list("Pod")[0]}
     assert "c" in names, "record after corruption was dropped"
     assert names == {"a", "c"}
     s2.create(make_pod("d").obj())  # appends continue cleanly
-    s3 = st.Store(journal_path=path)
+    s3 = st.Store(journal_path=path, shards=1)
     assert {p.meta.name for p in s3.list("Pod")[0]} >= {"a", "c", "d"}
 
 
@@ -300,7 +300,7 @@ def test_journal_compaction_bounds_growth(tmp_path):
     """Churny updates (lease renewals) must not grow the journal without
     bound: compaction rewrites to one record per live object."""
     path = str(tmp_path / "j.jsonl")
-    s = st.Store(journal_path=path)
+    s = st.Store(journal_path=path, shards=1)
     lease = api.Lease(meta=api.ObjectMeta(name="l", namespace="kube-system"))
     s.create(lease)
     for _ in range(3000):
@@ -311,7 +311,7 @@ def test_journal_compaction_bounds_growth(tmp_path):
         lines = sum(1 for _ in f)
     assert lines < 2000, f"journal grew to {lines} lines for 1 live object"
     # state survives compaction
-    s2 = st.Store(journal_path=path)
+    s2 = st.Store(journal_path=path, shards=1)
     assert s2.get("Lease", "l", "kube-system").spec.renew_time >= 2999
 
 
@@ -320,14 +320,14 @@ def test_journal_structurally_corrupt_line_skipped(tmp_path):
     must be skipped like byte corruption, not crash Store startup
     (review finding r4)."""
     path = str(tmp_path / "j.jsonl")
-    s1 = st.Store(journal_path=path)
+    s1 = st.Store(journal_path=path, shards=1)
     s1.create(make_pod("a").obj())
     s1.create(make_pod("b").obj())
     lines = open(path, "rb").read().splitlines(keepends=True)
     lines[0] = b"42\n"  # valid JSON, not a record
     with open(path, "wb") as f:
         f.writelines(lines)
-    s2 = st.Store(journal_path=path)  # must not raise
+    s2 = st.Store(journal_path=path, shards=1)  # must not raise
     assert {p.meta.name for p in s2.list("Pod")[0]} == {"b"}
 
 

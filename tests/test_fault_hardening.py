@@ -106,7 +106,7 @@ def test_journal_crc_detects_value_corruption(tmp_path):
     """A corrupted record that still parses as JSON (a flipped value,
     stale CRC) must be caught by the CRC check, skipped, and counted."""
     path = str(tmp_path / "j.jsonl")
-    s1 = st.Store(journal_path=path)
+    s1 = st.Store(journal_path=path, shards=1)
     s1.create(make_pod("a").req(cpu_milli=100).obj())
     s1.create(make_pod("b").req(cpu_milli=100).obj())
     s1.create(make_pod("c").req(cpu_milli=100).obj())
@@ -115,7 +115,7 @@ def test_journal_crc_detects_value_corruption(tmp_path):
     lines[1] = lines[1].replace(b'"name": "b"', b'"name": "x"')
     with open(path, "wb") as f:
         f.writelines(lines)
-    s2 = st.Store(journal_path=path)
+    s2 = st.Store(journal_path=path, shards=1)
     names = {p.meta.name for p in s2.list("Pod")[0]}
     assert names == {"a", "c"}, "CRC mismatch record was not skipped"
     assert s2.journal_recovered_records == 1
@@ -124,12 +124,12 @@ def test_journal_crc_detects_value_corruption(tmp_path):
 
 def test_journal_torn_tail_truncates_and_counts(tmp_path):
     path = str(tmp_path / "j.jsonl")
-    s1 = st.Store(journal_path=path)
+    s1 = st.Store(journal_path=path, shards=1)
     s1.create(make_pod("a").obj())
     s1.create(make_pod("b").obj())
     with open(path, "a") as f:
         f.write('{"op": "ADDED", "rv": 99, "kind": "Pod", "ke')  # torn
-    s2 = st.Store(journal_path=path)
+    s2 = st.Store(journal_path=path, shards=1)
     assert {p.meta.name for p in s2.list("Pod")[0]} == {"a", "b"}
     assert s2.journal_recovered_records == 1
     assert s2.journal_tail_truncations == 1
@@ -140,7 +140,7 @@ def test_injected_torn_write_is_contained_and_recovered(tmp_path):
     record only: the store keeps serving, and replay truncates the torn
     tail back to the last good record."""
     path = str(tmp_path / "j.jsonl")
-    store = st.Store(journal_path=path)
+    store = st.Store(journal_path=path, shards=1)
     store.create(make_pod("durable").obj())
     reg = faults.FaultRegistry().torn_write("store.journal.append", n=1)
     with faults.armed(reg):
@@ -148,7 +148,7 @@ def test_injected_torn_write_is_contained_and_recovered(tmp_path):
     assert store.journal_write_errors == 1
     assert store.get("Pod", "torn") is not None  # in-memory commit held
     store.create(make_pod("after").obj())  # appends continue
-    s2 = st.Store(journal_path=path)
+    s2 = st.Store(journal_path=path, shards=1)
     names = {p.meta.name for p in s2.list("Pod")[0]}
     # the torn record was never durable; records around it replay
     assert "durable" in names
@@ -158,23 +158,23 @@ def test_injected_torn_write_is_contained_and_recovered(tmp_path):
 
 def test_injected_fsync_failure_contained(tmp_path):
     path = str(tmp_path / "j.jsonl")
-    store = st.Store(journal_path=path)
+    store = st.Store(journal_path=path, shards=1)
     reg = faults.FaultRegistry().fail("store.journal.fsync", n=1)
     with faults.armed(reg):
         store.create(make_pod("a").obj())
     assert store.journal_write_errors == 1
     store.create(make_pod("b").obj())
-    assert {p.meta.name for p in st.Store(journal_path=path).list("Pod")[0]} >= {"b"}
+    assert {p.meta.name for p in st.Store(journal_path=path, shards=1).list("Pod")[0]} >= {"b"}
 
 
 def test_compaction_output_replays_with_crc(tmp_path):
     path = str(tmp_path / "j.jsonl")
-    s = st.Store(journal_path=path)
+    s = st.Store(journal_path=path, shards=1)
     s.create(make_pod("keep").obj())
     for _ in range(1500):  # push past the compaction threshold
         fresh = s.get("Pod", "keep")
         s.update(fresh)
-    s2 = st.Store(journal_path=path)
+    s2 = st.Store(journal_path=path, shards=1)
     assert s2.get("Pod", "keep") is not None
     assert s2.journal_recovered_records == 0  # compacted file is clean
 

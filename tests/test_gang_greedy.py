@@ -305,7 +305,7 @@ def test_gang_size_declared_via_update_takes_effect():
     # members stay queued until a delete/restage path runs), but the size
     # must be recorded so the NEXT member completes or stages correctly
     q.update(make_pod("g-0").group("g", size=3).obj())
-    assert q._group_size["g"] == 3
+    assert q._group_size["default/g"] == 3  # gangs key by namespace/group
     q.add(make_pod("g-2").group("g", size=3).obj())
     # gang whole: the new member must not strand in staging
     assert q.stats()["gang_staged"] == 0
@@ -330,3 +330,27 @@ def test_gang_size_raised_via_update_restages_active():
     q.add(make_pod("g-4").group("g", size=5).obj())
     batch = q.pop_batch(10, timeout=0.2)
     assert len(batch) == 5
+
+
+def test_same_named_gangs_in_different_namespaces_are_distinct():
+    """Gangs key by namespace/group: one namespace's INFLIGHT member must
+    never park another namespace's whole gang in pop_batch's gang pull
+    (the queue half of the r4 per-namespace quorum fix; the sharded
+    store's per-shard fan-out skews cross-namespace pop timing enough to
+    hit this deterministically)."""
+    q = SchedulingQueue()
+    a0 = make_pod("w0", namespace="team-a").group("workers").obj()
+    q.add(a0)
+    # team-a's member pops alone (its own gang, no declared size) ...
+    batch = q.pop_batch(10, timeout=0.2)
+    assert [f"{i.pod.meta.namespace}/{i.pod.meta.name}" for i in batch] == [
+        "team-a/w0"
+    ]
+    # ... and stays inflight (parked at Permit, say) while team-b's
+    # same-NAMED gang arrives whole: it must pop immediately
+    q.add(make_pod("w0", namespace="team-b").group("workers").obj())
+    q.add(make_pod("w1", namespace="team-b").group("workers").obj())
+    batch = q.pop_batch(10, timeout=0.5)
+    assert sorted(
+        f"{i.pod.meta.namespace}/{i.pod.meta.name}" for i in batch
+    ) == ["team-b/w0", "team-b/w1"]

@@ -4,7 +4,7 @@ kubemark-style cluster with heartbeat churn (pkg/kubemark analogue)."""
 import time
 
 from kubernetes_tpu.api import store as st
-from kubernetes_tpu.kubemark import HollowCluster
+from kubernetes_tpu.kubemark import FleetHarness, HollowCluster, percentiles
 from kubernetes_tpu.scheduler import Scheduler
 from kubernetes_tpu.testing.wrappers import MI, make_pod
 
@@ -42,3 +42,71 @@ def test_hollow_cluster_schedules_through_full_path():
     finally:
         sched.stop()
         hollow.stop()
+
+
+def test_heartbeats_are_wave_committed_batches():
+    """The heartbeat loop must commit its node slice through
+    update_wave (one coalesced transaction per tick), never O(batch)
+    single-object writes — asserted by counting Node write events per
+    heartbeat wave."""
+    store = st.Store(shards=4)
+    hollow = HollowCluster(
+        store, n_nodes=50, heartbeat_interval=0.2, run_pods=False
+    )
+    w = store.watch("Node")
+    hollow.start()
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and hollow.heartbeat_waves < 3:
+            time.sleep(0.02)
+        assert hollow.heartbeat_waves >= 3
+    finally:
+        hollow.stop()
+        w.stop()
+    # every beat flowed through a wave: the wave/beat accounting matches
+    # the per-tick batch size (read after the loop thread joined)
+    assert hollow.heartbeats == 5 * hollow.heartbeat_waves
+    beats = 0
+    while True:
+        ev = w.get(timeout=0.1)
+        if ev is None:
+            break
+        # an un-drained ADDED compacts with later MODIFIEDs and stays
+        # ADDED (latest-wins with the newest object) — either type
+        # carrying the annotation proves the wave flowed through watch
+        if "hollow/heartbeat" in (ev.obj.meta.annotations or {}):
+            beats += 1
+    assert beats > 0
+
+
+def test_fleet_harness_soak_lossless_with_percentiles():
+    """The 100k-fleet harness at test scale: the sustained lifecycle
+    soak loses no pod, double-binds no pod, reports SLO percentiles,
+    and spreads its bind sub-waves over the store shards."""
+    store = st.Store(shards=8)
+    fleet = FleetHarness(
+        store, n_nodes=60, namespaces=6, heartbeat_interval=0.5
+    ).start()
+    try:
+        report = fleet.soak(total_pods=90, round_pods=30, round_timeout=30)
+    finally:
+        fleet.stop()
+    assert report["pods"] == 90 and report["rounds"] == 3
+    assert report["lost_pods"] == 0
+    assert report["double_bound_pods"] == 0
+    assert report["lifecycle_p99_ms"] >= report["lifecycle_p50_ms"] > 0
+    assert 0.0 <= report["commit_share_per_step"] <= 1.0
+    assert store.watchers_terminated == 0
+    # the soak's namespaces hash onto more than one shard, so the bind
+    # rounds really exercised concurrent sub-wave commits
+    shards = {store.shard_index("Pod", f"fleet-{i}") for i in range(6)}
+    assert len(shards) > 1
+
+
+def test_percentiles_nearest_rank():
+    samples = [float(i) for i in range(1, 101)]
+    pct = percentiles(samples)
+    assert pct["p50"] == 50.0
+    assert pct["p90"] == 90.0
+    assert pct["p99"] == 99.0
+    assert percentiles([]) == {"p50": 0.0, "p90": 0.0, "p99": 0.0}

@@ -33,7 +33,7 @@ def test_checkpoint_snapshot_suffix_recovery(tmp_path):
     restart recovers snapshot + suffix to the exact pre-restart state
     and reports the recovery split."""
     path = str(tmp_path / "j.jsonl")
-    s1 = st.Store(journal_path=path)
+    s1 = st.Store(journal_path=path, shards=1)
     for i in range(6):
         s1.create(make_pod(f"pre{i}").req(cpu_milli=100).obj())
     assert s1.checkpoint() == 6
@@ -43,7 +43,7 @@ def test_checkpoint_snapshot_suffix_recovery(tmp_path):
         s1.create(make_pod(f"post{i}").req(cpu_milli=100).obj())
     want = _fp(s1)
 
-    s2 = st.Store(journal_path=path)
+    s2 = st.Store(journal_path=path, shards=1)
     assert _fp(s2) == want
     assert s2.snapshot_records == 6
     assert s2.journal_suffix_records == 3
@@ -51,7 +51,7 @@ def test_checkpoint_snapshot_suffix_recovery(tmp_path):
     assert s2.snapshot_fallbacks == 0
     # writes continue and survive another restart
     s2.create(make_pod("after").obj())
-    s3 = st.Store(journal_path=path)
+    s3 = st.Store(journal_path=path, shards=1)
     assert {p.meta.name for p in s3.list("Pod")[0]} == (
         {f"pre{i}" for i in range(6)}
         | {f"post{i}" for i in range(3)}
@@ -64,7 +64,7 @@ def test_snapshot_suffix_bit_identical_to_full_replay_oracle(tmp_path):
     (checkpoint(truncate=False)), recovery through snapshot+suffix must
     be BIT-IDENTICAL to a full-journal replay of the same history."""
     path = str(tmp_path / "j.jsonl")
-    s1 = st.Store(journal_path=path)
+    s1 = st.Store(journal_path=path, shards=1)
     s1.create(make_node("n0").capacity(cpu_milli=8000, mem=16 * GI).obj())
     for i in range(8):
         s1.create(make_pod(f"p{i}").req(cpu_milli=100).obj())
@@ -96,7 +96,7 @@ def test_auto_checkpoint_bounds_journal_growth(tmp_path):
     """The growth trigger checkpoints instead of rewriting the journal:
     churny single-object writers leave a snapshot + tiny suffix."""
     path = str(tmp_path / "j.jsonl")
-    s = st.Store(journal_path=path, checkpoint_records=64)
+    s = st.Store(journal_path=path, checkpoint_records=64, shards=1)
     lease = api.Lease(meta=api.ObjectMeta(name="l", namespace="kube-system"))
     s.create(lease)
     for _ in range(500):
@@ -106,14 +106,14 @@ def test_auto_checkpoint_bounds_journal_growth(tmp_path):
     assert s.checkpoints_total >= 1
     with open(path) as f:
         assert sum(1 for _ in f) <= 64
-    s2 = st.Store(journal_path=path)
+    s2 = st.Store(journal_path=path, shards=1)
     assert s2.get("Lease", "l", "kube-system").spec.renew_time >= 499
     assert s2.snapshot_records == 1
 
 
 def test_periodic_checkpoint_interval(tmp_path):
     path = str(tmp_path / "j.jsonl")
-    s = st.Store(journal_path=path, checkpoint_interval_seconds=0.05)
+    s = st.Store(journal_path=path, checkpoint_interval_seconds=0.05, shards=1)
     s.create(make_pod("a").obj())
     time.sleep(0.08)
     s.create(make_pod("b").obj())  # commit past the interval triggers
@@ -132,7 +132,7 @@ def _binder(node):
 
 
 def _setup_wave_journal(path, n_pods=4):
-    s = st.Store(journal_path=path)
+    s = st.Store(journal_path=path, shards=1)
     s.create(make_node("n0").capacity(cpu_milli=8000, mem=16 * GI).obj())
     for i in range(n_pods):
         s.create(make_pod(f"p{i}").req(cpu_milli=100).obj())
@@ -156,13 +156,13 @@ def test_torn_final_wave_dropped_whole(tmp_path):
     torn = b"".join(lines[:-1]) + lines[-1][: len(lines[-1]) // 2]
     with open(path, "wb") as f:
         f.write(torn)
-    s2 = st.Store(journal_path=path)
+    s2 = st.Store(journal_path=path, shards=1)
     bound = [p.meta.name for p in s2.list("Pod")[0] if p.spec.node_name]
     assert bound == [], f"half-applied wave: {bound}"
     assert s2.journal_torn_waves == 1
     # the wave's valid-prefix records were truncated away too
     s2.create(make_pod("later").obj())
-    s3 = st.Store(journal_path=path)
+    s3 = st.Store(journal_path=path, shards=1)
     assert s3.journal_torn_waves == 0
     assert "later" in {p.meta.name for p in s3.list("Pod")[0]}
 
@@ -176,7 +176,7 @@ def test_wave_without_terminator_dropped_whole(tmp_path):
     lines = open(path, "rb").read().splitlines(keepends=True)
     with open(path, "wb") as f:
         f.writelines(lines[:-1])  # drop the "wz" terminator record
-    s2 = st.Store(journal_path=path)
+    s2 = st.Store(journal_path=path, shards=1)
     assert all(not p.spec.node_name for p in s2.list("Pod")[0])
     assert s2.journal_torn_waves == 1
 
@@ -194,7 +194,7 @@ def test_wave_holed_mid_file_dropped_whole(tmp_path):
     lines[-3] = b'{"op": "MODIFIED", "rv": 0, "corrupt\xff\n'
     with open(path, "wb") as f:
         f.writelines(lines)
-    s2 = st.Store(journal_path=path)
+    s2 = st.Store(journal_path=path, shards=1)
     names = {p.meta.name for p in s2.list("Pod")[0]}
     assert "after" in names, "record after the holed wave was lost"
     assert all(not p.spec.node_name for p in s2.list("Pod")[0]), (
@@ -209,7 +209,7 @@ def test_complete_waves_replay_applied(tmp_path):
     path = str(tmp_path / "j.jsonl")
     s1 = _setup_wave_journal(path)
     want = _fp(s1)
-    s2 = st.Store(journal_path=path)
+    s2 = st.Store(journal_path=path, shards=1)
     assert _fp(s2) == want
     assert s2.journal_torn_waves == 0
     assert all(p.spec.node_name == "n0" for p in s2.list("Pod")[0])
@@ -220,7 +220,7 @@ def test_complete_waves_replay_applied(tmp_path):
 
 def test_corrupt_snapshot_falls_back_to_full_journal(tmp_path):
     path = str(tmp_path / "j.jsonl")
-    s1 = st.Store(journal_path=path)
+    s1 = st.Store(journal_path=path, shards=1)
     for i in range(5):
         s1.create(make_pod(f"p{i}").obj())
     s1.checkpoint(truncate=False)  # journal retains full history
@@ -231,7 +231,7 @@ def test_corrupt_snapshot_falls_back_to_full_journal(tmp_path):
     raw[len(raw) // 2] ^= 0xFF
     with open(path + ".snap", "wb") as f:
         f.write(raw)
-    s2 = st.Store(journal_path=path)
+    s2 = st.Store(journal_path=path, shards=1)
     assert s2.snapshot_fallbacks == 1
     assert s2.snapshot_records == 0
     assert _fp(s2) == want, "fallback replay lost state"
@@ -241,7 +241,7 @@ def test_truncated_snapshot_falls_back(tmp_path):
     """A snapshot missing records (count mismatch vs header) is treated
     as corrupt even when every remaining line is CRC-valid."""
     path = str(tmp_path / "j.jsonl")
-    s1 = st.Store(journal_path=path)
+    s1 = st.Store(journal_path=path, shards=1)
     for i in range(4):
         s1.create(make_pod(f"p{i}").obj())
     s1.checkpoint(truncate=False)
@@ -249,7 +249,7 @@ def test_truncated_snapshot_falls_back(tmp_path):
     lines = open(path + ".snap", "rb").read().splitlines(keepends=True)
     with open(path + ".snap", "wb") as f:
         f.writelines(lines[:-1])
-    s2 = st.Store(journal_path=path)
+    s2 = st.Store(journal_path=path, shards=1)
     assert s2.snapshot_fallbacks == 1
     assert _fp(s2) == want
 
@@ -262,11 +262,11 @@ def test_close_interval_sync_flushes_final_batch(tmp_path):
     window; Store.close() must flush+fsync the final dirty batch so a
     GRACEFUL shutdown loses nothing."""
     path = str(tmp_path / "j.jsonl")
-    s = st.Store(journal_path=path, journal_sync="interval")
+    s = st.Store(journal_path=path, journal_sync="interval", shards=1)
     for i in range(5):
         s.create(make_pod(f"p{i}").obj())
     s.close()
-    s2 = st.Store(journal_path=path)
+    s2 = st.Store(journal_path=path, shards=1)
     assert {p.meta.name for p in s2.list("Pod")[0]} == {
         f"p{i}" for i in range(5)
     }

@@ -1,0 +1,469 @@
+"""Cross-shard invariants of the sharded store (ISSUE 9).
+
+The store splits (kind, namespace) keyspaces over N shards — each with
+its own lock, journal, checkpoint and watch fan-out — while rv
+allocation and the in-memory publish serialize through one small global
+lock.  These tests pin the contracts that must survive the split:
+
+  * resourceVersion is strictly monotonic ACROSS shards under
+    concurrent commits (publish order == allocation order);
+  * a multi-shard bind wave commits as per-shard sub-waves, each
+    atomic, each fenced, and every pod binds exactly once even when a
+    deposed leader's wave races the successor's;
+  * a relist is a point-in-time-consistent cut: a sub-wave is
+    all-or-nothing in the snapshot and no item's rv exceeds the cut rv;
+  * per-object watch delivery stays rv-monotonic even when one kind's
+    events fan out from several shards;
+  * recovery is per shard — a torn tail on ONE shard's journal never
+    disturbs the surviving shards, and the crashed shard recovers
+    snapshot+suffix bit-identical to its full-replay oracle;
+  * an explicit shard count that disagrees with the on-disk layout
+    reshards losslessly.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import store as st
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client.informers import SharedInformer
+from kubernetes_tpu.testing import faults
+from kubernetes_tpu.testing.wrappers import make_pod
+
+NAMESPACES = [f"ns-{i}" for i in range(16)]
+
+
+def _pod(name, ns, **req):
+    pod = make_pod(name).req(cpu_milli=req.get("cpu_milli", 100)).obj()
+    pod.meta.namespace = ns
+    return pod
+
+
+def _lease(name, holder, transitions=0, namespace="kube-system"):
+    lease = api.Lease()
+    lease.meta.name = name
+    lease.meta.namespace = namespace
+    lease.spec.holder_identity = holder
+    lease.spec.lease_transitions = transitions
+    return lease
+
+
+def test_namespaces_spread_across_shards():
+    s = st.Store(shards=8)
+    indices = {s.shard_index("Pod", ns) for ns in NAMESPACES}
+    assert len(indices) > 1, "16 namespaces hashed to one shard"
+    # an object's shard is a pure function of (kind, namespace): the
+    # same namespace under a different kind may live elsewhere
+    assert s.shard_index("Pod", "ns-0") == s.shard_index("Pod", "ns-0")
+    # cluster-scoped kinds normalize to namespace "" regardless of what
+    # the caller passes — one shard owns all Nodes
+    assert s.shard_index("Node", "anything") == s.shard_index("Node", "")
+
+
+def test_rv_strictly_monotonic_across_shards_under_concurrent_commits():
+    """The chaos suite's dispatch-order audit, cross-shard: every
+    publish (single-object and wave) must hand its events to the
+    dispatch path in strictly ascending rv order even with 8 writer
+    threads spread over every shard."""
+    s = st.Store(shards=8)
+    violations = []
+    last = [0]
+    orig_dispatch, orig_wave = s._dispatch, s._dispatch_wave
+
+    def check(ev):
+        if ev.rv <= last[0]:
+            violations.append((ev.rv, last[0]))
+        last[0] = max(last[0], ev.rv)
+
+    def dispatch(ev):
+        check(ev)
+        orig_dispatch(ev)
+
+    def dispatch_wave(kind, events):
+        for ev in events:
+            check(ev)
+        orig_wave(kind, events)
+
+    s._dispatch, s._dispatch_wave = dispatch, dispatch_wave
+
+    per_thread = 40
+
+    def writer(t):
+        ns = NAMESPACES[t % len(NAMESPACES)]
+        for i in range(per_thread):
+            s.create(_pod(f"p{t}-{i}", ns))
+            if i % 4 == 3:
+                def label(pod, i=i):
+                    pod.meta.labels["i"] = str(i)
+                s.update_wave(
+                    "Pod",
+                    [(f"p{t}-{k}", ns, label) for k in range(i - 3, i + 1)],
+                )
+
+    threads = [
+        threading.Thread(target=writer, args=(t,)) for t in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not violations, f"rv regressions at dispatch: {violations[:5]}"
+    # allocation is gapless: the final rv equals the number of commits
+    # (per thread: per_thread creates + one 4-update wave per 4 creates)
+    writes = 8 * (per_thread + (per_thread // 4) * 4)
+    assert s.resource_version == writes
+
+
+def test_multi_shard_wave_applies_and_splits_errors_per_object():
+    s = st.Store(shards=8)
+    for i in range(12):
+        s.create(_pod(f"p{i}", NAMESPACES[i % 6]))
+
+    def set_node(pod):
+        pod.spec.node_name = "n0"
+
+    def boom(pod):
+        raise RuntimeError("bad mutate")
+
+    updates = [(f"p{i}", NAMESPACES[i % 6], set_node) for i in range(12)]
+    updates.append(("missing", "ns-0", set_node))
+    updates.append(("p0", "ns-0", boom))  # second entry for p0: conflict-free mutate error
+    applied, errors = s.update_wave("Pod", updates)
+    assert len(applied) == 12
+    assert isinstance(errors["ns-0/missing"], st.NotFound)
+    assert "ns-0/p0" in errors  # the boom entry
+    for i in range(12):
+        assert s.get("Pod", f"p{i}", NAMESPACES[i % 6]).spec.node_name == "n0"
+
+
+def test_multi_shard_wave_fenced_commits_nothing():
+    """A deposed leader's wave spanning shards is rejected by the
+    pre-flight fence check before ANY sub-wave publishes."""
+    s = st.Store(shards=8)
+    s.create(_lease("sched", holder="old-leader", transitions=3))
+    for i in range(8):
+        s.create(_pod(f"p{i}", NAMESPACES[i]))
+    stale = st.FenceToken(
+        "sched", "kube-system", "dead-leader", generation=2
+    )
+
+    def bind(pod):
+        pod.spec.node_name = "n1"
+
+    with pytest.raises(st.Fenced):
+        s.update_wave(
+            "Pod",
+            [(f"p{i}", NAMESPACES[i], bind) for i in range(8)],
+            fence=stale,
+        )
+    assert s.fenced_writes_total == 1
+    for i in range(8):
+        assert s.get("Pod", f"p{i}", NAMESPACES[i]).spec.node_name == ""
+
+
+def test_bound_exactly_once_per_subwave_under_fencing():
+    """The takeover race, store-level: an old leader's multi-shard bind
+    wave is mid-flight when the lease transitions.  Sub-waves that
+    publish BEFORE the transition commit under the old fence; everything
+    after is Fenced — and the new leader's wave re-binds only the
+    unbound remainder, so no pod is ever moved (bound exactly once per
+    sub-wave)."""
+    s = st.Store(shards=8)
+    s.create(_lease("sched", holder="leader-1", transitions=1))
+    pods = [(f"p{i}", NAMESPACES[i]) for i in range(8)]
+    for name, ns in pods:
+        s.create(_pod(name, ns))
+    old_fence = st.FenceToken("sched", "kube-system", "leader-1", 1)
+    new_fence = st.FenceToken("sched", "kube-system", "leader-2", 2)
+
+    def binder(node):
+        def mutate(pod):
+            if pod.spec.node_name and pod.spec.node_name != node:
+                raise st.Conflict(
+                    f"pod already bound to {pod.spec.node_name}"
+                )
+            pod.spec.node_name = node
+        return mutate
+
+    # the old leader commits the first half of its wave...
+    a1, e1 = s.update_wave(
+        "Pod", [(n, ns, binder("node-old")) for n, ns in pods[:4]],
+        fence=old_fence,
+    )
+    assert len(a1) == 4 and not e1
+    # ...then is deposed (lease transitions to the successor)...
+    lease = s.get("Lease", "sched", "kube-system")
+    lease.spec.holder_identity = "leader-2"
+    lease.spec.lease_transitions = 2
+    s.update(lease, force=True)
+    # ...and its second half is rejected whole
+    with pytest.raises(st.Fenced):
+        s.update_wave(
+            "Pod", [(n, ns, binder("node-old")) for n, ns in pods[4:]],
+            fence=old_fence,
+        )
+    # the successor binds the remainder; its wave ALSO carries the
+    # bound-exactly-once mutator guard, so recommitting the full set
+    # moves nothing — the first four stay on node-old
+    a2, e2 = s.update_wave(
+        "Pod", [(n, ns, binder("node-new")) for n, ns in pods],
+        fence=new_fence,
+    )
+    bound = {
+        f"{ns}/{n}": s.get("Pod", n, ns).spec.node_name for n, ns in pods
+    }
+    for n, ns in pods[:4]:
+        assert bound[f"{ns}/{n}"] == "node-old"
+        assert f"{ns}/{n}" in e2  # the Conflict split per object
+    for n, ns in pods[4:]:
+        assert bound[f"{ns}/{n}"] == "node-new"
+
+
+def test_relist_is_point_in_time_consistent_cut():
+    """Concurrent single-shard sub-waves stamp a generation across W
+    objects; every relist must observe each namespace's object set at
+    ONE generation (a sub-wave is all-or-nothing in the cut) and no
+    item newer than the cut rv."""
+    s = st.Store(shards=8)
+    W = 6
+    ns_list = NAMESPACES[:4]
+    for ns in ns_list:
+        for i in range(W):
+            s.create(_pod(f"g{i}", ns))
+    stop = threading.Event()
+    problems = []
+
+    def waver(ns):
+        gen = 0
+        while not stop.is_set():
+            gen += 1
+
+            def stamp(pod, gen=gen):
+                pod.meta.labels["gen"] = str(gen)
+
+            s.update_wave(
+                "Pod", [(f"g{i}", ns, stamp) for i in range(W)]
+            )
+
+    def churner():
+        # create/delete cycles: a delete must never mutate the rv of
+        # the committed object a concurrent cut is still copying
+        i = 0
+        while not stop.is_set():
+            i += 1
+            s.create(_pod(f"churn-{i % 7}", "ns-churn"))
+            s.delete("Pod", f"churn-{i % 7}", "ns-churn")
+
+    writers = [
+        threading.Thread(target=waver, args=(ns,)) for ns in ns_list
+    ] + [threading.Thread(target=churner)]
+    for t in writers:
+        t.start()
+    try:
+        for _ in range(60):
+            items, rv = s.list("Pod")
+            by_ns = {}
+            for p in items:
+                if p.meta.resource_version > rv:
+                    problems.append(
+                        f"item rv {p.meta.resource_version} > cut {rv}"
+                    )
+                if p.meta.namespace == "ns-churn":
+                    continue  # create/delete churn: rv bound only
+                by_ns.setdefault(p.meta.namespace, set()).add(
+                    p.meta.labels.get("gen")
+                )
+            for ns, gens in by_ns.items():
+                if len(gens) > 1:
+                    problems.append(f"{ns}: torn cut {sorted(gens)}")
+            if problems:
+                break
+    finally:
+        stop.set()
+        for t in writers:
+            t.join(timeout=5)
+    assert not problems, problems[:5]
+
+
+def test_informer_relist_sees_consistent_cut():
+    """The informer-level half of the cut contract: a SharedInformer
+    relisting during cross-shard wave churn lands on a cache whose
+    objects all have rv <= its relist bookmark."""
+    s = st.Store(shards=8)
+    for i, ns in enumerate(NAMESPACES[:4]):
+        for k in range(4):
+            s.create(_pod(f"p{k}", ns))
+    inf = SharedInformer(s, "Pod")
+    stop = threading.Event()
+
+    def churner():
+        while not stop.is_set():
+            for ns in NAMESPACES[:4]:
+                def touch(pod):
+                    pod.meta.labels["t"] = "x"
+                s.update_wave(
+                    "Pod", [(f"p{k}", ns, touch) for k in range(4)]
+                )
+
+    t = threading.Thread(target=churner)
+    t.start()
+    try:
+        inf.start()
+        assert inf.wait_for_sync(10)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and inf.relists < 1:
+            time.sleep(0.01)
+        cut = inf.last_relist_rv
+        assert cut > 0
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        inf.stop()
+    # every object the relist installed predates (or is) the cut
+    assert all(
+        p.meta.resource_version <= s.resource_version for p in inf.list()
+    )
+
+
+def test_watch_across_shards_is_per_object_monotonic_and_lossless():
+    """One Pod watcher fed by several shards' fan-out threads: per
+    object the rv sequence is strictly ascending, and the replayed
+    stream converges to the exact final store state (the coalescing
+    contract, cross-shard)."""
+    s = st.Store(shards=8)
+    w = s.watch("Pod")
+    n_threads, per_thread = 6, 30
+
+    def writer(t):
+        ns = NAMESPACES[t]
+        for i in range(per_thread):
+            name = f"p{t}-{i}"
+            s.create(_pod(name, ns))
+            fresh = s.get("Pod", name, ns)
+            fresh.meta.labels["v"] = "1"
+            s.update(fresh)
+
+    threads = [
+        threading.Thread(target=writer, args=(t,))
+        for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    state = {}
+    last_per_key = {}
+    while True:
+        ev = w.get(timeout=0.5)
+        if ev is None:
+            break
+        key = f"{ev.obj.meta.namespace}/{ev.obj.meta.name}"
+        assert ev.rv > last_per_key.get(key, 0), (
+            f"{key}: rv {ev.rv} after {last_per_key.get(key)}"
+        )
+        last_per_key[key] = ev.rv
+        state[key] = ev.obj.meta.resource_version
+    w.stop()
+    assert not w.expired and s.watchers_terminated == 0
+    final = {
+        f"{p.meta.namespace}/{p.meta.name}": p.meta.resource_version
+        for p in s.list("Pod")[0]
+    }
+    assert state == final
+
+
+def test_shard_fault_points_fire_with_shard_context(tmp_path):
+    """The per-shard fault points are live: a schedule on
+    store.shard.update_wave / store.shard.journal.append fires on the
+    first shard reaching the point."""
+    path = str(tmp_path / "j.jsonl")
+    s = st.Store(journal_path=path, shards=4)
+    s.create(_pod("a", "ns-0"))
+    reg = faults.FaultRegistry(seed=1)
+    reg.fail("store.shard.update_wave", n=1)
+    with faults.armed(reg):
+        with pytest.raises(faults.FaultInjected):
+            def touch(pod):
+                pod.meta.labels["x"] = "1"
+            s.update_wave("Pod", [("a", "ns-0", touch)])
+    assert reg.fired.get("store.shard.update_wave") == 1
+    reg2 = faults.FaultRegistry(seed=2)
+    reg2.fail("store.shard.journal.append", n=1)
+    with faults.armed(reg2):
+        s.create(_pod("b", "ns-1"))  # journal degrades, commit stands
+    assert reg2.fired.get("store.shard.journal.append") == 1
+    assert s.journal_write_errors == 1
+    assert s.get("Pod", "b", "ns-1").meta.name == "b"
+
+
+def test_one_shard_torn_tail_recovers_others_untouched(tmp_path):
+    """Crash-one-shard: tear one shard's journal tail mid-record.  The
+    surviving shards replay byte-identically; the crashed shard
+    truncates the torn tail and recovers its acked prefix — and the
+    whole recovered store matches its full-replay oracle."""
+    path = str(tmp_path / "j.jsonl")
+    s = st.Store(journal_path=path, shards=4)
+    for i in range(24):
+        s.create(_pod(f"p{i}", NAMESPACES[i % 8]))
+    s.close()
+    # find a shard journal with content and tear its final record
+    victim = None
+    for i in range(4):
+        p = f"{path}.s{i}"
+        if os.path.getsize(p) > 0:
+            victim = p
+    assert victim is not None
+    raw = open(victim, "rb").read()
+    open(victim, "wb").write(raw[: len(raw) - 17])
+    img = faults.crash_disk_image(path, str(tmp_path / "img"))
+    oracle_img = faults.crash_disk_image(path, str(tmp_path / "oracle"))
+    faults.remove_snapshots(oracle_img)
+    recovered = st.Store(journal_path=img)
+    oracle = st.Store(journal_path=oracle_img)
+    assert recovered.shard_count == 4
+    assert recovered.journal_tail_truncations == 1
+    assert recovered.state_fingerprint() == oracle.state_fingerprint()
+    # the torn shard lost exactly its final unacked record; the other
+    # shards' pods all survived
+    assert len(recovered.list("Pod")[0]) == 23
+
+
+def test_reshard_on_explicit_shard_count_is_lossless(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    s = st.Store(journal_path=path, shards=2)
+    for i in range(20):
+        s.create(_pod(f"p{i}", NAMESPACES[i % 10]))
+    def bind(pod):
+        pod.spec.node_name = "n0"
+    s.update_wave(
+        "Pod", [(f"p{i}", NAMESPACES[i % 10], bind) for i in range(20)]
+    )
+    fp = s.state_fingerprint()
+    s.close()
+    wide = st.Store(journal_path=path, shards=8)
+    assert wide.shard_count == 8
+    assert wide.state_fingerprint() == fp
+    wide.close()
+    # the new layout persists: inference now finds 8 shards
+    again = st.Store(journal_path=path)
+    assert again.shard_count == 8
+    assert again.state_fingerprint() == fp
+
+
+def test_checkpoint_all_shards_and_suffix_recovery(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    s = st.Store(journal_path=path, shards=4)
+    for i in range(16):
+        s.create(_pod(f"p{i}", NAMESPACES[i % 8]))
+    n = s.checkpoint()
+    assert n == 16
+    for i in range(16, 24):
+        s.create(_pod(f"p{i}", NAMESPACES[i % 8]))
+    s.close()
+    recovered = st.Store(journal_path=path)
+    assert recovered.snapshot_records == 16
+    assert recovered.journal_suffix_records == 8
+    assert recovered.state_fingerprint() == s.state_fingerprint()

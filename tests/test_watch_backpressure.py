@@ -37,9 +37,12 @@ def _wait_for(cond, timeout=5.0):
 
 def _settled(store, w):
     """True once the fan-out thread delivered every committed event into
-    the watcher's buffer (its dedup horizon reached the store rv)."""
+    the watcher's buffer (its dedup horizon reached the store rv).
+    The rv is read BEFORE taking the watch mutex: the store's lock
+    order is publish-lock -> Watch._mu, never the reverse."""
+    rv = store.resource_version
     with w._mu:
-        return w._last_rv >= store.resource_version
+        return w._last_rv >= rv
 
 
 # -- per-watcher coalescing --------------------------------------------------
