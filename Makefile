@@ -28,8 +28,9 @@ test:
 # the fixed seed matrices live in tests/test_chaos.py: SEEDS = range(20)
 # for the full-pipeline plans plus the overload-protection scenarios
 # (SLOW_CONSUMER_SEEDS, RELIST_STORM_SEEDS — backpressured fan-out,
-# coalescing, relist-storm containment); every seed replays
-# byte-identically via FaultRegistry(seed)
+# coalescing, relist-storm containment) and the mixed-priority
+# preemption churn (PREEMPT_SEEDS — batched-dry-run faults, PDB-guarded
+# victims); every seed replays byte-identically via FaultRegistry(seed)
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -m chaos -q \
 		-p no:cacheprovider

@@ -30,6 +30,13 @@ informer-fed cache.  `extra` carries all five configs:
        sub-waves), p50/p90/p99 lifecycle latency, zero lost/double-bound
        pods, watchers_terminated == 0, and per-shard snapshot+suffix
        recovery under STRICT_RECOVERY_BUDGET_MS
+  c9   20k nodes / 128 preemptors  mixed-priority preemption churn with
+       PDBs through the BATCHED PostFilter (one [P, N, K] dry-run per
+       pass); gates: oracle + batched-vs-sequential plan parity,
+       bound-exactly-once for preemptors and evicted victims, guarded
+       victims survive, a sustained preemption-throughput floor, zero
+       steady recompiles in the planning phase, and a ≥5x exposed
+       PostFilter planning speedup vs the per-pod walk on the same trace
 
 Every scenario reports step-latency p50/p90/p99 (the windowed sampler:
 attempt-duration percentiles for the loop configs, timed-sample
@@ -773,6 +780,279 @@ def config7():
     )
 
 
+# c9 preemption gates (BENCH_STRICT=1): the mixed-priority churn's
+# batched PostFilter must hold a minimum sustained preemption rate,
+# plan identically to the sequential per-pod loop AND the pure-Python
+# oracle, never double-bind a preemptor or evicted victim, keep
+# PDB-guarded victims alive while unguarded alternatives exist, and the
+# batched planning phase must beat the sequential walk by at least
+# STRICT_PREEMPT_SPEEDUP_MIN on the same frozen trace.
+STRICT_PREEMPT_MIN_PER_S = 0.5  # measured 1.43/s on a 1-CPU host
+STRICT_PREEMPT_SPEEDUP_MIN = 5.0  # measured 9.0x on the frozen trace
+
+
+def config9():
+    """c9: mixed-priority preemption churn at 20k nodes with PDBs — the
+    batched PostFilter (one [P, N, K] dry-run per pass,
+    scheduler/preemption.py shared_pass) as a first-class workload.
+
+    Phase A (live): every node is filled by a low-priority victim
+    (every 4th node's victim guarded by a zero-budget PDB), then a
+    mixed-priority preemptor stream (50/100/200) arrives — each
+    preemptor needs one eviction, so sustained PostFilter work is the
+    only way the stream binds.  An event audit asserts bound-exactly-
+    once for preemptors AND evicted victims.
+
+    Phase B (frozen trace): the SAME failed-pod set is planned twice on
+    an identical 20k-node state — once through the shared batched pass,
+    once through the sequential per-pod walk — proving plan parity and
+    measuring the exposed PostFilter planning speedup; a 256-node
+    randomized sub-state checks oracle parity (the documented
+    reprieve-policy divergence stays pinned).  The planning phase runs
+    under the retrace tracker with a steady window: zero recompiles."""
+    import threading
+    from collections import defaultdict
+
+    from kubernetes_tpu.analysis import retrace
+    from kubernetes_tpu.api import store as st
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.models.batch_scheduler import TPUBatchScheduler
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.scheduler.cache import SchedulerCache
+    from kubernetes_tpu.scheduler.config import SchedulerConfiguration
+    from kubernetes_tpu.scheduler.metrics import Registry
+    from kubernetes_tpu.scheduler.preemption import PreemptionEvaluator
+    from kubernetes_tpu.testing.oracle import Oracle
+    from kubernetes_tpu.testing.wrappers import GI, make_node, make_pod
+
+    n_nodes, n_preempt = 20_000, 128
+
+    def mk_nodes():
+        return [
+            make_node(f"node-{i}")
+            .capacity(cpu_milli=2000, mem=8 * GI, pods=16)
+            .zone(f"zone-{i % 16}")
+            .obj()
+            for i in range(n_nodes)
+        ]
+
+    def mk_victim(i):
+        pw = (
+            make_pod(f"victim-{i}")
+            .req(cpu_milli=1600, mem=GI // 2)
+            .priority(i % 5)
+            .node_name(f"node-{i}")
+        )
+        if i % 4 == 0:
+            pw = pw.labels(app="guarded")
+        p = pw.obj()
+        p.status.phase = "Running"
+        return p
+
+    def mk_preemptor(i, prefix="hi"):
+        return (
+            make_pod(f"{prefix}-{i}")
+            .req(cpu_milli=1800, mem=GI // 2)
+            .priority([50, 100, 200][i % 3])
+            .obj()
+        )
+
+    # -- phase A: live mixed-priority churn ----------------------------
+    store = st.Store(shards=8)
+    nodes = mk_nodes()
+    for nd in nodes:
+        store.create(nd)
+    for i in range(n_nodes):
+        store.create(mk_victim(i))
+    pdb = api.PodDisruptionBudget(
+        meta=api.ObjectMeta(name="guard", namespace="default"),
+        spec=api.PodDisruptionBudgetSpec(
+            selector=api.LabelSelector(match_labels={"app": "guarded"})
+        ),
+    )
+    pdb.status.disruptions_allowed = 0
+    store.create(pdb)
+
+    # bound-exactly-once audit over every committed event (preemptors
+    # AND victims: an evicted victim must never re-bind)
+    bound_nodes = defaultdict(set)
+    audit_lock = threading.Lock()
+    orig_dispatch = store._dispatch
+    orig_wave = store._dispatch_wave
+
+    def check(ev):
+        if ev.kind == "Pod" and ev.obj.spec.node_name:
+            with audit_lock:
+                key = f"{ev.obj.meta.namespace}/{ev.obj.meta.name}"
+                bound_nodes[key].add(ev.obj.spec.node_name)
+
+    def dispatch(ev):
+        check(ev)
+        orig_dispatch(ev)
+
+    def dispatch_wave(kind, events):
+        for ev in events:
+            check(ev)
+        orig_wave(kind, events)
+
+    store._dispatch = dispatch
+    store._dispatch_wave = dispatch_wave
+
+    # the latency SLO scales with the scenario: a 20k-node cycle on one
+    # host runs seconds of decode, and the DEFAULT 0.5s SLO would pin
+    # the overload ladder at level 2 (preemption deferred) on platform
+    # slowness alone; the short unschedulable flush is the liveness
+    # safety net for parked preemptors between eviction wake-ups
+    sched = Scheduler(
+        store, batch_size=256,
+        config=SchedulerConfiguration(
+            batch_latency_slo_seconds=10.0,
+            unschedulable_flush_seconds=2.0,
+        ),
+    )
+    sched.start()
+    sched.warmup([mk_preemptor(i, "warm") for i in range(64)])
+    terminated0 = store.watchers_terminated
+    m = sched.metrics
+    t0 = time.perf_counter()
+    for i in range(n_preempt):
+        store.create(mk_preemptor(i))
+    deadline = time.monotonic() + 600
+    bound = 0
+    while time.monotonic() < deadline:
+        bound = sum(
+            1
+            for p in sched.informers.informer("Pod").list()
+            if p.meta.name.startswith("hi-") and p.spec.node_name
+        )
+        if bound >= n_preempt:
+            break
+        time.sleep(0.1)
+    dt = time.perf_counter() - t0
+    nominated = m.preemption_attempts.get("nominated")
+    sched.stop()
+    survivors = {p.meta.name for p in store.list("Pod")[0]}
+    guarded_total = sum(1 for i in range(0, n_nodes, 4))
+    guarded_alive = sum(
+        1 for i in range(0, n_nodes, 4) if f"victim-{i}" in survivors
+    )
+    evicted = sum(
+        1 for i in range(n_nodes) if f"victim-{i}" not in survivors
+    )
+    double_bound = sum(1 for v in bound_nodes.values() if len(v) > 1)
+
+    # -- phase B: frozen-trace planning parity + speedup ----------------
+    tpu = TPUBatchScheduler()
+    for nd in nodes:
+        tpu.add_node(nd)
+    for i in range(n_nodes):
+        v = mk_victim(i)
+        tpu.assume(v, v.spec.node_name)
+    ev = PreemptionEvaluator(
+        tpu, SchedulerCache(tpu.state), st.Store(), Registry()
+    )
+    failed = [mk_preemptor(i, "plan") for i in range(16)]
+
+    def plan_key(got):
+        if got is None:
+            return None
+        cands, ranked, min_k = got
+        row, name, victims, _ = cands[ranked[0]]
+        return (name, [v.meta.name for v in victims[: int(min_k[ranked[0]])]])
+
+    retrace.clear_steady()
+    with ev.shared_pass(failed):
+        warm_batched = [plan_key(ev._candidates(p)) for p in failed]
+    warm_classic = plan_key(ev._candidates_classic(failed[0]))
+    retrace.mark_steady()
+    steady0 = retrace.steady_total()
+    t_b = time.perf_counter()
+    with ev.shared_pass(failed):
+        batched_plans = [plan_key(ev._candidates(p)) for p in failed]
+    t_batched = time.perf_counter() - t_b
+    t_s = time.perf_counter()
+    seq_plans = [plan_key(ev._candidates_classic(p)) for p in failed]
+    t_sequential = time.perf_counter() - t_s
+    steady_recompiles = retrace.steady_total() - steady0
+    retrace.clear_steady()
+    plan_parity = batched_plans == seq_plans
+    del warm_batched, warm_classic
+
+    # oracle parity on a randomized 256-node sub-state (no PDBs — the
+    # oracle mirrors the minimal-prefix policy, not budgets)
+    rng = np.random.default_rng(91)
+    small_nodes = [
+        make_node(f"o{i}").capacity(cpu_milli=4000, mem=8 * GI, pods=20).obj()
+        for i in range(256)
+    ]
+    small_bound = []
+    for i in range(512):
+        p = (
+            make_pod(f"ov{i}")
+            .req(cpu_milli=int(rng.choice([500, 1000, 1500])), mem=GI)
+            .priority(int(rng.integers(0, 5)))
+            .node_name(f"o{i % 256}")
+            .obj()
+        )
+        small_bound.append(p)
+    tpu2 = TPUBatchScheduler()
+    for nd in small_nodes:
+        tpu2.add_node(nd)
+    for p in small_bound:
+        tpu2.assume(p, p.spec.node_name)
+    ev2 = PreemptionEvaluator(
+        tpu2, SchedulerCache(tpu2.state), st.Store(), Registry()
+    )
+    oracle_parity = True
+    for j in range(6):
+        preemptor = (
+            make_pod(f"op{j}").req(cpu_milli=3500, mem=GI).priority(100).obj()
+        )
+        with ev2.shared_pass([preemptor]):
+            got = ev2._candidates(preemptor)
+        want = Oracle(small_nodes, bound_pods=small_bound).preempt(preemptor)
+        have = plan_key(got)
+        if want is None:
+            oracle_parity &= have is None
+        else:
+            oracle_parity &= have is not None and have[0] == want[0] and (
+                sorted(have[1]) == sorted(v.meta.name for v in want[1])
+            )
+
+    return {
+        "nodes": n_nodes, "preemptors": n_preempt, "placed": bound,
+        "latency_s": round(dt, 4),
+        "preempted": nominated,
+        "preemptions_per_s": round(nominated / dt, 2) if dt else 0.0,
+        "victims_evicted": evicted,
+        "guarded_total": guarded_total,
+        "guarded_alive": guarded_alive,
+        "guarded_survived": bool(guarded_alive == guarded_total),
+        "double_bound": double_bound,
+        "watchers_terminated": store.watchers_terminated - terminated0,
+        "preempt_batch_passes": m.preemption_batch_size.n,
+        "preempt_batch_size_avg": round(m.preemption_batch_size.average, 2),
+        "preempt_solve_s_total": round(
+            m.preemption_solve_duration.total, 4
+        ),
+        "conflict_serializations": (
+            m.preemption_conflict_serializations.total
+        ),
+        "pdb_blocked_total": m.preemption_pdb_blocked_total.total,
+        "preemption_victims": m.preemption_victims.n,
+        # phase B: the exposed PostFilter planning cost on one frozen
+        # 16-pod trace — batched (one encode + one [P, N, K] dispatch)
+        # vs the sequential per-pod walk the batch replaced
+        "postfilter_batched_s": round(t_batched, 4),
+        "postfilter_sequential_s": round(t_sequential, 4),
+        "postfilter_speedup": round(t_sequential / t_batched, 2)
+        if t_batched else 0.0,
+        "plan_parity": plan_parity,
+        "oracle_parity": oracle_parity,
+        "steady_recompiles": steady_recompiles,
+    }
+
+
 # c8 fleet gates (BENCH_STRICT=1): the 100k-node hollow fleet's
 # sustained lifecycle soak must lose no pod, double-bind no pod,
 # terminate no watcher, and the post-soak kill-free recovery (per-shard
@@ -866,6 +1146,7 @@ def main() -> None:
             "c6s_sustained_50k": config6_sustained(),
             "c7_sharded_100k": config7(),
             "c8_store_100k": config8(),
+            "c9_preempt_churn": config9(),
         }
     # every over-threshold schedule_batch cycle, with its per-step share
     # (commit- and solve-share per step are readable straight off the
@@ -1021,6 +1302,40 @@ def main() -> None:
             failures.append(
                 f"c8 per-shard recovery over budget: {c8['recovery_ms']}ms"
                 f" > {STRICT_RECOVERY_BUDGET_MS}ms"
+            )
+        # batched-preemption gates: oracle + batched-vs-sequential plan
+        # parity, bound-exactly-once across preemptors AND evicted
+        # victims, PDB-guarded victims alive, the sustained preemption
+        # floor, and the ≥5x exposed-PostFilter planning speedup on the
+        # same frozen trace (steady_recompiles rides the generic gate)
+        c9 = extra["c9_preempt_churn"]
+        if not c9["oracle_parity"]:
+            failures.append("c9 batched preemption diverged from the oracle")
+        if not c9["plan_parity"]:
+            failures.append(
+                "c9 batched plans diverged from the sequential walk"
+            )
+        if c9["double_bound"] or c9["placed"] < c9["preemptors"]:
+            failures.append(
+                f"c9 bound-exactly-once violated: {c9['double_bound']} "
+                f"double binds, {c9['placed']}/{c9['preemptors']} "
+                "preemptors placed"
+            )
+        if not c9["guarded_survived"]:
+            failures.append(
+                f"c9 evicted PDB-guarded victims: {c9['guarded_alive']}/"
+                f"{c9['guarded_total']} survived"
+            )
+        if c9["preemptions_per_s"] < STRICT_PREEMPT_MIN_PER_S:
+            failures.append(
+                f"c9 preemption throughput below floor: "
+                f"{c9['preemptions_per_s']} < {STRICT_PREEMPT_MIN_PER_S}/s"
+            )
+        if c9["postfilter_speedup"] < STRICT_PREEMPT_SPEEDUP_MIN:
+            failures.append(
+                f"c9 batched PostFilter speedup below floor: "
+                f"{c9['postfilter_speedup']}x < "
+                f"{STRICT_PREEMPT_SPEEDUP_MIN}x"
             )
         if failures:
             print("BENCH_STRICT: " + "; ".join(failures), file=sys.stderr)

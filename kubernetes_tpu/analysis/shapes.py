@@ -58,6 +58,20 @@ ENCODE_SIZES: Tuple[Tuple[int, int], ...] = ((3, 12), (20, 2))
 #: representative raw batch sizes for the gang-retry closure check
 GANG_RETRY_SIZES: Tuple[int, ...] = (5, 8, 100, 1024)
 
+#: (node, victim-slot, priority-level, pod) buckets the batched
+#: preemption kernel is driven across (ops/preemption.py
+#: batched_dry_run); the encoder pads with pad_dim(n, 8) / pad_dim(k, 4)
+#: / pad_dim(l, 1) / pad_dim(p, 4) — see scheduler/preemption.py
+PREEMPT_LATTICE: Tuple[Tuple[int, int, int, int], ...] = (
+    (8, 4, 1, 4), (16, 4, 1, 4), (16, 4, 2, 8), (32, 8, 2, 8),
+)
+
+#: raw (candidate nodes, victims, levels, pods) sizes the preemption
+#: encoder must land on the lattice from (closure check)
+PREEMPT_RAW_SIZES: Tuple[Tuple[int, int, int, int], ...] = (
+    (3, 1, 1, 2), (20, 5, 3, 9), (300, 17, 4, 16),
+)
+
 
 def _schema_contracts(root: str, package: str = "kubernetes_tpu"):
     files = load_sources(root, [os.path.join(package, "ops")])
@@ -571,6 +585,128 @@ def _check_gang_retry_closure(findings: List[Finding]) -> None:
             )
 
 
+def _check_preemption_kernel(byclass, findings: List[Finding]) -> None:
+    """Drive the batched preemption dry-run (ops/preemption.py
+    batched_dry_run) through eval_shape across PREEMPT_LATTICE: outputs
+    must match the BatchDryRunResult contracts at every bucket, the
+    abstract signature set must be exactly one per lattice point, and
+    the encoder's pad buckets must be closed over the raw (candidate,
+    victim, level, pod) sizes a PostFilter pass produces."""
+    import jax
+    import numpy as np
+
+    from ..ops import preemption as pre_ops
+    from ..ops import schema
+    from ..utils import vocab as vb
+    from . import retrace
+
+    file = "kubernetes_tpu/ops/preemption.py"
+    r = len(schema.FIXED_RESOURCES)
+    batch_fields = byclass.get("PreemptionBatch", {})
+    result_fields = byclass.get("BatchDryRunResult", {})
+    if not batch_fields or not result_fields:
+        findings.append(
+            Finding(
+                CHECK, file, 1, "PreemptionBatch",
+                "preemption batch contracts missing (run the "
+                "tensor-contract pass first)",
+            )
+        )
+        return
+
+    def abstract_batch(env):
+        vals = {}
+        for f in pre_ops.PreemptionBatch._fields:
+            c = batch_fields.get(f)
+            if c is None:
+                raise KeyError(f"PreemptionBatch.{f} has no contract")
+            vals[f] = jax.ShapeDtypeStruct(c.shape(env), np.dtype(c.dtype))
+        return pre_ops.PreemptionBatch(**vals)
+
+    signatures = set()
+    for n, k, l, p in PREEMPT_LATTICE:
+        env = {"N": n, "K": k, "L": l, "P": p, "R": r}
+        batch = abstract_batch(env)
+        signatures.add(retrace.signature(batch))
+        try:
+            res = jax.eval_shape(pre_ops.batched_dry_run, batch)
+        except Exception as e:  # noqa: BLE001 — abstract eval failed
+            findings.append(
+                Finding(
+                    CHECK, file, 1, "batched_dry_run",
+                    f"eval_shape failed at bucket {n}x{k}x{l}x{p}: {e}",
+                )
+            )
+            continue
+        for f in pre_ops.BatchDryRunResult._fields:
+            c = result_fields.get(f)
+            val = getattr(res, f)
+            if c is None:
+                continue
+            want = c.shape(env)
+            if tuple(val.shape) != want or str(val.dtype) != c.dtype:
+                findings.append(
+                    Finding(
+                        CHECK, file, c.line, f"BatchDryRunResult.{f}",
+                        f"preempt[{n}x{k}x{l}x{p}]: eval_shape output "
+                        f"{val.dtype}{tuple(val.shape)} != contract "
+                        f"{c.render()} (= {c.dtype}{want})",
+                    )
+                )
+    if len(signatures) != len(PREEMPT_LATTICE):
+        findings.append(
+            Finding(
+                CHECK, file, 1, "batched_dry_run",
+                f"{len(PREEMPT_LATTICE)} lattice points produced "
+                f"{len(signatures)} distinct compile keys — the abstract "
+                "signature set must be exactly the bucket set",
+            )
+        )
+    # closure: every raw (candidate, victim, level, pod) size a pass
+    # can produce must pad onto the power-of-two lattice family
+    for raw_n, raw_k, raw_l, raw_p in PREEMPT_RAW_SIZES:
+        padded = (
+            vb.pad_dim(raw_n, 8), vb.pad_dim(raw_k, 4),
+            vb.pad_dim(raw_l, 1), vb.pad_dim(raw_p, 4),
+        )
+        if not all(vb.is_pad_bucket(d, 1) for d in padded):
+            findings.append(
+                Finding(
+                    CHECK, file, 1, "PreemptionBatch",
+                    f"raw preemption sizes {(raw_n, raw_k, raw_l, raw_p)} "
+                    f"pad to {padded} — not closed over the "
+                    "power-of-two bucket family",
+                )
+            )
+    # the batched static-feasibility dispatch reuses the snapshot
+    # contracts: one eval at the base lattice point proves the vmapped
+    # kernel is shape-stable over contract-built components
+    from ..ops import schema as _schema
+
+    limits = _schema.SnapshotLimits()
+    snap = abstract_snapshot(byclass, limits, n=8, p=8)
+    try:
+        out = jax.eval_shape(
+            pre_ops.static_feasible_batch,
+            snap.cluster, snap.pods, snap.selectors,
+        )
+        if tuple(out.shape) != (8, 8) or str(out.dtype) != "bool":
+            findings.append(
+                Finding(
+                    CHECK, file, 1, "static_feasible_batch",
+                    f"static mask eval_shape produced {out.dtype}"
+                    f"{tuple(out.shape)}, want bool[P, N]",
+                )
+            )
+    except Exception as e:  # noqa: BLE001
+        findings.append(
+            Finding(
+                CHECK, file, 1, "static_feasible_batch",
+                f"eval_shape failed: {e}",
+            )
+        )
+
+
 def _check_mesh_kernels(byclass, findings: List[Finding]) -> None:
     """Mesh-sharded solver twins driven through eval_shape across the
     lattice: outputs must match the result contracts at every bucket,
@@ -737,6 +873,7 @@ def check(root: str, package: str = "kubernetes_tpu") -> List[Finding]:
     findings: List[Finding] = []
     _check_encode(byclass, findings)
     _check_kernels(byclass, findings)
+    _check_preemption_kernel(byclass, findings)
     _check_mesh_kernels(byclass, findings)
     _check_gang_retry_closure(findings)
     findings.sort(key=lambda f: (f.file, f.line, f.message))

@@ -10,6 +10,19 @@ answering "after evicting the k cheapest victims, does the preemptor
 fit?" for every (node, k) pair at once — the data-dependent dry-run loop
 becomes a cumsum + argmax.
 
+Two granularities share that shape:
+
+  * dry_run_victims — ONE preemptor against its candidate set (the
+    per-pod fallback path the solve circuit breaker routes to);
+  * batched_dry_run — EVERY failed pod of a PostFilter pass against
+    every node with victims, one ``[P, N, K]`` dispatch.  The per-node
+    victim tensors are encoded once per pass (scheduler/preemption.py
+    builds them from the same snapshot machinery the Filter/Score path
+    uses); per-preemptor victim eligibility (only strictly-lower
+    priorities are evictable) and the PDB-aware eviction order are
+    threaded in as a per-priority-level permutation + prefix length, so
+    pods sharing a priority share one row of host prep.
+
 Victim-choice policy (documented divergence): we evict the k
 lowest-priority pods on the node (priority ascending, pod key breaking
 ties), the minimal such k.  The reference instead removes all
@@ -18,13 +31,23 @@ first (preemption.go:
 selectVictimsOnNode) — for resource-only constraints both keep the
 highest-priority pods and differ only when a single high-priority
 victim could replace several low-priority ones.  The pure-Python oracle
-(testing/oracle.py:preempt_oracle) implements this module's policy, and
+(testing/oracle.py Oracle.preempt) implements this module's policy, and
 parity is asserted against it.
 
 Candidate ranking follows pickOneNodeForPreemption's criteria order
-minus PDBs (no PodDisruptionBudget API yet, stubbed at zero violations):
-lowest highest-victim-priority, then lowest priority sum, then fewest
-victims, then lowest node row (preemption.go:316 SelectCandidate).
+INCLUDING PodDisruptionBudgets: fewest PDB-violating victims first
+(minNumPDBViolatingScoreFunc, preemption.go:463), then lowest
+highest-victim-priority, then lowest priority sum, then fewest victims,
+then lowest node row (preemption.go:316 SelectCandidate).  The
+violation counts are computed ON DEVICE by the batched kernel (viol_k —
+small integers, exact in i32); the max/sum-of-priority statistics stay
+host-side with exact integer math — Kubernetes priorities reach ~2e9,
+past float32's 2^24 exact-integer envelope, so summing them on device
+would mis-rank candidates.  PDB-violating victims sort to the BACK of
+each node's eviction order (the prefix-eviction analogue of the
+reference's reprieve pass, which tries hardest to KEEP PDB-violating
+victims — preemption.go:198); scheduler/preemption.py computes that
+order per priority level and hands it down as ``perm``.
 """
 
 from __future__ import annotations
@@ -34,10 +57,13 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..analysis import retrace
+from ..analysis.markers import hot_path
+
 
 class DryRunResult(NamedTuple):
     feasible: jnp.ndarray   # bool[C]  pod fits after evicting min_k victims
-    min_k: jnp.ndarray      # i32[C]   victims needed (only valid if feasible)
+    min_k: jnp.ndarray      # i32[C]  victims needed (only valid if feasible)
 
 
 @jax.jit
@@ -69,3 +95,129 @@ def dry_run_victims(
     feasible = fits.any(axis=1)
     min_k = jnp.argmax(fits, axis=1).astype(jnp.int32)          # first True
     return DryRunResult(feasible, min_k)
+
+
+# -- the batched (whole-PostFilter-pass) dry-run ---------------------------
+
+
+class PreemptionBatch(NamedTuple):
+    """One PostFilter pass's preemption inputs, encoded ONCE from the
+    cluster state: N candidate nodes (every node holding at least one
+    pod below the pass's highest preemptor priority), K victim slots per
+    node sorted by (priority asc, pod key), L distinct preemptor
+    priority levels, P failed pods.  ``perm``/``elig_len``/``viol``
+    carry the per-level eviction order: victims evictable at level l are
+    the first ``elig_len[l, n]`` entries of ``perm[l, n]``, PDB-clean
+    victims first (see module docstring)."""
+
+    free: jnp.ndarray        # f32[N, R]  allocatable - requested per node
+    victim_req: jnp.ndarray  # f32[N, K, R]  usage per victim slot
+    perm: jnp.ndarray        # i32[L, N, K]  eviction order per level
+    elig_len: jnp.ndarray    # i32[L, N]  evictable victims per level
+    viol: jnp.ndarray        # bool[L, N, K]  PDB violation, eviction order
+    pods_req: jnp.ndarray    # f32[P, R]  preemptor resource vectors
+    pod_level: jnp.ndarray   # i32[P]  priority-level index per preemptor
+
+
+class BatchDryRunResult(NamedTuple):
+    feasible: jnp.ndarray  # bool[P, N]  pod p fits on node n after min_k
+    min_k: jnp.ndarray     # i32[P, N]  victims needed (valid if feasible)
+    viol_k: jnp.ndarray    # i32[P, N]  PDB violations in the evicted prefix
+
+
+@hot_path
+def batched_dry_run(batch: PreemptionBatch) -> BatchDryRunResult:
+    """Every (failed pod, candidate node) dry run of one PostFilter pass
+    in one dispatch: cumulative eviction per priority level (shared by
+    every pod at that level), then a ``[P, N, K+1]`` broadcast fit test.
+    The PDB-violation count of each minimal prefix comes back as a
+    device-side ranking axis (viol_k); exact-integer priority statistics
+    stay host-side (see dry_run_victims)."""
+    l, n, k = batch.perm.shape
+    # victims re-ordered into each level's eviction order
+    ordered = jnp.take_along_axis(
+        batch.victim_req[None, :, :, :], batch.perm[..., None], axis=2
+    )                                                       # [L, N, K, R]
+    in_prefix = (
+        jnp.arange(k, dtype=jnp.int32)[None, None, :]
+        < batch.elig_len[:, :, None]
+    )                                                       # [L, N, K]
+    cum = jnp.cumsum(
+        ordered * in_prefix[..., None].astype(ordered.dtype), axis=2
+    )                                                       # [L, N, K, R]
+    cum_viol = jnp.cumsum(
+        (batch.viol & in_prefix).astype(jnp.int32), axis=2
+    )                                                       # [L, N, K]
+    # per-pod gather of its level's cumulative tensors
+    cum_p = cum[batch.pod_level]                            # [P, N, K, R]
+    p = cum_p.shape[0]
+    r = cum_p.shape[3]
+    free_k = batch.free[None, :, None, :] + jnp.concatenate(
+        [jnp.zeros((p, n, 1, r), cum_p.dtype), cum_p], axis=2
+    )                                                       # [P, N, K+1, R]
+    req = batch.pods_req[:, None, None, :]
+    fits = ((req <= 0) | (req <= free_k)).all(axis=-1)      # [P, N, K+1]
+    pod_elig = batch.elig_len[batch.pod_level]              # [P, N]
+    ks = jnp.arange(k + 1, dtype=jnp.int32)[None, None, :]
+    fits = fits & (ks <= pod_elig[:, :, None])
+    feasible = fits.any(axis=2)
+    min_k = jnp.argmax(fits, axis=2).astype(jnp.int32)      # first True
+    viol_at = jnp.take_along_axis(
+        cum_viol[batch.pod_level],
+        jnp.maximum(min_k - 1, 0)[..., None],
+        axis=2,
+    )[..., 0]                                               # [P, N]
+    viol_k = jnp.where(min_k > 0, viol_at, 0)
+    return BatchDryRunResult(feasible, min_k, viol_k)
+
+
+_batched_dry_run_jit = jax.jit(batched_dry_run)
+
+
+def run_batched_dry_run(batch: PreemptionBatch) -> BatchDryRunResult:
+    """Dispatch the batched dry-run and report the executable key to the
+    recompile-discipline tracker (the same discipline the solver jits
+    follow: inputs land on the pad-bucket lattice, so the steady-state
+    trace count must be zero)."""
+    out = _batched_dry_run_jit(batch)
+    retrace.note(
+        "preempt-batch", _batched_dry_run_jit,
+        lambda: retrace.signature(batch),
+    )
+    return out
+
+
+run_batched_dry_run.jitted = _batched_dry_run_jit  # AOT prewarm hook
+
+
+@hot_path
+def static_feasible_batch(cluster, pods, selectors) -> jnp.ndarray:
+    """bool[P, N]: the placement-independent Filter slice (NodeName /
+    taints / affinity / validity) for EVERY preemptor of the pass at
+    once — resources deliberately excluded, that is what eviction frees.
+    One dispatch replaces the per-pod static snapshot the sequential
+    path evaluates (scheduler/preemption.py _static_row_from_snap)."""
+    from .filters import pod_view, selector_match, static_feasible_for_pod
+
+    sel_mask = selector_match(cluster, selectors)
+    p = pods.req.shape[0]
+
+    def one(i):
+        return static_feasible_for_pod(cluster, pod_view(pods, i), sel_mask)
+
+    return jax.vmap(one)(jnp.arange(p, dtype=jnp.int32))
+
+
+_static_feasible_jit = jax.jit(static_feasible_batch)
+
+
+def run_static_feasible_batch(cluster, pods, selectors) -> jnp.ndarray:
+    out = _static_feasible_jit(cluster, pods, selectors)
+    retrace.note(
+        "preempt-static", _static_feasible_jit,
+        lambda: retrace.signature((cluster, pods, selectors)),
+    )
+    return out
+
+
+run_static_feasible_batch.jitted = _static_feasible_jit

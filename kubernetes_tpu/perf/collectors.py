@@ -174,6 +174,9 @@ class MetricsCollector:
         # the realized cross-shard commit overlap (docs/scheduler_loop.md)
         "scheduler_commit_subwave_duration_seconds",
         "scheduler_commit_subwave_overlap_seconds",
+        # batched PostFilter: one shared encode + [P, N, K] dry-run per
+        # preemption pass (docs/scheduler_loop.md preemption section)
+        "scheduler_preemption_solve_duration_seconds",
     )
 
     # count-unit histograms: reported as raw percentiles (no ms scaling —
@@ -183,6 +186,8 @@ class MetricsCollector:
         "scheduler_solve_wave_count",
         "scheduler_solve_wave_fallbacks",
         "scheduler_preemption_victims",
+        # failed pods sharing one batched preemption dry-run
+        "scheduler_preemption_batch_size_pods",
     )
 
     # breaker / supervision / journal-recovery scalars (gauges and
@@ -225,6 +230,10 @@ class MetricsCollector:
         "scheduler_schedule_attempts_total",
         "scheduler_pending_pods",
         "scheduler_preemption_attempts_total",
+        # batched preemption: cross-preemptor conflict recomputes and
+        # PDB-blocked candidate rankings (docs/scheduler_loop.md)
+        "scheduler_preemption_conflict_serializations_total",
+        "scheduler_preemption_pdb_blocked_total",
     )
 
     def __init__(

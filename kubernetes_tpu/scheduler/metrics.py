@@ -325,6 +325,31 @@ class Registry:
         self.pending_pods = Gauge("scheduler_pending_pods")
         self.preemption_victims = Histogram("scheduler_preemption_victims")
         self.preemption_attempts = Counter("scheduler_preemption_attempts_total")
+        # -- batched-preemption surface (docs/scheduler_loop.md) -----------
+        # wall seconds of one PostFilter pass's shared encode + batched
+        # [P, N, K] device dry-run + static-feasibility dispatch (one
+        # observation per pass; the per-pod walk this replaced paid this
+        # cost per failed pod)
+        self.preemption_solve_duration = Histogram(
+            "scheduler_preemption_solve_duration_seconds"
+        )
+        # failed pods sharing one batched preemption solve
+        self.preemption_batch_size = Histogram(
+            "scheduler_preemption_batch_size_pods",
+            buckets=tuple(float(2 ** i) for i in range(13)),
+        )
+        # wavefront-style conflict serializations: (preemptor, node)
+        # pairs recomputed from live state because an earlier preemptor
+        # of the same pass evicted there (the coupling discipline that
+        # keeps batched == sequential)
+        self.preemption_conflict_serializations = Counter(
+            "scheduler_preemption_conflict_serializations_total"
+        )
+        # feasible candidates whose minimal eviction set would violate a
+        # PodDisruptionBudget (ranked last — minNumPDBViolatingScoreFunc)
+        self.preemption_pdb_blocked_total = Counter(
+            "scheduler_preemption_pdb_blocked_total"
+        )
 
     def snapshot(self) -> Dict[str, object]:
         """Name → metric, for collectors.  HistogramVec children appear

@@ -9,18 +9,42 @@ The reference flow (framework/preemption/preemption.go:150 Preempt):
   4. nominate: pod.status.nominatedNodeName = node; pod requeues and
      schedules onto the freed space on a later cycle
 
-Ours: the per-node dry-run loop is ops.preemption.dry_run_victims (one
-device dispatch over all candidates), selection is the same lexicographic
-criteria minus PDBs, victims are deleted through the store (informers
-unaccount them), and the chosen candidate is verified by a real re-solve
-with the victims masked out of the cluster state before anything is
-deleted — so every nomination is backed by an actual placement, including
-spread/inter-pod families the resource dry-run can't see.
+Ours: the dry-run loop is batched at PASS granularity.  A PostFilter
+pass opens a shared context (``shared_pass``) that walks
+``state._pods_by_node`` ONCE, encodes the per-node victim tensors
+(sorted by priority, PDB-aware eviction order per preemptor priority
+level) and runs ONE ``[P, N, K]`` device dry-run plus one batched
+static-feasibility dispatch for EVERY failed pod of the cycle
+(ops.preemption.batched_dry_run).  Each ``preempt()`` call then ranks
+its candidates from the shared tensors; selection is the same
+lexicographic criteria (PDB violations first), victims are deleted
+through the store (informers unaccount them), and the chosen candidate
+is verified by a real re-solve with the victims masked out of the
+cluster state before anything is deleted — so every nomination is
+backed by an actual placement, including spread/inter-pod families the
+resource dry-run can't see.
+
+Cross-preemptor conflicts resolve with a wavefront-style pass
+(mirroring ops.assign.plan_waves' coupling discipline): preemptors are
+processed in priority order, and the shared dry-run stays valid for a
+pod exactly while no earlier preemptor of the pass evicted on its
+candidate nodes.  A node an earlier eviction TOUCHED is recomputed
+from live state (counted in preemption_conflict_serializations), so two
+preemptors never claim overlapping victims or double-count freed
+capacity — batched results are identical to running the sequential
+``preempt()`` loop (tests/test_preemption.py parity suite).
+
+The sequential per-pod path (no shared context) is kept bit-for-bit as
+the exact-parity fallback: the batched encode/dry-run runs behind the
+device-solve circuit breaker, and any batched-dispatch failure (after
+one retry) trips the breaker and falls the pass back to it.
 """
 
 from __future__ import annotations
 
+import contextlib
 import logging
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -30,6 +54,7 @@ from ..api import store as st
 from ..api import types as api
 from ..models.batch_scheduler import TPUBatchScheduler
 from ..ops import preemption as pre_ops
+from ..testing import faults
 from ..utils.vocab import pad_dim
 from .cache import SchedulerCache
 from .metrics import Registry
@@ -43,6 +68,10 @@ MAX_CANDIDATES = 256
 # giving up (each verification is a single-pod device solve).
 MAX_VERIFY = 8
 
+# sentinel: pod not covered by the active shared pass — route to the
+# classic per-pod path (distinct from None = "no candidates")
+_MISS = object()
+
 
 class PreemptionResult:
     __slots__ = ("nominated_node", "victims")
@@ -50,6 +79,55 @@ class PreemptionResult:
     def __init__(self, nominated_node: str, victims: List[api.Pod]):
         self.nominated_node = nominated_node
         self.victims = victims
+
+
+class _SharedPass:
+    """One PostFilter pass's shared preemption state: the single
+    ``_pods_by_node`` walk, the batched device dry-run results, and the
+    conflict bookkeeping (``touched``) that keeps batched == sequential.
+    Built under the cache lock by ``_begin_shared``; consumed lock-free
+    except for touched-node recomputes."""
+
+    __slots__ = (
+        "fallback", "empty", "min_prio", "index", "level_of", "nodes",
+        "victims", "free", "elig_len", "perm", "viol", "feasible",
+        "min_k", "viol_k", "static_ok", "pods_req", "pdbs", "touched",
+        "touch_all", "_ordered",
+    )
+
+    def __init__(self):
+        self.fallback = False    # breaker open / batched dispatch failed
+        self.empty = True        # no candidate nodes encoded
+        self.min_prio: Optional[int] = None
+        self.index: Dict[str, int] = {}     # pod key -> batch row
+        self.level_of: Dict[int, int] = {}  # priority -> level row
+        self.nodes: List[Tuple[int, str]] = []   # (state row, node name)
+        self.victims: List[List[api.Pod]] = []   # per node, (prio, key) asc
+        self.free: Optional[np.ndarray] = None       # f32[N, R]
+        self.elig_len: Optional[np.ndarray] = None   # i32[L, N]
+        self.perm: Optional[np.ndarray] = None       # i32[L, N, K]
+        self.viol: Optional[np.ndarray] = None       # bool[L, N, K]
+        self.feasible: Optional[np.ndarray] = None   # bool[P, N]
+        self.min_k: Optional[np.ndarray] = None      # i32[P, N]
+        self.viol_k: Optional[np.ndarray] = None     # i32[P, N]
+        self.static_ok: Optional[np.ndarray] = None  # bool[P, rows]
+        self.pods_req: Optional[np.ndarray] = None   # f32[P, R]
+        self.pdbs: List[api.PodDisruptionBudget] = []
+        self.touched: set = set()   # node names an eviction dirtied
+        self.touch_all = False      # a victim's node was unknown: degrade
+        self._ordered: Dict[Tuple[int, int], Tuple[list, list]] = {}
+
+    def ordered(self, lvl: int, j: int) -> Tuple[list, list]:
+        """(victims, pdb flags) of node j in level lvl's eviction order
+        (PDB-clean first, priority ascending within each partition)."""
+        key = (lvl, j)
+        hit = self._ordered.get(key)
+        if hit is None:
+            e = int(self.elig_len[lvl, j])
+            vs = [self.victims[j][i] for i in self.perm[lvl, j, :e]]
+            flags = [bool(f) for f in self.viol[lvl, j, :e]]
+            hit = self._ordered[key] = (vs, flags)
+        return hit
 
 
 class PreemptionEvaluator:
@@ -69,18 +147,294 @@ class PreemptionEvaluator:
         # PDBAwarePreemption feature gate (set by the Scheduler): off
         # means victim ranking ignores disruption budgets
         self.pdb_aware = True
+        # the active shared PostFilter pass (None outside shared_pass);
+        # only the scheduling thread opens/consumes it
+        self._shared: Optional[_SharedPass] = None
 
     # -- eligibility (PodEligibleToPreemptOthers) --------------------------
+
+    def min_existing_priority(self) -> Optional[int]:
+        """The cluster's lowest bound/assumed pod priority, or None when
+        no pods exist — computed ONCE per PostFilter pass (shared_pass
+        caches it) instead of scanning ``state._pods`` per failed pod."""
+        state = self.tpu.state
+        with self.cache.lock:
+            return min(
+                (p.spec.priority for p in state._pods.values()),
+                default=None,
+            )
 
     def eligible(self, pod: api.Pod) -> bool:
         if pod.spec.preemption_policy == "Never":
             return False
-        prio = pod.spec.priority
-        state = self.tpu.state
-        with self.cache.lock:
-            return any(
-                p.spec.priority < prio for p in state._pods.values()
+        ctx = self._shared
+        if ctx is not None:
+            min_prio = ctx.min_prio
+        else:
+            min_prio = self.min_existing_priority()
+        return min_prio is not None and min_prio < pod.spec.priority
+
+    # -- the batched PostFilter pass ---------------------------------------
+
+    @contextlib.contextmanager
+    def shared_pass(self, pods: Sequence[api.Pod]):
+        """Open the shared preemption context for one PostFilter pass:
+        every ``preempt()`` call inside the block consumes the single
+        batched encode + dry-run instead of walking the cluster itself.
+        Nested entry is a passthrough (one context per pass)."""
+        if self._shared is not None:
+            yield self._shared
+            return
+        ctx = self._begin_shared(list(pods))
+        self._shared = ctx
+        try:
+            yield ctx
+        finally:
+            self._shared = None
+
+    def preempt_batch(
+        self, pods: Sequence[api.Pod]
+    ) -> List[Optional[PreemptionResult]]:
+        """Batched PostFilter: one shared encode + device dry-run for the
+        whole failed-pod set, then the per-pod select/verify/evict tail
+        in order.  Results are identical to calling ``preempt()``
+        sequentially on the same set (the conflict pass recomputes
+        touched nodes); on a tripped breaker or a failed batched
+        dispatch the pass transparently IS that sequential loop."""
+        out: List[Optional[PreemptionResult]] = []
+        with self.shared_pass(pods):
+            for pod in pods:
+                if not self.eligible(pod):
+                    out.append(None)
+                    continue
+                out.append(self.preempt(pod))
+        return out
+
+    def _begin_shared(self, pods: List[api.Pod]) -> _SharedPass:
+        ctx = _SharedPass()
+        ctx.min_prio = self.min_existing_priority()
+        elig = [
+            p for p in pods
+            if p.spec.preemption_policy != "Never"
+            and ctx.min_prio is not None
+            and ctx.min_prio < p.spec.priority
+        ]
+        if not elig:
+            return ctx
+        breaker = getattr(self.tpu, "breaker", None)
+        if breaker is not None and breaker.state_code() != 0.0:
+            # device path is sick: the pass runs on the exact-parity
+            # per-pod fallback until the breaker closes again
+            ctx.fallback = True
+            return ctx
+        try:
+            self._encode_and_dispatch(ctx, elig)
+        except Exception:  # noqa: BLE001 — batched dispatch fault
+            logging.getLogger(__name__).exception(
+                "batched preemption dry-run failed; retrying once"
             )
+            try:
+                self._encode_and_dispatch(ctx, elig)
+            except Exception:  # noqa: BLE001
+                if breaker is not None:
+                    breaker.record_failure()
+                logging.getLogger(__name__).exception(
+                    "batched preemption retry failed; falling back to the "
+                    "per-pod path for this pass"
+                )
+                ctx.fallback = True
+        return ctx
+
+    def _encode_and_dispatch(
+        self, ctx: _SharedPass, elig: List[api.Pod]
+    ) -> None:
+        """The tentpole: walk ``_pods_by_node`` once, build the padded
+        victim tensors + per-level eviction orders, dispatch ONE batched
+        dry-run and ONE batched static-feasibility solve for the whole
+        failed-pod set."""
+        t0 = time.perf_counter()
+        state = self.tpu.state
+        pdbs = self._pdbs()
+        levels = sorted({p.spec.priority for p in elig})
+        prio_max = levels[-1]
+        with self.cache.lock:
+            assumed = set(self.cache._assumed.keys())
+            r = state._r
+            nodes: List[Tuple[int, str]] = []
+            victims_l: List[List[api.Pod]] = []
+            prios_l: List[np.ndarray] = []
+            free_l: List[np.ndarray] = []
+            usage: Dict[str, np.ndarray] = {}
+            for name, keys in state._pods_by_node.items():
+                row = state._rows.get(name)
+                if row is None:
+                    continue
+                vs = [
+                    state._pods[k]
+                    for k in keys
+                    if state._pods[k].spec.priority < prio_max
+                    and k not in assumed
+                ]
+                if not vs:
+                    continue
+                vs.sort(key=lambda p: (p.spec.priority, pod_key(p)))
+                nodes.append((row, name))
+                victims_l.append(vs)
+                prios_l.append(
+                    np.array([v.spec.priority for v in vs], dtype=np.int64)
+                )
+                free_l.append(
+                    (state.allocatable[row] - state.requested[row]).copy()
+                )
+                for v in vs:
+                    vk = pod_key(v)
+                    if vk not in usage:
+                        usage[vk] = state.builder.pod_usage(v, r)[0]
+            ctx.pods_req = np.stack(
+                [state.builder.pod_usage(p, r)[0] for p in elig]
+            ).astype(np.float32)
+            # the static-feasibility snapshot for ALL preemptors at once
+            # (the aliasing cluster leaves are host-copied before
+            # device_put — see the classic _encode_static)
+            snap, _ = self.tpu.builder.build_from_state(state, elig)
+            snap = snap._replace(
+                cluster=jax.tree.map(np.array, snap.cluster)
+            )
+        ctx.pdbs = pdbs
+        ctx.index = {pod_key(p): i for i, p in enumerate(elig)}
+        ctx.level_of = {prio: i for i, prio in enumerate(levels)}
+        ctx.nodes = nodes
+        ctx.victims = victims_l
+        if self.metrics:
+            self.metrics.preemption_batch_size.observe(float(len(elig)))
+        if not nodes:
+            # no node holds an evictable pod: nothing to dry-run, but the
+            # static mask is unneeded too — every preempt() returns None
+            ctx.empty = True
+            if self.metrics:
+                self.metrics.preemption_solve_duration.observe(
+                    time.perf_counter() - t0
+                )
+            return
+        n = len(nodes)
+        k_max = max(len(v) for v in victims_l)
+        n_pad = pad_dim(n, 8)
+        k_pad = pad_dim(k_max, 4)
+        l_pad = pad_dim(len(levels), 1)
+        p_pad = pad_dim(len(elig), 4)
+        r = ctx.pods_req.shape[1]
+        free = np.zeros((n_pad, r), dtype=np.float32)
+        victim_req = np.zeros((n_pad, k_pad, r), dtype=np.float32)
+        perm = np.tile(
+            np.arange(k_pad, dtype=np.int32), (l_pad, n_pad, 1)
+        )
+        elig_len = np.zeros((l_pad, n_pad), dtype=np.int32)
+        viol = np.zeros((l_pad, n_pad, k_pad), dtype=bool)
+        for j, vs in enumerate(victims_l):
+            free[j] = free_l[j]
+            for vi, v in enumerate(vs[:k_pad]):
+                victim_req[j, vi] = usage[pod_key(v)]
+        for li, level in enumerate(levels):
+            for j, vs in enumerate(victims_l):
+                e = int(np.searchsorted(prios_l[j], level, side="left"))
+                elig_len[li, j] = e
+                if e == 0:
+                    continue
+                if pdbs:
+                    flags = self._pdb_flags(vs[:e], pdbs)
+                    if any(flags):
+                        # eviction preference: non-violating victims
+                        # first, stably (the prefix-eviction analogue of
+                        # the reference's reprieve pass)
+                        order = sorted(range(e), key=lambda i: flags[i])
+                        perm[li, j, :e] = np.array(order, dtype=np.int32)
+                        viol[li, j, :e] = np.array(
+                            [flags[i] for i in order], dtype=bool
+                        )
+        pods_req = np.zeros((p_pad, r), dtype=np.float32)
+        pods_req[: len(elig)] = ctx.pods_req
+        pod_level = np.zeros(p_pad, dtype=np.int32)
+        for i, p in enumerate(elig):
+            pod_level[i] = ctx.level_of[p.spec.priority]
+        batch = pre_ops.PreemptionBatch(
+            free=free, victim_req=victim_req, perm=perm,
+            elig_len=elig_len, viol=viol, pods_req=pods_req,
+            pod_level=pod_level,
+        )
+        self._prewarm_batch(batch)
+        act = faults.fire("batch.preemption", pods=len(elig), nodes=n)
+        result = pre_ops.run_batched_dry_run(batch)
+        static = pre_ops.run_static_feasible_batch(
+            snap.cluster, snap.pods, snap.selectors
+        )
+        got = jax.device_get((result, static))  # one coalesced readback
+        res, static_np = got
+        min_k = np.asarray(res.min_k)
+        if act == faults.CORRUPT:
+            # injected device corruption: poison the result so the
+            # health check below trips (the NaN-grade fault family)
+            min_k = np.full_like(min_k, -1)
+        if (min_k < 0).any() or (min_k > k_pad).any():
+            # health check (the breaker's non-finite-score analogue): a
+            # structurally-broken result means none of this pass's
+            # candidate stats can be trusted
+            raise RuntimeError(
+                "batched preemption dry-run returned out-of-range victim "
+                "counts — result untrusted"
+            )
+        ctx.free = free
+        ctx.elig_len = elig_len
+        ctx.perm = perm
+        ctx.viol = viol
+        ctx.feasible = np.asarray(res.feasible)[: len(elig), :n]
+        ctx.min_k = min_k[: len(elig), :n]
+        ctx.viol_k = np.asarray(res.viol_k)[: len(elig), :n]
+        ctx.static_ok = np.asarray(static_np)[: len(elig)]
+        ctx.empty = False
+        if self.metrics:
+            self.metrics.preemption_solve_duration.observe(
+                time.perf_counter() - t0
+            )
+
+    def _prewarm_batch(self, batch: pre_ops.PreemptionBatch) -> None:
+        """First-seen preemption-batch shape: speculatively compile the
+        neighbor pod buckets off-thread (SolverPrewarmPool), so churn
+        walking the failed-pod bucket ladder never compiles on the
+        scheduling thread (same discipline as the solver kernels)."""
+        pool = getattr(self.tpu, "prewarm_pool", None)
+        if pool is None:
+            return
+        l, n, k = batch.perm.shape
+        p, r = batch.pods_req.shape
+        key = ("preempt", l, n, k, p, r)
+        if not pool.mark_seen(key):
+            return
+
+        def abstract(p_variant: int):
+            def redim(arr, want_p=False):
+                shape = (p_variant,) + arr.shape[1:] if want_p else arr.shape
+                return jax.ShapeDtypeStruct(shape, arr.dtype)
+
+            return pre_ops.PreemptionBatch(
+                free=redim(batch.free),
+                victim_req=redim(batch.victim_req),
+                perm=redim(batch.perm),
+                elig_len=redim(batch.elig_len),
+                viol=redim(batch.viol),
+                pods_req=redim(batch.pods_req, want_p=True),
+                pod_level=redim(batch.pod_level, want_p=True),
+            )
+
+        for p_variant in (p * 2, p // 2):
+            if p_variant < 4:
+                continue
+            nkey = ("preempt", l, n, k, p_variant, r)
+            shapes = abstract(p_variant)
+
+            def compile_fn(shapes=shapes):
+                pre_ops.run_batched_dry_run.jitted.lower(shapes).compile()
+
+            pool.offer(nkey, f"preempt/p={p_variant}", compile_fn)
 
     # -- the PostFilter entry ----------------------------------------------
 
@@ -115,7 +469,15 @@ class PreemptionEvaluator:
         # the delete is a no-op).  Without the synchronous unaccount, the
         # next batch could race ahead of the informer, see the pod still
         # unschedulable, and evict a second victim set.
+        ctx = self._shared
         for v in victims:
+            if ctx is not None:
+                # conflict bookkeeping: a later preemptor of this pass
+                # must not trust the shared dry-run on this node
+                if v.spec.node_name:
+                    ctx.touched.add(v.spec.node_name)
+                else:
+                    ctx.touch_all = True
             try:
                 self.store.delete("Pod", v.meta.name, v.meta.namespace)
             except KeyError:
@@ -228,10 +590,10 @@ class PreemptionEvaluator:
         """Shrink pass: an early candidate's victims may be unnecessary
         once later candidates joined the accumulation (the gang fit
         thanks to them alone).  Try dropping each contribution —
-        earliest first, since later ones completed the fit — re-verifying
-        the remainder; keep any drop that still fully places.  Bounded:
-        one re-solve per contributing candidate (<= MAX_VERIFY extra
-        dry-runs, only on the success path)."""
+        earliest first, since later ones completed the fit —
+        re-verifying the remainder; keep any drop that still fully
+        places.  Bounded: one re-solve per contributing candidate
+        (<= MAX_VERIFY extra dry-runs, only on the success path)."""
         kept = list(chunks)
         best = placements
         for i in range(len(kept) - 1):  # the last chunk completed the fit
@@ -264,7 +626,127 @@ class PreemptionEvaluator:
         """Collect + rank candidate (node, victims) sets: the tensorized
         findCandidates/SelectCandidate half, shared by single-pod and
         gang planning.  Returns (cands, ranked indices, min_k) with
-        cands entries (row, node_name, victims, pdb_violation_flags)."""
+        cands entries (row, node_name, victims, pdb_violation_flags).
+
+        Inside an active shared pass the stats come from the batched
+        dry-run (one encode + one dispatch for the whole pass);
+        otherwise — and for pods the pass did not cover — the classic
+        per-pod walk runs (the exact-parity fallback)."""
+        ctx = self._shared
+        if ctx is not None and not ctx.fallback:
+            got = self._candidates_shared(pod, ctx)
+            if got is not _MISS:
+                return got
+        return self._candidates_classic(pod)
+
+    def _candidates_shared(self, pod: api.Pod, ctx: _SharedPass):
+        pi = ctx.index.get(pod_key(pod))
+        if pi is None:
+            return _MISS
+        if ctx.empty:
+            return None
+        lvl = ctx.level_of[pod.spec.priority]
+        cands: List[Tuple[int, str, List[api.Pod], List[bool]]] = []
+        feas_list: List[bool] = []
+        min_k_list: List[int] = []
+        viol_list: List[int] = []
+        with self.cache.lock:
+            for j, (row, name) in enumerate(ctx.nodes):
+                if ctx.touch_all or name in ctx.touched:
+                    # wavefront conflict serialization: an earlier
+                    # preemptor of this pass evicted here — the shared
+                    # dry-run no longer describes this node, recompute
+                    # it from live state (exactly what the sequential
+                    # loop would see)
+                    rec = self._recompute_node(ctx, name, row, pod)
+                    if self.metrics:
+                        self.metrics.preemption_conflict_serializations.inc()
+                    if rec is None:
+                        continue
+                    victims, flags, feas, mk, vk = rec
+                else:
+                    if int(ctx.elig_len[lvl, j]) == 0:
+                        continue
+                    victims, flags = ctx.ordered(lvl, j)
+                    feas = bool(ctx.feasible[pi, j])
+                    mk = int(ctx.min_k[pi, j])
+                    vk = int(ctx.viol_k[pi, j])
+                cands.append((row, name, victims, flags))
+                feas_list.append(feas)
+                min_k_list.append(mk)
+                viol_list.append(vk)
+                if len(cands) >= MAX_CANDIDATES:
+                    break
+        if not cands:
+            return None
+        static_ok = ctx.static_ok[pi]
+        keep = [i for i, c in enumerate(cands) if static_ok[c[0]]]
+        cands = [cands[i] for i in keep]
+        feas_list = [feas_list[i] for i in keep]
+        min_k_list = [min_k_list[i] for i in keep]
+        viol_list = [viol_list[i] for i in keep]
+        if not cands:
+            return None
+        min_k = np.array(min_k_list, dtype=np.int32)
+        # min_k == 0 means the pod already fits — that is a scheduling
+        # outcome, not a preemption candidate (see _rank_classic)
+        feasible = np.array(feas_list, dtype=bool) & (min_k > 0)
+        ranked = self._order_candidates(
+            cands, feasible, min_k, np.array(viol_list, dtype=np.int64)
+        )
+        if not ranked:
+            return None
+        return cands, ranked, min_k
+
+    def _recompute_node(
+        self, ctx: _SharedPass, name: str, row: int, pod: api.Pod
+    ):
+        """Per-node recompute against LIVE state (caller holds the cache
+        lock): the single-node slice of the classic walk plus a host
+        mirror of the kernel's f32 cumulative dry-run — bit-identical to
+        what a sequential ``preempt()`` would compute after the earlier
+        evictions.  Returns (victims, flags, feasible, min_k, viol_k) or
+        None when the node no longer holds an eligible victim."""
+        state = self.tpu.state
+        prio = pod.spec.priority
+        assumed = set(self.cache._assumed.keys())
+        keys = state._pods_by_node.get(name, ())
+        victims = [
+            state._pods[k]
+            for k in keys
+            if state._pods[k].spec.priority < prio and k not in assumed
+        ]
+        if not victims:
+            return None
+        victims.sort(key=lambda p: (p.spec.priority, pod_key(p)))
+        flags = self._pdb_flags(victims, ctx.pdbs)
+        paired = sorted(zip(victims, flags), key=lambda vf: vf[1])
+        victims = [v for v, _ in paired]
+        flags = [f for _, f in paired]
+        r = state._r
+        free = (
+            state.allocatable[row] - state.requested[row]
+        ).astype(np.float32)
+        reqs = np.stack(
+            [state.builder.pod_usage(v, r)[0] for v in victims]
+        ).astype(np.float32)
+        cum = np.cumsum(reqs, axis=0)                      # f32, like the kernel
+        free_k = np.concatenate(
+            [free[None, :], free[None, :] + cum], axis=0
+        )                                                  # [K+1, R]
+        pod_req = ctx.pods_req[ctx.index[pod_key(pod)]]
+        fits = (
+            (pod_req[None, :] <= 0) | (pod_req[None, :] <= free_k)
+        ).all(axis=-1)
+        feasible = bool(fits.any())
+        mk = int(np.argmax(fits)) if feasible else 0
+        vk = int(sum(flags[:mk]))
+        return victims, flags, feasible, mk, vk
+
+    def _candidates_classic(self, pod: api.Pod):
+        """The sequential per-pod walk (the exact-parity fallback the
+        breaker routes to): one ``_pods_by_node`` scan, one single-pod
+        static snapshot, one per-pod device dry-run."""
         state = self.tpu.state
         prio = pod.spec.priority
         pdbs = self._pdbs()
@@ -362,10 +844,10 @@ class PreemptionEvaluator:
         usage: Dict[str, np.ndarray],
         pod_req: np.ndarray,
     ) -> Tuple[List[int], np.ndarray]:
-        """Run the device dry-run over all candidates (lock-free — inputs
-        were copied out under the lock); return candidate indices ranked
-        most-preferred first (feasible only) plus per-candidate victim
-        counts."""
+        """Run the per-pod device dry-run over all candidates (lock-free
+        — inputs were copied out under the lock); return candidate
+        indices ranked most-preferred first (feasible only) plus
+        per-candidate victim counts."""
         r = pod_req.shape[0]
         c_dim = pad_dim(len(cands), 8)
         k_dim = pad_dim(max(len(c[2]) for c in cands), 4)
@@ -385,26 +867,49 @@ class PreemptionEvaluator:
         # PostFilter when no node passed filters; a zero-victim candidate
         # here is a stale-state race and must not cause a nomination)
         feasible = feasible & (min_k > 0)
-        # ranking stats with exact integer math (priorities reach ~2e9,
-        # past f32's exact envelope) and node-row tie-break — both must
-        # match testing/oracle.preempt for the parity contract.  PDB
-        # violations rank first (fewest preferred —
-        # pickOneNodeForPreemption's minNumPDBViolatingScoreFunc,
-        # preemption.go:463).
+        n_viol = np.zeros(len(cands), dtype=np.int64)
+        for ci, (_, _, _victims, flags) in enumerate(cands):
+            if feasible[ci]:
+                n_viol[ci] = sum(flags[: int(min_k[ci])])
+        ranked = self._order_candidates(cands, feasible, min_k, n_viol)
+        return ranked, min_k
+
+    def _order_candidates(
+        self,
+        cands: Sequence[Tuple[int, str, List[api.Pod], List[bool]]],
+        feasible: np.ndarray,
+        min_k: np.ndarray,
+        n_viol_arr: np.ndarray,
+    ) -> List[int]:
+        """The shared SelectCandidate ordering (both the batched and the
+        classic path land here so they cannot diverge): ranking stats
+        with exact integer math (priorities reach ~2e9, past f32's exact
+        envelope) and node-row tie-break — both must match
+        testing/oracle Oracle.preempt for the parity contract.  PDB
+        violations rank first (fewest preferred —
+        pickOneNodeForPreemption's minNumPDBViolatingScoreFunc,
+        preemption.go:463)."""
         big = np.iinfo(np.int64).max
         max_prio = np.full(len(cands), big, dtype=np.int64)
         sum_prio = np.zeros(len(cands), dtype=np.int64)
         n_viol = np.full(len(cands), big, dtype=np.int64)
         rows = np.array([c[0] for c in cands], dtype=np.int64)
-        for ci, (_, _, victims, flags) in enumerate(cands):
+        blocked = 0
+        for ci, (_, _, victims, _flags) in enumerate(cands):
             if feasible[ci]:
                 k = int(min_k[ci])
                 prios = [v.spec.priority for v in victims[:k]]
                 max_prio[ci] = max(prios)
                 sum_prio[ci] = sum(prios)
-                n_viol[ci] = sum(flags[:k])
+                n_viol[ci] = int(n_viol_arr[ci])
+                if n_viol[ci] > 0:
+                    blocked += 1
+        if blocked and self.metrics:
+            # feasible candidates whose minimal eviction set would
+            # violate a disruption budget: the ranking pushes them last
+            self.metrics.preemption_pdb_blocked_total.inc(by=float(blocked))
         order = np.lexsort((rows, min_k, sum_prio, max_prio, n_viol))
-        return [int(i) for i in order if feasible[i]], min_k
+        return [int(i) for i in order if feasible[i]]
 
     def _verify(
         self, pod: api.Pod, node_name: str, victims: List[api.Pod]
@@ -426,16 +931,29 @@ class PreemptionEvaluator:
         """Solve `pods` against the state with `victims` removed (state
         restored before returning); placements list, or None on encode
         failure.  The gang path feeds all pending members so the solver's
-        all-or-nothing post-pass judges the whole group."""
+        all-or-nothing post-pass judges the whole group.
+
+        OTHER preemptors' nominations overlay their nodes as
+        reservations (the filters-with-nominated-pods analogue,
+        runtime/framework.go:962): without them, a node an earlier
+        preemptor of the pass just freed attracts this verify solve,
+        failing the legitimate candidate — observed steering evictions
+        onto PDB-guarded victims whose node merely had a lower row
+        index than the reserved one."""
         state = self.tpu.state
         with self.cache.lock:
+            reservations = self.cache.nominations_excluding(
+                {pod_key(p) for p in pods}
+            )
             removed = []
             try:
                 for v in victims:
                     if state.has_pod(v):
                         state.remove_pod(v)
                         removed.append(v)
-                snap, meta = self.tpu.encode_pending(pods)
+                snap, meta = self.tpu.encode_pending(
+                    pods, reservations=reservations
+                )
             finally:
                 for v in removed:
                     state.add_pod(v, v.spec.node_name or fallback_node)

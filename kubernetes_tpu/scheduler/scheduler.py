@@ -64,16 +64,26 @@ from .waitingpods import WaitingPod, WaitingPodsMap
 class OverloadController:
     """Load-aware degradation ladder for the solve stage.
 
-    Tracks an EWMA of solve-stage cycle duration against the latency SLO
-    and exposes a shed level consumed each cycle:
+    Tracks an EWMA of the cycle's PLACEMENT work (pop → solve → stage →
+    dispatch) against the latency SLO and exposes a shed level consumed
+    each cycle.  The PostFilter preemption pass is EXCLUDED from the
+    fed duration: shedding decisions must not be driven by the work
+    they shed — counting the pass made one expensive preemption round
+    trip the ladder to level 2, which deferred preemption, which left
+    no cycles to decay the average: preemption froze exactly when the
+    backlog needed it (the self-inhibition bench c9 exposed).
 
       0  healthy — full work;
       1  overloaded (ewma > slo) — background work sheds first: the
-         PostFilter preemption dry-runs are deferred (counted in
-         scheduler_overload_shed_total), never the placement work itself;
-      2  severe (ewma > 2*slo) — additionally the adaptive batch window
-         pins at its max: fewer, fuller cycles shed per-cycle fixed
-         overhead without dropping pods.
+         PostFilter preemption BATCH is capped (the batched dry-run
+         amortized the per-pod marginal cost, so an overloaded cycle
+         keeps a small batch instead of deferring preemption outright —
+         preemption load spikes exactly when the cluster is overloaded);
+         pods past the cap count into scheduler_overload_shed_total,
+         never the placement work itself;
+      2  severe (ewma > 2*slo) — preemption dry-runs defer entirely and
+         the adaptive batch window pins at its max: fewer, fuller
+         cycles shed per-cycle fixed overhead without dropping pods.
 
     Levels fall only when the EWMA drops below 80% of the rising
     threshold (hysteresis), so one fast cycle doesn't flap the ladder.
@@ -1199,31 +1209,67 @@ class Scheduler:
             # PostFilter: preemption for unschedulable pods, highest
             # priority first (handleSchedulingFailure ->
             # Evaluator.Preempt, schedule_one.go:1017, preemption.go:150).
-            # Victim deletes emit AssignedPodDelete events that requeue
-            # the nominee.  Under overload (level >= 1) the dry-runs are
-            # DEFERRED — background rescoring is the first work shed;
-            # the parked pods stay in unschedulable and a later healthy
-            # cycle (or the flush interval) retries them.
+            # The whole batch shares ONE victim-tensor encode + device
+            # dry-run (PreemptionEvaluator.shared_pass); victim deletes
+            # emit AssignedPodDelete events that requeue the nominee.
+            # Under overload the batch is CAPPED at level 1 (the batched
+            # solve amortized the per-pod marginal cost — preemption
+            # load spikes exactly when the cluster is overloaded, so
+            # deferring it outright was backwards) and deferred only at
+            # level 2; pods past the cap count into overload_shed_total
+            # and stay parked for a later healthy cycle (or the flush
+            # interval).
             cycle.failed.sort(key=lambda i: -i.pod.spec.priority)
+            t_postfilter = self._clock()
             budget = self.max_preemptions_per_cycle
-            if self.overload.level() >= 1:
+            level = self.overload.level()
+            if level >= 2:
                 budget = 0
+            elif level == 1:
+                budget = max(1, budget // 4)
             eligible = cycle.failed[: self.max_preemptions_per_cycle]
-            for info in eligible[:budget]:
-                fwk = self.profiles.for_pod(info.pod)
-                if fwk is not None and fwk.run_post_filter(info.pod):
-                    stats["preempted"] = stats.get("preempted", 0) + 1
-            if budget == 0 and eligible:
-                self.metrics.overload_shed_total.inc(by=float(len(eligible)))
+            batch_infos = eligible[:budget]
+            try:
+                if batch_infos:
+                    with self.preemption.shared_pass(
+                        [info.pod for info in batch_infos]
+                    ):
+                        for info in batch_infos:
+                            fwk = self.profiles.for_pod(info.pod)
+                            if fwk is not None and fwk.run_post_filter(
+                                info.pod
+                            ):
+                                stats["preempted"] = (
+                                    stats.get("preempted", 0) + 1
+                                )
+            except (faults.FaultCrash, Exception):  # noqa: BLE001
+                # preemption is background work: a crash-grade fault in
+                # the batched dry-run must not kill the scheduling
+                # thread — the failed pods stay parked and retry on a
+                # later cycle (the flush interval is the floor)
+                logging.getLogger(__name__).exception(
+                    "PostFilter preemption pass failed; continuing"
+                )
+            if len(eligible) > len(batch_infos):
+                self.metrics.overload_shed_total.inc(
+                    by=float(len(eligible) - len(batch_infos))
+                )
+            postfilter_s = self._clock() - t_postfilter
             trace.step("postfilter")
             qs = self.queue.stats()
             for tier, v in qs.items():
                 self.metrics.pending_pods.set(v, tier)
+        else:
+            postfilter_s = 0.0
         trace.log_if_long()
         self.metrics.schedule_batch_duration.observe(trace.total)
-        # overload ladder: feed the cycle duration, publish the level,
+        # overload ladder: feed the cycle's PLACEMENT duration — the
+        # PostFilter pass is excluded (see OverloadController: shedding
+        # must not be driven by the work it sheds) — publish the level,
         # and let the adaptive window react (level 2 pins it wide)
-        level = self.overload.note_cycle(trace.total)
+        level = self.overload.note_cycle(
+            max(trace.total - postfilter_s, 0.0)
+        )
         self.metrics.overload_level.set(float(level))
         if self.window_ctl is not None:
             self.window_ctl.set_overload(level)

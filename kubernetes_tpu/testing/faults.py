@@ -60,6 +60,10 @@ KNOWN_POINTS = frozenset({
     "watch.offer",
     "watch.consume",
     "batch.solve",
+    # the batched PostFilter dry-run (one [P, N, K] dispatch per pass);
+    # corrupt-grade schedules poison the decoded result so the health
+    # check trips and the pass falls back to the per-pod parity path
+    "batch.preemption",
     "binder.commit_wave",
     "leader.renew",
 })

@@ -64,6 +64,10 @@ def _fault_plan(rng: random.Random) -> faults.FaultRegistry:
     reg.delay("watch.consume", seconds=0.002, n=5, probability=0.5)
     reg.delay("store.list", seconds=0.005, n=3, probability=0.5)
     reg.fail("leader.renew", n=rng.randint(1, 2))
+    # the batched-preemption point is registered here for coverage; base
+    # seeds never reach PostFilter (every pod fits), so the dedicated
+    # PREEMPT_SEEDS below are where it actually fires
+    reg.fail("batch.preemption", n=1, probability=0.5)
     return reg
 
 
@@ -370,6 +374,166 @@ def test_chaos_slow_consumer(seed):
         faults.disarm()
         if sched is not None:
             sched.stop()
+
+
+# -- mixed-priority preemption churn under batched-dry-run faults ------------
+#
+# These seeds arm the batch.preemption point (fail / latency / NaN-grade
+# corruption of the [P, N, K] dry-run result) while a mixed-priority
+# preemptor stream forces sustained PostFilter work against PDB-guarded
+# victims.  Invariants on top of the PR 3 set:
+#
+#   * every preemptor ends bound (a failed batched dispatch falls the
+#     pass back to the per-pod parity path — liveness never depends on
+#     the batched kernel);
+#   * no victim is evicted without its preemptor binding: every pod
+#     MISSING from the store at quiesce was deleted by a Preempted
+#     eviction (the event trail proves it), never lost;
+#   * PDB-guarded victims survive while unguarded alternatives exist;
+#   * bound-exactly-once for preemptors AND victims (the event audit).
+
+PREEMPT_SEEDS = list(range(400, 405))
+
+
+def _preempt_fault_plan(rng: random.Random) -> faults.FaultRegistry:
+    reg = faults.FaultRegistry(seed=rng.randint(0, 2 ** 31))
+    reg.fail("batch.preemption", n=rng.randint(1, 2))
+    if rng.random() < 0.5:
+        # NaN-grade: the decoded min_k tensor is poisoned; the health
+        # check must trip and the pass degrade with parity
+        reg.corrupt("batch.preemption", n=1)
+    reg.delay("batch.preemption", seconds=0.01, n=2)
+    reg.fail("batch.solve", n=1, probability=0.5)
+    reg.fail("binder.commit_wave", n=1, probability=0.5)
+    reg.drop("watch.offer", n=1, probability=0.5)
+    return reg
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+@pytest.mark.parametrize("seed", PREEMPT_SEEDS)
+def test_chaos_preemption_churn(seed):
+    from kubernetes_tpu.api import types as api
+
+    rng = random.Random(seed)
+    reg = _preempt_fault_plan(rng)
+    store = st.Store()
+    audit = _EventAudit(store)
+    n_nodes = rng.randint(4, 6)
+    for i in range(n_nodes):
+        store.create(
+            make_node(f"n{i}")
+            .capacity(cpu_milli=2000, mem=16 * GI, pods=110)
+            .zone(f"z{i % 3}")
+            .obj()
+        )
+    # two 1000m victims fill every node; node n0's victims are guarded
+    # by a zero-budget PDB — preemptors must rank them last and, while
+    # unguarded nodes remain, never evict them
+    victim_names = []
+    for i in range(n_nodes):
+        for j in range(2):
+            name = f"victim-{i}-{j}"
+            pw = (
+                make_pod(name)
+                .req(cpu_milli=1000, mem=GI // 4)
+                .priority(rng.randint(0, 4))
+                .node_name(f"n{i}")
+            )
+            if i == 0:
+                pw = pw.labels(app="guarded")
+            p = pw.obj()
+            p.status.phase = "Running"
+            store.create(p)
+            victim_names.append(name)
+    pdb = api.PodDisruptionBudget(
+        meta=api.ObjectMeta(name="guard", namespace="default"),
+        spec=api.PodDisruptionBudgetSpec(
+            selector=api.LabelSelector(match_labels={"app": "guarded"})
+        ),
+    )
+    pdb.status.disruptions_allowed = 0
+    store.create(pdb)
+    config = SchedulerConfiguration(
+        pod_initial_backoff_seconds=0.05,
+        pod_max_backoff_seconds=0.4,
+        batch_window_seconds=0.01,
+        unschedulable_flush_seconds=0.5,
+    )
+    sched = Scheduler(store, assume_ttl=1.0, config=config)
+    # leave the guarded node out of the count: every preemptor must be
+    # satisfiable WITHOUT violating the budget
+    n_preempt = rng.randint(2, n_nodes - 1)
+    preempt_names = [f"preemptor-{i}" for i in range(n_preempt)]
+    try:
+        with faults.armed(reg):
+            sched.start()
+            for i, name in enumerate(preempt_names):
+                store.create(
+                    make_pod(name)
+                    .req(cpu_milli=1500, mem=GI // 4)
+                    .priority(rng.choice([50, 100, 200]))
+                    .obj()
+                )
+                time.sleep(rng.random() * 0.05)
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                pods, _ = store.list("Pod")
+                if pods and all(p.spec.node_name for p in pods):
+                    break
+                time.sleep(0.1)
+
+        # -- invariants (faults disarmed) --------------------------------
+        assert reg.fired.get("batch.preemption"), (
+            f"seed {seed}: the batched-preemption fault never fired"
+        )
+        pods, _ = store.list("Pod")
+        by_name = {p.meta.name: p for p in pods}
+        unbound = [p.meta.name for p in pods if not p.spec.node_name]
+        assert not unbound, (
+            f"seed {seed}: pods unbound past quiesce: {unbound}\n"
+            f"  queue: {sched.queue.stats()}\n"
+            f"  breaker: {sched.tpu.breaker.state}\n"
+            f"  preemption: attempted="
+            f"{sched.metrics.preemption_attempts.get('attempted')} "
+            f"nominated={sched.metrics.preemption_attempts.get('nominated')}"
+        )
+        for name in preempt_names:
+            assert name in by_name, f"seed {seed}: preemptor {name} lost"
+        # preemption actually ran (the stream cannot fit without it)
+        assert sched.metrics.preemption_attempts.get("nominated") >= 1
+        assert sched.metrics.preemption_victims.n >= 1
+        # no victim lost: every missing victim has a Preempted event
+        # naming it (eviction, not loss)
+        sched.events.stop()  # flush the async event writer
+        events, _ = store.list("Event")
+        evicted = {
+            e.involved_object.name
+            for e in events
+            if e.reason == "Preempted"
+        }
+        for name in victim_names:
+            if name not in by_name:
+                assert name in evicted, (
+                    f"seed {seed}: victim {name} vanished without eviction"
+                )
+        # PDB-guarded victims survive while unguarded nodes sufficed
+        for i in range(2):
+            assert f"victim-0-{i}" in by_name, (
+                f"seed {seed}: guarded victim evicted despite unguarded "
+                "alternatives"
+            )
+        # bound-exactly-once across preemptors AND victims
+        assert not audit.violations, f"seed {seed}: {audit.violations[:5]}"
+        rebound = {
+            k: v for k, v in audit.bound_nodes.items() if len(v) > 1
+        }
+        assert not rebound, f"seed {seed}: double binds {rebound}"
+        assert sched.flush_binds(15)
+    finally:
+        faults.disarm()
+        sched.stop()
 
 
 # -- kill-restart chaos: crash a component, restart it, prove parity ---------

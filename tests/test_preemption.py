@@ -18,6 +18,7 @@ from kubernetes_tpu.models.batch_scheduler import TPUBatchScheduler
 from kubernetes_tpu.ops import preemption as pre_ops
 from kubernetes_tpu.scheduler import Scheduler
 from kubernetes_tpu.scheduler.cache import SchedulerCache
+from kubernetes_tpu.scheduler.metrics import Registry
 from kubernetes_tpu.scheduler.preemption import PreemptionEvaluator
 from kubernetes_tpu.testing.oracle import Oracle
 from kubernetes_tpu.testing.wrappers import GI, MI, make_node, make_pod
@@ -399,6 +400,312 @@ def test_pdb_steers_victim_choice_end_to_end():
         store.get("Pod", "guarded")     # survives
         with pytest.raises(KeyError):
             store.get("Pod", "free")    # evicted
+    finally:
+        sched.stop()
+
+
+# -- batched PostFilter (preempt_batch) vs the sequential loop -------------
+#
+# The batched path encodes the per-node victim tensors ONCE per pass and
+# runs one [P, N, K] device dry-run; the wavefront-style conflict pass
+# (touched-node recompute) must make its results IDENTICAL to running
+# preempt() sequentially on the same failed-pod set — including gang
+# preemptors and PDB-blocked candidates.
+
+
+def _pod_result_key(res):
+    if res is None:
+        return None
+    return (res.nominated_node, sorted(v.meta.name for v in res.victims))
+
+
+def _store_evaluator(nodes, bound, preemptors, pdbs=()):
+    """Evaluator with a REAL store behind it (preempt() re-fetches the
+    preemptor and deletes victims through the API)."""
+    tpu = TPUBatchScheduler()
+    store = st.Store()
+    for n in nodes:
+        tpu.add_node(n)
+        store.create(n)
+    for p in bound:
+        tpu.assume(p, p.spec.node_name)
+        store.create(p)
+    for p in preemptors:
+        store.create(p)
+    for pdb in pdbs:
+        store.create(pdb)
+    cache = SchedulerCache(tpu.state)
+    ev = PreemptionEvaluator(tpu, cache, store, Registry())
+    return ev
+
+
+def _mixed_cluster(rng, n_nodes=6, n_victims=14, n_preemptors=4,
+                   gang_of=0, db_every=0):
+    nodes = [
+        make_node(f"n{i}").capacity(cpu_milli=4000, mem=8 * GI, pods=20).obj()
+        for i in range(n_nodes)
+    ]
+    bound = []
+    for i in range(n_victims):
+        pw = (
+            make_pod(f"v{i}")
+            .req(cpu_milli=int(rng.choice([500, 1000, 1500])), mem=GI)
+            .priority(int(rng.integers(0, 5)))
+            .node_name(f"n{i % n_nodes}")
+        )
+        if db_every and i % db_every == 0:
+            pw = pw.labels(app="db")
+        p = pw.obj()
+        p.status.phase = "Running"
+        bound.append(p)
+    preemptors = []
+    for j in range(n_preemptors):
+        pw = make_pod(f"hi{j}").req(cpu_milli=3500, mem=GI).priority(
+            int(rng.choice([50, 100, 200]))
+        )
+        if gang_of and j < gang_of:
+            pw = pw.group("band", size=gang_of)
+        preemptors.append(pw.obj())
+    return nodes, bound, preemptors
+
+
+def _assert_batch_matches_sequential(nodes, bound, preemptors, pdbs=()):
+    ev_seq = _store_evaluator(nodes, bound, preemptors, pdbs)
+    ev_bat = _store_evaluator(nodes, bound, preemptors, pdbs)
+    seq = [
+        ev_seq.preempt(p) if ev_seq.eligible(p) else None
+        for p in preemptors
+    ]
+    bat = ev_bat.preempt_batch(preemptors)
+    for j, (a, b) in enumerate(zip(seq, bat)):
+        assert _pod_result_key(a) == _pod_result_key(b), (
+            f"preemptor {j}: sequential {_pod_result_key(a)} != "
+            f"batched {_pod_result_key(b)}"
+        )
+    # the surviving accounted state must be identical too
+    assert sorted(ev_seq.tpu.state._pod_node.items()) == sorted(
+        ev_bat.tpu.state._pod_node.items()
+    )
+    return ev_bat
+
+
+def test_preempt_batch_matches_sequential(rng):
+    """Randomized mixed-priority clusters: batched == sequential for the
+    whole failed-pod set, INCLUDING passes where earlier preemptors'
+    evictions touch later preemptors' candidate nodes (the conflict
+    recompute)."""
+    any_conflict = False
+    for trial in range(8):
+        nodes, bound, preemptors = _mixed_cluster(rng)
+        ev = _assert_batch_matches_sequential(nodes, bound, preemptors)
+        any_conflict = any_conflict or (
+            ev.metrics.preemption_conflict_serializations.total > 0
+        )
+        assert ev.metrics.preemption_batch_size.n >= 1
+    # with 4 preemptors over 6 nodes, at least one trial must have
+    # exercised the touched-node recompute — otherwise the conflict
+    # pass is untested
+    assert any_conflict, "no trial exercised a cross-preemptor conflict"
+
+
+def test_preempt_batch_gang_parity(rng):
+    """Gang preemptors ride the shared pass: the multi-node accumulation
+    (_plan_gang) consumes the batched candidates and stays identical to
+    the sequential loop."""
+    for trial in range(4):
+        nodes, bound, preemptors = _mixed_cluster(
+            rng, n_nodes=4, n_victims=8, n_preemptors=3, gang_of=2
+        )
+        _assert_batch_matches_sequential(nodes, bound, preemptors)
+
+
+def test_preempt_batch_pdb_parity(rng):
+    """PDB-blocked candidates: the per-level eviction reorder
+    (non-violating victims first) and the device-side violation counts
+    must rank identically to the sequential host-only pass."""
+    for trial in range(4):
+        nodes, bound, preemptors = _mixed_cluster(rng, db_every=2)
+        pdbs = [_pdb("db-pdb", {"app": "db"}, 1)]
+        ev = _assert_batch_matches_sequential(
+            nodes, bound, preemptors, pdbs
+        )
+        assert ev.pdb_aware
+
+
+def test_preempt_batch_pdb_blocked_metric():
+    """A candidate whose only victim violates a zero-budget PDB ranks
+    last and counts into preemption_pdb_blocked_total."""
+    nodes = [
+        make_node(f"n{i}").capacity(cpu_milli=2000, pods=10).obj()
+        for i in range(2)
+    ]
+    bound = []
+    for name, node, app in (("guarded", "n0", "db"), ("free", "n1", "web")):
+        p = (
+            make_pod(name).labels(app=app).req(cpu_milli=2000)
+            .priority(1).node_name(node).obj()
+        )
+        p.status.phase = "Running"
+        bound.append(p)
+    preemptor = make_pod("hi").req(cpu_milli=1500).priority(100).obj()
+    ev = _store_evaluator(
+        nodes, bound, [preemptor], [_pdb("db-pdb", {"app": "db"}, 0)]
+    )
+    results = ev.preempt_batch([preemptor])
+    assert results[0] is not None
+    assert results[0].nominated_node == "n1"  # the unprotected node wins
+    assert ev.metrics.preemption_pdb_blocked_total.total >= 1
+
+
+def test_preempt_batch_oracle_parity(rng):
+    """Randomized snapshots: the batched plan for a single preemptor
+    must equal the pure-Python policy mirror (the documented
+    reprieve-policy divergence stays pinned — Oracle.preempt implements
+    OUR minimal-prefix policy, not the reference's reprieve pass)."""
+    for trial in range(8):
+        nodes, bound = _build_cluster(rng)
+        preemptor = (
+            make_pod("hi").req(cpu_milli=3500, mem=GI).priority(100).obj()
+        )
+        ev = _store_evaluator(nodes, bound, [preemptor])
+        with ev.shared_pass([preemptor]):
+            assert not ev._shared.fallback
+            plan = ev._plan(preemptor)
+        want = Oracle(nodes, bound_pods=bound).preempt(preemptor)
+        if plan is None:
+            assert want is None, f"trial {trial}: oracle found {want}"
+            continue
+        assert want is not None, f"trial {trial}: oracle found nothing"
+        node, victims = plan
+        wnode, wvictims = want
+        assert node == wnode, trial
+        assert sorted(v.meta.name for v in victims) == sorted(
+            v.meta.name for v in wvictims
+        ), trial
+
+
+def test_preempt_batch_fallback_parity(rng):
+    """Injected batched-dispatch failures (the breaker wire): the pass
+    falls back to the per-pod exact-parity path and still produces the
+    sequential loop's results; the shared solve breaker trips."""
+    from kubernetes_tpu.testing import faults
+
+    nodes, bound, preemptors = _mixed_cluster(rng)
+    ev_seq = _store_evaluator(nodes, bound, preemptors)
+    seq = [
+        ev_seq.preempt(p) if ev_seq.eligible(p) else None
+        for p in preemptors
+    ]
+    ev_bat = _store_evaluator(nodes, bound, preemptors)
+    reg = faults.FaultRegistry(seed=1)
+    reg.fail("batch.preemption", n=2)  # first attempt AND its retry
+    with faults.armed(reg):
+        bat = ev_bat.preempt_batch(preemptors)
+    assert reg.fired.get("batch.preemption") == 2
+    assert ev_bat.tpu.breaker.state == ev_bat.tpu.breaker.OPEN
+    for a, b in zip(seq, bat):
+        assert _pod_result_key(a) == _pod_result_key(b)
+
+
+def test_preempt_batch_corrupt_result_falls_back(rng):
+    """NaN-grade corruption of the batched dry-run result trips the
+    health check (out-of-range victim counts) on BOTH attempts; the
+    pass degrades to the per-pod path with parity."""
+    from kubernetes_tpu.testing import faults
+
+    nodes, bound, preemptors = _mixed_cluster(rng)
+    ev_seq = _store_evaluator(nodes, bound, preemptors)
+    seq = [
+        ev_seq.preempt(p) if ev_seq.eligible(p) else None
+        for p in preemptors
+    ]
+    ev_bat = _store_evaluator(nodes, bound, preemptors)
+    reg = faults.FaultRegistry(seed=2)
+    reg.corrupt("batch.preemption", n=2)
+    with faults.armed(reg):
+        bat = ev_bat.preempt_batch(preemptors)
+    for a, b in zip(seq, bat):
+        assert _pod_result_key(a) == _pod_result_key(b)
+
+
+def test_eligible_uses_shared_min_priority():
+    """The satellite: eligibility inside a shared pass consults the
+    pass's cached min-existing-priority instead of scanning
+    state._pods per failed pod."""
+    nodes = [make_node("n0").capacity(cpu_milli=2000, pods=10).obj()]
+    victim = (
+        make_pod("v").req(cpu_milli=2000).priority(5).node_name("n0").obj()
+    )
+    victim.status.phase = "Running"
+    hi = make_pod("hi").req(cpu_milli=500).priority(100).obj()
+    lo = make_pod("lo").req(cpu_milli=500).priority(3).obj()
+    ev = _store_evaluator(nodes, [victim], [hi, lo])
+    assert ev.min_existing_priority() == 5
+    with ev.shared_pass([hi, lo]) as ctx:
+        assert ctx.min_prio == 5
+        assert ev.eligible(hi)        # 100 > 5
+        assert not ev.eligible(lo)    # 3 < 5: nothing evictable
+        # the cached value is consulted — mutating state mid-pass must
+        # not change eligibility answers (one scan per pass)
+        ev.tpu.state.remove_pod(victim)
+        assert ev.eligible(hi)
+    # outside the pass the live scan is back
+    assert ev.min_existing_priority() is None
+    assert not ev.eligible(hi)
+
+
+def test_scheduler_postfilter_uses_batched_pass():
+    """End-to-end: the scheduler's PostFilter stage routes the failed
+    batch through one shared preemption pass (preemption_batch_size
+    observes) and the nominee lands."""
+    store = st.Store()
+    store.create(make_node("n0").capacity(cpu_milli=2000, pods=10).obj())
+    for i in range(2):
+        p = (
+            make_pod(f"low-{i}").req(cpu_milli=1000).priority(i)
+            .node_name("n0").obj()
+        )
+        p.status.phase = "Running"
+        store.create(p)
+    sched = _mk_scheduler(store)
+    try:
+        store.create(make_pod("hi").req(cpu_milli=1500).priority(100).obj())
+        deadline = time.monotonic() + 15
+        placed = None
+        while time.monotonic() < deadline and not placed:
+            sched.schedule_batch(timeout=0.2)
+            placed = store.get("Pod", "hi").spec.node_name
+        assert placed == "n0"
+        assert sched.metrics.preemption_batch_size.n >= 1
+        assert sched.metrics.preemption_solve_duration.n >= 1
+    finally:
+        sched.stop()
+
+
+def test_overload_level1_caps_instead_of_deferring():
+    """The degradation ladder's level-1 action is now a CAP on the
+    preemption batch (the batched solve amortized the per-pod cost),
+    not a full deferral; level 2 still defers."""
+    store = st.Store()
+    store.create(make_node("n0").capacity(cpu_milli=2000, pods=10).obj())
+    p = make_pod("low").req(cpu_milli=2000).priority(0).node_name("n0").obj()
+    p.status.phase = "Running"
+    store.create(p)
+    sched = _mk_scheduler(store)
+    try:
+        # push the controller to level 1 (ewma > slo)
+        for _ in range(10):
+            sched.overload.note_cycle(2 * sched.overload.slo * 0.9)
+        assert sched.overload.level() == 1
+        store.create(make_pod("hi").req(cpu_milli=1500).priority(100).obj())
+        deadline = time.monotonic() + 15
+        placed = None
+        while time.monotonic() < deadline and not placed:
+            sched.schedule_batch(timeout=0.2)
+            placed = store.get("Pod", "hi").spec.node_name
+        # level 1 must NOT have deferred the preemption outright
+        assert placed == "n0"
+        assert sched.metrics.preemption_attempts.get("nominated") >= 1
     finally:
         sched.stop()
 

@@ -44,6 +44,35 @@ def test_shapes_tree_is_clean():
     assert findings == [], "\n".join(f.render() for f in findings)
 
 
+def test_preemption_lattice_closure_and_contracts():
+    """The batched preemption kernel: every raw (candidate, victim,
+    level, pod) size pads onto the power-of-two family, and eval_shape
+    at one lattice bucket matches the BatchDryRunResult contracts (the
+    targeted twin of the tree gate's _check_preemption_kernel)."""
+    from kubernetes_tpu.ops import preemption as pre_ops
+
+    for raw_n, raw_k, raw_l, raw_p in shapes.PREEMPT_RAW_SIZES:
+        for dim, floor in (
+            (raw_n, 8), (raw_k, 4), (raw_l, 1), (raw_p, 4),
+        ):
+            assert vb.is_pad_bucket(vb.pad_dim(dim, floor), 1)
+    n, k, l, p = shapes.PREEMPT_LATTICE[-1]
+    r = 4
+    batch = pre_ops.PreemptionBatch(
+        free=jax.ShapeDtypeStruct((n, r), np.float32),
+        victim_req=jax.ShapeDtypeStruct((n, k, r), np.float32),
+        perm=jax.ShapeDtypeStruct((l, n, k), np.int32),
+        elig_len=jax.ShapeDtypeStruct((l, n), np.int32),
+        viol=jax.ShapeDtypeStruct((l, n, k), bool),
+        pods_req=jax.ShapeDtypeStruct((p, r), np.float32),
+        pod_level=jax.ShapeDtypeStruct((p,), np.int32),
+    )
+    res = jax.eval_shape(pre_ops.batched_dry_run, batch)
+    assert tuple(res.feasible.shape) == (p, n) and str(res.feasible.dtype) == "bool"
+    assert tuple(res.min_k.shape) == (p, n) and str(res.min_k.dtype) == "int32"
+    assert tuple(res.viol_k.shape) == (p, n) and str(res.viol_k.dtype) == "int32"
+
+
 def test_gang_retry_bucket_closure():
     """The pad-bucket lattice is closed under the gang-admission-retry
     subset solves: with num_pods_hint pinned to the full batch, every
