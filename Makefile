@@ -5,13 +5,13 @@
 
 PY ?= python
 
-.PHONY: test chaos chaos-restart bench lint lint-shapes multichip
+.PHONY: test chaos chaos-restart bench lint lint-shapes multichip race
 
 # graftlint: the project-native static analysis suite (guarded-by,
-# hot-path purity, registry drift, lock-order, tensor-contract —
-# docs/static_analysis.md).  Exits non-zero on any finding outside
-# kubernetes_tpu/analysis/baseline.json and on stale baseline entries.
-# Import-light: no JAX init.
+# hot-path purity, registry drift, lock-order, tensor-contract,
+# atomicity — docs/static_analysis.md).  Exits non-zero on any finding
+# outside kubernetes_tpu/analysis/baseline.json and on stale baseline
+# entries.  Import-light: no JAX init.
 lint:
 	$(PY) -m kubernetes_tpu.analysis
 
@@ -24,6 +24,18 @@ lint-shapes:
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow and not chaos' \
 		--continue-on-collection-errors -p no:cacheprovider
+
+# graftsched: the concurrency gate (docs/static_analysis.md).  Arms the
+# runtime lock-order tracker for the whole session and runs the
+# deterministic interleaving suite — the DEEP sweeps (200+ seeded
+# schedules per scenario, every invariant oracle green, seed-replay
+# determinism) plus the atomicity-sensitive test files.  Tier-1 carries
+# only the fast interleave smoke subset ('interleave and not slow').
+race:
+	GRAFTLINT_LOCK_ORDER=1 JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_interleave.py tests/test_static_analysis.py \
+		tests/test_concurrency_stress.py tests/test_watch_backpressure.py \
+		-q -m 'not chaos' -p no:cacheprovider
 
 # the fixed seed matrices live in tests/test_chaos.py: SEEDS = range(20)
 # for the full-pipeline plans plus the overload-protection scenarios

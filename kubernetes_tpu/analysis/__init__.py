@@ -1,6 +1,6 @@
 """graftlint — project-native static analysis for the scheduler tree.
 
-Five import-light passes (plus the JAX-backed ``--shapes`` mode) enforce
+Six import-light passes (plus the JAX-backed ``--shapes`` mode) enforce
 the conventions the solve→assume→bind pipeline's correctness rests on
 (docs/static_analysis.md):
 
@@ -29,6 +29,14 @@ the conventions the solve→assume→bind pipeline's correctness rests on
                must stay dtype-stable (no 64-bit numpy values, no
                bare-int bitset shifts) and axis-consistent (a
                ``P``-derived variable must not index an ``N`` axis).
+  atomicity    guarded accesses must COMPOSE: no check-then-act across
+               a lock boundary (a guarded value captured under the lock
+               then branched on / written back after release), no split
+               read-modify-write (a compound guarded update spanning two
+               ``with lock:`` sections of one method), and every
+               ``Condition.wait`` sits in a while-predicate loop inside
+               its ``with``.  The runtime complement is the interleaving
+               explorer (analysis/interleave.py + analysis/scenarios.py).
   recompile-discipline
                (``--shapes`` mode / ``make lint-shapes``: imports JAX)
                every @hot_path kernel driven through ``jax.eval_shape``
@@ -62,12 +70,13 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 #: runs only under `python -m kubernetes_tpu.analysis --shapes`.
 CHECK_IDS = (
     "guarded-by", "purity", "registry", "lock-order", "tensor-contract",
-    "recompile-discipline",
+    "atomicity", "recompile-discipline",
 )
 
 #: the stdlib-ast subset run_all executes (no JAX initialization)
 STATIC_CHECK_IDS = (
     "guarded-by", "purity", "registry", "lock-order", "tensor-contract",
+    "atomicity",
 )
 
 # check ids after `disable=`, comma-separated; anything after the ids
@@ -247,11 +256,11 @@ def run_all(
     checks: Optional[Sequence[str]] = None,
     package: str = "kubernetes_tpu",
 ) -> List[Finding]:
-    """Run the selected static passes (default: all five import-light
+    """Run the selected static passes (default: all six import-light
     checks) over root/<package>.  The JAX-backed recompile-discipline
     pass is NOT run here — it lives behind the CLI's ``--shapes`` mode
     (analysis/shapes.py) so ``make lint`` stays import-light."""
-    from . import guarded, lockorder, purity, registry, tensorcontract
+    from . import atomicity, guarded, lockorder, purity, registry, tensorcontract
 
     files = load_sources(root, [package])
     selected = set(checks or STATIC_CHECK_IDS)
@@ -266,5 +275,7 @@ def run_all(
         findings.extend(lockorder.check(files))
     if "tensor-contract" in selected:
         findings.extend(tensorcontract.check(files))
+    if "atomicity" in selected:
+        findings.extend(atomicity.check(files))
     findings.sort(key=lambda f: (f.file, f.line, f.check, f.message))
     return findings

@@ -1,6 +1,6 @@
 """graftlint CLI: ``python -m kubernetes_tpu.analysis`` (or ``make lint``).
 
-Default mode runs the five import-light static passes over the
+Default mode runs the six import-light static passes over the
 repository's ``kubernetes_tpu`` tree, subtracts the reviewed baseline,
 and exits non-zero on any new finding OR any stale baseline entry (the
 baseline only shrinks).
@@ -9,6 +9,11 @@ baseline only shrinks).
 recompile-discipline pass instead — eval_shape over the pad-bucket
 lattice plus real-encoder shape validation (analysis/shapes.py).  It is
 a separate mode on purpose: the default lint must never initialize JAX.
+
+``--interleave`` mode runs graftsched — the deterministic interleaving
+explorer over the scenario library (analysis/interleave.py +
+analysis/scenarios.py; ``make race`` is the deep pytest driver) — also
+its own mode because the scheduler scenarios import JAX.
 """
 
 from __future__ import annotations
@@ -53,6 +58,25 @@ def main(argv=None) -> int:
         "JAX_PLATFORMS=cpu for a hardware-free run)",
     )
     parser.add_argument(
+        "--interleave",
+        action="store_true",
+        help="run the graftsched interleaving explorer over the scenario "
+        "library (imports JAX for the scheduler scenarios; "
+        "JAX_PLATFORMS=cpu works)",
+    )
+    parser.add_argument(
+        "--scenario",
+        default=None,
+        help="with --interleave: run only this scenario (default: all)",
+    )
+    parser.add_argument(
+        "--seeds",
+        type=int,
+        default=10,
+        help="with --interleave: seeds per policy per scenario "
+        "(schedules = 2 * seeds; default 10)",
+    )
+    parser.add_argument(
         "--baseline",
         default=None,
         help="baseline file (default: kubernetes_tpu/analysis/baseline.json)",
@@ -68,6 +92,8 @@ def main(argv=None) -> int:
     root = args.root or os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
+    if args.interleave:
+        return _run_interleave(args)
     if args.shapes:
         from . import shapes
 
@@ -117,6 +143,55 @@ def main(argv=None) -> int:
     )
     print(summary)
     return 1 if new or stale else 0
+
+
+def _run_interleave(args) -> int:
+    """graftsched CLI mode: sweep the scenario library, every schedule
+    must pass its oracles; a failure prints the seed/policy so the
+    schedule replays exactly (docs/static_analysis.md triage)."""
+    import logging
+
+    from . import interleave, scenarios
+
+    # the fault-plan scenarios exercise containment paths that log
+    # loudly BY DESIGN; the CLI reports pass/fail, not the noise
+    logging.disable(logging.ERROR)
+
+    names = (
+        [args.scenario] if args.scenario else list(scenarios.SCENARIOS)
+    )
+    unknown = [n for n in names if n not in scenarios.SCENARIOS]
+    if unknown:
+        print(
+            f"unknown scenario(s): {', '.join(unknown)}; "
+            f"available: {', '.join(scenarios.SCENARIOS)}",
+            file=sys.stderr,
+        )
+        return 2
+    failures = 0
+    for name in names:
+        cls = scenarios.SCENARIOS[name]
+        for policy in ("random", "pct"):
+            for seed in range(args.seeds):
+                try:
+                    ex = scenarios.run_schedule(cls, seed, policy=policy)
+                except Exception as e:  # noqa: BLE001 — report and continue
+                    failures += 1
+                    print(
+                        f"FAIL {name} seed={seed} policy={policy}: "
+                        f"{type(e).__name__}: {e}"
+                    )
+                    continue
+        print(
+            f"graftsched: {name}: {2 * args.seeds} schedules explored "
+            f"({interleave.TOTALS['yield_points']} yield points total)"
+        )
+    print(
+        f"graftsched: {interleave.TOTALS['schedules']} schedules, "
+        f"{interleave.TOTALS['yield_points']} yield points, "
+        f"{failures} failure(s) across {len(names)} scenario(s)"
+    )
+    return 1 if failures else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,349 @@
+"""atomicity: lock-ATOMICITY discipline for annotated shared state.
+
+The guarded-by pass proves every touch of a ``GUARDED_FIELDS`` field
+happens under its lock; this pass proves the touches COMPOSE correctly.
+Holding the lock for each individual access is not enough when a
+decision spans a release: the classic TOCTOU shapes are invisible to
+guarded-by because every single access is locked.  Three rules:
+
+check-then-act
+    A guarded value is captured into a local under ``with self.<lock>:``
+    and, after the block ends, the local is branched on (``if``/``while``
+    test) or written back into a guarded field while the lock is no
+    longer held.  Between release and use any other thread may have
+    changed the field — the branch decides on stale state.  Fix: widen
+    the critical section, or re-read the field under the lock before
+    acting (re-assigning the local from ``self.<field>`` under a later
+    ``with self.<lock>:`` clears the capture).
+
+split-rmw
+    The same capture-then-write-back shape, but the write-back happens
+    under a SECOND ``with self.<lock>:`` section of the same method — a
+    compound read-modify-write split across two critical sections.  The
+    update is lost if another thread wrote between the sections.  Fix:
+    one critical section, or recompute from the field inside the second.
+
+cv-wait-without-predicate-loop
+    ``<cv>.wait(...)`` inside ``with <cv>:`` but not inside a ``while``
+    loop WITHIN that with-block.  Condition waits can wake spuriously,
+    on a broadcast meant for someone else, or via timeout — the
+    predicate must be re-checked under the SAME lock acquisition before
+    acting (an outer loop that re-enters the with-block re-checks under
+    a fresh acquisition, which leaves an act-on-stale-wake window inside
+    the first; docs/static_analysis.md shows the rewrite).
+    ``wait_for`` loops internally and never flags.  This rule needs no
+    ``GUARDED_FIELDS`` declaration — it applies to every with+wait pair
+    in the tree.
+
+Escape hatch: ``# graftlint: disable=atomicity -- <why>`` on the USE
+line (the branch / write-back / wait), like every other pass.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Finding, SourceFile, dotted_name
+from .guarded import _class_decls, _method_exempt, _with_locks
+
+CHECK = "atomicity"
+
+
+@dataclass
+class _Capture:
+    """A local holding a guarded value: ``x = ...self.<field>...`` under
+    ``with self.<lock>:``."""
+
+    var: str
+    field: str
+    lock: str
+    line: int
+    with_id: int   # id() of the With node the capture happened under
+
+
+def _guarded_reads(
+    node: ast.AST, guarded: Dict[str, str], held: Set[str]
+) -> List[str]:
+    """Guarded fields read by this expression whose lock is held."""
+    out: List[str] = []
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Attribute)
+            and isinstance(sub.value, ast.Name)
+            and sub.value.id == "self"
+            and sub.attr in guarded
+            and guarded[sub.attr] in held
+        ):
+            out.append(sub.attr)
+    return out
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {
+        sub.id for sub in ast.walk(node)
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load)
+    }
+
+
+class _MethodChecker:
+    """Walks one method in source order, tracking lock context and
+    captured guarded values, emitting check-then-act / split-rmw
+    findings at use sites."""
+
+    def __init__(
+        self,
+        src: SourceFile,
+        symbol: str,
+        guarded: Dict[str, str],
+        findings: List[Finding],
+    ):
+        self.src = src
+        self.symbol = symbol
+        self.guarded = guarded
+        self.findings = findings
+        self.captures: Dict[str, _Capture] = {}
+
+    # -- capture bookkeeping -----------------------------------------------
+
+    def _assign(
+        self, stmt: ast.Assign, held: Set[str], with_id: int
+    ) -> None:
+        if len(stmt.targets) != 1 or not isinstance(stmt.targets[0], ast.Name):
+            # tuple unpacks / attribute targets: not the capture shape
+            for tgt in stmt.targets:
+                for name in ast.walk(tgt):
+                    if isinstance(name, ast.Name):
+                        self.captures.pop(name.id, None)
+            return
+        var = stmt.targets[0].id
+        reads = _guarded_reads(stmt.value, self.guarded, held)
+        if reads and with_id:
+            self.captures[var] = _Capture(
+                var, reads[0], self.guarded[reads[0]], stmt.lineno, with_id
+            )
+        else:
+            # reassigned from something else (or outside any lock):
+            # the local no longer tracks the guarded field
+            self.captures.pop(var, None)
+
+    # -- use sites ---------------------------------------------------------
+
+    def _flag(self, cap: _Capture, line: int, kind: str, what: str) -> None:
+        if self.src.suppressed(line, CHECK):
+            return
+        if kind == "split-rmw":
+            msg = (
+                f"split read-modify-write: '{cap.var}' captured from "
+                f"guarded field '{cap.field}' under 'with self.{cap.lock}' "
+                f"(line {cap.line}) is {what} under a separate "
+                f"'with self.{cap.lock}' section — the compound update is "
+                "lost if another thread wrote between the sections"
+            )
+        else:
+            msg = (
+                f"check-then-act across a lock boundary: '{cap.var}' "
+                f"captured from guarded field '{cap.field}' under "
+                f"'with self.{cap.lock}' (line {cap.line}) is {what} after "
+                "the lock was released, without revalidation"
+            )
+        self.findings.append(
+            Finding(CHECK, self.src.relpath, line, self.symbol, msg)
+        )
+
+    def _check_use(
+        self,
+        names: Set[str],
+        line: int,
+        held: Set[str],
+        with_id: int,
+        what: str,
+        write_back: bool,
+    ) -> None:
+        for var in sorted(names & set(self.captures)):
+            cap = self.captures[var]
+            if with_id == cap.with_id:
+                continue  # same critical section: atomic
+            if cap.lock in held:
+                # re-locked in a different section: a branch here re-runs
+                # under the lock against live state unless it consults
+                # the stale capture for a WRITE — that's the split-RMW
+                # shape; branch-only re-locked uses stay quiet (the
+                # second section revalidates by construction when it
+                # re-reads the field, and flagging every metrics-style
+                # carry-over would drown the signal)
+                if write_back:
+                    self._flag(cap, line, "split-rmw", what)
+                    self.captures.pop(var, None)
+            else:
+                self._flag(cap, line, "check-then-act", what)
+                self.captures.pop(var, None)
+
+    # -- the walk ----------------------------------------------------------
+
+    def visit_block(
+        self, body: List[ast.stmt], held: Set[str], with_id: int
+    ) -> None:
+        for stmt in body:
+            self.visit_stmt(stmt, held, with_id)
+
+    def visit_stmt(self, stmt: ast.stmt, held: Set[str], with_id: int) -> None:
+        if isinstance(stmt, ast.With):
+            locks = {
+                a for a in _with_locks(stmt)
+                if a in set(self.guarded.values())
+            }
+            inner_id = id(stmt) if locks else with_id
+            self.visit_block(stmt.body, held | locks, inner_id)
+            return
+        if isinstance(stmt, ast.Assign):
+            # write-back to a guarded field using a stale capture?
+            for tgt in stmt.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                    and tgt.attr in self.guarded
+                ):
+                    self._check_use(
+                        _names_in(stmt.value), stmt.lineno, held, with_id,
+                        f"written back into guarded field '{tgt.attr}'",
+                        write_back=True,
+                    )
+            self._assign(stmt, held, with_id)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            tgt = stmt.target
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+                and tgt.attr in self.guarded
+            ):
+                self._check_use(
+                    _names_in(stmt.value), stmt.lineno, held, with_id,
+                    f"written back into guarded field '{tgt.attr}'",
+                    write_back=True,
+                )
+            elif isinstance(tgt, ast.Name):
+                self.captures.pop(tgt.id, None)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._check_use(
+                _names_in(stmt.test), stmt.lineno, held, with_id,
+                "branched on", write_back=False,
+            )
+            self.visit_block(stmt.body, held, with_id)
+            self.visit_block(stmt.orelse, held, with_id)
+            return
+        if isinstance(stmt, ast.For):
+            self.visit_block(stmt.body, held, with_id)
+            self.visit_block(stmt.orelse, held, with_id)
+            return
+        if isinstance(stmt, ast.Try):
+            self.visit_block(stmt.body, held, with_id)
+            for h in stmt.handlers:
+                self.visit_block(h.body, held, with_id)
+            self.visit_block(stmt.orelse, held, with_id)
+            self.visit_block(stmt.finalbody, held, with_id)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def captures by reference at CALL time — beyond
+            # this lexical pass; clear anything it rebinds and move on
+            return
+        # other statements (Expr, Return, Raise, ...): no branch, no
+        # write-back — a plain read of a stale local (logging, metrics,
+        # return values) is not an atomicity decision
+
+
+def _check_methods(src: SourceFile, findings: List[Finding]) -> None:
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        guarded, locked_methods = _class_decls(src, node)
+        if not guarded:
+            continue
+        for stmt in node.body:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and not _method_exempt(stmt.name, locked_methods):
+                checker = _MethodChecker(
+                    src, f"{node.name}.{stmt.name}", guarded, findings
+                )
+                checker.visit_block(stmt.body, set(), 0)
+
+
+# -- cv-wait-without-predicate-loop ------------------------------------------
+
+
+def _check_cv_waits(src: SourceFile, findings: List[Finding]) -> None:
+    """For every ``with E:`` block, a ``E.wait(...)`` inside it must sit
+    under a ``while`` that is itself inside the with-block."""
+
+    def fn_symbol(stack: List[str]) -> str:
+        return ".".join(stack) or src.module
+
+    def walk(node: ast.AST, stack: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk(child, stack + [child.name])
+            elif isinstance(child, ast.ClassDef):
+                walk(child, stack + [child.name])
+            else:
+                if isinstance(child, ast.With):
+                    for item in child.items:
+                        cv = dotted_name(item.context_expr)
+                        if cv is not None:
+                            _scan_with(child, cv, stack)
+                walk(child, stack)
+
+    def _scan_with(with_node: ast.With, cv: str, stack: List[str]) -> None:
+        def scan(node: ast.AST, in_while: bool) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return  # nested defs run later, elsewhere
+            if isinstance(node, ast.With) and any(
+                dotted_name(i.context_expr) == cv for i in node.items
+            ):
+                # reentrant re-acquisition of the same cv: the outer
+                # walk scans that block as its own root
+                return
+            if isinstance(node, ast.While):
+                for child in ast.iter_child_nodes(node):
+                    scan(child, True)
+                return
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "wait"
+                and dotted_name(node.func.value) == cv
+                and not in_while
+                and not src.suppressed(node.lineno, CHECK)
+            ):
+                findings.append(
+                    Finding(
+                        CHECK, src.relpath, node.lineno,
+                        fn_symbol(stack),
+                        f"'{cv}.wait(...)' is not inside a while-"
+                        f"predicate loop within 'with {cv}:' — a "
+                        "spurious or stolen wakeup acts without "
+                        "re-checking the predicate under this "
+                        "acquisition",
+                    )
+                )
+                return
+            for child in ast.iter_child_nodes(node):
+                scan(child, in_while)
+
+        for stmt in with_node.body:
+            scan(stmt, False)
+
+    walk(src.tree, [])
+
+
+def check(files: List[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in files:
+        _check_methods(src, findings)
+        _check_cv_waits(src, findings)
+    return findings

@@ -1,0 +1,882 @@
+"""graftsched — deterministic interleaving explorer for the control
+plane's thread zoo.
+
+The runtime lock-order tracker (analysis/runtime.py) RECORDS what the
+OS scheduler happened to do; this module DECIDES what the scheduler
+does.  While an :class:`Explorer` is installed, every
+``threading.Lock`` / ``RLock`` / ``Condition`` created in the window is
+a virtual primitive and every ``threading.Thread`` started in the
+window is a managed thread: all managed threads serialize through a
+single control token, handing it back at *yield points* — lock
+acquire/release, condition wait/notify, ``faults.fire`` sites,
+``time.sleep`` — where a seeded policy picks who runs next.  One seed =
+one schedule = one byte-identical trace, so any failing interleaving
+replays exactly (the chaos suite's property, but over SCHEDULES instead
+of fault plans: chaos is probabilistic, graftsched is systematic).
+
+Policies (both seeded):
+
+random
+    uniform random walk over the eligible threads at every step — the
+    baseline explorer; good at shallow races.
+pct
+    PCT-style priority scheduling (Burckhardt et al.): each thread gets
+    a random priority at spawn, the highest-priority eligible thread
+    runs, and at ``depth`` pre-drawn step indices the running thread's
+    priority drops to the floor — far better than random for races
+    that need several ORDERED context switches.
+
+Timeouts are virtual: ``time.monotonic``/``time.time`` serve a logical
+clock, ``time.sleep`` advances it, and a TIMED condition wait is always
+eligible to fire as a timeout (the policy choosing it advances the
+clock past the deadline) — so every bounded-wait path in the tree is
+explorable without wall-clock cost, and an UNTIMED wait with nobody
+left to notify it is a detected deadlock, not a hang.
+
+Blocking semantics are faithful where it matters: ``notify(n)`` is
+consumed FIFO, and a waiter that already timed out (but has not yet
+resumed) still eats the notification — CPython's lost-wakeup window —
+so predicate-loop discipline is actually exercised.
+
+Ground rules for scenarios (analysis/scenarios.py has the library):
+
+  * build shared objects (stores, queues, caches) INSIDE the installed
+    window so their locks are virtual, from the controller thread,
+    BEFORE spawning workers;
+  * after workers start, the controller only schedules — shared state
+    is touched from managed threads (oracles run via ``run_inline``);
+  * pass ``explorer.clock`` as the ``clock=`` argument to components
+    that default it at import time (SchedulingQueue, Scheduler) — the
+    ``time.monotonic`` patch cannot reach an already-bound default;
+  * real blocking calls (``queue.SimpleQueue.get``, socket reads)
+    inside the window wedge the schedule and are reported as such.
+
+Nothing here imports JAX; scenarios that drive the scheduler do.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time as _time_mod
+from random import Random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..testing import faults as _faults
+
+# -- module-wide exploration counters (mirrored into the scheduler
+# Registry as scheduler_interleave_* via mirror_metrics) ---------------------
+
+TOTALS = {"schedules": 0, "yield_points": 0}
+
+
+def mirror_metrics(registry, atomicity_findings: int = 0) -> None:
+    """Export the exploration counters (and, when the caller just ran
+    the static pass, its finding count) through a scheduler metrics
+    Registry — perf/collectors.py SCALAR_METRICS keeps the surface
+    reconciled by the graftlint registry pass."""
+    registry.interleave_schedules_total.set(float(TOTALS["schedules"]))
+    registry.interleave_yield_points.set(float(TOTALS["yield_points"]))
+    registry.atomicity_findings.set(float(atomicity_findings))
+
+
+class DeadlockError(AssertionError):
+    """No eligible thread, but foreground work remains."""
+
+
+class ScheduleBudgetExceeded(AssertionError):
+    """The schedule ran past its step budget without quiescing."""
+
+
+_DONE = "done"
+_LIVE = "live"
+
+# cv-waiter entry states
+_WAITING = "waiting"
+_NOTIFIED = "notified"
+_TIMEDOUT = "timedout"
+
+
+class _Gate:
+    """A real event built from pre-patch primitives (threading.Event
+    would hand back a virtual-backed one while the patch is live, and
+    the deadline math below must use the pre-patch wall clock)."""
+
+    def __init__(self, real_lock_ctor, real_cond_ctor, real_clock):
+        self._cond = real_cond_ctor(real_lock_ctor())
+        self._clock = real_clock
+        self._flag = False
+
+    def set(self) -> None:
+        with self._cond:
+            self._flag = True
+            self._cond.notify_all()
+
+    def clear(self) -> None:
+        with self._cond:
+            self._flag = False
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        with self._cond:
+            if timeout is None:
+                while not self._flag:
+                    self._cond.wait()
+                return True
+            deadline = self._clock() + timeout
+            while not self._flag:
+                remaining = deadline - self._clock()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
+
+
+class _Rec:
+    """One managed thread's scheduler-side record."""
+
+    __slots__ = (
+        "name", "index", "ident", "gate", "state", "parked", "blocked_on",
+        "background", "priority", "exc", "where",
+    )
+
+    def __init__(self, name: str, index: int, gate: _Gate, background: bool):
+        self.name = name
+        self.index = index
+        self.ident: Optional[int] = None
+        self.gate = gate
+        self.state = _LIVE
+        self.parked = False
+        # None | ("lock", VirtualLock) | ("cv", _CvEntry) | ("join", _Rec)
+        self.blocked_on: Optional[Tuple[str, Any]] = None
+        self.background = background
+        self.priority = 0.0
+        self.exc: Optional[BaseException] = None
+        self.where = "spawn"
+
+    def __repr__(self):
+        return f"<_Rec {self.name} {self.state} at {self.where}>"
+
+
+class _CvEntry:
+    __slots__ = ("rec", "state", "timed", "timeout")
+
+    def __init__(self, rec: _Rec, timed: bool, timeout: float):
+        self.rec = rec
+        self.state = _WAITING
+        self.timed = timed
+        self.timeout = timeout
+
+
+class VirtualLock:
+    """Lock/RLock stand-in.  Managed threads use the cooperative
+    protocol (ownership is scheduler bookkeeping — serialization makes
+    a real mutex redundant); unmanaged threads (controller setup and
+    teardown, or any thread after detach) fall through to a real
+    lock."""
+
+    def __init__(self, explorer: "Explorer", reentrant: bool, name: str):
+        self._ex = explorer
+        self._reentrant = reentrant
+        self.name = name
+        self.owner: Optional[_Rec] = None
+        self.count = 0
+        self._real = (
+            explorer._real_rlock() if reentrant else explorer._real_lock()
+        )
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        rec = self._ex._current_rec()
+        if rec is None:
+            if self.owner is not None:
+                raise RuntimeError(
+                    f"unmanaged acquire of {self.name} while virtually "
+                    f"owned by {self.owner.name} — touch shared state "
+                    "only from managed threads while exploring"
+                )
+            return self._real.acquire(blocking, timeout)
+        if self.owner is rec:
+            if not self._reentrant:
+                raise RuntimeError(
+                    f"non-reentrant {self.name} re-acquired by {rec.name}"
+                )
+            self.count += 1
+            return True
+        if not blocking:
+            self._ex._yield(rec, f"tryacquire:{self.name}")
+            if self.owner is None:
+                self.owner, self.count = rec, 1
+                return True
+            return False
+        self._ex._block_on_lock(rec, self)
+        return True
+
+    def release(self):
+        rec = self._ex._current_rec()
+        if rec is None:
+            return self._real.release()
+        if self.owner is not rec:
+            raise RuntimeError(
+                f"release of {self.name} by non-owner {rec.name}"
+            )
+        self.count -= 1
+        if self.count == 0:
+            self.owner = None
+            self._ex._yield(rec, f"release:{self.name}")
+
+    def locked(self):
+        if self._ex._current_rec() is None:
+            return (
+                self._real.locked() if hasattr(self._real, "locked")
+                else self.owner is not None
+            )
+        return self.owner is not None
+
+    def _at_fork_reinit(self):
+        # os.register_at_fork hooks captured by imports inside the
+        # window (concurrent.futures.thread) land here
+        return self._real._at_fork_reinit()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # Condition-over-lock hooks (our VirtualCondition and any stdlib
+    # machinery built on a patched Lock use these)
+    def _is_owned(self):
+        rec = self._ex._current_rec()
+        if rec is None:
+            if hasattr(self._real, "_is_owned"):
+                return self._real._is_owned()
+            if self._real.acquire(False):
+                self._real.release()
+                return False
+            return True
+        return self.owner is rec
+
+    def _release_save(self):
+        rec = self._ex._current_rec()
+        if rec is None:
+            if hasattr(self._real, "_release_save"):
+                return self._real._release_save()
+            self._real.release()
+            return 1
+        count, self.count, self.owner = self.count, 0, None
+        return count
+
+    def _acquire_restore(self, state):
+        rec = self._ex._current_rec()
+        if rec is None:
+            if hasattr(self._real, "_acquire_restore"):
+                return self._real._acquire_restore(state)
+            return self._real.acquire()
+        self._ex._block_on_lock(rec, self)
+        self.count = state
+
+    def __repr__(self):
+        who = self.owner.name if self.owner else None
+        return f"<VirtualLock {self.name} owner={who} n={self.count}>"
+
+
+class VirtualCondition:
+    """Condition stand-in over a VirtualLock, with faithful FIFO notify
+    consumption (a timed-out-but-not-yet-resumed waiter still eats a
+    notify — the CPython lost-wakeup window predicate loops exist
+    for)."""
+
+    def __init__(self, explorer: "Explorer", lock=None, name: str = "cv"):
+        self._ex = explorer
+        self.name = name
+        if lock is None:
+            lock = VirtualLock(explorer, reentrant=True, name=f"{name}.lock")
+        self._vlock = lock
+        self._waiters: List[_CvEntry] = []
+        inner = lock._real if isinstance(lock, VirtualLock) else lock
+        self._real = explorer._real_condition(inner)
+
+    # lock surface forwards
+    def acquire(self, *a, **k):
+        return self._vlock.acquire(*a, **k)
+
+    def release(self):
+        return self._vlock.release()
+
+    def __enter__(self):
+        self._vlock.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._vlock.release()
+        return False
+
+    def _is_owned(self):
+        return self._vlock._is_owned()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        rec = self._ex._current_rec()
+        if rec is None:
+            # detached/unmanaged: bounded real wait so leftover service
+            # loops cycle quickly toward their exit checks
+            t = 0.02 if timeout is None else min(timeout, 0.02)
+            return self._real.wait(t)
+        if self._vlock.owner is not rec:
+            raise RuntimeError(f"wait on {self.name} without its lock")
+        entry = _CvEntry(
+            rec, timed=timeout is not None, timeout=timeout or 0.0
+        )
+        self._waiters.append(entry)
+        saved = self._vlock._release_save()
+        self._ex._block_on_cv(rec, entry, self)
+        try:
+            self._waiters.remove(entry)
+        except ValueError:
+            pass
+        self._vlock._acquire_restore(saved)
+        return entry.state == _NOTIFIED
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        # self-contained: the stdlib helper computes deadlines with the
+        # REAL clock, which spins against the virtual one
+        end = None if timeout is None else self._ex.clock() + timeout
+        result = predicate()
+        while not result:
+            remaining = None
+            if end is not None:
+                remaining = end - self._ex.clock()
+                if remaining <= 0:
+                    break
+            self.wait(remaining)
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        rec = self._ex._current_rec()
+        if rec is None:
+            return self._real.notify(n)
+        self._ex._yield(rec, f"notify:{self.name}")
+        consumed = 0
+        for entry in self._waiters:
+            if consumed >= n:
+                break
+            if entry.state == _WAITING:
+                entry.state = _NOTIFIED
+                consumed += 1
+            elif entry.state == _TIMEDOUT:
+                # the CPython window: a notify landing on a waiter that
+                # timed out internally but has not yet resumed is WASTED
+                consumed += 1
+
+    def notify_all(self) -> None:
+        rec = self._ex._current_rec()
+        if rec is None:
+            return self._real.notify_all()
+        self._ex._yield(rec, f"notifyall:{self.name}")
+        for entry in self._waiters:
+            if entry.state == _WAITING:
+                entry.state = _NOTIFIED
+
+    notifyAll = notify_all
+
+    def __repr__(self):
+        return f"<VirtualCondition {self.name} waiters={len(self._waiters)}>"
+
+
+class Explorer:
+    """One schedule's cooperative scheduler.  Use via :meth:`installed`
+    (patches threading/time/faults for the dynamic extent), spawn
+    foreground work with :meth:`spawn`, then :meth:`drive` to run the
+    schedule to quiescence and :meth:`run_inline` for oracles."""
+
+    def __init__(
+        self,
+        seed: int = 0,
+        policy: str = "random",
+        pct_depth: int = 3,
+        max_steps: int = 50_000,
+    ):
+        if policy not in ("random", "pct"):
+            raise ValueError(f"unknown policy {policy!r}")
+        self.seed = seed
+        self.policy = policy
+        self.max_steps = max_steps
+        self.rng = Random(seed * 1_000_003 + (0 if policy == "random" else 1))
+        self.steps = 0
+        self.trace: List[Tuple[int, str, str]] = []
+        self._clock = 1000.0
+        self._recs: List[_Rec] = []
+        self._by_ident: Dict[int, _Rec] = {}
+        self.active = False
+        self._installed = False
+        self._real_lock = threading.Lock
+        self._real_rlock = threading.RLock
+        self._real_condition = threading.Condition
+        self._real_thread = threading.Thread
+        self._real_monotonic = _time_mod.monotonic
+        self._mu = None
+        self._ctl = None          # _Gate: threads -> controller
+        self._saved: Dict[str, Any] = {}
+        self._spawn_i = 0
+        self._prio_floor = -1.0
+        # PCT change points are drawn over a horizon matched to real
+        # schedule lengths (a few hundred steps), not max_steps — points
+        # past the schedule's natural end would never fire
+        self._pct_changes = set()
+        if policy == "pct":
+            horizon = min(2048, max_steps)
+            self._pct_changes = {
+                self.rng.randrange(1, horizon) for _ in range(pct_depth)
+            }
+
+    # -- virtual clock -----------------------------------------------------
+
+    def clock(self) -> float:
+        return self._clock
+
+    def _advance(self, dt: float) -> None:
+        self._clock += dt
+
+    # -- install/uninstall -------------------------------------------------
+
+    @contextlib.contextmanager
+    def installed(self):
+        """Patch threading.Lock/RLock/Condition/Thread, time.monotonic/
+        time/sleep and faults.fire for the dynamic extent; restore on
+        exit and detach any still-live managed threads (service loops
+        then run against real primitives and exit via their own
+        stop-flag/weakref checks)."""
+        if self._installed:
+            raise RuntimeError("explorer already installed")
+        # capture the CURRENT ctors (possibly the lock-order tracker's
+        # wrappers — real behavior either way) before replacing them
+        self._real_lock = threading.Lock
+        self._real_rlock = threading.RLock
+        self._real_condition = threading.Condition
+        self._real_thread = threading.Thread
+        self._real_monotonic = _time_mod.monotonic
+        self._mu = self._real_lock()
+        self._ctl = _Gate(
+            self._real_lock, self._real_condition, self._real_monotonic
+        )
+        self._saved = dict(
+            Lock=threading.Lock,
+            RLock=threading.RLock,
+            Condition=threading.Condition,
+            Thread=threading.Thread,
+            monotonic=_time_mod.monotonic,
+            time=_time_mod.time,
+            sleep=_time_mod.sleep,
+            fire=_faults.fire,
+        )
+        ex = self
+
+        def make_lock():
+            return VirtualLock(ex, reentrant=False, name=f"L{ex._name_seq()}")
+
+        def make_rlock():
+            return VirtualLock(ex, reentrant=True, name=f"R{ex._name_seq()}")
+
+        def make_condition(lock=None):
+            return VirtualCondition(ex, lock, name=f"C{ex._name_seq()}")
+
+        real_thread = self._real_thread
+
+        class ManagedThread(real_thread):
+            """Threads STARTED while the explorer is active register as
+            managed background threads and serialize through it."""
+
+            def start(self):
+                if not ex.active:
+                    return super().start()
+                rec = ex._register(
+                    self.name or f"thread-{ex._spawn_i}", background=True
+                )
+                self._graftsched_rec = rec
+                run = self.run
+
+                def bootstrap():
+                    ex._bootstrap(rec, run)
+
+                runner = real_thread(
+                    target=bootstrap, name=self.name, daemon=True
+                )
+                self._graftsched_runner = runner
+                runner.start()
+
+            def is_alive(self):
+                runner = getattr(self, "_graftsched_runner", None)
+                if runner is not None:
+                    return runner.is_alive()
+                return super().is_alive()
+
+            def join(self, timeout=None):
+                runner = getattr(self, "_graftsched_runner", None)
+                rec = getattr(self, "_graftsched_rec", None)
+                me = ex._current_rec()
+                if rec is not None and me is not None and ex.active:
+                    ex._block_on_join(me, rec)
+                    return
+                if runner is not None:
+                    return runner.join(timeout)
+                return super().join(timeout)
+
+        def v_monotonic():
+            return ex._clock
+
+        def v_time():
+            return 1_700_000_000.0 + ex._clock
+
+        def v_sleep(seconds):
+            rec = ex._current_rec()
+            if rec is None:
+                return  # controller/unmanaged: virtual time is free
+            ex._advance(max(float(seconds), 0.0))
+            ex._yield(rec, f"sleep:{seconds}")
+
+        saved_fire = self._saved["fire"]
+
+        def v_fire(point, **ctx):
+            rec = ex._current_rec()
+            if rec is not None:
+                ex._yield(rec, f"fault:{point}")
+            return saved_fire(point, **ctx)
+
+        threading.Lock = make_lock
+        threading.RLock = make_rlock
+        threading.Condition = make_condition
+        threading.Thread = ManagedThread
+        _time_mod.monotonic = v_monotonic
+        _time_mod.time = v_time
+        _time_mod.sleep = v_sleep
+        _faults.fire = v_fire
+        armed_here = False
+        if _faults._registry is None:
+            # gated fire sites check the registry before calling; arm an
+            # empty plan so every site becomes a yield point
+            _faults.arm(_faults.FaultRegistry(self.seed))
+            armed_here = True
+        self.active = True
+        self._installed = True
+        try:
+            yield self
+        finally:
+            self.active = False
+            self._detach_all()
+            threading.Lock = self._saved["Lock"]
+            threading.RLock = self._saved["RLock"]
+            threading.Condition = self._saved["Condition"]
+            threading.Thread = self._saved["Thread"]
+            _time_mod.monotonic = self._saved["monotonic"]
+            _time_mod.time = self._saved["time"]
+            _time_mod.sleep = self._saved["sleep"]
+            _faults.fire = self._saved["fire"]
+            if armed_here:
+                _faults.disarm()
+            self._installed = False
+            TOTALS["schedules"] += 1
+
+    def _detach_all(self) -> None:
+        """Open every parked thread's gate; with ``active`` False their
+        next yield/wait is a no-op/real-wait and service loops run
+        free."""
+        with self._mu:
+            recs = list(self._recs)
+        for rec in recs:
+            if rec.state != _DONE:
+                rec.gate.set()
+
+    def _name_seq(self) -> int:
+        self._spawn_i += 1
+        return self._spawn_i
+
+    # -- registration / bootstrap ------------------------------------------
+
+    def _register(self, name: str, background: bool) -> _Rec:
+        with self._mu:
+            rec = _Rec(
+                name,
+                len(self._recs),
+                _Gate(
+                    self._real_lock, self._real_condition,
+                    self._real_monotonic,
+                ),
+                background,
+            )
+            rec.priority = self.rng.random()
+            self._recs.append(rec)
+        return rec
+
+    def spawn(
+        self, fn: Callable, *args, name: Optional[str] = None
+    ) -> _Rec:
+        """Start a FOREGROUND managed thread running fn(*args) —
+        :meth:`drive` runs until every foreground thread completes."""
+        rec = self._register(name or fn.__name__, background=False)
+
+        def bootstrap():
+            self._bootstrap(rec, lambda: fn(*args))
+
+        t = self._real_thread(target=bootstrap, name=rec.name, daemon=True)
+        t.start()
+        return rec
+
+    def _bootstrap(self, rec: _Rec, target: Callable) -> None:
+        rec.ident = threading.get_ident()
+        with self._mu:
+            self._by_ident[rec.ident] = rec
+        self._yield(rec, "start")  # park until first scheduled
+        try:
+            target()
+        except BaseException as e:  # noqa: BLE001 — recorded, not printed
+            rec.exc = e
+        finally:
+            with self._mu:
+                rec.state = _DONE
+                rec.parked = True
+                rec.blocked_on = None
+                self._by_ident.pop(rec.ident, None)
+            self._ctl.set()
+
+    def _current_rec(self) -> Optional[_Rec]:
+        if not self.active:
+            return None
+        return self._by_ident.get(threading.get_ident())
+
+    # -- thread-side yield/block -------------------------------------------
+
+    def _yield(self, rec: _Rec, label: str) -> None:
+        """Pause at a yield point until the policy schedules this thread
+        again.  After detach this is a no-op."""
+        if not self.active:
+            return
+        rec.where = label
+        with self._mu:
+            rec.parked = True
+        self._ctl.set()
+        # the CONTROLLER flips rec.parked back to False before opening
+        # the gate, so "every live thread parked" can never be observed
+        # stale while this thread is already running again
+        while not rec.gate.wait(timeout=60.0):
+            if not self.active:
+                break
+            raise RuntimeError(
+                f"controller stalled; {rec.name} abandoned at {label}"
+            )
+        rec.gate.clear()
+
+    def _block_on_lock(self, rec: _Rec, lock: VirtualLock) -> None:
+        rec.blocked_on = ("lock", lock)
+        while True:
+            self._yield(rec, f"acquire:{lock.name}")
+            if not self.active:
+                rec.blocked_on = None
+                return  # detached: ownership bookkeeping is moot now
+            if lock.owner is None:
+                lock.owner, lock.count = rec, 1
+                rec.blocked_on = None
+                return
+
+    def _block_on_cv(
+        self, rec: _Rec, entry: _CvEntry, cv: VirtualCondition
+    ) -> None:
+        rec.blocked_on = ("cv", entry)
+        while True:
+            self._yield(rec, f"wait:{cv.name}")
+            if not self.active:
+                entry.state = _TIMEDOUT
+                rec.blocked_on = None
+                return
+            if entry.state != _WAITING:
+                rec.blocked_on = None
+                return
+
+    def _block_on_join(self, rec: _Rec, target: _Rec) -> None:
+        rec.blocked_on = ("join", target)
+        while True:
+            self._yield(rec, f"join:{target.name}")
+            if not self.active or target.state == _DONE:
+                rec.blocked_on = None
+                return
+
+    # -- controller --------------------------------------------------------
+
+    def _live(self) -> List[_Rec]:
+        with self._mu:
+            return [r for r in self._recs if r.state != _DONE]
+
+    def _wait_all_parked(self) -> None:
+        """Block until every live managed thread is parked at a yield
+        point (only then is scheduler state consistent and only then is
+        it safe for the controller to read scenario state)."""
+        deadline = self._real_monotonic() + 60.0
+        while True:
+            with self._mu:
+                pending = [
+                    r for r in self._recs
+                    if r.state != _DONE and not r.parked
+                ]
+            if not pending:
+                return
+            if self._real_monotonic() > deadline:
+                names = ", ".join(f"{r.name}@{r.where}" for r in pending)
+                raise RuntimeError(
+                    f"managed thread(s) wedged (real blocking call inside "
+                    f"the exploration window?): {names}"
+                )
+            self._ctl.wait(timeout=0.5)
+            self._ctl.clear()
+
+    def _eligible(self) -> List[Tuple[_Rec, str]]:
+        """(rec, action) pairs the policy may pick: 'run' resumes the
+        thread; 'timeout' fires a timed cv wait."""
+        out: List[Tuple[_Rec, str]] = []
+        for rec in self._recs:
+            if rec.state == _DONE or rec.ident is None:
+                continue
+            b = rec.blocked_on
+            if b is None:
+                out.append((rec, "run"))
+            elif b[0] == "lock":
+                if b[1].owner is None:
+                    out.append((rec, "run"))
+            elif b[0] == "cv":
+                entry: _CvEntry = b[1]
+                if entry.state != _WAITING:
+                    out.append((rec, "run"))
+                elif entry.timed:
+                    out.append((rec, "timeout"))
+            elif b[0] == "join":
+                if b[1].state == _DONE:
+                    out.append((rec, "run"))
+        return out
+
+    def _demote(self, rec: _Rec) -> None:
+        self._prio_floor -= 1.0
+        rec.priority = self._prio_floor
+
+    def _pick(self, eligible: List[Tuple[_Rec, str]]) -> Tuple[_Rec, str]:
+        if self.policy == "pct":
+            best = max(eligible, key=lambda e: (e[0].priority, -e[0].index))
+            if self.steps in self._pct_changes:
+                self._demote(best[0])
+            elif best[1] == "timeout":
+                # firing a timed wait means its full timeout elapsed on
+                # the virtual clock — every runnable thread would have
+                # run in that window, so the waiter drops below them
+                # (this also breaks idle-spin starvation under PCT)
+                self._demote(best[0])
+            return best
+        return eligible[self.rng.randrange(len(eligible))]
+
+    def _step(self) -> bool:
+        """Schedule one thread for one hop.  False when no live managed
+        thread can make progress (all done, or only untimed-parked
+        background threads remain)."""
+        self._wait_all_parked()
+        if not self._live():
+            return False
+        eligible = self._eligible()
+        if not eligible:
+            live = self._live()
+            fg = [r for r in live if not r.background]
+            where = ", ".join(f"{r.name}@{r.where}" for r in live)
+            if fg:
+                raise DeadlockError(
+                    f"deadlock: no eligible thread among [{where}] "
+                    f"(seed={self.seed}, policy={self.policy}, "
+                    f"step={self.steps}); trace tail: {self.trace[-8:]}"
+                )
+            return False
+        rec, action = self._pick(eligible)
+        self.steps += 1
+        TOTALS["yield_points"] += 1
+        self._advance(0.0005)
+        if action == "timeout":
+            entry: _CvEntry = rec.blocked_on[1]
+            entry.state = _TIMEDOUT
+            self._advance(max(entry.timeout, 0.0))
+        self.trace.append((self.steps, rec.name, rec.where))
+        self._ctl.clear()
+        with self._mu:
+            rec.parked = False
+        rec.gate.set()
+        self._wait_all_parked()
+        return True
+
+    def drive(
+        self,
+        quiesce: Optional[Callable[[], bool]] = None,
+        max_extra_steps: int = 5_000,
+    ) -> None:
+        """Run the schedule: step until every foreground thread is done,
+        then (with ``quiesce``) keep scheduling background threads until
+        the predicate holds.  Raises DeadlockError /
+        ScheduleBudgetExceeded on failure; re-raises the first
+        foreground thread's exception if one died."""
+        while True:
+            if self.steps > self.max_steps:
+                dead = [
+                    f"{r.name}: {r.exc!r}"
+                    for r in self._recs if r.exc is not None
+                ]
+                raise ScheduleBudgetExceeded(
+                    f"schedule exceeded {self.max_steps} steps "
+                    f"(seed={self.seed}); dead threads: {dead or 'none'}; "
+                    f"trace tail: {self.trace[-8:]}"
+                )
+            with self._mu:
+                fg_live = any(
+                    not r.background and r.state != _DONE for r in self._recs
+                )
+            if not fg_live:
+                break
+            if not self._step():
+                break
+        for name, exc in self.foreground_errors():
+            raise exc
+        if quiesce is not None:
+            extra = 0
+            while not quiesce():
+                extra += 1
+                if extra > max_extra_steps:
+                    raise ScheduleBudgetExceeded(
+                        f"quiesce predicate never held after {extra} extra "
+                        f"steps (seed={self.seed})"
+                    )
+                if not self._step():
+                    if not quiesce():
+                        raise DeadlockError(
+                            "background threads idle but quiesce predicate "
+                            f"false (seed={self.seed})"
+                        )
+                    break
+
+    def run_inline(self, fn: Callable, name: str = "oracle") -> None:
+        """Run fn to completion as a managed foreground thread (oracles
+        that touch shared state must participate in the schedule).
+        Re-raises whatever fn raised."""
+        rec = self.spawn(fn, name=name)
+        budget = self.steps + 20_000
+        while rec.state != _DONE:
+            if self.steps > budget:
+                raise ScheduleBudgetExceeded(
+                    f"'{name}' never completed (seed={self.seed})"
+                )
+            if not self._step():
+                break
+        if rec.exc is not None:
+            raise rec.exc
+
+    def foreground_errors(self) -> List[Tuple[str, BaseException]]:
+        with self._mu:
+            return [
+                (r.name, r.exc)
+                for r in self._recs
+                if not r.background and r.exc is not None
+            ]
+
+    def thread_names(self) -> List[str]:
+        with self._mu:
+            return [r.name for r in self._recs]

@@ -165,6 +165,13 @@ class TrackedLock:
     def locked(self):
         return self._inner.locked()
 
+    def _at_fork_reinit(self):
+        # modules captured at import time wire this into
+        # os.register_at_fork (concurrent.futures.thread's global
+        # shutdown lock) — a proxy without it breaks any IMPORT that
+        # happens inside a tracked window
+        return self._inner._at_fork_reinit()
+
     def __enter__(self):
         self.acquire()
         return self

@@ -1315,13 +1315,30 @@ class Store:
             watchers = list(self._watchers.get(kind, ()))
         expired: List[Watch] = []
         for w in watchers:
-            for ev in events:
-                verdict = w._offer(ev)
-                if verdict is OFFER_EXPIRED:
-                    expired.append(w)
-                    break
-                if verdict is OFFER_STOPPED:
-                    break  # _drop_watch unregisters it; skip the rest
+            try:
+                for ev in events:
+                    verdict = w._offer(ev)
+                    if verdict is OFFER_EXPIRED:
+                        expired.append(w)
+                        break
+                    if verdict is OFFER_STOPPED:
+                        break  # _drop_watch unregisters it; skip the rest
+            except Exception:  # noqa: BLE001 — per-watcher containment
+                # a poisoned offer (fault-schedule exception, corrupt
+                # payload) must cost only THIS watcher, and it must cost
+                # it loudly: expire the stream so the consumer relists.
+                # Letting the exception unwind the whole batch silently
+                # starved every remaining watcher of the rest of the
+                # batch with no 410 signal — a stale informer cache with
+                # no recovery path (interleave scenario
+                # 'writers_vs_dispatch' with a watch.offer fail schedule
+                # pins this).
+                logging.getLogger(__name__).exception(
+                    "watch offer failed; expiring the watcher"
+                )
+                with w._mu:
+                    w._expire_locked()
+                expired.append(w)
         for w in expired:
             self._retire_expired_watch(w, kind)
 
@@ -1900,9 +1917,18 @@ def _watch_dispatch_loop(store_ref: "weakref.ref[Store]", sid: int) -> None:
             return
         shard = store._shards[sid]
         batch = None
+        # deadline-bounded predicate loop: doze until a batch arrives,
+        # re-checking the backlog under the SAME acquisition after every
+        # wakeup (graftlint atomicity cv-discipline), but still fall out
+        # after ~0.2 s so the strong store/shard refs drop and an
+        # abandoned store can be collected
+        doze = time.monotonic() + 0.2
         with shard._dispatch_cv:
-            if not shard._dispatch_backlog:
-                shard._dispatch_cv.wait(0.2)
+            while not shard._dispatch_backlog:
+                remaining = doze - time.monotonic()
+                if remaining <= 0:
+                    break
+                shard._dispatch_cv.wait(remaining)
             if shard._dispatch_backlog:
                 batch = shard._dispatch_backlog.popleft()
                 # close() waits for backlog-empty AND not-inflight, so a

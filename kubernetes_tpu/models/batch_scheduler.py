@@ -536,7 +536,10 @@ class SolverPrewarmPool:
             pass
         with self._lock:
             t = self._thread
-        if t is not None and t.is_alive():
+        # snapshot join: a respawned thread sees _stop and exits on its
+        # own, so joining a superseded handle is safe — stale here is
+        # harmless by design
+        if t is not None and t.is_alive():  # graftlint: disable=atomicity -- snapshot join; _stop gates respawn
             t.join(timeout=timeout)
 
 

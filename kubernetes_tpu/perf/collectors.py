@@ -234,6 +234,12 @@ class MetricsCollector:
         # PDB-blocked candidate rankings (docs/scheduler_loop.md)
         "scheduler_preemption_conflict_serializations_total",
         "scheduler_preemption_pdb_blocked_total",
+        # graftsched: interleaving schedules explored / yield points
+        # scheduled (analysis/interleave.py) and static atomicity
+        # findings at the last mirrored run (docs/static_analysis.md)
+        "scheduler_interleave_schedules_total",
+        "scheduler_interleave_yield_points",
+        "scheduler_atomicity_findings",
     )
 
     def __init__(

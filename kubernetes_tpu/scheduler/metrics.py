@@ -350,6 +350,19 @@ class Registry:
         self.preemption_pdb_blocked_total = Counter(
             "scheduler_preemption_pdb_blocked_total"
         )
+        # -- graftsched surface (docs/static_analysis.md) ------------------
+        # deterministic interleaving schedules explored and yield points
+        # scheduled across them (analysis/interleave.py TOTALS, mirrored
+        # via interleave.mirror_metrics — make race / --interleave runs)
+        self.interleave_schedules_total = Gauge(
+            "scheduler_interleave_schedules_total"
+        )
+        self.interleave_yield_points = Gauge(
+            "scheduler_interleave_yield_points"
+        )
+        # findings of the static atomicity pass at the last mirrored
+        # lint run (tree-clean CI keeps this 0; mirror_metrics sets it)
+        self.atomicity_findings = Gauge("scheduler_atomicity_findings")
 
     def snapshot(self) -> Dict[str, object]:
         """Name → metric, for collectors.  HistogramVec children appear

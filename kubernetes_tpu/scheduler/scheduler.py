@@ -715,12 +715,19 @@ class Scheduler:
         while True:
             self._ensure_binder()
             with self._wave_cv:
-                if not self._waves and not self._wave_active:
+                # predicate loop under ONE acquisition (graftlint
+                # atomicity cv-discipline); breaks out to re-run the
+                # binder watchdog when the worker died mid-drain — a
+                # dead worker can never notify this cv again
+                while self._waves or self._wave_active:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                    self._wave_cv.wait(min(remaining, 0.2))
+                    if not self._bind_thread.is_alive():
+                        break
+                else:
                     return True
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    return False
-                self._wave_cv.wait(min(remaining, 0.2))
 
     def _solve_window(self, start: float, end: float) -> None:
         with self._solve_lock:

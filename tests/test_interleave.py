@@ -1,0 +1,335 @@
+"""graftsched (kubernetes_tpu/analysis/interleave.py + scenarios.py) —
+the deterministic interleaving explorer and its scenario library.
+
+Three layers:
+
+  * explorer unit tests: seed-replay determinism, virtual-timeout
+    semantics, deadlock detection, managed thread spawn/join, policy
+    behavior;
+  * scenario smoke (tier-1, `interleave and not slow`): a few seeded
+    schedules per scenario with every invariant oracle armed;
+  * deep sweeps (`make race`, marked slow): 200+ distinct schedules
+    per scenario across both policies, plus full-trace replay checks.
+
+Plus the regression pins for the true positives graftsched surfaced:
+the silent watch-fan-out batch drop (fixed in Store._fan_out) and the
+if-guarded dispatcher cv-wait (fixed in _watch_dispatch_loop; the
+static pin lives in tests/test_static_analysis.py).
+"""
+
+import logging
+import threading
+
+import pytest
+
+from kubernetes_tpu.analysis import interleave as il
+from kubernetes_tpu.analysis import scenarios as scn
+from kubernetes_tpu.testing import faults
+
+pytestmark = pytest.mark.interleave
+
+SMOKE_SEEDS = range(3)
+
+
+# -- explorer unit tests -----------------------------------------------------
+
+
+def _counter_scenario(seed, policy="random"):
+    ex = il.Explorer(seed=seed, policy=policy)
+    with ex.installed():
+        lock = threading.Lock()
+        state = {"n": 0}
+
+        def worker():
+            for _ in range(3):
+                with lock:
+                    state["n"] += 1
+
+        ex.spawn(worker, name="w1")
+        ex.spawn(worker, name="w2")
+        ex.drive()
+        assert state["n"] == 6
+    return ex.trace
+
+
+def test_seed_replay_identical_trace():
+    assert _counter_scenario(1) == _counter_scenario(1)
+    assert _counter_scenario(5, "pct") == _counter_scenario(5, "pct")
+
+
+def test_seeds_explore_distinct_schedules():
+    traces = {tuple(_counter_scenario(s)) for s in range(8)}
+    assert len(traces) > 1, "every seed produced the same schedule"
+
+
+def test_timed_wait_can_fire_as_timeout_and_as_notify():
+    """Across seeds, the explorer must exercise BOTH outcomes of a
+    timed Condition.wait: notified (True) and timed out (False)."""
+    outcomes = set()
+    for seed in range(20):
+        ex = il.Explorer(seed=seed)
+        with ex.installed():
+            cv = threading.Condition()
+            got = {}
+
+            def waiter():
+                with cv:
+                    got["r"] = cv.wait(0.25)
+
+            def notifier():
+                with cv:
+                    cv.notify()
+
+            ex.spawn(waiter, name="waiter")
+            ex.spawn(notifier, name="notifier")
+            ex.drive()
+        outcomes.add(got["r"])
+    assert outcomes == {True, False}, outcomes
+
+
+def test_untimed_wait_without_notifier_is_deadlock():
+    ex = il.Explorer(seed=0)
+    with pytest.raises(il.DeadlockError):
+        with ex.installed():
+            cv = threading.Condition()
+
+            def waiter():
+                with cv:
+                    cv.wait()  # untimed, nobody will notify
+
+            ex.spawn(waiter, name="waiter")
+            ex.drive()
+
+
+def test_abba_deadlock_detected_with_trace():
+    import time
+
+    found = 0
+    for seed in range(20):
+        ex = il.Explorer(seed=seed)
+        try:
+            with ex.installed():
+                a, b = threading.Lock(), threading.Lock()
+
+                def one():
+                    with a:
+                        time.sleep(0.01)
+                        with b:
+                            pass
+
+                def two():
+                    with b:
+                        time.sleep(0.01)
+                        with a:
+                            pass
+
+                ex.spawn(one, name="t1")
+                ex.spawn(two, name="t2")
+                ex.drive()
+        except il.DeadlockError as e:
+            assert "acquire" in str(e)
+            found += 1
+    assert found > 0, "no schedule drove the AB/BA window"
+
+
+def test_managed_thread_spawn_and_cooperative_join():
+    ex = il.Explorer(seed=3)
+    with ex.installed():
+        order = []
+        lock = threading.Lock()
+
+        def child():
+            with lock:
+                order.append("child")
+
+        def parent():
+            t = threading.Thread(target=child, daemon=True)
+            t.start()
+            t.join()
+            with lock:
+                order.append("parent")
+
+        ex.spawn(parent, name="parent")
+        ex.drive()
+        assert order == ["child", "parent"]
+
+
+def test_faults_fire_sites_are_yield_points():
+    ex = scn.run_schedule(scn.SCENARIOS["writers_vs_dispatch"], seed=0)
+    labels = {lbl for _, _, lbl in ex.trace}
+    assert any(lbl.startswith("fault:") for lbl in labels), labels
+
+
+def test_virtual_clock_advances_on_sleep_and_timeout():
+    ex = il.Explorer(seed=0)
+    with ex.installed():
+        import time
+
+        stamps = {}
+
+        def sleeper():
+            t0 = time.monotonic()
+            time.sleep(1.5)
+            stamps["dt"] = time.monotonic() - t0
+
+        ex.spawn(sleeper, name="sleeper")
+        ex.drive()
+    assert stamps["dt"] >= 1.5
+
+
+def test_mirror_metrics_reconciles_with_collectors():
+    from kubernetes_tpu.perf.collectors import MetricsCollector
+    from kubernetes_tpu.scheduler.metrics import Registry
+
+    # ensure at least one schedule has been counted in this session
+    scn.run_schedule(scn.SCENARIOS["subwave_vs_fencing"], seed=0)
+    reg = Registry()
+    il.mirror_metrics(reg, atomicity_findings=0)
+    assert reg.interleave_schedules_total.total >= 1
+    assert reg.interleave_yield_points.total >= 1
+    names = {
+        item["labels"]["Metric"] for item in MetricsCollector(reg).collect()
+    }
+    assert "scheduler_interleave_schedules_total" in names
+    assert "scheduler_interleave_yield_points" in names
+
+
+# -- scenario smoke (tier-1) -------------------------------------------------
+
+
+@pytest.mark.parametrize("name", sorted(scn.SCENARIOS))
+def test_scenario_smoke(name):
+    logging.disable(logging.ERROR)
+    try:
+        for seed in SMOKE_SEEDS:
+            scn.run_schedule(scn.SCENARIOS[name], seed)
+    finally:
+        logging.disable(logging.NOTSET)
+
+
+def test_scenario_replay_on_real_store():
+    a = scn.run_schedule(scn.SCENARIOS["writers_vs_dispatch"], seed=7)
+    b = scn.run_schedule(scn.SCENARIOS["writers_vs_dispatch"], seed=7)
+    assert a.trace == b.trace
+    assert a.steps == b.steps
+
+
+# -- regression pins ---------------------------------------------------------
+
+
+def test_fanout_poison_offer_expires_watcher_not_silent_loss():
+    """True positive pinned: a fail-grade fault inside Watch._offer used
+    to unwind the whole fan-out batch — every remaining watcher lost the
+    rest of the batch with NO Expired signal, so informer caches went
+    stale forever.  Post-fix the poisoned watcher expires (bookmark +
+    relist) and every schedule converges; pre-fix no seed here did."""
+    logging.disable(logging.ERROR)
+    try:
+        for seed in SMOKE_SEEDS:
+            ex = scn.run_schedule(
+                scn.SCENARIOS["writers_vs_dispatch_faulted"], seed
+            )
+            assert ex.steps > 0
+    finally:
+        logging.disable(logging.NOTSET)
+
+
+def test_fanout_poison_offer_direct_real_threads():
+    """The same pin without the explorer: real store, real fan-out
+    thread, one fail(watch.offer) — the watcher must EXPIRE, not stay
+    silently starved."""
+    import time
+
+    from kubernetes_tpu.api import store as st
+    from kubernetes_tpu.api import types as api
+
+    logging.disable(logging.ERROR)
+    try:
+        with faults.armed(faults.FaultRegistry(0).fail("watch.offer", n=1)):
+            store = st.Store(shards=1)
+            w = store.watch("Pod")
+            store.create(api.Pod(meta=api.ObjectMeta(name="p0")))
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                with w._mu:
+                    if w.expired:
+                        break
+                time.sleep(0.01)
+            with w._mu:
+                assert w.expired, (
+                    "poisoned offer neither delivered nor expired the "
+                    "watcher — silent event loss"
+                )
+    finally:
+        logging.disable(logging.NOTSET)
+
+
+def test_notify_consumed_by_timed_out_waiter_is_survivable():
+    """The explorer models CPython's lost-wakeup window (a notify landing
+    on a waiter that already timed out internally is WASTED).  A
+    predicate-loop consumer must survive it; this drives the window
+    explicitly across seeds."""
+    for seed in range(10):
+        ex = il.Explorer(seed=seed)
+        with ex.installed():
+            cv = threading.Condition()
+            box = {"ready": False, "woke": 0}
+
+            def producer():
+                with cv:
+                    box["ready"] = True
+                    cv.notify()  # may land on a timed-out waiter
+
+            def consumer():
+                with cv:
+                    while not box["ready"]:
+                        cv.wait(0.2)
+                box["woke"] += 1
+
+            ex.spawn(consumer, name="c1")
+            ex.spawn(consumer, name="c2")
+            ex.spawn(producer, name="p")
+            ex.drive()
+            assert box["woke"] == 2
+
+
+# -- deep sweeps (make race) -------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(scn.SCENARIOS))
+def test_scenario_deep_sweep(name):
+    """ISSUE acceptance: >= 200 distinct schedules per scenario with
+    every invariant oracle green (100 seeds x random/pct)."""
+    logging.disable(logging.ERROR)
+    try:
+        stats = scn.explore(
+            scn.SCENARIOS[name], seeds=range(100),
+            policies=("random", "pct"),
+        )
+    finally:
+        logging.disable(logging.NOTSET)
+    assert stats["schedules"] == 200
+    assert stats["yield_points"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(scn.SCENARIOS))
+def test_scenario_deep_seed_replay(name):
+    """Full seed-replay determinism on sampled seeds of every scenario:
+    same seed + policy => byte-identical schedule trace."""
+    logging.disable(logging.ERROR)
+    try:
+        for policy in ("random", "pct"):
+            for seed in (0, 13):
+                a = scn.run_schedule(
+                    scn.SCENARIOS[name], seed, policy=policy
+                )
+                b = scn.run_schedule(
+                    scn.SCENARIOS[name], seed, policy=policy
+                )
+                assert a.trace == b.trace, (
+                    f"{name} seed={seed} policy={policy} diverged"
+                )
+    finally:
+        logging.disable(logging.NOTSET)

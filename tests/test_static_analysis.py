@@ -5,12 +5,12 @@ Two layers:
   * fixture tests: per-checker good/bad snippets (constructed as
     in-memory SourceFiles) prove each pass flags seeded violations and
     stays quiet on conforming code;
-  * the real-tree gate: all five static passes run over the actual
+  * the real-tree gate: all six static passes run over the actual
     repository and must produce nothing beyond the reviewed baseline —
-    the tier-1 regression wire for lock discipline, hot-path purity,
-    registry consistency, lock ordering and tensor contracts.  (The
-    JAX-backed recompile-discipline pass has its own tier-1 gate in
-    tests/test_shapes.py.)
+    the tier-1 regression wire for lock discipline, lock atomicity,
+    hot-path purity, registry consistency, lock ordering and tensor
+    contracts.  (The JAX-backed recompile-discipline pass has its own
+    tier-1 gate in tests/test_shapes.py.)
 
 Plus the runtime lock-order tracker's inversion regression tests
 (analysis/runtime.py).
@@ -29,7 +29,7 @@ from kubernetes_tpu.analysis import (
     load_baseline,
     run_all,
 )
-from kubernetes_tpu.analysis import guarded, lockorder, purity, registry
+from kubernetes_tpu.analysis import atomicity, guarded, lockorder, purity, registry
 from kubernetes_tpu.analysis import runtime as rt
 from kubernetes_tpu.analysis import tensorcontract
 
@@ -441,6 +441,160 @@ def test_contract_parser_grammar():
     assert dtype == "int32" and axes == ()
     assert ct.parse_spec("[C, N] missing dtype") is None
     assert ct.parse_spec("f33[N]") is None
+
+
+# -- atomicity ---------------------------------------------------------------
+
+ATOMICITY_CTA = '''
+import threading
+
+class Q:
+    GUARDED_FIELDS = {"_items": "_lock", "_n": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._n = 0
+
+    def drain(self):
+        with self._lock:
+            pending = self._items
+        if pending:                  # check-then-act: finding
+            with self._lock:
+                self._items = []
+
+    def bump(self):
+        with self._lock:
+            n = self._n
+        with self._lock:
+            self._n = n + 1          # split-rmw: finding
+'''
+
+ATOMICITY_GOOD = '''
+import threading
+
+class Q:
+    GUARDED_FIELDS = {"_items": "_lock", "_n": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._n = 0
+
+    def same_section(self):
+        with self._lock:
+            n = self._n
+            if n > 0:                # same critical section: atomic
+                self._n = n - 1
+
+    def revalidated(self):
+        with self._lock:
+            n = self._n
+        with self._lock:
+            n = self._n              # re-captured under the lock
+            self._n = n + 1
+
+    def plain_read(self):
+        with self._lock:
+            n = self._n
+        return n                     # no branch/write-back: telemetry
+
+    def reviewed(self):
+        with self._lock:
+            n = self._n
+        if n:  # graftlint: disable=atomicity -- reviewed snapshot probe
+            return True
+        return False
+'''
+
+ATOMICITY_CV_BAD = '''
+import threading
+
+def pump(cv, backlog):
+    with cv:
+        if not backlog:
+            cv.wait(0.2)             # if-guarded wait: finding
+        if backlog:
+            return backlog.pop()
+'''
+
+ATOMICITY_CV_GOOD = '''
+import threading
+
+def pump(cv, backlog):
+    with cv:
+        while not backlog:
+            cv.wait(0.2)             # predicate loop: fine
+        return backlog.pop()
+
+def pump_forever(cv, backlog, out):
+    with cv:
+        while True:                  # while-True predicate loop: fine
+            if backlog:
+                out.append(backlog.pop())
+                continue
+            cv.wait(0.5)
+
+def event_style(stop):
+    stop.wait(1.0)                   # no enclosing `with stop:` — not a cv
+'''
+
+
+def test_atomicity_flags_check_then_act_and_split_rmw():
+    findings = atomicity.check([src("kubernetes_tpu/x.py", ATOMICITY_CTA)])
+    by_symbol = {}
+    for f in findings:
+        by_symbol.setdefault(f.symbol, []).append(f.message)
+    assert any(
+        "check-then-act" in m and "'pending'" in m and "'_items'" in m
+        for m in by_symbol.get("Q.drain", [])
+    ), findings
+    assert any(
+        "split read-modify-write" in m and "'n'" in m and "'_n'" in m
+        for m in by_symbol.get("Q.bump", [])
+    ), findings
+    assert len(findings) == 2
+
+
+def test_atomicity_quiet_on_conforming_code():
+    assert atomicity.check([src("kubernetes_tpu/x.py", ATOMICITY_GOOD)]) == []
+
+
+def test_atomicity_flags_cv_wait_without_predicate_loop():
+    findings = atomicity.check([src("kubernetes_tpu/x.py", ATOMICITY_CV_BAD)])
+    assert len(findings) == 1
+    assert "while-predicate loop" in findings[0].message
+    assert findings[0].symbol == "pump"
+
+
+def test_atomicity_quiet_on_predicate_loops():
+    assert atomicity.check(
+        [src("kubernetes_tpu/x.py", ATOMICITY_CV_GOOD)]
+    ) == []
+
+
+def test_atomicity_pins_the_dispatch_loop_shape():
+    """Regression pin for the true positive the pass found in
+    Store._watch_dispatch_loop: an if-guarded `shard._dispatch_cv.wait`
+    whose re-check lived in the NEXT outer-loop iteration (a fresh
+    acquisition).  The exact pre-fix shape must stay flagged."""
+    code = '''
+def _watch_dispatch_loop(store_ref, sid):
+    while True:
+        store = store_ref()
+        if store is None:
+            return
+        shard = store._shards[sid]
+        batch = None
+        with shard._dispatch_cv:
+            if not shard._dispatch_backlog:
+                shard._dispatch_cv.wait(0.2)
+            if shard._dispatch_backlog:
+                batch = shard._dispatch_backlog.popleft()
+'''
+    findings = atomicity.check([src("kubernetes_tpu/api/x.py", code)])
+    assert len(findings) == 1
+    assert "shard._dispatch_cv" in findings[0].message
 
 
 # -- lock-order (static) -----------------------------------------------------
