@@ -597,6 +597,232 @@ class _NullTrace:
         pass
 
 
+class _StubElector:
+    """Minimal leader elector for scenarios: always leading, fence
+    tokens pinned to one acquisition (identity A, generation 1) — a
+    rival transferring the Lease makes every later fenced commit
+    reject, without the real elector's renew thread."""
+
+    on_started_leading = None
+
+    def __init__(self, token):
+        self._token = token
+
+    def is_leader(self) -> bool:
+        return True
+
+    def fence_token(self):
+        return self._token
+
+
+class SpeculativeSolveVsCommit(Scenario):
+    """Lane A's SPECULATIVE solve over lane B's assumed placements vs.
+    lane B's wave commit vs. assume-TTL expiry vs. a leader fence —
+    the PR 12 speculative-overlap window.  Lane B assumes + stages a
+    bind wave; lane A records the wave-failure generation, reads the
+    snapshot lane B's assumes shaped (the encode analogue), and only
+    stages its own wave when the speculation still holds — a commit
+    failure or mid-wave fence (the rival's Lease transfer) must
+    invalidate lane A's batch and requeue it whole.  Oracles:
+    bound-exactly-once, no lost pod (bound or back in the queue),
+    assume set empty at quiesce, rv ring gapless, a fenced wave
+    commits nothing."""
+
+    name = "speculative_solve_vs_commit"
+
+    @staticmethod
+    def preload() -> None:
+        from ..api import store, types  # noqa: F401
+        from ..scheduler import scheduler  # noqa: F401
+
+    def setup(self, ex: Explorer) -> None:
+        from ..api import store as st
+        from ..api import types as api
+        from ..scheduler import scheduler as sched_mod
+
+        # 1-shard store: the commit pool (ThreadPoolExecutor) would
+        # real-block inside the window (same constraint as
+        # binder_crash_vs_salvage); streaming is exercised by the chaos
+        # seeds with real threads instead
+        self.store = st.Store(shards=1)
+        lease = api.Lease(
+            meta=api.ObjectMeta(name="scheduler", namespace="kube-system"),
+            spec=api.LeaseSpec(holder_identity="A", lease_transitions=1),
+        )
+        self.store.create(lease)
+        token = st.FenceToken(
+            name="scheduler", namespace="kube-system",
+            identity="A", generation=1,
+        )
+        self.sched = sched_mod.Scheduler(
+            self.store, clock=ex.clock, assume_ttl=0.001,
+            leader_elector=_StubElector(token),
+        )
+        self.cache = self.sched.cache
+        self.cache.add_node(
+            api.Node(
+                meta=api.ObjectMeta(name="n1", namespace=""),
+                status=api.NodeStatus(
+                    allocatable={"cpu": 64_000, "memory": 1 << 34, "pods": 110}
+                ),
+            )
+        )
+        fwk = self.sched.profiles.default
+        self.pods_b, self.pods_a = [], []
+        for i in range(2):
+            pod = api.Pod(meta=api.ObjectMeta(name=f"b{i}", namespace="d"))
+            pod.spec.priority = 10
+            self.store.create(pod)
+            self.sched.queue.add(pod)
+            self.pods_b.append(pod)
+        for i in range(2):
+            pod = api.Pod(meta=api.ObjectMeta(name=f"a{i}", namespace="d"))
+            pod.spec.scheduler_name = "lane-a"
+            self.store.create(pod)
+            self.sched.queue.add(pod)
+            self.pods_a.append(pod)
+        self.invalidated = False
+        self.a_observed_b_assumes = 0
+        self.lanes_done = 0
+        self.requeued: List[object] = []
+
+        def lane_b() -> None:
+            batch = self.sched.queue.pop_batch(
+                2, timeout=0, profiles={"default-scheduler"}
+            )
+            assert len(batch) == 2, "lane B lost its pods"
+            wave = []
+            for info in batch:
+                self.cache.assume(info.pod, "n1")
+                wave.append((fwk, info, "n1", ex.clock()))
+            self.sched._dispatch_wave_async(wave)
+            self.lanes_done += 1
+
+        def lane_a() -> None:
+            # the speculative dispatch: record the wave-failure
+            # generation, then "solve" over whatever lane B assumed
+            token = self.sched._spec_token()
+            with self.cache.lock:
+                self.a_observed_b_assumes = sum(
+                    1 for p in self.pods_b
+                    if self.cache.state.has_pod(p)
+                )
+            batch = self.sched.queue.pop_batch(
+                2, timeout=0, profiles={"lane-a"}
+            )
+            assert len(batch) == 2, "lane A lost its pods"
+            if self.sched._spec_invalidated(token):
+                # mis-speculation: requeue exactly this batch
+                self.invalidated = True
+                self.sched.metrics.misspeculation_total.inc()
+                for info in batch:
+                    self.sched.queue.requeue_backoff(info)
+                self.lanes_done += 1
+                return
+            wave = []
+            for info in batch:
+                self.cache.assume(info.pod, "n1")
+                wave.append((fwk, info, "n1", ex.clock()))
+            self.sched._dispatch_wave_async(wave)
+            self.lanes_done += 1
+
+        def rival() -> None:
+            cur = self.store.get("Lease", "scheduler", "kube-system")
+            cur.spec.holder_identity = "B"
+            cur.spec.lease_transitions = 2
+            self.store.update(cur)
+
+        def confirm_and_expire() -> None:
+            # informer-style confirm + the assume-TTL sweep: loop until
+            # every pod settled (bound-and-confirmed, or unbound and
+            # back in the queue) so the assume set provably drains
+            w = self.store.watch("Pod", from_rv=0)
+            while not self._settled():
+                ev = w.get(timeout=0.3)
+                if ev is not None and ev.obj.spec.node_name:
+                    self.cache.add_pod(ev.obj)
+                for pod in self.cache.cleanup_expired():
+                    self.requeued.append(pod)
+                    self.sched.queue.add(pod)
+            w.stop()
+
+        ex.spawn(lane_b, name="lane-b")
+        ex.spawn(lane_a, name="lane-a")
+        ex.spawn(rival, name="rival")
+        ex.spawn(confirm_and_expire, name="confirm")
+
+    def _settled(self) -> bool:
+        if self.lanes_done < 2 or self.sched._waves_in_flight():
+            return False
+        pods = store_pods(self.store)
+        for pod in self.pods_b + self.pods_a:
+            key = f"{pod.meta.namespace}/{pod.meta.name}"
+            cur = pods.get(key)
+            if cur is None:
+                return False
+            if cur.spec.node_name:
+                if self.cache.is_assumed(cur):
+                    return False  # confirm still pending
+            elif not self.sched.queue.contains(key):
+                return False  # neither bound nor requeued: in flight
+        return True
+
+    def quiesced(self) -> bool:
+        with self.sched._wave_cv:
+            drained = (
+                not self.sched._waves
+                and not self.sched._wave_active
+                and not self.sched._stream_inflight
+            )
+        return drained and _store_quiesced(self.store)
+
+    def check(self) -> None:
+        pods = store_pods(self.store)
+        fenced = self.store.fenced_writes_total
+        bound_b = [
+            bool(pods[f"d/{p.meta.name}"].spec.node_name)
+            for p in self.pods_b
+        ]
+        for pod in self.pods_b + self.pods_a:
+            cur = pods[f"d/{pod.meta.name}"]
+            node = cur.spec.node_name
+            assert node in (None, "", "n1"), (
+                f"{pod.meta.name} bound to an impossible node: {node}"
+            )
+            if not node:
+                # unbound at quiesce: must be back in the queue, never
+                # stranded inflight or assumed
+                key = f"d/{pod.meta.name}"
+                assert self.sched.queue.contains(key), (
+                    f"{key} lost: unbound and not requeued"
+                )
+        # a fenced wave commits nothing: fence hit => at least one
+        # whole wave's pods stayed unbound
+        if fenced:
+            assert not all(bound_b) or not all(
+                bool(pods[f"d/{p.meta.name}"].spec.node_name)
+                for p in self.pods_a
+            ), "Fenced raised but every wave committed"
+        # mis-speculation accounting: lane A invalidated => its pods
+        # requeued whole (none bound), and the failure generation moved
+        if self.invalidated:
+            assert self.sched._spec_token() >= 1
+            for p in self.pods_a:
+                assert not pods[f"d/{p.meta.name}"].spec.node_name, (
+                    "invalidated speculative batch still bound a pod"
+                )
+        # assume set empty at quiesce (confirmed, expired, or released)
+        assert self.cache.assumed_count() == 0, (
+            f"assume set not empty: {self.cache.assumed_nodes()}"
+        )
+        # rv ring gapless and monotonic across every commit path
+        rvs = [ev.rv for ev in self.store._buffer]
+        assert rvs == list(
+            range(1, self.store.resource_version + 1)
+        ), f"rv ring not gapless: {rvs}"
+        self.sched.stop()
+
+
 SCENARIOS: Dict[str, Type[Scenario]] = {
     cls.name: cls
     for cls in (
@@ -605,6 +831,7 @@ SCENARIOS: Dict[str, Type[Scenario]] = {
         SubwaveVsFencing,
         AssumeBridgeVsCommit,
         BinderCrashVsSalvage,
+        SpeculativeSolveVsCommit,
     )
 }
 

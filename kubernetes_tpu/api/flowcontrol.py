@@ -117,6 +117,50 @@ DEFAULT_SCHEMAS = [
     FlowSchema("catch-all", "catch-all"),
 ]
 
+_LEVEL_KEYS = {"seats", "queueLimit"}
+
+
+def levels_from_config(doc: dict) -> Dict[str, Tuple[int, int]]:
+    """Per-level seat/queue knobs from a config mapping — the
+    fleet-scale serving path's tuning surface (the seats were
+    compile-time constants before; thousands of informers through one
+    apiserver need per-deployment sizing).
+
+    Shape: ``{level: {"seats": int, "queueLimit": int}}``.  Levels merge
+    ONTO :data:`DEFAULT_LEVELS`, so a document tuning one level keeps
+    the defaults for the rest; new level names are allowed (schemas must
+    route to them explicitly).  Validated: unknown per-level keys are
+    rejected, ``seats`` must be >= 1 (a 0-seat level deadlocks every
+    request routed to it), ``queueLimit`` >= 0, and the ``catch-all``
+    level cannot be removed (classification falls back to it)."""
+    levels: Dict[str, Tuple[int, int]] = dict(DEFAULT_LEVELS)
+    for name, spec in (doc or {}).items():
+        if not isinstance(spec, dict):
+            raise ValueError(
+                f"apfLevels[{name!r}] must be a mapping with "
+                f"{sorted(_LEVEL_KEYS)}"
+            )
+        unknown = set(spec) - _LEVEL_KEYS
+        if unknown:
+            raise ValueError(
+                f"apfLevels[{name!r}]: unknown keys {sorted(unknown)} "
+                f"(known: {sorted(_LEVEL_KEYS)})"
+            )
+        cur = levels.get(name, (0, 0))
+        seats = int(spec.get("seats", cur[0]))
+        qlen = int(spec.get("queueLimit", cur[1]))
+        if seats < 1:
+            raise ValueError(
+                f"apfLevels[{name!r}]: seats must be >= 1 (a 0-seat "
+                "level rejects every request routed to it)"
+            )
+        if qlen < 0:
+            raise ValueError(f"apfLevels[{name!r}]: queueLimit must be >= 0")
+        levels[name] = (seats, qlen)
+    if "catch-all" not in levels:
+        raise ValueError("apfLevels must keep the catch-all level")
+    return levels
+
 
 class APFGate:
     """The filter the server calls around every request
@@ -134,6 +178,35 @@ class APFGate:
         }
         self.schemas = list(schemas or DEFAULT_SCHEMAS)
         self.queue_wait_s = queue_wait_s
+
+    @classmethod
+    def from_config(cls, source) -> "APFGate":
+        """Build a gate from a config document: a dict, a YAML string,
+        or a YAML file path.  Top-level keys: ``apfLevels`` (per-level
+        seat/queue knobs, see :func:`levels_from_config`) and
+        ``queueWaitSeconds``; unknown keys are rejected (the strict
+        decoding posture the scheduler config takes)."""
+        import os
+
+        if isinstance(source, dict):
+            doc = source
+        else:
+            import yaml
+
+            text = source
+            if isinstance(source, str) and os.path.exists(source):
+                with open(source) as f:
+                    text = f.read()
+            doc = yaml.safe_load(text) or {}
+        unknown = set(doc) - {"apfLevels", "queueWaitSeconds"}
+        if unknown:
+            raise ValueError(
+                f"unknown APF configuration fields: {sorted(unknown)}"
+            )
+        return cls(
+            levels=levels_from_config(doc.get("apfLevels")),
+            queue_wait_s=float(doc.get("queueWaitSeconds", 5.0)),
+        )
 
     def classify(self, subject: authmod.Subject, verb: str) -> PriorityLevel:
         for schema in self.schemas:

@@ -482,8 +482,15 @@ class APIServer:
         port: int = 0,
         authn=None,
         authz=None,
-        apf=None,  # Optional[flowcontrol.APFGate]; classify→queue→shed
+        apf=None,  # flowcontrol.APFGate, or an APF config dict/YAML/path
     ):
+        if apf is not None and not hasattr(apf, "acquire"):
+            # config-shaped apf (dict / YAML string / file path): the
+            # per-level seat knobs are deployment configuration, not
+            # code — build the gate here (flowcontrol.APFGate.from_config)
+            from . import flowcontrol
+
+            apf = flowcontrol.APFGate.from_config(apf)
         handler = type(
             "BoundHandler", (_Handler,),
             {"store": store, "authn": authn, "authz": authz, "apf": apf},

@@ -1499,6 +1499,7 @@ class Store:
         *,
         admit: bool = True,
         fence: Optional[FenceToken] = None,
+        shard_hint: Optional[int] = None,
     ) -> Tuple[List[str], Dict[str, Exception]]:
         """Commit a wave of read-modify-write updates as per-shard
         transactions.
@@ -1533,17 +1534,49 @@ class Store:
         late bind wave can never double-bind behind its successor's back
         (the etcd lease-ownership txn compare).  The fence is also
         pre-checked before the first sub-wave so an already-stale wave
-        commits nothing."""
+        commits nothing.
+
+        `shard_hint` is the STREAMED HAND-OFF fast path: a caller that
+        already partitioned its wave with ``shard_index`` (the binder's
+        per-shard sub-waves, streamed or pooled) names the owning shard
+        and the store verifies it with ONE hash per distinct namespace
+        instead of re-hashing every object.  A mismatched hint (a wave
+        that actually spans shards) falls back to the full partition —
+        misrouted records would split ownership silently, so the hint
+        is an optimization, never a trust boundary."""
         faults.fire("store.update_wave", kind=kind, updates=len(updates))
         applied: List[str] = []
         errors: Dict[str, Exception] = {}
         # partition by shard, preserving caller order within each shard
         groups: "OrderedDict[int, List[tuple]]" = OrderedDict()
-        for name, namespace, mutate in updates:
-            if kind in api.CLUSTER_SCOPED_KINDS:
-                namespace = ""
-            sid = self._hash_index(kind, namespace)
-            groups.setdefault(sid, []).append((name, namespace, mutate))
+        hinted = False
+        if (
+            shard_hint is not None
+            and 0 <= shard_hint < len(self._shards)
+            and updates
+        ):
+            hinted = True
+            memo: Dict[str, int] = {}
+            normalized: List[tuple] = []
+            for name, namespace, mutate in updates:
+                if kind in api.CLUSTER_SCOPED_KINDS:
+                    namespace = ""
+                sid = memo.get(namespace)
+                if sid is None:
+                    sid = memo[namespace] = self._hash_index(kind, namespace)
+                if sid != shard_hint:
+                    hinted = False
+                    break
+                normalized.append((name, namespace, mutate))
+            if hinted:
+                groups[shard_hint] = normalized
+        if not hinted:
+            groups.clear()
+            for name, namespace, mutate in updates:
+                if kind in api.CLUSTER_SCOPED_KINDS:
+                    namespace = ""
+                sid = self._hash_index(kind, namespace)
+                groups.setdefault(sid, []).append((name, namespace, mutate))
         with self._write_guard():
             if fence is not None:
                 # pre-flight: a wave staged by an already-deposed leader

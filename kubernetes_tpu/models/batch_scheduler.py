@@ -146,6 +146,62 @@ class SolveCircuitBreaker:
             self._open_until = 0.0
 
 
+class DispatchArbiter:
+    """Device-admission control for concurrent profile LANES sharing one
+    device/mesh (docs/scheduler_loop.md, pipelined multi-lane cycle).
+
+    Each lane runs its own pop→encode→solve pipeline; encodes already
+    serialize under the scheduler-cache lock, but device DISPATCH must
+    be arbitrated: the arbiter bounds in-flight device solves to `depth`
+    (default 2 — double-buffering: lane A's batch N+1 dispatches while
+    batch N reads back, and a third program can't pile onto the device
+    queue ahead of another lane's turn).  A slot is released by
+    DeviceSolve's coalesced decode (or an explicit release on the
+    mis-speculation invalidation path).
+
+    The wait is deadline-bounded as a safety valve: a leaked slot (a
+    caller that dispatched and never decoded) degrades fairness, never
+    wedges a lane — forced admissions are counted in `forced`."""
+
+    GUARDED_FIELDS = {"_inflight": "_cv", "acquires": "_cv", "forced": "_cv"}
+
+    def __init__(self, depth: int = 2, timeout: float = 30.0,
+                 clock=time.monotonic):
+        self.depth = max(int(depth), 1)
+        self.timeout = timeout
+        self._clock = clock
+        self._cv = threading.Condition()
+        self._inflight = 0
+        self.acquires = 0
+        self.forced = 0
+
+    def acquire(self) -> bool:  # graftlint: disable=purity -- lane admission: the slot wait IS the arbitration; uncontended cost is one mutex acquire
+        """Take a dispatch slot; False means the deadline expired and
+        admission was forced (the safety valve, not the normal path)."""
+        with self._cv:
+            self.acquires += 1
+            deadline = self._clock() + self.timeout
+            while self._inflight >= self.depth:
+                remaining = deadline - self._clock()
+                if remaining <= 0:
+                    self.forced += 1
+                    self._inflight += 1
+                    return False
+                self._cv.wait(min(remaining, 0.2))
+            self._inflight += 1
+            return True
+
+    def release(self) -> None:  # graftlint: disable=purity -- slot return; reached from the decode path, not between dispatch and readback
+        with self._cv:
+            if self._inflight > 0:
+                self._inflight -= 1
+            self._cv.notify_all()
+
+    def inflight(self) -> int:
+        with self._cv:
+            return self._inflight
+
+
 class HostSolve:
     """A completed host-fallback solve quacking like DeviceSolve: names
     are already materialized, there is no device future to read back and
@@ -172,6 +228,9 @@ class HostSolve:
 
     def reasons(self) -> Optional[List[int]]:
         return None
+
+    def release_slot(self) -> None:
+        """No-op: the host fallback never held a dispatch slot."""
 
 
 _FILL_CACHE_MAX = 64  # entries; shape buckets churn as the cluster grows —
@@ -364,6 +423,10 @@ class DeviceSolve:
         self._clock = clock
         self.dispatched_at = clock()
         self._decoded = None
+        # DispatchArbiter slot held for this in-flight solve (multi-lane
+        # admission); released by the coalesced decode, or explicitly by
+        # the mis-speculation invalidation path (which never decodes)
+        self._slot: Optional[DispatchArbiter] = None
         # step wall split, filled by schedule_pending_async / _decode
         self.encode_s = 0.0        # snapshot encode (under the cache lock)
         self.dispatch_s = 0.0      # jit trace/compile + dispatch enqueue
@@ -381,6 +444,13 @@ class DeviceSolve:
         except AttributeError:  # host numpy result (raw-kernel callers)
             return True
 
+    def release_slot(self) -> None:
+        """Give the dispatch-arbiter slot back (idempotent).  Runs from
+        the decode's finally and from the invalidation path."""
+        slot, self._slot = self._slot, None
+        if slot is not None:
+            slot.release()
+
     def _decode(self):
         if self._decoded is None:
             t0 = self._clock()
@@ -392,7 +462,12 @@ class DeviceSolve:
                 "wave_count": getattr(self.result, "wave_count", None),
                 "wave_fallbacks": getattr(self.result, "wave_fallbacks", None),
             }
-            got = jax.device_get(tree)  # one coalesced readback
+            try:
+                got = jax.device_get(tree)  # one coalesced readback
+            finally:
+                # the device finished (or failed) this program — the
+                # next lane's dispatch may proceed either way
+                self.release_slot()
             self.decode_wait_s = self._clock() - t0
             assignment = np.asarray(got["assignment"])
             # health check (the circuit breaker's non-finite-score trip
@@ -573,6 +648,7 @@ class TPUBatchScheduler:
         use_wavefront: bool = True,  # wave-parallel greedy feature gate
         wave_cap: int = assign_ops.DEFAULT_WAVE_CAP,
         prewarm: Optional[bool] = None,  # None = auto (off on CPU backend)
+        arbiter: Optional[DispatchArbiter] = None,  # shared across lanes
     ):
         if state is not None:
             # shared-state instance: multiple scheduler PROFILES solve the
@@ -634,6 +710,11 @@ class TPUBatchScheduler:
         self.sharded_fallbacks = 0
         self._mirror = DeviceClusterMirror(self.state, mesh=mesh)
         self.use_mirror = use_mirror
+        # multi-lane device admission: profile lanes sharing one
+        # device/mesh pass ONE DispatchArbiter (FrameworkRegistry wires
+        # it for multi-profile configs); None = uncontended single lane,
+        # no admission overhead on the dispatch path
+        self.arbiter = arbiter
         # device-solve circuit breaker: XLA runtime/compile errors and
         # non-finite score tensors retry once, then trip every batch to
         # the host-side per-pod exact-evaluation fallback for a cooldown
@@ -1041,7 +1122,17 @@ class TPUBatchScheduler:
         (DeviceSolve) and the readback happens on first names()/reasons()
         access — callers overlap it with host work."""
         act = faults.fire("batch.solve", pods=meta.num_pods)
-        result = self._dispatch(snap, meta)
+        slot = self.arbiter
+        if slot is not None:
+            # multi-lane admission: at most `depth` device programs in
+            # flight across every profile lane sharing this device
+            slot.acquire()  # graftlint: disable=purity -- lane admission gate BEFORE dispatch, never between dispatch and readback; single-lane configs pass arbiter=None and skip it
+        try:
+            result = self._dispatch(snap, meta)
+        except BaseException:
+            if slot is not None:
+                slot.release()
+            raise
         if act == faults.CORRUPT and getattr(result, "scores", None) is not None:
             # injected device corruption: poison the score tensor so the
             # decode-side health check (SolveUnhealthy) trips
@@ -1049,7 +1140,9 @@ class TPUBatchScheduler:
                 scores=jnp.full_like(result.scores, jnp.nan)
             )
         self.last_result = result
-        return DeviceSolve(result, meta)
+        ds = DeviceSolve(result, meta)
+        ds._slot = slot
+        return ds
 
     def solve_encoded(
         self, snap: schema.Snapshot, meta: schema.SnapshotMeta

@@ -183,6 +183,33 @@ class DeviceClusterMirror:
             "delta_syncs": self.delta_syncs,
         }
 
+    def speculation_point(self) -> tuple:
+        """Bookmark the resident buffer for a SPECULATIVE encode: the
+        current device tensors + generations.  Device arrays are
+        immutable, so holding the reference IS the double buffer — a
+        later sync() scatters into fresh arrays while any in-flight
+        solve keeps reading the bookmarked ones.  Caller holds the
+        cache lock (same contract as sync())."""
+        return (
+            self._dev, self._synced_gen, self._struct_gen, self._shape,
+            self._resident_sharded,
+        )
+
+    def rollback(self, point: tuple) -> None:
+        """Restore the resident buffer to a speculation_point() bookmark
+        — the speculative batch was invalidated (the wave it solved over
+        failed or was fenced), so the deltas synced for it are dropped
+        whole instead of layering the forget-restore scatters on top.
+        Always safe: ClusterState.dirty_rows(synced_gen) covers EVERY
+        row dirtied since the bookmarked generation, so the next sync()
+        re-scatters anything the dropped buffer carried (or performs a
+        full upload when the struct generation moved past the
+        bookmark).  Caller holds the cache lock."""
+        (
+            self._dev, self._synced_gen, self._struct_gen, self._shape,
+            self._resident_sharded,
+        ) = point
+
     def invalidate(self) -> None:
         """Drop the resident copy so the next sync() performs a full
         (RESHARDED, under a mesh) re-upload.  Leadership reconciliation

@@ -188,6 +188,9 @@ class MetricsCollector:
         "scheduler_preemption_victims",
         # failed pods sharing one batched preemption dry-run
         "scheduler_preemption_batch_size_pods",
+        # commit lead (ms) each streamed sub-wave gained over the
+        # whole-wave hand-off (docs/scheduler_loop.md multi-lane cycle)
+        "scheduler_subwave_stream_lead_ms",
     )
 
     # breaker / supervision / journal-recovery scalars (gauges and
@@ -234,6 +237,12 @@ class MetricsCollector:
         # PDB-blocked candidate rankings (docs/scheduler_loop.md)
         "scheduler_preemption_conflict_serializations_total",
         "scheduler_preemption_pdb_blocked_total",
+        # pipelined multi-lane cycle: concurrent profile lanes,
+        # speculative dispatches and invalidated speculations
+        # (docs/scheduler_loop.md)
+        "scheduler_lane_count",
+        "scheduler_speculative_solves_total",
+        "scheduler_misspeculation_total",
         # graftsched: interleaving schedules explored / yield points
         # scheduled (analysis/interleave.py) and static atomicity
         # findings at the last mirrored run (docs/static_analysis.md)

@@ -92,6 +92,27 @@ class SchedulerConfiguration:
     # serializes sub-waves; the effective width is min(this, store
     # shards).
     commit_subwave_concurrency: int = 4
+    # Pipelined multi-lane scheduling (docs/scheduler_loop.md):
+    # scheduler_lanes caps the number of concurrent profile lanes — each
+    # lane runs its own pop→encode→solve pipeline over its profiles'
+    # disjoint pod classes, sharing one device/mesh through the dispatch
+    # arbiter.  0 = auto (one lane per configured profile); 1 pins the
+    # serial single-thread loop regardless of profile count.
+    scheduler_lanes: int = 0
+    # Speculative solve overlap: batch N+1's encode/solve runs against
+    # batch N's ASSUMED placements while N's wave is still committing
+    # (the PR 1 assume-cache bridge extended across the commit seam).  A
+    # commit failure / fence after the speculative dispatch invalidates
+    # the in-flight batch — it requeues with backoff and counts into
+    # scheduler_misspeculation_total.  False serializes strictly: a new
+    # batch dispatches only once every staged wave has committed.
+    speculative_solve: bool = True
+    # Streamed sub-wave commits: staged placements are handed to the
+    # commit pool per STORE SHARD as each shard's slice of the wave is
+    # decoded+staged, instead of after the whole wave stages — shard A's
+    # commit overlaps shard B's staging and the next solve.  Requires a
+    # multi-shard store (a 1-shard store keeps the whole-wave path).
+    stream_subwaves: bool = True
     # parity-only knobs (see module docstring)
     parallelism: int = 16
     percentage_of_nodes_to_score: int = 100
@@ -175,6 +196,10 @@ class SchedulerConfiguration:
             raise ValueError("max_preemptions_per_cycle must be >= 0")
         if self.commit_subwave_concurrency < 1:
             raise ValueError("commit_subwave_concurrency must be >= 1")
+        if self.scheduler_lanes < 0:
+            raise ValueError(
+                "scheduler_lanes must be >= 0 (0 = one lane per profile)"
+            )
         if self.mesh_devices < 0:
             raise ValueError("mesh_devices must be >= 0")
         if self.mesh_devices and (
@@ -206,6 +231,7 @@ _TOP_KEYS = {
     "unschedulableFlushSeconds", "maxPreemptionsPerCycle",
     "adaptiveBatchWindow", "batchWindowMinSeconds", "batchWindowMaxSeconds",
     "batchLatencySLOSeconds", "meshDevices", "commitSubwaveConcurrency",
+    "schedulerLanes", "speculativeSolve", "streamSubwaves",
 }
 
 
@@ -268,6 +294,12 @@ def load_config(source: Any) -> SchedulerConfiguration:
         cfg.mesh_devices = int(doc["meshDevices"])
     if "commitSubwaveConcurrency" in doc:
         cfg.commit_subwave_concurrency = int(doc["commitSubwaveConcurrency"])
+    if "schedulerLanes" in doc:
+        cfg.scheduler_lanes = int(doc["schedulerLanes"])
+    if "speculativeSolve" in doc:
+        cfg.speculative_solve = bool(doc["speculativeSolve"])
+    if "streamSubwaves" in doc:
+        cfg.stream_subwaves = bool(doc["streamSubwaves"])
     if "featureGates" in doc:
         cfg.feature_gates = {
             str(k): bool(v) for k, v in (doc["featureGates"] or {}).items()

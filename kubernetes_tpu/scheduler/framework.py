@@ -207,6 +207,15 @@ class FrameworkRegistry:
             mesh = make_mesh(config.mesh_devices)
         first: Optional[TPUBatchScheduler] = None
         self.frameworks: Dict[str, Framework] = {}
+        # multi-profile configs run concurrent LANES sharing one
+        # device/mesh: one dispatch arbiter admits their device programs
+        # (double-buffer depth, FIFO-ish fairness).  A single profile
+        # has no contention and pays nothing.
+        from ..models.batch_scheduler import DispatchArbiter
+
+        self.arbiter = (
+            DispatchArbiter() if len(config.profiles) > 1 else None
+        )
         for profile in config.profiles:
             tpu = TPUBatchScheduler(
                 score_config=profile.effective_score_config(),
@@ -215,6 +224,7 @@ class FrameworkRegistry:
                 mode=mode,
                 use_mirror=use_mirror,
                 mesh=mesh,
+                arbiter=self.arbiter,
             )
             if first is None:
                 first = tpu

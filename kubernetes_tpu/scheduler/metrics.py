@@ -350,6 +350,24 @@ class Registry:
         self.preemption_pdb_blocked_total = Counter(
             "scheduler_preemption_pdb_blocked_total"
         )
+        # -- pipelined multi-lane surface (docs/scheduler_loop.md) ---------
+        # concurrent profile lanes in force (1 = the serial loop)
+        self.lane_count = Gauge("scheduler_lane_count")
+        # batches dispatched SPECULATIVELY — encode/solve run while an
+        # earlier wave was still committing, over its assumed placements
+        self.speculative_solves_total = Counter(
+            "scheduler_speculative_solves_total"
+        )
+        # speculative batches invalidated (a wave they solved over
+        # failed/was fenced after their dispatch) and requeued whole
+        self.misspeculation_total = Counter("scheduler_misspeculation_total")
+        # per streamed sub-wave: milliseconds between its hand-off to
+        # the commit pool and the completion of the whole group's
+        # staging — the commit lead streaming bought that sub-wave
+        self.subwave_stream_lead_ms = Histogram(
+            "scheduler_subwave_stream_lead_ms",
+            buckets=tuple(0.1 * 2 ** i for i in range(15)),
+        )
         # -- graftsched surface (docs/static_analysis.md) ------------------
         # deterministic interleaving schedules explored and yield points
         # scheduled across them (analysis/interleave.py TOTALS, mirrored

@@ -203,6 +203,8 @@ class SchedulingQueue:
     # (producer handlers, pop_batch, and the wake paths race otherwise)
     GUARDED_FIELDS = {
         "_active": "_cond",
+        "_class_rr": "_cond",
+        "_rr_offset": "_cond",
         "_backoff": "_cond",
         "_unschedulable": "_cond",
         "_gated": "_cond",
@@ -249,7 +251,16 @@ class SchedulingQueue:
         self._window_ctl = window_ctl
         self._cond = threading.Condition()
         self._seq = itertools.count()
-        self._active: List[tuple] = []           # (-prio, ts, seq, key)
+        # The active tier is split into one queuesort heap PER PROFILE
+        # CLASS (pod.spec.scheduler_name): pop_batch serves the classes
+        # deficit-round-robin so one hot profile's arrival stream can
+        # never starve another profile's lane, and a profile lane can
+        # pop only its own class (`profiles=`).  A single-class queue
+        # (the default profile) degenerates to exactly the old global
+        # heap — pop order is bit-identical.
+        self._active: Dict[str, List[tuple]] = {}  # class -> (-prio, ts, seq, key)
+        self._class_rr: List[str] = []           # class round-robin order
+        self._rr_offset = 0                      # rotation cursor
         self._backoff: List[tuple] = []          # (ready, seq, key)
         self._unschedulable: Dict[str, QueuedPodInfo] = {}
         self._gated: Dict[str, QueuedPodInfo] = {}
@@ -283,10 +294,19 @@ class SchedulingQueue:
 
     # -- helpers -----------------------------------------------------------
 
+    @staticmethod
+    def _class_of(pod: api.Pod) -> str:
+        return pod.spec.scheduler_name or ""
+
     def _push_active(self, info: QueuedPodInfo) -> None:
         key = pod_key(info.pod)
+        cls = self._class_of(info.pod)
+        heap = self._active.get(cls)
+        if heap is None:
+            heap = self._active[cls] = []
+            self._class_rr.append(cls)
         heapq.heappush(
-            self._active,
+            heap,
             (-info.pod.spec.priority, info.timestamp, next(self._seq), key),
         )
         self._tier[key] = "active"
@@ -500,6 +520,7 @@ class SchedulingQueue:
         max_n: int,
         timeout: Optional[float] = None,
         window: Optional[float] = None,
+        profiles: Optional[set] = None,
     ) -> List[QueuedPodInfo]:
         """Drain up to max_n pods in queuesort order; blocks until at
         least one is available (or timeout).  Popped pods are 'inflight'
@@ -519,7 +540,17 @@ class SchedulingQueue:
         accumulation window: with at least one pod in hand but fewer than
         max_n, the pop keeps collecting arrivals for up to `window`
         seconds before returning.  Never exceeds `timeout` — a timeout=0
-        (non-blocking) pop stays non-blocking."""
+        (non-blocking) pop stays non-blocking.
+
+        `profiles` restricts the pop to those profile classes
+        (pod.spec.scheduler_name) — a profile LANE pops only its own
+        disjoint pod class.  None pops every class, serving classes
+        deficit-round-robin: each rotation takes one pod (or one whole
+        gang) per class, so a 10:1 arrival skew between two profiles
+        still drains both — one hot class cannot starve another lane's
+        pods out of the batch (queuesort order is preserved WITHIN each
+        class; a single-class queue pops in exactly the old global
+        order)."""
         deadline = None if timeout is None else self._clock() + timeout
         if window is None:
             if self._window_ctl is not None:
@@ -545,10 +576,12 @@ class SchedulingQueue:
                 batch.append(info)
                 return info
 
-            def collect() -> None:
-                skipped: Dict[str, QueuedPodInfo] = {}
-                while self._active and len(batch) < max_n:
-                    _, _, _, key = heapq.heappop(self._active)
+            def take_one(cls: str, skipped: Dict[str, QueuedPodInfo]) -> bool:
+                """Take one pod (or one whole gang) from a class heap.
+                Returns False when the class has nothing pullable."""
+                heap = self._active.get(cls)
+                while heap:
+                    _, _, _, key = heapq.heappop(heap)
                     info = self._infos.get(key)
                     if (
                         info is None
@@ -559,7 +592,7 @@ class SchedulingQueue:
                     group = gang_key(info.pod)
                     if not group:
                         take(key)
-                        continue
+                        return True
                     # the popped key rides along even if registration was
                     # somehow missed — a popped-but-untaken pod would
                     # otherwise strand in tier 'active' with no heap entry
@@ -571,6 +604,33 @@ class SchedulingQueue:
                         continue
                     for k in members:
                         take(k)
+                    return True
+                return False
+
+            def collect() -> None:
+                skipped: Dict[str, QueuedPodInfo] = {}
+                classes = [
+                    c for c in self._class_rr
+                    if profiles is None or c in profiles
+                ]
+                n_cls = len(classes)
+                if n_cls:
+                    # deficit round-robin across profile classes: one
+                    # pod (or gang) per class per rotation, starting at
+                    # the rotating cursor so successive pops don't
+                    # favor the same class's head-of-line
+                    start = self._rr_offset % n_cls
+                    exhausted: set = set()
+                    while len(batch) < max_n and len(exhausted) < n_cls:
+                        for j in range(n_cls):
+                            cls = classes[(start + j) % n_cls]
+                            if cls in exhausted:
+                                continue
+                            if not take_one(cls, skipped):
+                                exhausted.add(cls)
+                            if len(batch) >= max_n:
+                                break
+                    self._rr_offset += 1
                 for info in skipped.values():
                     self._push_active(info)
 

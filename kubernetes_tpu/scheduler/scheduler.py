@@ -148,7 +148,8 @@ class _Cycle:
     the 'inflight' tier (Scheduler._salvage_cycle)."""
 
     __slots__ = ("stats", "trace", "reservations", "failed", "wave",
-                 "pending", "solved_any", "batch", "handled")
+                 "pending", "solved_any", "batch", "handled",
+                 "spec_token", "mirror_points")
 
     def __init__(self, stats, trace, reservations, batch):
         self.stats = stats
@@ -160,6 +161,11 @@ class _Cycle:
         self.solved_any = False
         self.batch: List[QueuedPodInfo] = batch
         self.handled: set = set()
+        # speculative dispatch: the wave-failure generation this cycle's
+        # solves were dispatched under (None = not speculative), plus
+        # per-profile mirror bookmarks for the invalidation rollback
+        self.spec_token = None
+        self.mirror_points: Dict[str, tuple] = {}
 
 
 _REASON_TEXT = {
@@ -180,8 +186,11 @@ class Scheduler:
         "_waves": "_wave_cv",
         "_wave_active": "_wave_cv",
         "_binder_stop": "_wave_cv",
+        "_stream_inflight": "_wave_cv",
         "_solve_windows": "_solve_lock",
         "_solve_open": "_solve_lock",
+        "_wave_fail_gen": "_spec_lock",
+        "_inflight_cycles": "_inflight_lock",
     }
 
     def __init__(
@@ -323,6 +332,37 @@ class Scheduler:
         self._clock = clock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # -- pipelined multi-lane scheduling ------------------------------
+        # each lane runs its own pop→encode→solve pipeline over its
+        # profiles' disjoint pod classes (docs/scheduler_loop.md); lane 0
+        # is the LEAD lane (leadership reconcile, assume-TTL sweeps,
+        # cross-cutting metric mirrors).  scheduler_lanes=0 auto-sizes to
+        # one lane per profile; a single profile keeps the serial loop.
+        names = list(self.profiles.frameworks)
+        lanes_cfg = self.config.scheduler_lanes
+        n_lanes = len(names) if lanes_cfg == 0 else min(lanes_cfg, len(names))
+        n_lanes = max(n_lanes, 1)
+        if n_lanes > 1:
+            self._lane_profiles: List[Optional[set]] = [
+                set(names[i::n_lanes]) for i in range(n_lanes)
+            ]
+        else:
+            self._lane_profiles = [None]  # one lane pops every class
+        self._lane_threads: List[threading.Thread] = []
+        self.metrics.lane_count.set(float(n_lanes))
+        # per-scheduling-thread in-flight cycle (lanes + direct
+        # schedule_batch callers salvage their OWN cycle on faults)
+        self._inflight_lock = threading.Lock()
+        self._inflight_cycles: Dict[int, "_Cycle"] = {}
+        # speculative solve overlap: batches dispatched while a wave is
+        # still committing record the wave-failure generation; a commit
+        # failure/fence bumps it and invalidates the speculation
+        self._speculation_enabled = self.config.speculative_solve
+        self._spec_lock = threading.Lock()
+        self._wave_fail_gen = 0
+        # PostFilter preemption shares one evaluator: concurrent lanes
+        # serialize their passes (preemption is background work)
+        self._postfilter_lock = threading.Lock()
         # -- binding stage (the async binding cycle) ----------------------
         # schedule_batch stages placements (assume + Permit) and hands the
         # bind tail to this worker as a wave; the next cycle's pop/solve
@@ -334,9 +374,6 @@ class Scheduler:
         self._wave_active = False
         self._binder_stop = False
         self._max_wave_backlog = 2
-        # the cycle currently mid-dispatch/finalize: _salvage_cycle reads
-        # it when a cycle dies so popped pods never strand inflight
-        self._inflight_cycle: Optional[_Cycle] = None
         # device-solve intervals, for the pipeline-overlap metric (the
         # binder reads them to attribute its commit time)
         self._solve_lock = threading.Lock()
@@ -359,6 +396,15 @@ class Scheduler:
             if subwave_width > 1
             else None
         )
+        self._subwave_width = subwave_width
+        # streamed sub-wave commits: staging hands each store shard's
+        # slice of a wave to the commit pool AS IT STAGES, instead of
+        # dispatching the whole wave after the full readback; bounded by
+        # 2x the pool width (backpressure on the solve stage)
+        self._stream_enabled = (
+            self.config.stream_subwaves and self._commit_pool is not None
+        )
+        self._stream_inflight = 0
         self._bind_thread = threading.Thread(
             target=self._bind_worker, name="bind-wave", daemon=True
         )
@@ -476,9 +522,20 @@ class Scheduler:
         self.informers.informer("DeviceClass").start()
         self.informers.wait_for_sync()
         self._thread = threading.Thread(
-            target=self._run, name="scheduler", daemon=True
+            target=self._run, args=(0,), name="scheduler", daemon=True
         )
         self._thread.start()
+        # additional profile lanes (multi-profile configs): each pops
+        # and solves its own pod classes concurrently
+        self._lane_threads = [
+            threading.Thread(
+                target=self._run, args=(i,), name=f"scheduler-lane{i}",
+                daemon=True,
+            )
+            for i in range(1, len(self._lane_profiles))
+        ]
+        for t in self._lane_threads:
+            t.start()
 
     def stop(self) -> None:
         self._stop.set()
@@ -488,6 +545,8 @@ class Scheduler:
             # the interpreter down under an XLA compile aborts the process,
             # so wait the compile out
             self._thread.join(timeout=120)
+        for t in self._lane_threads:
+            t.join(timeout=120)
         # drain the binding stage: staged placements are assumed in the
         # cache, so dropping their waves would leak phantom usage until
         # the assume TTL fires
@@ -516,6 +575,8 @@ class Scheduler:
             self._wave_cv.notify_all()
         if self._thread:
             self._thread.join(timeout=10)
+        for t in self._lane_threads:
+            t.join(timeout=10)
         self._bind_thread.join(timeout=5)
         if self._commit_pool is not None:
             self._commit_pool.shutdown(wait=False)
@@ -719,7 +780,9 @@ class Scheduler:
                 # atomicity cv-discipline); breaks out to re-run the
                 # binder watchdog when the worker died mid-drain — a
                 # dead worker can never notify this cv again
-                while self._waves or self._wave_active:
+                while (
+                    self._waves or self._wave_active or self._stream_inflight
+                ):
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         return False
@@ -728,6 +791,96 @@ class Scheduler:
                         break
                 else:
                     return True
+
+    # -- per-thread in-flight cycle tracking ------------------------------
+
+    def _inflight_set(self, cycle: Optional["_Cycle"]) -> None:
+        ident = threading.get_ident()
+        with self._inflight_lock:
+            if cycle is None:
+                self._inflight_cycles.pop(ident, None)
+            else:
+                self._inflight_cycles[ident] = cycle
+
+    def _inflight_get(self) -> Optional["_Cycle"]:
+        with self._inflight_lock:
+            return self._inflight_cycles.get(threading.get_ident())
+
+    # -- speculative solve overlap ----------------------------------------
+
+    def _spec_token(self) -> int:
+        """The wave-failure generation a speculative dispatch records;
+        any commit failure / fence bumps it (see _note_commit_failure)."""
+        with self._spec_lock:
+            return self._wave_fail_gen
+
+    def _spec_invalidated(self, token: int) -> bool:
+        with self._spec_lock:
+            return self._wave_fail_gen != token
+
+    def _note_commit_failure(self) -> None:
+        """A staged placement was released on the commit side (failed
+        sub-wave, fenced wave, PreBind error): any batch dispatched
+        speculatively over the released assumes must invalidate."""
+        with self._spec_lock:
+            self._wave_fail_gen += 1
+
+    def _waves_in_flight(self) -> bool:
+        with self._wave_cv:
+            return bool(
+                self._waves or self._wave_active or self._stream_inflight
+            )
+
+    # -- streamed sub-wave commits ----------------------------------------
+
+    def _dispatch_subwave_async(self, entries: List[tuple], sid: int) -> None:
+        """Hand one store shard's staged slice of a wave to the commit
+        pool immediately (before the rest of the wave stages).  Bounded
+        by 2x the pool width so a slow store backpressures the solve
+        stage instead of growing an unbounded in-flight set."""
+        faults.fire("binder.stream_subwave", pods=len(entries), shard=sid)
+        cap = 2 * self._subwave_width
+        with self._wave_cv:
+            while self._stream_inflight >= cap and not self._binder_stop:
+                self._wave_cv.wait(0.2)
+            self._stream_inflight += 1
+            self._wave_cv.notify_all()
+        try:
+            self._commit_pool.submit(self._commit_stream_subwave, entries)
+        except BaseException:
+            with self._wave_cv:
+                self._stream_inflight -= 1
+                self._wave_cv.notify_all()
+            raise
+
+    def _commit_stream_subwave(self, entries: List[tuple]) -> None:
+        """One streamed per-shard sub-wave on the commit pool.  The
+        wave-retry/poison machinery stays with the whole-wave binder
+        path; a streamed sub-wave commits once and a whole-sub-wave
+        fault requeues its pods with backoff (bound-exactly-once per
+        sub-wave holds: the mutator's already-bound guard plus fencing
+        reject any duplicate commit)."""
+        try:
+            self._commit_wave(entries)
+        except BaseException:  # noqa: BLE001 — crash-grade containment:
+            # the pool thread must survive and the pods must not strand
+            # on the assume TTL
+            logging.getLogger(__name__).exception(
+                "streamed sub-wave commit failed; requeueing %d pod(s)",
+                len(entries),
+            )
+            for fwk, info, _, _ in entries:
+                try:
+                    self._fail_bind(fwk, info)
+                except Exception:  # noqa: BLE001
+                    logging.getLogger(__name__).exception(
+                        "streamed sub-wave requeue failed for %s",
+                        pod_key(info.pod),
+                    )
+        finally:
+            with self._wave_cv:
+                self._stream_inflight -= 1
+                self._wave_cv.notify_all()
 
     def _solve_window(self, start: float, end: float) -> None:
         with self._solve_lock:
@@ -824,7 +977,7 @@ class Scheduler:
             )
             groups.setdefault(sid, []).append(entry)
 
-        def commit_group(group):
+        def commit_group(sid, group):
             updates = [
                 (info.pod.meta.name, info.pod.meta.namespace,
                  bind_mutator(node_name))
@@ -832,9 +985,13 @@ class Scheduler:
             ]
             t_g = self._clock()
             try:
-                _, errs = self.store.update_wave(
-                    "Pod", updates, fence=fence
-                )
+                # the binder already partitioned by shard_index: the
+                # shard hint lets the store skip re-hashing every pod
+                # (the streamed hand-off fast path)
+                kwargs = {"fence": fence}
+                if shard_of is not None:
+                    kwargs["shard_hint"] = sid
+                _, errs = self.store.update_wave("Pod", updates, **kwargs)
                 bad = set(errs)
             except st.Fenced:
                 logging.getLogger(__name__).warning(
@@ -855,16 +1012,16 @@ class Scheduler:
         t_all = self._clock()
         if len(groups) > 1 and self._commit_pool is not None:
             futures = [
-                self._commit_pool.submit(commit_group, g)
-                for g in groups.values()
+                self._commit_pool.submit(commit_group, sid, g)
+                for sid, g in groups.items()
             ]
             for f in futures:
                 bad, dt = f.result()
                 failed |= bad
                 durations.append(dt)
         else:
-            for g in groups.values():
-                bad, dt = commit_group(g)
+            for sid, g in groups.items():
+                bad, dt = commit_group(sid, g)
                 failed |= bad
                 durations.append(dt)
         wall = self._clock() - t_all
@@ -879,7 +1036,10 @@ class Scheduler:
 
     def _fail_bind(self, fwk: Framework, info: QueuedPodInfo) -> None:
         """The binding stage's per-pod failure tail: forget the assume,
-        roll back reservations, requeue with backoff."""
+        roll back reservations, requeue with backoff.  Also bumps the
+        wave-failure generation: a batch dispatched speculatively over
+        this (now released) assume invalidates at harvest."""
+        self._note_commit_failure()
         released = self.cache.forget(info.pod)
         fwk.run_unreserve(info.pod)
         if released:
@@ -891,13 +1051,20 @@ class Scheduler:
         self.metrics.schedule_attempts.inc("error")
         self.queue.requeue_backoff(info)
 
-    def _run(self) -> None:
+    def _run(self, lane_idx: int = 0) -> None:
         # The solve-side pipeline: the LAST profile group of cycle N stays
         # a device future (DeviceSolve) while the next pop's accumulation
         # window runs — the device solves and the readback transfers while
         # the host collects arrivals, instead of the host idling inside
         # np.asarray.  The deferred group is decoded and staged BEFORE the
         # next batch encodes, so snapshots still see every assume.
+        #
+        # Each profile LANE runs this loop over its own disjoint pod
+        # classes (multi-profile configs); lane 0 is the LEAD lane —
+        # leadership reconciliation and the assume-TTL sweep run there
+        # only, once per pass, never once per lane.
+        lead = lane_idx == 0
+        profiles = self._lane_profiles[lane_idx]
         cycle: Optional[_Cycle] = None
         while not self._stop.is_set():
             self._ensure_binder()
@@ -906,6 +1073,13 @@ class Scheduler:
                 time.sleep(0.05)
                 continue
             if self._reconcile_needed.is_set():
+                if not lead:
+                    # reconciliation is in flight on the lead lane: a
+                    # follower lane must not dispatch over un-reconciled
+                    # caches — wait for the lead to clear the flag
+                    cycle = self._finish_contained(cycle)
+                    time.sleep(0.01)
+                    continue
                 # first pass after start or (re)acquired leadership:
                 # reconcile local state against the store BEFORE popping
                 self._reconcile_needed.clear()
@@ -922,7 +1096,9 @@ class Scheduler:
                 timeout = 0.2 if cycle is None else min(
                     0.05, self.config.batch_window_seconds or 0.05
                 )
-                batch = self.queue.pop_batch(self.batch_size, timeout=timeout)
+                batch = self.queue.pop_batch(
+                    self.batch_size, timeout=timeout, profiles=profiles
+                )
             except Exception:  # noqa: BLE001
                 batch = []
             if (
@@ -949,14 +1125,15 @@ class Scheduler:
                 # process's lifetime.  Salvage first: popped pods the
                 # dead cycle never dispositioned go back to the queue
                 # instead of stranding in the 'inflight' tier.
-                self._salvage_cycle(self._inflight_cycle)
+                self._salvage_cycle(self._inflight_get())
                 cycle = None
                 logging.getLogger(__name__).exception(
                     "schedule_batch cycle failed; continuing"
                 )
-            for pod in self.cache.cleanup_expired():
-                # binding never confirmed: give the pod another chance
-                self.queue.add(pod)
+            if lead:
+                for pod in self.cache.cleanup_expired():
+                    # binding never confirmed: give the pod another chance
+                    self.queue.add(pod)
         self._finish_contained(cycle)
 
     def _salvage_cycle(self, cycle: Optional["_Cycle"]) -> None:
@@ -966,7 +1143,7 @@ class Scheduler:
         any assume the dead cycle left behind.  The chaos invariant this
         maintains: every popped pod ends bound or back in the queue,
         never wedged inflight."""
-        self._inflight_cycle = None
+        self._inflight_set(None)
         if cycle is None:
             return
         if cycle.wave:
@@ -997,7 +1174,7 @@ class Scheduler:
             try:
                 self._finish_cycle(cycle)
             except Exception:  # noqa: BLE001
-                self._salvage_cycle(self._inflight_cycle)
+                self._salvage_cycle(self._inflight_get())
                 logging.getLogger(__name__).exception(
                     "deferred cycle finalize failed"
                 )
@@ -1028,7 +1205,7 @@ class Scheduler:
         except Exception:
             # direct callers see the error, but popped pods must not
             # strand inflight (the same salvage the hot loop runs)
-            self._salvage_cycle(self._inflight_cycle)
+            self._salvage_cycle(self._inflight_get())
             raise
 
     def _dispatch_batch(self, batch: List[QueuedPodInfo]) -> "_Cycle":
@@ -1041,6 +1218,12 @@ class Scheduler:
         the hot loop overlaps with the next pop window)."""
         stats = {"popped": len(batch), "scheduled": 0, "unschedulable": 0,
                  "bind_errors": 0}
+        if not self._speculation_enabled:
+            # speculative_solve=false: strict solve-vs-commit
+            # serialization — a new batch dispatches only over durably
+            # committed waves (the rollback knob; the default pipeline
+            # overlaps and invalidates on failure instead)
+            self.flush_binds(timeout=30.0)
         # Encode under the cache lock (informer threads mutate the same
         # ClusterState/vocabularies); solve outside it.  A pod whose spec
         # can't be encoded (cap overflow, unsupported field) must only
@@ -1056,7 +1239,15 @@ class Scheduler:
         # with-block exit double-logged every over-threshold trace.
         trace = Trace("schedule_batch", threshold=1.0, pods=len(batch))
         cycle = _Cycle(stats, trace, reservations, batch)
-        self._inflight_cycle = cycle
+        self._inflight_set(cycle)
+        if self._speculation_enabled and self._waves_in_flight():
+            # SPECULATIVE dispatch: this batch's encode/solve runs over
+            # placements an in-flight wave only ASSUMED.  Record the
+            # wave-failure generation — a commit failure/fence before
+            # this cycle harvests invalidates it (requeue, not stage).
+            self.metrics.speculative_solves_total.inc()
+            faults.fire("solve.speculate", pods=len(batch))
+            cycle.spec_token = self._spec_token()
         # A pod can be popped twice into one accumulation window (delete
         # + recreate races a mid-cycle requeue): the duplicate would make
         # cache.assume raise "already assumed" downstream — requeue it
@@ -1099,6 +1290,16 @@ class Scheduler:
         t_solve = self._clock()
         with self._solve_lock:
             self._solve_open = t_solve
+        if cycle.spec_token is not None:
+            # speculative encode: bookmark the profile's device-mirror
+            # resident buffer (the double-buffer base) so invalidation
+            # can drop the speculative delta chain whole
+            mirror = getattr(fwk.tpu, "_mirror", None)
+            if mirror is not None and sched_name not in cycle.mirror_points:
+                with self.cache.lock:
+                    cycle.mirror_points[sched_name] = (
+                        mirror, mirror.speculation_point()
+                    )
         pods = [info.pod for info in group]
         try:
             ds = fwk.tpu.schedule_pending_async(
@@ -1131,9 +1332,39 @@ class Scheduler:
         cycle.trace.step(f"encode[{sched_name}]")
         return (fwk, sched_name, group, ds, t_solve)
 
+    def _misspeculate_group(self, cycle, fwk, sched_name, group, ds) -> None:
+        """A wave this group's solve speculated over failed or was
+        fenced after the dispatch: the solve ran against assumed
+        placements that no longer hold.  Discard the solve undecoded
+        (releasing its dispatch slot), roll the profile's mirror back to
+        its pre-speculation resident buffer, and requeue EXACTLY this
+        batch with backoff — bounded, because attempts already counted
+        at pop and backoff grows per retry."""
+        if hasattr(ds, "release_slot"):
+            ds.release_slot()
+        point = cycle.mirror_points.get(sched_name)
+        if point is not None:
+            mirror, bookmark = point
+            with self.cache.lock:
+                mirror.rollback(bookmark)
+        self.metrics.misspeculation_total.inc()
+        logging.getLogger(__name__).info(
+            "mis-speculation: requeueing %d pod(s) of profile %s "
+            "(a wave failed/fenced after the speculative dispatch)",
+            len(group), sched_name,
+        )
+        for info in group:
+            cycle.handled.add(pod_key(info.pod))
+            self.queue.requeue_backoff(info)
+
     def _harvest_group(self, cycle, fwk, sched_name, group, ds, t_solve):
         """Decode one dispatched group (the coalesced readback) and stage
         its placements."""
+        if cycle.spec_token is not None and self._spec_invalidated(
+            cycle.spec_token
+        ):
+            self._misspeculate_group(cycle, fwk, sched_name, group, ds)
+            return
         names = fwk.tpu.finalize_pending(
             [info.pod for info in group], ds, lock=self.cache.lock,
             reservations=cycle.reservations,
@@ -1238,7 +1469,10 @@ class Scheduler:
             batch_infos = eligible[:budget]
             try:
                 if batch_infos:
-                    with self.preemption.shared_pass(
+                    # concurrent lanes serialize their PostFilter passes:
+                    # the evaluator's shared pass caches per-pass state
+                    # (victim tensors, priority floor) one pass at a time
+                    with self._postfilter_lock, self.preemption.shared_pass(
                         [info.pod for info in batch_infos]
                     ):
                         for info in batch_infos:
@@ -1345,7 +1579,7 @@ class Scheduler:
                 getattr(self.store, "terminated_by_kind", {})
             ).items():
                 self.metrics.watch_terminated_total.set(float(n), kind)
-        self._inflight_cycle = None
+        self._inflight_set(None)
         return stats
 
     def _stage_group(
@@ -1366,72 +1600,135 @@ class Scheduler:
 
         A duplicate assume ("already assumed" ValueError — the same pod
         reaching the solve twice despite the dispatch dedup) is contained
-        to a per-pod requeue-with-backoff; it never kills the cycle."""
-        stats, failed, wave = cycle.stats, cycle.failed, cycle.wave
-        for i, (info, node_name) in enumerate(zip(group, names)):
-            t_attempt = self._clock()
-            if node_name is not None:
-                node_name = fwk.run_filter_result(info.pod, node_name)
-                if node_name is None:
-                    # a later plugin rejected a placement an earlier one
-                    # may have reserved for (e.g. volume Reserve) — roll
-                    # the reservations back before parking
-                    fwk.run_unreserve(info.pod)
-            if node_name is None:
-                stats["unschedulable"] += 1
-                self.metrics.schedule_attempts.inc("unschedulable")
-                self.queue.add_unschedulable(info, reason=reasons[i])
-                self.events.eventf(
-                    info.pod, "Warning", "FailedScheduling",
-                    f"0 nodes available ({_REASON_TEXT.get(reasons[i], 'unschedulable')})",
+        to a per-pod requeue-with-backoff; it never kills the cycle.
+
+        STREAMED sub-wave commits (stream_subwaves, multi-shard stores):
+        instead of accumulating the whole group into ``cycle.wave`` and
+        dispatching after the full readback+staging, the group is staged
+        per STORE SHARD and each shard's slice is handed to the commit
+        pool the moment it finishes staging — shard A's journal fsync /
+        watch fan-out run while shard B's pods are still staging (and
+        while the next solve runs).  Each pod lands in exactly ONE
+        streamed sub-wave, and every sub-wave carries the same fence /
+        bound-exactly-once semantics as a whole wave."""
+        shard_of = getattr(self.store, "shard_index", None)
+        if not (self._stream_enabled and shard_of is not None):
+            for i, (info, node_name) in enumerate(zip(group, names)):
+                entry = self._stage_one(
+                    fwk, info, node_name, reasons[i], cycle
                 )
-                failed.append(info)
-                cycle.handled.add(pod_key(info.pod))
+                if entry is not None:
+                    cycle.wave.append(entry)
+            return
+        # streamed: bucket the group's indices by owning store shard,
+        # stage shard-by-shard, hand each staged slice off immediately
+        buckets: Dict[int, List[int]] = {}
+        for i, node_name in enumerate(names):
+            sid = (
+                shard_of("Pod", group[i].pod.meta.namespace)
+                if node_name is not None else -1
+            )
+            buckets.setdefault(sid, []).append(i)
+        handoffs: List[float] = []
+        for sid, idxs in buckets.items():
+            entries: List[tuple] = []
+            for i in idxs:
+                entry = self._stage_one(
+                    fwk, group[i], names[i], reasons[i], cycle
+                )
+                if entry is not None:
+                    entries.append(entry)
+            if sid < 0 or not entries:
                 continue
             try:
-                self.cache.assume(info.pod, node_name)
-            except (KeyError, ValueError):
-                fwk.run_unreserve(info.pod)
-                stats["bind_errors"] += 1
-                self.metrics.schedule_attempts.inc("error")
-                self.queue.requeue_backoff(info)
-                cycle.handled.add(pod_key(info.pod))
-                continue
-            # Permit (schedule_one.go:231): reject aborts; wait parks
-            # the pod in the waiting map and the binding runs on its own
-            # thread blocking in WaitOnPermit (:278) — the scheduling
-            # loop moves on, like the reference's async bindingCycle
-            verdict, timeout = fwk.run_permit(info.pod, node_name)
-            if verdict == "reject":
-                self.cache.forget(info.pod)
-                fwk.run_unreserve(info.pod)
-                stats["unschedulable"] += 1
-                self.metrics.schedule_attempts.inc("unschedulable")
-                self.events.eventf(
-                    info.pod, "Warning", "FailedScheduling",
-                    f"permit rejected on node {node_name}",
+                self._dispatch_subwave_async(entries, sid)
+                handoffs.append(self._clock())
+            except Exception:  # noqa: BLE001 — hand-off containment:
+                # staged (assumed) pods must not strand on the TTL
+                logging.getLogger(__name__).exception(
+                    "streamed sub-wave hand-off failed; requeueing"
                 )
-                self.queue.requeue_backoff(info)
-                cycle.handled.add(pod_key(info.pod))
-                continue
-            if verdict == "wait":
-                wp = WaitingPod(info.pod, node_name, timeout)
-                self.waiting.add(wp)
-                t = threading.Thread(
-                    target=self._binding_cycle_async,
-                    args=(fwk, info, node_name, wp, t_attempt),
-                    name=f"bind-{info.pod.meta.name}",
-                    daemon=True,
+                for e in entries:
+                    self._fail_bind(e[0], e[1])
+        if handoffs:
+            t_end = self._clock()
+            for t in handoffs:
+                # the commit lead streaming bought this sub-wave over
+                # the whole-group hand-off point
+                self.metrics.subwave_stream_lead_ms.observe(
+                    (t_end - t) * 1000.0
                 )
-                t.start()
-                stats["waiting"] = stats.get("waiting", 0) + 1
-                cycle.handled.add(pod_key(info.pod))
-                continue
-            # staged: assumed + Permit-allowed; the binding stage owns
-            # the rest (PreBind -> wave commit -> PostBind)
-            wave.append((fwk, info, node_name, t_attempt))
-            stats["scheduled"] += 1
+
+    def _stage_one(self, fwk, info, node_name, reason, cycle):
+        """Stage ONE placement (the per-pod tail shared by the whole-wave
+        and streamed paths): filter_result veto → assume → Permit.
+        Returns a bind-wave entry for the allow path, None when a
+        terminal path (park, requeue, WaitOnPermit thread) took the
+        pod."""
+        stats, failed = cycle.stats, cycle.failed
+        t_attempt = self._clock()
+        if node_name is not None:
+            node_name = fwk.run_filter_result(info.pod, node_name)
+            if node_name is None:
+                # a later plugin rejected a placement an earlier one
+                # may have reserved for (e.g. volume Reserve) — roll
+                # the reservations back before parking
+                fwk.run_unreserve(info.pod)
+        if node_name is None:
+            stats["unschedulable"] += 1
+            self.metrics.schedule_attempts.inc("unschedulable")
+            self.queue.add_unschedulable(info, reason=reason)
+            self.events.eventf(
+                info.pod, "Warning", "FailedScheduling",
+                f"0 nodes available ({_REASON_TEXT.get(reason, 'unschedulable')})",
+            )
+            failed.append(info)
             cycle.handled.add(pod_key(info.pod))
+            return None
+        try:
+            self.cache.assume(info.pod, node_name)
+        except (KeyError, ValueError):
+            fwk.run_unreserve(info.pod)
+            stats["bind_errors"] += 1
+            self.metrics.schedule_attempts.inc("error")
+            self.queue.requeue_backoff(info)
+            cycle.handled.add(pod_key(info.pod))
+            return None
+        # Permit (schedule_one.go:231): reject aborts; wait parks
+        # the pod in the waiting map and the binding runs on its own
+        # thread blocking in WaitOnPermit (:278) — the scheduling
+        # loop moves on, like the reference's async bindingCycle
+        verdict, timeout = fwk.run_permit(info.pod, node_name)
+        if verdict == "reject":
+            self.cache.forget(info.pod)
+            fwk.run_unreserve(info.pod)
+            stats["unschedulable"] += 1
+            self.metrics.schedule_attempts.inc("unschedulable")
+            self.events.eventf(
+                info.pod, "Warning", "FailedScheduling",
+                f"permit rejected on node {node_name}",
+            )
+            self.queue.requeue_backoff(info)
+            cycle.handled.add(pod_key(info.pod))
+            return None
+        if verdict == "wait":
+            wp = WaitingPod(info.pod, node_name, timeout)
+            self.waiting.add(wp)
+            t = threading.Thread(
+                target=self._binding_cycle_async,
+                args=(fwk, info, node_name, wp, t_attempt),
+                name=f"bind-{info.pod.meta.name}",
+                daemon=True,
+            )
+            t.start()
+            stats["waiting"] = stats.get("waiting", 0) + 1
+            cycle.handled.add(pod_key(info.pod))
+            return None
+        # staged: assumed + Permit-allowed; the binding stage owns
+        # the rest (PreBind -> wave commit -> PostBind)
+        stats["scheduled"] += 1
+        cycle.handled.add(pod_key(info.pod))
+        return (fwk, info, node_name, t_attempt)
 
     def _bind_tail(self, fwk, info, node_name, t_attempt) -> bool:
         """PreBind -> bind -> PostBind with failure containment: the

@@ -65,6 +65,14 @@ KNOWN_POINTS = frozenset({
     # check trips and the pass falls back to the per-pod parity path
     "batch.preemption",
     "binder.commit_wave",
+    # a batch dispatched SPECULATIVELY — encode/solve over an earlier
+    # wave's assumed placements while that wave is still committing;
+    # fail-grade schedules kill the dispatch (the cycle containment
+    # requeues exactly the speculative batch)
+    "solve.speculate",
+    # a streamed per-store-shard sub-wave handed to the commit pool as
+    # its slice of the wave finished staging (before the rest staged)
+    "binder.stream_subwave",
     "leader.renew",
 })
 

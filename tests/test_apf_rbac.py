@@ -210,6 +210,73 @@ def test_apf_flood_does_not_starve_system_writes():
         srv.stop()
 
 
+def test_apf_levels_are_config_knobs():
+    """Per-level seat counts are deployment configuration now, not
+    compile-time constants: a YAML-shaped document tunes one level's
+    seats/queue, merges onto the defaults, and the knob demonstrably
+    takes effect (a 1-seat 0-queue catch-all sheds the second
+    concurrent request with 429)."""
+    gate = flowcontrol.APFGate.from_config(
+        {
+            "apfLevels": {
+                "catch-all": {"seats": 1, "queueLimit": 0},
+                "workload-high": {"seats": 64},
+            },
+            "queueWaitSeconds": 0.05,
+        }
+    )
+    # tuned levels took effect; untouched defaults survived the merge
+    assert gate.levels["catch-all"].seats == 1
+    assert gate.levels["catch-all"].queue_limit == 0
+    assert gate.levels["workload-high"].seats == 64
+    assert gate.levels["workload-high"].queue_limit == (
+        flowcontrol.DEFAULT_LEVELS["workload-high"][1]
+    )
+    assert gate.levels["system"].seats == (
+        flowcontrol.DEFAULT_LEVELS["system"][0]
+    )
+    nobody = auth.ANONYMOUS
+    first = gate.acquire(nobody, "list")
+    assert first is not None
+    # one seat, zero queue: the concurrent second request sheds
+    assert gate.acquire(nobody, "list") is None
+    assert gate.levels["catch-all"].rejected_total == 1
+    first.release()
+    assert gate.acquire(nobody, "list") is not None
+
+
+def test_apf_config_served_end_to_end():
+    """APIServer accepts the APF config document directly and the tuned
+    seat counts govern the serving path."""
+    store = st.Store()
+    srv = APIServer(
+        store,
+        apf={"apfLevels": {"catch-all": {"seats": 2, "queueLimit": 1}}},
+    ).start()
+    try:
+        client = RestClient(srv.url)
+        client.create(make_pod("p").obj())
+        assert client.get("Pod", "p").meta.name == "p"
+        gate = srv.httpd.RequestHandlerClass.apf
+        assert gate.levels["catch-all"].seats == 2
+        assert gate.levels["catch-all"].queue_limit == 1
+    finally:
+        srv.stop()
+
+
+def test_apf_config_validation_rejects_bad_knobs():
+    with pytest.raises(ValueError, match="seats must be >= 1"):
+        flowcontrol.levels_from_config({"catch-all": {"seats": 0}})
+    with pytest.raises(ValueError, match="queueLimit"):
+        flowcontrol.levels_from_config(
+            {"system": {"seats": 4, "queueLimit": -1}}
+        )
+    with pytest.raises(ValueError, match="unknown keys"):
+        flowcontrol.levels_from_config({"system": {"seat": 4}})
+    with pytest.raises(ValueError, match="unknown APF configuration"):
+        flowcontrol.APFGate.from_config({"levels": {}})
+
+
 def test_apf_metrics_endpoint():
     store = st.Store()
     srv, apf = _apf_server(store)

@@ -912,6 +912,149 @@ def test_chaos_relist_storm(seed):
             sched.stop()
 
 
+# -- speculative multi-lane pipeline under commit failure / fence ------------
+#
+# Seeds 500-509 drive the PR 12 pipeline: TWO profile lanes popping
+# disjoint pod classes concurrently, STREAMED per-shard sub-wave commits
+# on a 4-shard store, and SPECULATIVE solves dispatched while earlier
+# waves are still committing — with faults at the new points
+# (solve.speculate kills speculative dispatches, binder.stream_subwave
+# kills streamed hand-offs) layered over commit failures, crash-grade
+# binder faults, shard-wave failures and leader-renew failures (the
+# fence-mid-wave shape).  Invariants on top of the PR 3 set:
+#
+#   * a mis-speculation requeues EXACTLY the speculative batch — every
+#     pod still ends bound within the bounded quiesce (requeue+backoff,
+#     never a loss);
+#   * bound-exactly-once per streamed sub-wave (the event audit);
+#   * the assume set drains to empty at quiesce.
+
+SPECULATE_SEEDS = list(range(500, 510))
+
+
+def _speculate_fault_plan(rng: random.Random) -> faults.FaultRegistry:
+    reg = faults.FaultRegistry(seed=rng.randint(0, 2 ** 31))
+    reg.fail("solve.speculate", n=rng.randint(1, 2), probability=0.7)
+    reg.delay("solve.speculate", seconds=0.002, n=3, probability=0.5)
+    reg.fail("binder.stream_subwave", n=rng.randint(1, 2), probability=0.7)
+    # commit failures AFTER speculative dispatches: the mis-speculation
+    # invalidation path.  The commit delays are deliberately HEAVY
+    # (~50ms x 20 sub-waves) so waves are reliably still in flight when
+    # the next batch dispatches — every seed genuinely speculates.
+    reg.delay("binder.commit_wave", seconds=0.05, n=20)
+    reg.fail("binder.commit_wave", n=rng.randint(1, 2))
+    if rng.random() < 0.5:
+        reg.crash("binder.commit_wave", n=1)
+    reg.fail("store.shard.update_wave", n=1, probability=0.7)
+    reg.fail("leader.renew", n=rng.randint(1, 2))
+    reg.drop("watch.offer", n=1, probability=0.3)
+    return reg
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+@pytest.mark.parametrize("seed", SPECULATE_SEEDS)
+def test_chaos_speculative_lanes(seed, tmp_path):
+    from kubernetes_tpu.scheduler.config import ProfileConfig
+
+    rng = random.Random(seed)
+    reg = _speculate_fault_plan(rng)
+    store = st.Store(
+        journal_path=str(tmp_path / "journal.jsonl"), shards=4
+    )
+    audit = _EventAudit(store)
+    for i in range(rng.randint(4, 8)):
+        store.create(
+            make_node(f"n{i}")
+            .capacity(cpu_milli=8000, mem=16 * GI, pods=110)
+            .zone(f"z{i % 3}")
+            .obj()
+        )
+    elector = LeaderElector(
+        store, "spec-sched", f"holder-{seed}",
+        lease_duration=1.0, renew_period=0.05,
+    ).start()
+    config = SchedulerConfiguration(
+        profiles=[
+            ProfileConfig(),
+            ProfileConfig(scheduler_name="batch-scheduler"),
+        ],
+        pod_initial_backoff_seconds=0.05,
+        pod_max_backoff_seconds=0.4,
+        batch_window_seconds=0.01,
+        unschedulable_flush_seconds=0.5,
+    )
+    sched = Scheduler(
+        store, assume_ttl=1.0, leader_elector=elector, config=config
+    )
+    assert sched._stream_enabled  # the streamed path is under test
+    assert len(sched._lane_profiles) == 2
+    n_pods = rng.randint(24, 40)
+    namespaces = [f"ns-{i}" for i in range(4)]
+    try:
+        with faults.armed(reg):
+            sched.start()
+            assert elector.wait_for_leadership(10)
+            for i in range(n_pods):
+                spec = make_pod(
+                    f"p{i}", namespace=namespaces[i % 4]
+                ).req(
+                    cpu_milli=rng.choice([50, 100, 200]),
+                    mem=rng.choice([GI // 4, GI // 2]),
+                )
+                pod = spec.obj()
+                if i % 2:
+                    pod.spec.scheduler_name = "batch-scheduler"
+                store.create(pod)
+                if rng.random() < 0.4:
+                    time.sleep(rng.random() * 0.01)
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                pods, _ = store.list("Pod")
+                if pods and all(p.spec.node_name for p in pods):
+                    break
+                time.sleep(0.1)
+
+        # -- invariants (faults disarmed) --------------------------------
+        assert reg.fired, f"seed {seed}: no fault ever fired"
+        pods, _ = store.list("Pod")
+        assert len(pods) == n_pods
+        unbound = [p.meta.name for p in pods if not p.spec.node_name]
+        assert not unbound, (
+            f"seed {seed}: pods lost/wedged past bounded quiesce: {unbound}\n"
+            f"  queue: {sched.queue.stats()}\n"
+            f"  assumed: {list(sched.cache._assumed)}\n"
+            f"  speculative={sched.metrics.speculative_solves_total.total} "
+            f"misspec={sched.metrics.misspeculation_total.total}\n"
+            f"  stream_inflight={sched._stream_inflight} "
+            f"waves={len(sched._waves)}\n"
+            f"  fired={reg.fired} pending={reg.pending()}"
+        )
+        # the overlap genuinely happened on this seed matrix: commits
+        # were delayed, so at least one dispatch was speculative
+        assert sched.metrics.speculative_solves_total.total >= 1, (
+            f"seed {seed}: no dispatch ever speculated"
+        )
+        assert not audit.violations, f"seed {seed}: {audit.violations[:5]}"
+        rebound = {
+            k: nodes for k, nodes in audit.bound_nodes.items()
+            if len(nodes) > 1
+        }
+        assert not rebound, f"seed {seed}: double binds {rebound}"
+        assert sched.flush_binds(15)
+        deadline = time.monotonic() + 10
+        while sched.cache.assumed_count() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sched.cache.assumed_count() == 0, (
+            f"seed {seed}: assume set not empty at quiesce"
+        )
+    finally:
+        faults.disarm()
+        sched.stop()
+        elector.stop()
+
+
 # -- sharded-store kill-restart: crash ONE shard mid-fsync -------------------
 #
 # The store is sharded (per-shard locks/journals/checkpoints, ISSUE 9);

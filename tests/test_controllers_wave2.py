@@ -155,13 +155,18 @@ def test_quota_rejects_over_budget_creates():
         with pytest.raises(adm.AdmissionError, match="exceeded quota"):
             store.create(make_pod("d").req(cpu_milli=400).obj())
         store.create(make_pod("e").req(cpu_milli=100).obj())
-        # controller reconciles status.used
+        # controller reconciles status.used.  Wait on BOTH dimensions:
+        # pods==2 alone also matches the stale pre-delete {a, b} state
+        # (2 pods, 400m), so asserting cpu right after that wait raced
+        # the reconcile of b's delete.
         assert _wait(
-            lambda: store.get("ResourceQuota", "budget").status.used.get("pods")
-            == 2
-        )
-        assert (
-            store.get("ResourceQuota", "budget").status.used[api.CPU] == 300
+            lambda: (
+                store.get("ResourceQuota", "budget").status.used.get("pods")
+                == 2
+                and store.get(
+                    "ResourceQuota", "budget"
+                ).status.used.get(api.CPU) == 300
+            )
         )
         # other namespaces are not constrained
         store.create(make_pod("f", namespace="other").req(cpu_milli=900).obj())

@@ -20,6 +20,7 @@ from kubernetes_tpu.models.batch_scheduler import (
     TPUBatchScheduler,
 )
 from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.scheduler.config import SchedulerConfiguration
 from kubernetes_tpu.scheduler.queue import QueuedPodInfo, pod_key
 from kubernetes_tpu.testing import faults
 from kubernetes_tpu.testing.wrappers import GI, make_node, make_pod
@@ -317,7 +318,12 @@ def test_binder_watchdog_restarts_crashed_worker_and_recommits():
     _cluster(store)
     for i in range(3):
         store.create(make_pod(f"p{i}").req(cpu_milli=100).obj())
-    sched = _mk_scheduler(store)
+    # whole-wave path pinned: binder supervision (watchdog restart,
+    # poison split) belongs to the non-streamed wave worker — the
+    # streamed path requeues a failed sub-wave instead by design
+    sched = _mk_scheduler(
+        store, config=SchedulerConfiguration(stream_subwaves=False)
+    )
     reg = faults.FaultRegistry().crash("binder.commit_wave", n=1)
     try:
         with faults.armed(reg):
@@ -338,7 +344,9 @@ def test_poison_wave_splits_to_per_pod_commits():
     _cluster(store)
     for i in range(3):
         store.create(make_pod(f"p{i}").req(cpu_milli=100).obj())
-    sched = _mk_scheduler(store)
+    sched = _mk_scheduler(  # whole-wave path: see watchdog test
+        store, config=SchedulerConfiguration(stream_subwaves=False)
+    )
     # the whole wave fails twice (attempt + retry) -> split; the per-pod
     # commits run with the schedule drained and succeed
     reg = faults.FaultRegistry().fail("binder.commit_wave", n=2)
@@ -358,7 +366,9 @@ def test_poison_pod_in_split_requeues_with_backoff():
     _cluster(store)
     for i in range(3):
         store.create(make_pod(f"p{i}").req(cpu_milli=100).obj())
-    sched = _mk_scheduler(store)
+    sched = _mk_scheduler(  # whole-wave path: see watchdog test
+        store, config=SchedulerConfiguration(stream_subwaves=False)
+    )
     # wave fails twice, then the FIRST per-pod commit fails too: that one
     # pod requeues with backoff instead of riding the assume-TTL
     reg = faults.FaultRegistry().fail("binder.commit_wave", n=3)

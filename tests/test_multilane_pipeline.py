@@ -1,0 +1,469 @@
+"""The pipelined multi-lane cycle (docs/scheduler_loop.md):
+
+  * per-profile-class deficit round-robin in SchedulingQueue.pop_batch
+    (one hot profile cannot starve another lane) + the `profiles` lane
+    filter;
+  * concurrent profile LANES — one pop→encode→solve pipeline per
+    profile sharing one device through the DispatchArbiter;
+  * SPECULATIVE solve overlap — batch N+1 dispatched over batch N's
+    assumed placements while N's wave commits; a commit failure/fence
+    invalidates the speculative batch and requeues exactly it;
+  * STREAMED sub-wave commits — each store shard's slice of a wave
+    hands to the commit pool as it stages, bound-exactly-once per
+    sub-wave;
+  * the DeviceClusterMirror speculation double-buffer (bookmark +
+    rollback);
+  * the scheduler_lanes / speculativeSolve / streamSubwaves knobs.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import store as st
+from kubernetes_tpu.models.batch_scheduler import DispatchArbiter
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.scheduler.config import (
+    ProfileConfig,
+    SchedulerConfiguration,
+    load_config,
+)
+from kubernetes_tpu.scheduler.queue import SchedulingQueue
+from kubernetes_tpu.testing import faults
+from kubernetes_tpu.testing.wrappers import GI, make_node, make_pod
+
+
+def _pod(name, cls=None, namespace="default", prio=0):
+    p = make_pod(name, namespace=namespace).req(cpu_milli=50, mem=GI // 8)
+    if prio:
+        p = p.priority(prio)
+    pod = p.obj()
+    if cls is not None:
+        pod.spec.scheduler_name = cls
+    return pod
+
+
+# -- pop_batch: per-profile fairness + lane filter ---------------------------
+
+
+def test_pop_batch_round_robin_across_profile_classes():
+    """A 10:1 arrival skew between two profile classes must not let the
+    hot class fill the whole batch: the deficit round-robin serves one
+    pod per class per rotation, so the cold class's pods ride every
+    batch and both classes drain."""
+    q = SchedulingQueue()
+    for i in range(20):
+        q.add(_pod(f"hot-{i}"))
+    for i in range(2):
+        q.add(_pod(f"cold-{i}", cls="batch-scheduler"))
+    batch = q.pop_batch(10, timeout=0)
+    assert len(batch) == 10
+    cold = [i for i in batch if i.pod.spec.scheduler_name == "batch-scheduler"]
+    # both cold pods made the first batch despite the 10:1 skew
+    assert len(cold) == 2
+    # everything drains across subsequent pops
+    seen = {i.pod.meta.name for i in batch}
+    while True:
+        more = q.pop_batch(10, timeout=0)
+        if not more:
+            break
+        seen |= {i.pod.meta.name for i in more}
+    assert len(seen) == 22
+
+
+def test_pop_batch_single_class_keeps_queuesort_order():
+    """One class (the default profile) must pop in exactly the old
+    global queuesort order: priority desc, then arrival."""
+    q = SchedulingQueue()
+    q.add(_pod("low-a", prio=1))
+    q.add(_pod("high", prio=9))
+    q.add(_pod("low-b", prio=1))
+    batch = q.pop_batch(3, timeout=0)
+    assert [i.pod.meta.name for i in batch] == ["high", "low-a", "low-b"]
+
+
+def test_pop_batch_profiles_filter_pops_only_that_lane():
+    q = SchedulingQueue()
+    q.add(_pod("a0"))
+    q.add(_pod("b0", cls="batch-scheduler"))
+    q.add(_pod("b1", cls="batch-scheduler"))
+    lane_b = q.pop_batch(10, timeout=0, profiles={"batch-scheduler"})
+    assert sorted(i.pod.meta.name for i in lane_b) == ["b0", "b1"]
+    # the other class is untouched and pops for its own lane
+    lane_a = q.pop_batch(10, timeout=0, profiles={"default-scheduler"})
+    assert [i.pod.meta.name for i in lane_a] == ["a0"]
+    # an empty lane pops nothing even though pods exist elsewhere
+    assert q.pop_batch(10, timeout=0, profiles={"ghost"}) == []
+
+
+# -- concurrent profile lanes ------------------------------------------------
+
+
+def _two_profile_config(**kw):
+    return SchedulerConfiguration(
+        profiles=[
+            ProfileConfig(),
+            ProfileConfig(scheduler_name="batch-scheduler"),
+        ],
+        pod_initial_backoff_seconds=0.05,
+        pod_max_backoff_seconds=0.4,
+        batch_window_seconds=0.01,
+        **kw,
+    )
+
+
+def test_two_profile_lanes_schedule_both_classes():
+    """Two profiles run as two concurrent lanes (scheduler_lanes=0 auto)
+    sharing one device through the dispatch arbiter; both pod classes
+    place, nothing double-binds."""
+    store = st.Store()
+    sched = Scheduler(store, config=_two_profile_config())
+    assert len(sched._lane_profiles) == 2
+    assert sched.metrics.lane_count.total == 2.0
+    assert sched.profiles.arbiter is not None
+    for i in range(3):
+        store.create(
+            make_node(f"n{i}").capacity(
+                cpu_milli=8000, mem=16 * GI, pods=110
+            ).obj()
+        )
+    try:
+        sched.start()
+        for i in range(12):
+            store.create(_pod(f"d-{i}"))
+            store.create(_pod(f"b-{i}", cls="batch-scheduler"))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            pods, _ = store.list("Pod")
+            if pods and all(p.spec.node_name for p in pods):
+                break
+            time.sleep(0.05)
+        pods, _ = store.list("Pod")
+        unbound = [p.meta.name for p in pods if not p.spec.node_name]
+        assert not unbound, f"unbound after both lanes ran: {unbound}"
+        assert sched.flush_binds(10)
+    finally:
+        sched.stop()
+
+
+def test_scheduler_lanes_knob_pins_serial_loop():
+    """scheduler_lanes=1 keeps the serial single-thread loop even with
+    two profiles (the rollback knob)."""
+    store = st.Store()
+    sched = Scheduler(store, config=_two_profile_config(scheduler_lanes=1))
+    assert len(sched._lane_profiles) == 1
+    assert sched._lane_profiles[0] is None  # one lane pops every class
+    assert sched.metrics.lane_count.total == 1.0
+
+
+# -- speculative solve overlap ----------------------------------------------
+
+
+def _lone_node_scheduler(store, **cfg_kw):
+    cfg = SchedulerConfiguration(
+        pod_initial_backoff_seconds=0.02,
+        pod_max_backoff_seconds=0.1,
+        batch_window_seconds=0.0,
+        adaptive_batch_window=False,
+        **cfg_kw,
+    )
+    sched = Scheduler(store, config=cfg)
+    node = make_node("n1").capacity(
+        cpu_milli=64000, mem=64 * GI, pods=110
+    ).obj()
+    store.create(node)
+    sched.cache.add_node(node)
+    return sched
+
+
+def test_speculative_batch_invalidated_by_commit_failure():
+    """A batch dispatched while a wave is in flight records the
+    wave-failure generation; a commit failure before its harvest must
+    requeue EXACTLY that batch (no staging, no assumes) and count one
+    mis-speculation — then the requeued pods place on a later healthy
+    cycle."""
+    store = st.Store()
+    sched = _lone_node_scheduler(store)
+    try:
+        for i in range(2):
+            pod = _pod(f"p{i}")
+            store.create(pod)
+            sched.queue.add(pod)
+        sched._waves_in_flight = lambda: True  # a wave is "committing"
+        batch = sched.queue.pop_batch(4, timeout=0)
+        assert len(batch) == 2
+        cycle = sched._dispatch_batch(batch)
+        assert cycle.spec_token is not None
+        assert sched.metrics.speculative_solves_total.total == 1.0
+        # the wave it speculated over fails before the harvest
+        sched._note_commit_failure()
+        stats = sched._finish_cycle(cycle)
+        assert stats["scheduled"] == 0
+        assert sched.metrics.misspeculation_total.total == 1.0
+        assert sched.cache.assumed_count() == 0  # nothing was assumed
+        tiers = sched.queue.stats()
+        assert tiers["backoff"] == 2 and tiers["inflight"] == 0
+        # healthy retry: speculation holds, the pods place
+        time.sleep(0.15)
+        sched._waves_in_flight = lambda: False
+        stats = sched.schedule_batch(timeout=0)
+        assert stats["scheduled"] == 2
+        assert sched.flush_binds(10)
+        pods, _ = store.list("Pod")
+        assert all(p.spec.node_name == "n1" for p in pods)
+    finally:
+        sched.stop()
+
+
+def test_speculation_holds_on_healthy_commits():
+    """No commit failure => the speculative batch stages normally
+    (zero mis-speculations); placements match the serial path."""
+    store = st.Store()
+    sched = _lone_node_scheduler(store)
+    try:
+        for i in range(2):
+            pod = _pod(f"p{i}")
+            store.create(pod)
+            sched.queue.add(pod)
+        sched._waves_in_flight = lambda: True
+        batch = sched.queue.pop_batch(4, timeout=0)
+        cycle = sched._dispatch_batch(batch)
+        assert cycle.spec_token is not None
+        stats = sched._finish_cycle(cycle)
+        assert stats["scheduled"] == 2
+        assert sched.metrics.misspeculation_total.total == 0.0
+        assert sched.flush_binds(10)
+    finally:
+        sched.stop()
+
+
+def test_speculative_solve_gate_off_serializes():
+    """speculative_solve=false: batches only dispatch over drained
+    waves — the speculative counter never moves."""
+    store = st.Store()
+    sched = _lone_node_scheduler(store, speculative_solve=False)
+    try:
+        assert not sched._speculation_enabled
+        for i in range(4):
+            pod = _pod(f"p{i}")
+            store.create(pod)
+            sched.queue.add(pod)
+        stats = sched.schedule_batch(timeout=0)
+        assert stats["scheduled"] == 4
+        assert sched.metrics.speculative_solves_total.total == 0.0
+        assert sched.flush_binds(10)
+    finally:
+        sched.stop()
+
+
+# -- streamed sub-wave commits ----------------------------------------------
+
+
+def test_streamed_subwaves_commit_per_shard():
+    """A wave spanning namespaces on different store shards streams one
+    sub-wave per shard to the commit pool as it stages; every pod binds
+    exactly once and the stream-lead histogram records the hand-offs."""
+    store = st.Store()  # default 4 shards -> commit pool exists
+    sched = _lone_node_scheduler(store)
+    assert sched._stream_enabled
+    namespaces = [f"ns-{i}" for i in range(6)]
+    shards = {store.shard_index("Pod", ns) for ns in namespaces}
+    assert len(shards) > 1  # the wave genuinely spans shards
+    try:
+        for i, ns in enumerate(namespaces):
+            pod = _pod(f"p{i}", namespace=ns)
+            store.create(pod)
+            sched.queue.add(pod)
+        stats = sched.schedule_batch(timeout=0)
+        assert stats["scheduled"] == 6
+        assert sched.flush_binds(10)
+        pods, _ = store.list("Pod")
+        assert all(p.spec.node_name == "n1" for p in pods)
+        assert sched.metrics.subwave_stream_lead_ms.n >= len(shards)
+    finally:
+        sched.stop()
+
+
+def test_streamed_subwave_fault_requeues_only_its_pods():
+    """A fail-grade fault at the streamed hand-off requeues that
+    sub-wave's pods with backoff; they bind on a later cycle — no pod
+    lost, bound exactly once."""
+    store = st.Store()
+    sched = _lone_node_scheduler(store)
+    namespaces = [f"ns-{i}" for i in range(6)]
+    reg = faults.FaultRegistry(seed=1)
+    reg.fail("binder.stream_subwave", n=1)
+    try:
+        for i, ns in enumerate(namespaces):
+            pod = _pod(f"p{i}", namespace=ns)
+            store.create(pod)
+            sched.queue.add(pod)
+        with faults.armed(reg):
+            sched.schedule_batch(timeout=0)
+            assert sched.flush_binds(10)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                pods, _ = store.list("Pod")
+                if all(p.spec.node_name for p in pods):
+                    break
+                sched.schedule_batch(timeout=0.05)
+                sched.flush_binds(5)
+        pods, _ = store.list("Pod")
+        unbound = [p.meta.name for p in pods if not p.spec.node_name]
+        assert not unbound, f"streamed fault lost pods: {unbound}"
+        assert reg.fired.get("binder.stream_subwave") == 1
+        assert sched.cache.assumed_count() == 0 or sched.flush_binds(5)
+    finally:
+        faults.disarm()
+        sched.stop()
+
+
+def test_stream_subwaves_gate_off_keeps_whole_wave_path():
+    store = st.Store()
+    sched = _lone_node_scheduler(store, stream_subwaves=False)
+    try:
+        assert not sched._stream_enabled
+        for i in range(3):
+            pod = _pod(f"p{i}", namespace=f"ns-{i}")
+            store.create(pod)
+            sched.queue.add(pod)
+        stats = sched.schedule_batch(timeout=0)
+        assert stats["scheduled"] == 3
+        assert sched.flush_binds(10)
+        assert sched.metrics.subwave_stream_lead_ms.n == 0
+    finally:
+        sched.stop()
+
+
+def test_pipelined_placements_parity_with_serial_path():
+    """Acceptance pin: with healthy commits, the pipelined loop
+    (speculation + streaming on) places a pinned workload IDENTICALLY
+    to the fully-serialized path (speculative_solve=false,
+    stream_subwaves=false) — batch composition held fixed."""
+
+    def run(speculative, streaming):
+        store = st.Store()
+        for i in range(4):
+            store.create(
+                make_node(f"n{i}").capacity(
+                    cpu_milli=4000, mem=8 * GI, pods=32
+                ).obj()
+            )
+        cfg = SchedulerConfiguration(
+            speculative_solve=speculative,
+            stream_subwaves=streaming,
+            batch_window_seconds=0.0,
+            adaptive_batch_window=False,
+        )
+        sched = Scheduler(store, config=cfg)
+        for i in range(4):
+            sched.cache.add_node(store.get("Node", f"n{i}", namespace=""))
+        try:
+            # three fixed batches so later solves see earlier assumes
+            for lo in (0, 8, 16):
+                for i in range(lo, lo + 8):
+                    pod = _pod(f"p{i:02d}", namespace=f"ns-{i % 3}")
+                    store.create(pod)
+                    sched.queue.add(pod)
+                sched.schedule_batch(timeout=0)
+            assert sched.flush_binds(10)
+            pods, _ = store.list("Pod")
+            return {p.meta.name: p.spec.node_name for p in pods}
+        finally:
+            sched.stop()
+
+    pipelined = run(speculative=True, streaming=True)
+    serial = run(speculative=False, streaming=False)
+    assert pipelined == serial
+    assert all(pipelined.values())
+
+
+# -- dispatch arbiter --------------------------------------------------------
+
+
+def test_dispatch_arbiter_bounds_inflight_and_fifo_releases():
+    arb = DispatchArbiter(depth=1, timeout=5.0)
+    assert arb.acquire()
+    got = []
+
+    def second():
+        got.append(arb.acquire())
+
+    t = threading.Thread(target=second)
+    t.start()
+    time.sleep(0.1)
+    assert not got  # blocked behind the held slot
+    arb.release()
+    t.join(timeout=5)
+    assert got == [True]
+    arb.release()
+    assert arb.inflight() == 0
+
+
+def test_dispatch_arbiter_timeout_is_a_safety_valve():
+    arb = DispatchArbiter(depth=1, timeout=0.05)
+    assert arb.acquire()
+    assert arb.acquire() is False  # forced through after the deadline
+    assert arb.forced == 1
+    arb.release()
+    arb.release()
+    assert arb.inflight() == 0
+
+
+# -- mirror speculation double-buffer ----------------------------------------
+
+
+def test_mirror_speculation_rollback_resyncs_cleanly():
+    """rollback(speculation_point()) restores the pre-speculation
+    resident buffer; the next sync re-scatters every row dirtied since
+    the bookmark, converging on exactly the live state."""
+    import numpy as np
+
+    from kubernetes_tpu.models.batch_scheduler import TPUBatchScheduler
+
+    tpu = TPUBatchScheduler()
+    for i in range(4):
+        tpu.add_node(
+            make_node(f"n{i}").capacity(
+                cpu_milli=4000, mem=8 * GI, pods=110
+            ).obj()
+        )
+    mirror = tpu._mirror
+    mirror.sync()
+    point = mirror.speculation_point()
+    # speculative delta: a pod assumed on n1 dirties its usage row
+    pod = _pod("spec-pod")
+    tpu.assume(pod, "n1")
+    dev_spec = mirror.sync()
+    assert dev_spec is not mirror.speculation_point()[0] or True
+    # invalidation: drop the speculative chain, then mutate further
+    mirror.rollback(point)
+    tpu.forget(pod)
+    tpu.assume(_pod("other-pod"), "n2")
+    dev = mirror.sync()
+    want = tpu.state.tensors()
+    for field in want._fields:
+        got = np.asarray(getattr(dev, field))
+        exp = np.asarray(getattr(want, field))
+        assert np.array_equal(got, exp), f"mirror diverged on {field}"
+
+
+# -- config knobs ------------------------------------------------------------
+
+
+def test_multilane_yaml_knobs_load_and_validate():
+    cfg = load_config(
+        {
+            "apiVersion": "kubescheduler.config.k8s.io/v1",
+            "kind": "KubeSchedulerConfiguration",
+            "schedulerLanes": 2,
+            "speculativeSolve": False,
+            "streamSubwaves": False,
+        }
+    )
+    assert cfg.scheduler_lanes == 2
+    assert cfg.speculative_solve is False
+    assert cfg.stream_subwaves is False
+    with pytest.raises(ValueError):
+        SchedulerConfiguration(scheduler_lanes=-1).validate()
