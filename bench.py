@@ -30,6 +30,11 @@ informer-fed cache.  `extra` carries all five configs:
        sub-waves), p50/p90/p99 lifecycle latency, zero lost/double-bound
        pods, watchers_terminated == 0, and per-shard snapshot+suffix
        recovery under STRICT_RECOVERY_BUDGET_MS
+  c10   4k nodes / 64 slices of 4x4x4  SLICE PACKING: mixed gang shapes
+       arriving/leaving through the carve-out scorer (prefer policy);
+       gates placement QUALITY — BENCH_STRICT floors on the
+       contiguous-placement rate and the end-state fragmentation score
+       — alongside throughput and steady_recompiles == 0
   c9   20k nodes / 128 preemptors  mixed-priority preemption churn with
        PDBs through the BATCHED PostFilter (one [P, N, K] dry-run per
        pass); gates: oracle + batched-vs-sequential plan parity,
@@ -1153,6 +1158,139 @@ def config8():
     return report
 
 
+# c10 slice-packing gates (BENCH_STRICT=1): the carve-out scorer must
+# realize contiguous placements for nearly every gang of the churn mix
+# (prefer policy — quality is the scorer's job, not a filter's) and the
+# end-state fragmentation must stay bounded after arrivals/departures.
+STRICT_SLICE_CONTIG_MIN = 0.9   # contiguous gangs / completed gangs
+STRICT_SLICE_FRAG_MAX = 0.5     # final cluster fragmentation score
+
+
+def config10():
+    """c10: slice packing — 4096 nodes as 64 slices of 4x4x4, mixed gang
+    shapes arriving and leaving through the carve-out scorer (prefer
+    policy).  Gates placement QUALITY, not just throughput: the
+    fragmentation score of the end state and the contiguous-placement
+    rate across the churn, plus steady_recompiles == 0 (every round
+    reuses one executable — fixed gang mix, one pad bucket)."""
+    from kubernetes_tpu.analysis import retrace
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.models.batch_scheduler import TPUBatchScheduler
+    from kubernetes_tpu.ops import slices as slices_ops
+    from kubernetes_tpu.testing.wrappers import GI, make_node, make_pod
+
+    rng = np.random.default_rng(10)
+    dims, n_slices = (4, 4, 4), 64
+    nodes = [
+        make_node(f"s{s:02d}-{x}{y}{z}")
+        .capacity(cpu_milli=16000, mem=32 * GI, pods=110)
+        .label(api.LABEL_TPU_SLICE, f"slice-{s:02d}")
+        .label(api.LABEL_TPU_TOPOLOGY, "4x4x4")
+        .label(api.LABEL_TPU_COORDS, f"{x},{y},{z}")
+        .obj()
+        for s in range(n_slices)
+        for z in range(dims[2])
+        for y in range(dims[1])
+        for x in range(dims[0])
+    ]
+    sched = TPUBatchScheduler(carveout_policy="prefer")
+    for nd in nodes:
+        sched.add_node(nd)
+
+    # fixed per-round gang mix (same pod count + gang count each round,
+    # so every round hits one executable): 26 gangs / 208 pods per round
+    mix = (("2x2x1", 4, 12), ("2x2x2", 8, 8), ("4x2x2", 16, 4),
+           ("4x4x1", 16, 2))
+
+    def make_round(r):
+        pods, gid = [], 0
+        for shape, size, count in mix:
+            for _k in range(count):
+                for i in range(size):
+                    p = (
+                        make_pod(f"c10-r{r}-g{gid}-{i}")
+                        .req(cpu_milli=100)
+                        .group(f"c10-r{r}-g{gid}")
+                        .obj()
+                    )
+                    p.spec.tpu_topology = shape
+                    pods.append(p)
+                gid += 1
+        return pods
+
+    live = []  # (pod, node) per placed member, grouped per gang
+    stats = {"completed": 0, "contiguous": 0, "fallbacks": 0,
+             "carveouts": 0, "placed": 0, "arrived": 0}
+
+    def run_round(r, timed):
+        pods = make_round(r)
+        t0 = time.perf_counter()
+        names = sched.schedule_pending(pods)
+        dt = time.perf_counter() - t0
+        ds = sched.last_solve
+        stats["arrived"] += len(pods)
+        stats["placed"] += sum(n is not None for n in names)
+        stats["carveouts"] += ds.carveouts or 0
+        stats["contiguous"] += ds.contiguous_gangs or 0
+        stats["fallbacks"] += ds.carveout_fallbacks or 0
+        stats["completed"] += (ds.contiguous_gangs or 0) + (
+            ds.carveout_fallbacks or 0
+        )
+        by_gang = {}
+        for p, n in zip(pods, names):
+            if n is not None:
+                sched.assume(p, n)
+                by_gang.setdefault(p.spec.scheduling_group, []).append((p, n))
+        live.extend(by_gang.values())
+        return dt, float(ds.frag_score or 0.0)
+
+    rounds = 6
+    retrace.clear_steady()
+    warm_dt, _ = run_round(0, timed=False)  # compiles the executable
+    retrace.mark_steady()
+    steady0 = retrace.steady_total()
+    walls, frags = [], []
+    for r in range(1, rounds):
+        # departures: half the live gangs leave (seeded), freeing boxes
+        rng.shuffle(live)
+        for members in live[: len(live) // 2]:
+            for p, n in members:
+                sched.forget(p)
+        del live[: len(live) // 2]
+        dt, frag = run_round(r, timed=True)
+        walls.append(dt)
+        frags.append(frag)
+    steady_recompiles = retrace.steady_total() - steady0
+    retrace.clear_steady()
+    final_frag = slices_ops.fragmentation_report(sched.state.tensors())
+    contig_rate = stats["contiguous"] / max(stats["completed"], 1)
+    pods_per_round = stats["arrived"] // rounds
+    from kubernetes_tpu.kubemark import percentiles
+
+    pct = percentiles(list(walls))
+    return {
+        "nodes": len(nodes), "pods": stats["arrived"],
+        "placed": stats["placed"],
+        "slices": n_slices, "slice_dims": "4x4x4",
+        "rounds": rounds, "pods_per_round": pods_per_round,
+        "latency_s": round(min(walls), 4),
+        "pods_per_s": round(pods_per_round / min(walls), 1),
+        "latency_p50_s": round(pct["p50"], 4),
+        "latency_p90_s": round(pct["p90"], 4),
+        "latency_p99_s": round(pct["p99"], 4),
+        "commit_share_per_step": 0.0,
+        "first_step_s": round(warm_dt, 4),
+        "steady_recompiles": steady_recompiles,
+        # the quality gates
+        "carveouts": stats["carveouts"],
+        "contiguous_gangs": stats["contiguous"],
+        "carveout_fallbacks": stats["fallbacks"],
+        "contiguous_rate": round(contig_rate, 4),
+        "frag_score_per_round": [round(f, 4) for f in frags],
+        "frag_score_final": round(final_frag["score"], 4),
+    }
+
+
 def main() -> None:
     import sys
 
@@ -1181,6 +1319,7 @@ def main() -> None:
             "c7_sharded_100k": config7(),
             "c8_store_100k": config8(),
             "c9_preempt_churn": config9(),
+            "c10_slice_pack": config10(),
         }
     # every over-threshold schedule_batch cycle, with its per-step share
     # (commit- and solve-share per step are readable straight off the
@@ -1370,6 +1509,20 @@ def main() -> None:
                 f"c9 batched PostFilter speedup below floor: "
                 f"{c9['postfilter_speedup']}x < "
                 f"{STRICT_PREEMPT_SPEEDUP_MIN}x"
+            )
+        # slice-packing quality gates: the carve-out scorer must keep
+        # placing gangs contiguously through churn and the end state
+        # must not shatter (steady_recompiles rides the generic gate)
+        c10 = extra["c10_slice_pack"]
+        if c10["contiguous_rate"] < STRICT_SLICE_CONTIG_MIN:
+            failures.append(
+                f"c10 contiguous-placement rate below floor: "
+                f"{c10['contiguous_rate']} < {STRICT_SLICE_CONTIG_MIN}"
+            )
+        if c10["frag_score_final"] > STRICT_SLICE_FRAG_MAX:
+            failures.append(
+                f"c10 fragmentation above ceiling: "
+                f"{c10['frag_score_final']} > {STRICT_SLICE_FRAG_MAX}"
             )
         if failures:
             print("BENCH_STRICT: " + "; ".join(failures), file=sys.stderr)

@@ -559,6 +559,177 @@ def _check_kernels(byclass, findings: List[Finding]) -> None:
             )
 
 
+#: (slice-count, torus-extent) buckets the slice carve-out kernels are
+#: driven across (ops/slices.py; features.slice_z / slice_dim are both
+#: pad_dim powers of two, so they stay on the executable-key lattice)
+SLICE_LATTICE: Tuple[Tuple[int, int], ...] = ((1, 2), (2, 2), (4, 4))
+
+
+def _check_slice_kernels(byclass, findings: List[Finding]) -> None:
+    """Slice carve-out coverage: the greedy solver with the slice family
+    armed must eval_shape across the (slice_z, slice_dim) lattice with
+    contract-stable SolveResult outputs (carve-out telemetry scalars
+    included), one compile key per bucket, distinct from the base keys
+    — and the sharded twin's keys distinct from the single-chip ones.
+    The standalone fragmentation kernel is checked against the
+    SliceStats contracts at every bucket."""
+    import jax
+    import numpy as np
+
+    from ..ops import assign, schema
+    from ..ops import slices as slices_ops
+    from ..parallel import sharded
+    from . import retrace
+
+    file = "kubernetes_tpu/ops/slices.py"
+    limits = schema.SnapshotLimits()
+    n, p = 16, 8
+    snap = abstract_snapshot(byclass, limits, n=n, p=p)
+    stats_fields = byclass.get("SliceStats", {})
+    if not stats_fields:
+        findings.append(
+            Finding(
+                CHECK, file, 1, "SliceStats",
+                "slice-stats contracts missing (run the tensor-contract "
+                "pass first)",
+            )
+        )
+        return
+
+    base_sig = retrace.signature(snap, (1, assign.FeatureFlags(), 0))
+    sigs = set()
+    for policy_require in (False, True):
+        for sz, sd in SLICE_LATTICE:
+            ff = assign.FeatureFlags(
+                slices=True, slice_require=policy_require,
+                slice_z=sz, slice_dim=sd,
+            )
+            sig = retrace.signature(snap, (1, ff, 4))
+            sigs.add(sig)
+            if sig == base_sig:
+                findings.append(
+                    Finding(
+                        CHECK, file, 1, "carveout_eval",
+                        "slice-enabled compile key collides with the base "
+                        "key (slice feature flags must be part of the key)",
+                    )
+                )
+            try:
+                res = jax.eval_shape(
+                    lambda s, ff=ff: assign.greedy_assign(
+                        s, topo_z=1, features=ff, n_groups=4
+                    ),
+                    snap,
+                )
+            except Exception as e:  # noqa: BLE001 — abstract eval failed
+                findings.append(
+                    Finding(
+                        CHECK, file, 1, "carveout_eval",
+                        f"eval_shape failed at slice bucket "
+                        f"{sz}x{sd} (require={policy_require}): {e}",
+                    )
+                )
+                continue
+            env = _class_env("ClusterTensors", limits, n, p, {})
+            _result_contract_check(
+                res, "SolveResult", byclass, env,
+                f"greedy+slices[{sz}x{sd}]", findings,
+                "kubernetes_tpu/ops/assign.py",
+            )
+            for f in ("frag_score", "carveouts", "contiguous_gangs",
+                      "carveout_fallbacks"):
+                if getattr(res, f, None) is None:
+                    findings.append(
+                        Finding(
+                            CHECK, file, 1, f,
+                            f"slice-family solve returned no {f} at "
+                            f"bucket {sz}x{sd}",
+                        )
+                    )
+            # fragmentation kernel vs SliceStats contracts
+            try:
+                stats = jax.eval_shape(
+                    lambda c, sz=sz, sd=sd: slices_ops.fragmentation(
+                        c, sz, sd
+                    ),
+                    snap.cluster,
+                )
+            except Exception as e:  # noqa: BLE001
+                findings.append(
+                    Finding(
+                        CHECK, file, 1, "fragmentation",
+                        f"eval_shape failed at slice bucket {sz}x{sd}: {e}",
+                    )
+                )
+                continue
+            senv = {"S": sz}
+            for f in slices_ops.SliceStats._fields:
+                c = stats_fields.get(f)
+                val = getattr(stats, f)
+                if c is None:
+                    continue
+                want = c.shape(senv)
+                if tuple(val.shape) != want or str(val.dtype) != c.dtype:
+                    findings.append(
+                        Finding(
+                            CHECK, file, c.line, f"SliceStats.{f}",
+                            f"slices[{sz}x{sd}]: eval_shape output "
+                            f"{val.dtype}{tuple(val.shape)} != contract "
+                            f"{c.render()} (= {c.dtype}{want})",
+                        )
+                    )
+    want_sigs = 2 * len(SLICE_LATTICE)
+    if len(sigs) != want_sigs:
+        findings.append(
+            Finding(
+                CHECK, file, 1, "carveout_eval",
+                f"{want_sigs} slice lattice points produced {len(sigs)} "
+                "distinct compile keys — slice_z/slice_dim/slice_require "
+                "must each be part of the key",
+            )
+        )
+    # sharded twin: the mesh shape must discriminate slice keys too
+    ndev = len(jax.devices())
+    size = 1
+    while size * 2 <= min(ndev, 8):
+        size *= 2
+    mesh = sharded.make_mesh(size)
+    mesh_sig = sharded.mesh_signature(mesh)
+    ff = assign.FeatureFlags(slices=True, slice_z=2, slice_dim=2)
+    if retrace.signature(snap, (1, ff, 4, mesh_sig)) == retrace.signature(
+        snap, (1, ff, 4)
+    ):
+        findings.append(
+            Finding(
+                CHECK, file, 1, "carveout_eval",
+                "sharded slice compile key collides with the single-chip "
+                "key (mesh shape must be part of the signature)",
+            )
+        )
+    if n % size == 0:
+        try:
+            res = jax.eval_shape(
+                lambda s: sharded.sharded_greedy_assign(
+                    s, mesh, topo_z=1, features=ff, n_groups=4
+                ),
+                snap,
+            )
+            if getattr(res, "frag_score", None) is None:
+                findings.append(
+                    Finding(
+                        CHECK, file, 1, "frag_score",
+                        "sharded slice-family solve returned no frag_score",
+                    )
+                )
+        except Exception as e:  # noqa: BLE001
+            findings.append(
+                Finding(
+                    CHECK, file, 1, "sharded_greedy_assign",
+                    f"sharded slice eval_shape failed: {e}",
+                )
+            )
+
+
 def _check_gang_retry_closure(findings: List[Finding]) -> None:
     """The gang-admission binary search re-solves SUBSETS of the batch
     with num_pods_hint pinned to the full batch size: every subset must
@@ -875,6 +1046,7 @@ def check(root: str, package: str = "kubernetes_tpu") -> List[Finding]:
     _check_kernels(byclass, findings)
     _check_preemption_kernel(byclass, findings)
     _check_mesh_kernels(byclass, findings)
+    _check_slice_kernels(byclass, findings)
     _check_gang_retry_closure(findings)
     findings.sort(key=lambda f: (f.file, f.line, f.message))
     return findings

@@ -53,6 +53,55 @@ LABEL_HOSTNAME = "kubernetes.io/hostname"
 LABEL_ZONE = "topology.kubernetes.io/zone"
 LABEL_REGION = "topology.kubernetes.io/region"
 
+# TPU slice-topology node labels (the GKE tpu-topology label family,
+# normalized): a node that is one device of a multi-host TPU slice
+# carries its slice (pool) name, the slice's torus extent "XxYxZ", its
+# own coordinates "x,y,z" within the slice, and (optionally) a core
+# index on the host.  ops/schema.py encodes them into the cluster
+# tensors (slice_id / torus_coords / slice_dims / slice_pos);
+# ops/slices.py carves gangs out of them.
+LABEL_TPU_SLICE = "tpu.kubernetes.io/slice"
+LABEL_TPU_TOPOLOGY = "tpu.kubernetes.io/topology"
+LABEL_TPU_COORDS = "tpu.kubernetes.io/coords"
+LABEL_TPU_CORE = "tpu.kubernetes.io/core"
+
+
+def parse_topology(text) -> Optional[Tuple[int, int, int]]:
+    """Parse an "AxBxC" torus-extent string (1 or 2 axes are padded
+    with trailing 1s: "8" -> (8,1,1), "4x2" -> (4,2,1)).  Returns None
+    for anything unparseable or non-positive — callers treat that as
+    'no declared topology', never an error (one malformed label must
+    not sink an encode)."""
+    if not text or not isinstance(text, str):
+        return None
+    parts = text.lower().split("x")
+    if not 1 <= len(parts) <= 3:
+        return None
+    try:
+        dims = [int(p) for p in parts]
+    except ValueError:
+        return None
+    if any(d <= 0 for d in dims):
+        return None
+    return tuple(dims + [1] * (3 - len(dims)))
+
+
+def parse_coords(text) -> Optional[Tuple[int, int, int]]:
+    """Parse an "x,y,z" in-slice coordinate string (missing trailing
+    axes read 0).  None for unparseable/negative values."""
+    if not text or not isinstance(text, str):
+        return None
+    parts = text.split(",")
+    if not 1 <= len(parts) <= 3:
+        return None
+    try:
+        coords = [int(p) for p in parts]
+    except ValueError:
+        return None
+    if any(c < 0 for c in coords):
+        return None
+    return tuple(coords + [0] * (3 - len(parts)))
+
 _uid_counter = itertools.count(1)
 
 
@@ -380,6 +429,11 @@ class PodSpec:
     # never solved (and hence never partially bound) before it is whole.
     scheduling_group_size: Optional[int] = None
     scheduling_gates: List[str] = field(default_factory=list)
+    # Requested TPU carve-out shape "AxBxC" (api.parse_topology): the
+    # pod — or, for a gang, every member of its scheduling_group — asks
+    # to be placed as a contiguous axis-aligned sub-cuboid of one TPU
+    # slice (ops/slices.py).  Empty = no topology request.
+    tpu_topology: str = ""
     restart_policy: str = "Always"
     termination_grace_period_seconds: int = 30
     service_account: str = ""  # defaulted to "default" at admission
@@ -710,6 +764,12 @@ class DeviceClass:
 class ResourceClaimSpec:
     device_class_name: str = ""
     count: int = 1                 # devices requested from the class
+    # Topology-shaped claim: request an "AxBxC" contiguous carve-out of
+    # one TPU slice instead of `count` loose devices.  Allocation
+    # records the carve-out (status.carveout) and every consumer is
+    # pinned INSIDE it via slice/coord label selector terms — matched
+    # in the batched filter, not host Python.
+    topology: str = ""
 
 
 @dataclass
@@ -720,6 +780,10 @@ class ResourceClaimStatus:
     # claim's device count — keeps usage stable across the pod's
     # lifetime while sharers add only the co-location pin
     carrier: str = ""
+    # topology-shaped allocation record: "slice=<name>;lo=x,y,z;shape=AxBxC"
+    # (scheduler/deviceclaims.py format_carveout) — the carved sub-cuboid
+    # consumers are pinned inside
+    carveout: str = ""
 
 
 @dataclass

@@ -211,6 +211,10 @@ class HostSolve:
     result = None
     wave_count = None
     wave_fallbacks = None
+    frag_score = None
+    carveouts = None
+    contiguous_gangs = None
+    carveout_fallbacks = None
 
     def __init__(self, names: List[Optional[str]]):
         self._names = names
@@ -461,6 +465,15 @@ class DeviceSolve:
                 "reasons": self.result.reasons,  # None stays None
                 "wave_count": getattr(self.result, "wave_count", None),
                 "wave_fallbacks": getattr(self.result, "wave_fallbacks", None),
+                # slice carve-out telemetry (None off the slice family)
+                "frag_score": getattr(self.result, "frag_score", None),
+                "carveouts": getattr(self.result, "carveouts", None),
+                "contiguous_gangs": getattr(
+                    self.result, "contiguous_gangs", None
+                ),
+                "carveout_fallbacks": getattr(
+                    self.result, "carveout_fallbacks", None
+                ),
             }
             try:
                 got = jax.device_get(tree)  # one coalesced readback
@@ -487,6 +500,13 @@ class DeviceSolve:
                 None if got["wave_count"] is None else int(got["wave_count"]),
                 None if got["wave_fallbacks"] is None
                 else int(got["wave_fallbacks"]),
+                None if got["frag_score"] is None
+                else float(got["frag_score"]),
+                None if got["carveouts"] is None else int(got["carveouts"]),
+                None if got["contiguous_gangs"] is None
+                else int(got["contiguous_gangs"]),
+                None if got["carveout_fallbacks"] is None
+                else int(got["carveout_fallbacks"]),
             )
         return self._decoded
 
@@ -507,6 +527,23 @@ class DeviceSolve:
     @property
     def wave_fallbacks(self) -> Optional[int]:
         return self._decode()[3]
+
+    @property
+    def frag_score(self) -> Optional[float]:
+        """Post-solve cluster fragmentation (None off the slice family)."""
+        return self._decode()[4]
+
+    @property
+    def carveouts(self) -> Optional[int]:
+        return self._decode()[5]
+
+    @property
+    def contiguous_gangs(self) -> Optional[int]:
+        return self._decode()[6]
+
+    @property
+    def carveout_fallbacks(self) -> Optional[int]:
+        return self._decode()[7]
 
 
 class SolverPrewarmPool:
@@ -649,6 +686,7 @@ class TPUBatchScheduler:
         wave_cap: int = assign_ops.DEFAULT_WAVE_CAP,
         prewarm: Optional[bool] = None,  # None = auto (off on CPU backend)
         arbiter: Optional[DispatchArbiter] = None,  # shared across lanes
+        carveout_policy: str = "prefer",  # slice carve-outs: prefer|require|off
     ):
         if state is not None:
             # shared-state instance: multiple scheduler PROFILES solve the
@@ -664,6 +702,16 @@ class TPUBatchScheduler:
         self.mesh = mesh
         self.use_wavefront = use_wavefront
         self.wave_cap = wave_cap
+        # TPU slice carve-out policy (ops/slices.py): "prefer" biases
+        # shaped gangs onto contiguous sub-cuboids, "require" filters on
+        # them (a gang that can't fit contiguously parks whole), "off"
+        # disarms the family (SchedulerConfiguration.slice_carveout_policy)
+        if carveout_policy not in ("prefer", "require", "off"):
+            raise ValueError(
+                f"carveout_policy must be prefer|require|off, got "
+                f"{carveout_policy!r}"
+            )
+        self.carveout_policy = carveout_policy
         self._greedy = assign_ops.greedy_assign_jit(score_config)
         self._wavefront = assign_ops.wavefront_assign_jit(score_config)
         self._auction = auction_ops.auction_assign_jit(score_config)
@@ -793,10 +841,16 @@ class TPUBatchScheduler:
         if route == "greedy" and (
             self.use_wavefront
             and snap.pods.req.shape[0] >= self.WAVEFRONT_MIN_PODS
+            and not features.slices
         ):
             # same semantics as the scan (ops.assign parity suite), P/W
             # sequential steps instead of P; mesh mode routes here too —
-            # the sharded wavefront is scan-identical across shards
+            # the sharded wavefront is scan-identical across shards.
+            # Slice carve-out batches stay on the classic scan: every
+            # shaped pod writes the free mask every other shaped pod's
+            # corner evaluation reads, so wave-start evaluation cannot
+            # hold (auction_features_ok excludes them for the same
+            # reason — sequential-by-construction anchor semantics).
             route = "wavefront"
         return route
 
@@ -844,6 +898,7 @@ class TPUBatchScheduler:
             class_id=redim(shapes.pods.class_id),
             priority=redim(shapes.pods.priority),
             group_id=redim(shapes.pods.group_id),
+            pod_shape=redim(shapes.pods.pod_shape),
         )
         return shapes._replace(
             pods=pods,
@@ -953,7 +1008,9 @@ class TPUBatchScheduler:
         than required aliases topology domains together and silently
         corrupts spread/inter-pod state, so it is validated (when those
         families are active — it is unused otherwise)."""
-        features = assign_ops.features_of(snap)
+        features = assign_ops.features_of(
+            snap, slice_policy=self.carveout_policy
+        )
         if assign_ops.needs_topo(features):
             required = assign_ops.required_topo_z(snap)
             if topo_z is None:
@@ -970,7 +1027,9 @@ class TPUBatchScheduler:
         self, snap: schema.Snapshot, meta: Optional[schema.SnapshotMeta] = None
     ) -> Result:
         meta = meta or schema.SnapshotMeta(0, 0, [], [], self.builder.limits)
-        features = meta.features or assign_ops.features_of(snap)
+        features = meta.features or assign_ops.features_of(
+            snap, slice_policy=self.carveout_policy
+        )
         topo_split = meta.topo_split or assign_ops.required_topo_z_split(snap)
         n_groups = (
             meta.n_groups
@@ -1058,7 +1117,8 @@ class TPUBatchScheduler:
             # probing them post-transfer costs one tunnel round-trip each
             no_bound = not self.state._pods
             meta.features = assign_ops.features_of(
-                snap, no_bound_pods=no_bound
+                snap, no_bound_pods=no_bound,
+                slice_policy=self.carveout_policy,
             )
             meta.topo_split = assign_ops.required_topo_z_split(snap)
             meta.n_groups = schema.num_groups(snap)
@@ -1122,6 +1182,15 @@ class TPUBatchScheduler:
         (DeviceSolve) and the readback happens on first names()/reasons()
         access — callers overlap it with host work."""
         act = faults.fire("batch.solve", pods=meta.num_pods)
+        if (
+            meta.features is not None
+            and getattr(meta.features, "slices", False)
+            and (meta.n_groups or 0) > 0
+        ):
+            # the gang carve-out dispatch point (chaos seeds 600-604):
+            # fail-grade schedules kill the solve here and ride the same
+            # retry/breaker containment as batch.solve faults
+            faults.fire("solve.carveout", gangs=meta.n_groups)
         slot = self.arbiter
         if slot is not None:
             # multi-lane admission: at most `depth` device programs in
@@ -1326,7 +1395,8 @@ class TPUBatchScheduler:
                 if name in state._node_objs
             ]
             oracle = Oracle(
-                nodes, fit_strategy=self.score_config.fit_strategy
+                nodes, fit_strategy=self.score_config.fit_strategy,
+                slice_policy=self.carveout_policy,
             )
             by_name = {s.node.meta.name: s for s in oracle.states}
             for key, pod in state._pods.items():
@@ -1477,7 +1547,9 @@ class TPUBatchScheduler:
             # device_get instead of a bare np.asarray readback per
             # gang-retry subset solve (a graftlint purity finding —
             # each bare readback paid a blocking round-trip)
-            meta.features = assign_ops.features_of(snap)
+            meta.features = assign_ops.features_of(
+                snap, slice_policy=self.carveout_policy
+            )
             meta.topo_split = assign_ops.required_topo_z_split(snap)
             meta.n_groups = schema.num_groups(snap)
             meta.tie_k = auction_ops.default_tie_k(snap)

@@ -53,7 +53,7 @@ from ..utils import vocab as vb
 # separately: its node axis is axis 1.
 _STATIC_LEAVES = (
     "allocatable", "node_valid", "name_id", "label_bits", "topo_ids",
-    "image_bits",
+    "image_bits", "slice_id", "torus_coords", "slice_dims", "slice_pos",
 )
 _USAGE_LEAVES = ("requested", "nonzero_requested", "port_bits")
 

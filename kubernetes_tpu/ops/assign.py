@@ -86,6 +86,15 @@ class FeatureFlags(NamedTuple):
     bound_spread: bool = False
     bound_terms: bool = False
     bound_pref: bool = False
+    # TPU slice-topology carve-outs (ops/slices.py): active when shaped
+    # pods meet a slice-labelled cluster.  slice_z/slice_dim size the
+    # value-space grid [S, D, D, D] (static, like topo_z they are part
+    # of the executable key); slice_require flips the carve-out
+    # preference into a filter (the prefer-vs-require config knob).
+    slices: bool = False
+    slice_require: bool = False
+    slice_z: int = 1
+    slice_dim: int = 1
 
 
 def required_topo_z(snapshot: Snapshot) -> int:  # graftlint: disable=purity -- host-side prep on the pre-transfer snapshot
@@ -130,14 +139,22 @@ def needs_topo(features: FeatureFlags) -> bool:
 
 
 def features_of(  # graftlint: disable=purity -- host-side prep: cheap numpy reductions on the pre-transfer snapshot
-    snapshot: Snapshot, no_bound_pods: bool = False
+    snapshot: Snapshot, no_bound_pods: bool = False,
+    slice_policy: str = "prefer",
 ) -> FeatureFlags:
     """Derive the static gates host-side (cheap numpy reductions).
 
     no_bound_pods: the caller knows the cluster holds zero bound pods
     (ClusterState._pods empty), so the bound-count tables are zeros by
     construction — skips full scans of the largest snapshot arrays
-    (tens of MB each at 20k+ nodes) on the per-batch encode path."""
+    (tens of MB each at 20k+ nodes) on the per-batch encode path.
+
+    slice_policy: the carve-out knob ("prefer" | "require" | "off",
+    SchedulerConfiguration.slice_carveout_policy) — the slice family
+    arms only when shaped pods meet a slice-labelled cluster AND the
+    policy isn't off."""
+    from ..utils.vocab import pad_dim
+
     spread_valid = np.asarray(snapshot.spread.valid)
     hard = np.asarray(snapshot.spread.hard)
     term_valid = np.asarray(snapshot.terms.valid)
@@ -154,6 +171,20 @@ def features_of(  # graftlint: disable=purity -- host-side prep: cheap numpy red
             np.asarray(snapshot.prefpod.node_counts).any()
             or np.asarray(snapshot.prefpod.owner_weight).any()
         )
+    shapes = np.asarray(snapshot.pods.pod_shape)
+    sids = np.asarray(snapshot.cluster.slice_id)
+    slices_on = (
+        slice_policy != "off"
+        and bool((shapes.prod(axis=1) > 0).any())
+        and bool((sids >= 0).any())
+    )
+    if slices_on:
+        slice_z = pad_dim(int(sids.max()) + 1, 1)
+        slice_dim = pad_dim(
+            max(int(np.asarray(snapshot.cluster.slice_dims).max()), 1), 1
+        )
+    else:
+        slice_z = slice_dim = 1
     return FeatureFlags(
         spread=bool(spread_valid.any()),
         soft_spread=bool((spread_valid & ~hard).any()),
@@ -172,6 +203,10 @@ def features_of(  # graftlint: disable=purity -- host-side prep: cheap numpy red
         bound_spread=bound_spread,
         bound_terms=bound_terms,
         bound_pref=bound_pref,
+        slices=slices_on,
+        slice_require=slices_on and slice_policy == "require",
+        slice_z=slice_z,
+        slice_dim=slice_dim,
     )
 
 
@@ -189,6 +224,8 @@ REASON_INTERPOD = 4   # InterPodAffinity (required)
 REASON_GANG = 5       # placed individually but released with its gang
 REASON_UNENCODABLE = 6  # spec exceeds encoder caps / unsupported field —
                         # only a pod UPDATE can help; no event wakes it
+REASON_SLICE = 7      # slice carve-out (require mode): no free contiguous
+                      # sub-cuboid / anchored cuboid exhausted
 
 
 def _axis_any(x: jnp.ndarray, axis_name: Optional[str]) -> jnp.ndarray:
@@ -268,6 +305,12 @@ class SolveResult(NamedTuple):
     # count and fallback count (serialized waves + per-pod full re-evals)
     wave_count: jnp.ndarray = None      # i32[]
     wave_fallbacks: jnp.ndarray = None  # i32[]
+    # slice carve-out telemetry (None unless features.slices): post-solve
+    # cluster fragmentation and per-gang carve-out outcomes
+    frag_score: jnp.ndarray = None          # f32[]
+    carveouts: jnp.ndarray = None           # i32[]
+    contiguous_gangs: jnp.ndarray = None    # i32[]
+    carveout_fallbacks: jnp.ndarray = None  # i32[]
 
 
 def class_statics(
@@ -345,12 +388,19 @@ def _eval_pod(
     features: FeatureFlags,
     cfg: ScoreConfig,
     axis_name: Optional[str] = None,
+    gang_sl: Optional[jnp.ndarray] = None,
+    gang_lo: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """The Filter+Score half of one scheduling step for pod i against the
     given carry state: (feas[N], masked_scores[N], found, reason,
     feasible_count).  Shared verbatim by the classic scan step, the
     wavefront pre-evaluation, and the wavefront's exact re-evaluation
     fallback, so the three paths cannot drift apart.
+
+    gang_sl/gang_lo: the slice carve-out carry ([G] anchored slice id,
+    [G, 3] carved corner) when features.slices and gangs are present —
+    the carve-out family (ops/slices.py) filters (require mode) and
+    score-biases (both modes) shaped pods toward contiguous sub-cuboids.
 
     Under shard_map (axis_name set) the node tensors hold one shard:
     feas/masked stay local while the per-stage anys, the feasible count,
@@ -369,8 +419,23 @@ def _eval_pod(
     a_spread = _axis_any(feas, axis_name)
     if features.interpod:
         feas = feas & interpod_filter(tm, terms, i)
+    s_bonus = None
+    if features.slices:
+        from .slices import carveout_eval
+
+        s_bonus, s_ok = carveout_eval(
+            cl, pods, i, gang_sl, gang_lo, features, axis_name=axis_name
+        )
+        if features.slice_require:
+            a_interpod = _axis_any(feas, axis_name)
+            feas = feas & s_ok
     found = _axis_any(feas, axis_name)
     # first stage whose filter emptied the candidate set
+    last = (
+        jnp.where(~a_interpod, REASON_INTERPOD, REASON_SLICE)
+        if features.slices and features.slice_require
+        else REASON_INTERPOD
+    )
     reason = jnp.where(
         found, REASON_NONE,
         jnp.where(
@@ -379,7 +444,7 @@ def _eval_pod(
                 ~a_res, REASON_RESOURCES,
                 jnp.where(
                     ~a_ports, REASON_PORTS,
-                    jnp.where(~a_spread, REASON_SPREAD, REASON_INTERPOD),
+                    jnp.where(~a_spread, REASON_SPREAD, last),
                 ),
             ),
         ),
@@ -394,6 +459,11 @@ def _eval_pod(
         spread_score=sp_score,
         extra=extra_c[cls] if extra_c is not None else None,
     )
+    if s_bonus is not None:
+        # the carve-out family rides OUTSIDE the normalized base sum:
+        # exact-integer bonuses large enough that contiguous placements
+        # rank strictly above fragmenting ones (ops/slices.py weights)
+        scores = scores + s_bonus
     masked = jnp.where(feas, scores, NEG_INF)
     cnt = feas.sum().astype(jnp.int32)
     if axis_name is not None:
@@ -550,16 +620,20 @@ def greedy_assign(
      sp0, tm0, c_dim, n, p) = _solver_prep(
         snapshot, cfg, topo_z, features, axis_name=axis_name
     )
-    offset, _n_total, node_rows, node_col = _shard_layout(axis_name, n)
+    offset, n_total, node_rows, node_col = _shard_layout(axis_name, n)
     order = solve_order(pods)
     keys = (
         jax.random.split(jax.random.PRNGKey(tie_seed), p)
         if tie_seed is not None
         else None
     )
+    # slice carve-out carry: per-gang anchored slice + carved corner
+    # (written by the gang's first placed member, read by the rest)
+    use_gang_carve = features.slices and n_groups > 0
 
     def step(carry, k):
-        requested, nonzero, new_ports, sp_counts, tm_present, tm_blocked, tm_global = carry
+        (requested, nonzero, new_ports, sp_counts, tm_present, tm_blocked,
+         tm_global, gang_sl, gang_lo, gang_corner) = carry
         i = order[k]
         cl = cluster._replace(requested=requested, nonzero_requested=nonzero)
         pod = pod_view(pods, i)
@@ -575,6 +649,8 @@ def greedy_assign(
             cl, pods, i, cls, sfeas_c, aff_c, taint_c, extra_c,
             new_ports, sp, tm, spread, terms, features, cfg,
             axis_name=axis_name,
+            gang_sl=gang_sl if use_gang_carve else None,
+            gang_lo=gang_lo if use_gang_carve else None,
         )
         if axis_name is None:
             choice = _pick(masked, feas, keys[k] if keys is not None else None)
@@ -604,9 +680,39 @@ def greedy_assign(
             tm_present, tm_blocked, tm_global = (
                 tm.present_bits, tm.blocked_bits, tm.global_any
             )
+        if use_gang_carve:
+            from .slices import corner_mask as _corner_mask
+            from .slices import free_devices as _free_devices
+
+            g = pods.group_id[i]
+            gc = jnp.clip(g, 0, n_groups - 1)
+            shaped = pods.pod_shape[i].prod() > 0
+            ch_sid = node_rows(cluster.slice_id, choice)
+            ch_xyz = node_rows(cluster.torus_coords, choice)[:3]
+            # was the anchor a genuine free-box corner (pre-placement
+            # carry state)?  Drives the contiguous-vs-fallback counters:
+            # a prefer-mode anchor dropped on a non-corner can still
+            # cluster its members, but the REQUESTED carve-out was not
+            # realized
+            corner_n = _corner_mask(
+                cl, _free_devices(cl), pods.pod_shape[i],
+                features.slice_z, features.slice_dim, axis_name=axis_name,
+            )
+            ch_corner = node_rows(corner_n, choice)
+            new_anchor = found & (g >= 0) & shaped & (gang_sl[gc] < 0)
+            gang_sl = gang_sl.at[gc].set(
+                jnp.where(new_anchor, ch_sid, gang_sl[gc])
+            )
+            gang_lo = gang_lo.at[gc].set(
+                jnp.where(new_anchor, ch_xyz, gang_lo[gc])
+            )
+            gang_corner = gang_corner.at[gc].set(
+                jnp.where(new_anchor, ch_corner, gang_corner[gc])
+            )
         out = (i, idx, jnp.where(found, win_val, NEG_INF),
                feas_cnt, reason)
-        carry = (requested, nonzero, new_ports, sp_counts, tm_present, tm_blocked, tm_global)
+        carry = (requested, nonzero, new_ports, sp_counts, tm_present,
+                 tm_blocked, tm_global, gang_sl, gang_lo, gang_corner)
         return carry, out
 
     zero = jnp.zeros(())
@@ -618,10 +724,15 @@ def greedy_assign(
         tm0.present_bits if features.interpod else zero,
         tm0.blocked_bits if features.interpod else zero,
         tm0.global_any if features.interpod else zero,
+        jnp.full(n_groups, -1, jnp.int32) if use_gang_carve else zero,
+        jnp.full((n_groups, 3), -1, jnp.int32) if use_gang_carve else zero,
+        jnp.zeros(n_groups, bool) if use_gang_carve else zero,
     )
-    (requested, nonzero, new_ports, *_rest), (pod_is, assign_o, win_o, feas_o, reason_o) = (
-        jax.lax.scan(step, init, jnp.arange(p))
-    )
+    (
+        (requested, nonzero, new_ports, _sp_c, _tm_p, _tm_b, _tm_g,
+         gang_sl_f, gang_lo_f, gang_corner_f),
+        (pod_is, assign_o, win_o, feas_o, reason_o),
+    ) = jax.lax.scan(step, init, jnp.arange(p))
     # Scatter scan outputs (priority order) back to batch positions.
     assignment = jnp.full(p, -1, jnp.int32).at[pod_is].set(assign_o)
     win_scores = jnp.full(p, NEG_INF).at[pod_is].set(win_o)
@@ -642,7 +753,54 @@ def greedy_assign(
         port_bits=(cluster.port_bits | new_ports) if features.ports
         else cluster.port_bits,
     )
-    return SolveResult(assignment, win_scores, feas_counts, final, reasons)
+    frag = carveouts = contiguous = fallbacks = None
+    if features.slices:
+        from .slices import fragmentation
+
+        frag = fragmentation(
+            final, features.slice_z, features.slice_dim,
+            axis_name=axis_name,
+        ).score
+        carveouts = jnp.int32(0)
+        contiguous = jnp.int32(0)
+        fallbacks = jnp.int32(0)
+        if use_gang_carve:
+            # carve-out telemetry over the POST-RELEASE assignment:
+            # anchored = the gang carved a box; complete = every shaped
+            # member placed; contiguous = complete with every member
+            # inside its box (require mode makes complete ⇒ contiguous)
+            g = pods.group_id
+            gc = jnp.clip(g, 0, n_groups - 1)
+            member = pods.valid & (g >= 0) & (pods.pod_shape.prod(-1) > 0)
+            any_member = jnp.zeros(n_groups, bool).at[gc].max(member)
+            unplaced = jnp.zeros(n_groups, bool).at[gc].max(
+                member & (assignment < 0)
+            )
+            complete = any_member & ~unplaced
+            a = jnp.clip(assignment, 0, n_total - 1)
+            a_sid = node_rows(cluster.slice_id, a)           # i32[P]
+            a_xyz = node_rows(cluster.torus_coords, a)[:, :3]
+            lo = gang_lo_f[gc]
+            in_cub = (
+                (a_sid == gang_sl_f[gc])
+                & (a_xyz >= lo).all(-1)
+                & (a_xyz < lo + pods.pod_shape).all(-1)
+            )
+            out_of_cub = jnp.zeros(n_groups, bool).at[gc].max(
+                member & (assignment >= 0) & ~in_cub
+            )
+            anchored = (gang_sl_f >= 0) & any_member
+            carveouts = anchored.sum().astype(jnp.int32)
+            contiguous = (
+                (complete & anchored & gang_corner_f & ~out_of_cub)
+                .sum().astype(jnp.int32)
+            )
+            fallbacks = complete.sum().astype(jnp.int32) - contiguous
+    return SolveResult(
+        assignment, win_scores, feas_counts, final, reasons,
+        frag_score=frag, carveouts=carveouts,
+        contiguous_gangs=contiguous, carveout_fallbacks=fallbacks,
+    )
 
 
 def greedy_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
@@ -877,7 +1035,8 @@ def _rows_cluster(cap, requested, nonzero):
     return ClusterTensors(
         allocatable=cap, requested=requested, nonzero_requested=nonzero,
         node_valid=None, name_id=None, label_bits=None, taint_bits=None,
-        port_bits=None, topo_ids=None, image_bits=None,
+        port_bits=None, topo_ids=None, image_bits=None, slice_id=None,
+        torus_coords=None, slice_dims=None, slice_pos=None,
     )
 
 
@@ -911,6 +1070,14 @@ def wavefront_assign(
 
     if features is None:
         features = features_of(snapshot)
+    if features.slices:
+        # every shaped pod writes the free mask that every other shaped
+        # pod's corner evaluation reads — wave-start evaluation cannot
+        # hold; TPUBatchScheduler._route keeps these on the classic scan
+        raise ValueError(
+            "slice carve-out batches (features.slices) route to the "
+            "classic greedy scan, not the wavefront solver"
+        )
     if topo_z is None:
         topo_z = required_topo_z(snapshot)
     (cluster, pods, spread, terms, sfeas_c, aff_c, taint_c, extra_c,
@@ -1419,6 +1586,16 @@ def evaluate_single(
             has_bound=features.bound_terms,
         )
         feas = feas & interpod_filter(tm, terms, 0)
+    s_bonus = None
+    if features.slices:
+        # single-pod view: anchor semantics only (no gang carry)
+        from .slices import carveout_eval
+
+        s_bonus, s_ok = carveout_eval(
+            cluster, pods, 0, None, None, features
+        )
+        if features.slice_require:
+            feas = feas & s_ok
     extra = None
     if features.interpod_pref or features.images:
         from .scores import static_extra
@@ -1439,4 +1616,6 @@ def evaluate_single(
         taint_toleration_raw(cluster, pod),
         cfg, spread_score=sp_score, extra=extra,
     )
+    if s_bonus is not None:
+        scores = scores + s_bonus
     return feas, jnp.where(feas, scores, NEG_INF)

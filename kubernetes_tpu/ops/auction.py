@@ -115,8 +115,11 @@ class AuctionResult(NamedTuple):
 
 
 def auction_features_ok(features: FeatureFlags) -> bool:
-    """True when the joint solve covers this batch's constraint families."""
-    return not (features.ports or features.interpod_aff)
+    """True when the joint solve covers this batch's constraint families.
+    Slice carve-outs (features.slices) are sequential-by-construction —
+    the anchor member's placement defines every later member's cuboid —
+    so shaped batches stay on the greedy scan."""
+    return not (features.ports or features.interpod_aff or features.slices)
 
 
 def default_tie_k(snapshot: Snapshot) -> int:  # graftlint: disable=purity -- host-side prep on the pre-transfer snapshot

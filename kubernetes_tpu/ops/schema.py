@@ -89,6 +89,11 @@ class ClusterTensors(NamedTuple):
     port_bits: np.ndarray          # u32[N, PW]
     topo_ids: np.ndarray           # i32[N, TK]  per-key value id, -1 absent
     image_bits: np.ndarray         # u32[N, IW]  images present on the node
+    # TPU slice topology (api.LABEL_TPU_* node labels; ops/slices.py):
+    slice_id: np.ndarray           # i32[N]  slice/pool membership, -1 none
+    torus_coords: np.ndarray       # i32[N, 4]  in-slice (x, y, z, core), -1 absent
+    slice_dims: np.ndarray         # i32[N, 3]  owning slice's torus extent, 0 absent
+    slice_pos: np.ndarray          # i32[N]  linear in-slice position, -1 absent
 
 
 class SelectorTable(NamedTuple):
@@ -190,6 +195,7 @@ class PodBatch(NamedTuple):
     class_rep: np.ndarray    # i32[C]  representative pod index, -1 pad
     priority: np.ndarray     # f32[P]  pod priority (queuesort order)
     group_id: np.ndarray     # i32[P]  gang/coscheduling group, -1 none
+    pod_shape: np.ndarray    # i32[P, 3]  requested carve-out extent, 0 none
     spec_rep: np.ndarray     # i32[Cs] representative pod per spec class
     joint_spec: np.ndarray   # i32[C]  spec class of each joint class
     cons_rep: np.ndarray     # i32[Cc] representative pod per constraint class
@@ -275,6 +281,10 @@ class SnapshotLimits:
     topology_keys: Tuple[str, ...] = (api.LABEL_HOSTNAME, api.LABEL_ZONE, api.LABEL_REGION)
     min_nodes: int = 8
     min_pods: int = 8
+    # largest per-axis torus extent a slice may declare
+    # (api.LABEL_TPU_TOPOLOGY) — bounds the ops/slices.py value-space
+    # grid at [S, D, D, D]; an over-cap label raises at encode
+    max_slice_dim: int = 16
 
     @property
     def label_words(self) -> int:
@@ -348,6 +358,9 @@ class SnapshotBuilder:
         self.topo_vocabs: Dict[str, vb.Vocab] = {
             k: vb.Vocab() for k in self.limits.topology_keys
         }
+        # slice/pool names (api.LABEL_TPU_SLICE) -> dense slice ids for
+        # ClusterTensors.slice_id; append-only like every other vocab
+        self.slice_vocab = vb.Vocab()
         self.scalar_resources: List[str] = []
         self._scalar_index: Dict[str, int] = {}
         # Optional per-pod requirement hook: (pod) -> (extra required
@@ -356,11 +369,34 @@ class SnapshotBuilder:
         # attach limits become scalar resources, so the device kernels
         # need no volume-specific code (scheduler/volumebinding.py).
         self.pod_transform = None
+        # Optional per-pod carve-out shape hook: (pod) -> (a, b, c) or
+        # None.  The device-claims integration point: an unallocated
+        # topology-shaped ResourceClaim gives its prospective carrier a
+        # carve-out shape (scheduler/deviceclaims.py pod_shape) on top
+        # of any pod.spec.tpu_topology request.
+        self.pod_shape_hook = None
 
     def _transform(self, pod: api.Pod):
         if self.pod_transform is None:
             return None, None
         return self.pod_transform(pod)
+
+    def pod_carveout_shape(self, pod: api.Pod) -> Tuple[int, int, int]:
+        """The pod's requested carve-out extent: pod.spec.tpu_topology,
+        else the shape hook's answer (topology-shaped device claims),
+        else (0, 0, 0) — the one derivation encode and policy surfaces
+        share."""
+        shape = api.parse_topology(pod.spec.tpu_topology)
+        if shape is None and self.pod_shape_hook is not None:
+            shape = self.pod_shape_hook(pod)
+        if shape is None:
+            return (0, 0, 0)
+        if max(shape) > self.limits.max_slice_dim:
+            raise OverflowError(
+                f"pod {pod.meta.name!r}: carve-out extent {shape} exceeds "
+                f"max_slice_dim={self.limits.max_slice_dim}"
+            )
+        return tuple(int(d) for d in shape)
 
     def effective_requests(self, pod: api.Pod) -> Dict[str, int]:
         """resource_requests plus the transform's extra scalar requests
@@ -724,11 +760,16 @@ class SnapshotBuilder:
         port_bits = np.zeros((n, lim.port_words), dtype=np.uint32)
         topo_ids = np.full((n, len(lim.topology_keys)), -1, dtype=np.int32)
         image_bits = np.zeros((n, lim.image_words), dtype=np.uint32)
+        slice_id = np.full(n, -1, dtype=np.int32)
+        torus_coords = np.full((n, 4), -1, dtype=np.int32)
+        slice_dims = np.zeros((n, 3), dtype=np.int32)
+        slice_pos = np.full(n, -1, dtype=np.int32)
 
         for i, node in enumerate(nodes):
             self._write_node_row(
                 node, i, valid, name_id, alloc, label_bits, taint_bits,
-                topo_ids, image_bits,
+                topo_ids, image_bits, slice_id, torus_coords, slice_dims,
+                slice_pos,
             )
 
         for pod in bound_pods:
@@ -751,7 +792,44 @@ class SnapshotBuilder:
             port_bits=port_bits,
             topo_ids=topo_ids,
             image_bits=image_bits,
+            slice_id=slice_id,
+            torus_coords=torus_coords,
+            slice_dims=slice_dims,
+            slice_pos=slice_pos,
         )
+
+    def _slice_row(self, node: api.Node) -> Tuple[int, tuple, tuple, int]:
+        """(slice id, (x, y, z, core), (dx, dy, dz), linear position) of
+        a node's TPU slice-topology labels, or the absent sentinel row.
+        Malformed coordinate/topology labels degrade to 'no topology'
+        (a bad label must not sink the encode); an over-cap extent
+        raises — the grid capacity is a static limit like every other
+        SnapshotLimits cap."""
+        absent = (-1, (-1, -1, -1, -1), (0, 0, 0), -1)
+        labels = node.meta.labels
+        name = labels.get(api.LABEL_TPU_SLICE)
+        if not name:
+            return absent
+        dims = api.parse_topology(labels.get(api.LABEL_TPU_TOPOLOGY))
+        coords = api.parse_coords(labels.get(api.LABEL_TPU_COORDS))
+        if dims is None or coords is None:
+            return absent
+        if max(dims) > self.limits.max_slice_dim:
+            raise OverflowError(
+                f"node {node.meta.name!r}: slice extent {dims} exceeds "
+                f"max_slice_dim={self.limits.max_slice_dim}"
+            )
+        if any(c >= d for c, d in zip(coords, dims)):
+            return absent  # coordinates outside the declared extent
+        try:
+            core = int(labels.get(api.LABEL_TPU_CORE, "0"))
+        except ValueError:
+            core = 0
+        sid = self.slice_vocab.intern(name)
+        x, y, z = coords
+        dx, dy, _dz = dims
+        pos = x + dx * (y + dy * z)
+        return sid, (x, y, z, core), dims, pos
 
     def _write_node_row(
         self,
@@ -764,6 +842,10 @@ class SnapshotBuilder:
         taint_bits: np.ndarray,
         topo_ids: np.ndarray,
         image_bits: Optional[np.ndarray] = None,
+        slice_id: Optional[np.ndarray] = None,
+        torus_coords: Optional[np.ndarray] = None,
+        slice_dims: Optional[np.ndarray] = None,
+        slice_pos: Optional[np.ndarray] = None,
     ) -> None:
         """Encode one node's static state into row i of the given arrays.
         Interns the node's strings first, so it is safe for incremental
@@ -792,6 +874,12 @@ class SnapshotBuilder:
                 topo_ids[i, j] = self.topo_vocabs[key].get(val)
         if image_bits is not None:
             self._image_row(node, image_bits[i])
+        if slice_id is not None:
+            sid, coords, dims, pos = self._slice_row(node)
+            slice_id[i] = sid
+            torus_coords[i] = coords
+            slice_dims[i] = dims
+            slice_pos[i] = pos
 
     def _check_f32_exact(
         self, name: str, row: np.ndarray, kind: str = "node"
@@ -857,6 +945,7 @@ class SnapshotBuilder:
         pref_weight = np.zeros((p_dim, mt), dtype=np.float32)
         priority = np.zeros(p_dim, dtype=np.float32)
         group_id = np.full(p_dim, -1, dtype=np.int32)
+        pod_shape = np.zeros((p_dim, 3), dtype=np.int32)
         group_index: Dict[str, int] = {}
 
         # Dedup tables keyed by canonical signatures.
@@ -895,11 +984,15 @@ class SnapshotBuilder:
                 # transform output (e.g. volume topology): pods with the
                 # same spec but different claims must not share a row
                 _selector_signature(extra_sel) if extra_sel else None,
+                # carve-out shape (spec.tpu_topology or the shape hook):
+                # shaped and unshaped pods must not share a row
+                self.pod_carveout_shape(pod),
             )
 
         for i, pod in enumerate(pods):
             valid[i] = True
             priority[i] = float(pod.spec.priority)
+            pod_shape[i] = self.pod_carveout_shape(pod)
             if pod.spec.scheduling_group:
                 group_id[i] = group_index.setdefault(
                     pod.spec.scheduling_group, len(group_index)
@@ -995,7 +1088,7 @@ class SnapshotBuilder:
 
         class_id, class_rep = _pod_classes(
             valid, name_id, sel_idx, tol_bits, tol_all, port_bits,
-            pref_idx, pref_weight, req, nonzero,
+            pref_idx, pref_weight, req, nonzero, pod_shape,
         )
         batch = PodBatch(
             valid=valid,
@@ -1012,6 +1105,7 @@ class SnapshotBuilder:
             class_rep=class_rep,
             priority=priority,
             group_id=group_id,
+            pod_shape=pod_shape,
             # unrefined: joint == spec, one trivial constraint class
             spec_rep=class_rep,
             joint_spec=np.arange(class_rep.shape[0], dtype=np.int32),
@@ -1414,6 +1508,10 @@ class ClusterState:
         self.port_bits = np.zeros((cap, lim.port_words), dtype=np.uint32)
         self.topo_ids = np.full((cap, len(lim.topology_keys)), -1, dtype=np.int32)
         self.image_bits = np.zeros((cap, lim.image_words), dtype=np.uint32)
+        self.slice_id = np.full(cap, -1, dtype=np.int32)
+        self.torus_coords = np.full((cap, 4), -1, dtype=np.int32)
+        self.slice_dims = np.zeros((cap, 3), dtype=np.int32)
+        self.slice_pos = np.full(cap, -1, dtype=np.int32)
         # i64 is deliberate here: monotonic host-side generation counters
         # for the mirror sync protocol — they never cross to the device
         # and must not wrap within a process lifetime
@@ -1435,6 +1533,10 @@ class ClusterState:
         self.port_bits[:h] = old.port_bits[:h]
         self.topo_ids[:h] = old.topo_ids[:h]
         self.image_bits[:h] = old.image_bits[:h]
+        self.slice_id[:h] = old.slice_id[:h]
+        self.torus_coords[:h] = old.torus_coords[:h]
+        self.slice_dims[:h] = old.slice_dims[:h]
+        self.slice_pos[:h] = old.slice_pos[:h]
         self._static_gen[:h] = old_sg[:h]
         self._usage_gen[:h] = old_ug[:h]
         self._cap = cap
@@ -1478,6 +1580,7 @@ class ClusterState:
         self.builder._write_node_row(
             node, i, self.node_valid, self.name_id, self.allocatable,
             self.label_bits, self.taint_bits, self.topo_ids, self.image_bits,
+            self.slice_id, self.torus_coords, self.slice_dims, self.slice_pos,
         )
         self._static_gen[i] = self._usage_gen[i] = self._bump()
 
@@ -1492,6 +1595,7 @@ class ClusterState:
         self.builder._write_node_row(
             node, i, self.node_valid, self.name_id, self.allocatable,
             self.label_bits, self.taint_bits, self.topo_ids, self.image_bits,
+            self.slice_id, self.torus_coords, self.slice_dims, self.slice_pos,
         )
         self._static_gen[i] = self._bump()
 
@@ -1516,6 +1620,10 @@ class ClusterState:
         self.port_bits[i] = 0
         self.topo_ids[i] = -1
         self.image_bits[i] = 0
+        self.slice_id[i] = -1
+        self.torus_coords[i] = -1
+        self.slice_dims[i] = 0
+        self.slice_pos[i] = -1
         self.node_names[i] = None
         self._static_gen[i] = self._usage_gen[i] = self._bump()
 
@@ -1530,6 +1638,10 @@ class ClusterState:
         self.port_bits[dst] = self.port_bits[src]
         self.topo_ids[dst] = self.topo_ids[src]
         self.image_bits[dst] = self.image_bits[src]
+        self.slice_id[dst] = self.slice_id[src]
+        self.torus_coords[dst] = self.torus_coords[src]
+        self.slice_dims[dst] = self.slice_dims[src]
+        self.slice_pos[dst] = self.slice_pos[src]
         name = self.node_names[src]
         self.node_names[dst] = name
         self._rows[name] = dst
@@ -1635,6 +1747,10 @@ class ClusterState:
             port_bits=self.port_bits[:n],
             topo_ids=self.topo_ids[:n],
             image_bits=self.image_bits[:n],
+            slice_id=self.slice_id[:n],
+            torus_coords=self.torus_coords[:n],
+            slice_dims=self.slice_dims[:n],
+            slice_pos=self.slice_pos[:n],
         )
 
     # -- device-mirror sync protocol --------------------------------------
@@ -1782,6 +1898,7 @@ def _pod_classes(
     pref_weight: np.ndarray,
     req: np.ndarray,
     nonzero_req: np.ndarray,
+    pod_shape: Optional[np.ndarray] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Group pods into spec-equivalence classes (see PodBatch docstring).
 
@@ -1806,7 +1923,8 @@ def _pod_classes(
             pref_weight.view(np.uint32),
             req.view(np.uint32),
             nonzero_req.view(np.uint32),
-        ],
+        ]
+        + ([pod_shape.view(np.uint32)] if pod_shape is not None else []),
         axis=1,
     )
     # Row-bytes dict dedup: ~10x faster than np.unique(axis=0)'s

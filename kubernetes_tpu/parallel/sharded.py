@@ -94,6 +94,10 @@ CLUSTER_SPECS = ClusterTensors(
     port_bits=P(AXIS, None),
     topo_ids=P(AXIS, None),
     image_bits=P(AXIS, None),
+    slice_id=P(AXIS),
+    torus_coords=P(AXIS, None),
+    slice_dims=P(AXIS, None),
+    slice_pos=P(AXIS),
 )
 
 
@@ -192,9 +196,17 @@ def sharded_greedy_assign(
     _check_divisible(parts[0].allocatable.shape[0], mesh)
 
     rep = P()
+    slice_specs = (
+        {
+            "frag_score": rep, "carveouts": rep,
+            "contiguous_gangs": rep, "carveout_fallbacks": rep,
+        }
+        if features.slices
+        else {}
+    )
     out_specs = SolveResult(
         assignment=rep, scores=rep, feasible_counts=rep,
-        cluster=CLUSTER_SPECS, reasons=rep,
+        cluster=CLUSTER_SPECS, reasons=rep, **slice_specs,
     )
 
     @partial(

@@ -249,6 +249,12 @@ class MetricsCollector:
         "scheduler_interleave_schedules_total",
         "scheduler_interleave_yield_points",
         "scheduler_atomicity_findings",
+        # TPU slice topology: post-solve fragmentation and gang
+        # carve-out outcomes (docs/scheduler_loop.md)
+        "scheduler_fragmentation_score",
+        "scheduler_slice_carveouts_total",
+        "scheduler_slice_carveout_fallbacks_total",
+        "scheduler_gang_contiguous_placements_total",
     )
 
     def __init__(

@@ -113,6 +113,22 @@ class SchedulerConfiguration:
     # commit overlaps shard B's staging and the next solve.  Requires a
     # multi-shard store (a 1-shard store keeps the whole-wave path).
     stream_subwaves: bool = True
+    # TPU slice carve-outs (docs/scheduler_loop.md "TPU slice topology"):
+    # how gang/claim carve-out requests (pod.spec.tpu_topology /
+    # ResourceClaim.spec.topology) bind to slice sub-cuboids.
+    #   prefer  — carve-out quality rides the score (contiguous
+    #             placements rank strictly above fragmenting ones; a
+    #             gang that can't fit contiguously scatters and counts a
+    #             carveout fallback);
+    #   require — the carve-out preference becomes a filter: a gang
+    #             without a free contiguous sub-cuboid parks whole
+    #             (all-or-nothing releases the anchor too);
+    #   off     — the slice family is disarmed.
+    slice_carveout_policy: str = "prefer"
+    # largest per-axis torus extent a slice may declare
+    # (SnapshotLimits.max_slice_dim — bounds the carve-out grid);
+    # 0 keeps the SnapshotLimits default
+    slice_max_dim: int = 0
     # parity-only knobs (see module docstring)
     parallelism: int = 16
     percentage_of_nodes_to_score: int = 100
@@ -124,6 +140,16 @@ class SchedulerConfiguration:
 
     def gate(self) -> FeatureGate:
         return FeatureGate(overrides=self.feature_gates)
+
+    def effective_limits(self) -> Optional[SnapshotLimits]:
+        """The SnapshotLimits every profile's builder uses: the explicit
+        `limits` when given (None means builder defaults), with a
+        non-zero sliceMaxDim knob folded in."""
+        lim = self.limits
+        if self.slice_max_dim > 0:
+            lim = lim if lim is not None else SnapshotLimits()
+            lim.max_slice_dim = self.slice_max_dim
+        return lim
 
     def validate(self) -> "SchedulerConfiguration":
         """Raise ValueError on an invalid configuration (the
@@ -210,6 +236,14 @@ class SchedulerConfiguration:
                 "buckets are powers of two, and the node axis must "
                 "split evenly across the mesh (parallel/sharded.py)"
             )
+        if self.slice_carveout_policy not in ("prefer", "require", "off"):
+            raise ValueError(
+                "slice_carveout_policy must be one of prefer|require|off"
+            )
+        if self.slice_max_dim < 0:
+            raise ValueError(
+                "slice_max_dim must be >= 0 (0 = SnapshotLimits default)"
+            )
         self.gate()  # unknown/locked gate overrides raise here
         return self
 
@@ -232,6 +266,7 @@ _TOP_KEYS = {
     "adaptiveBatchWindow", "batchWindowMinSeconds", "batchWindowMaxSeconds",
     "batchLatencySLOSeconds", "meshDevices", "commitSubwaveConcurrency",
     "schedulerLanes", "speculativeSolve", "streamSubwaves",
+    "sliceCarveoutPolicy", "sliceMaxDim",
 }
 
 
@@ -300,6 +335,10 @@ def load_config(source: Any) -> SchedulerConfiguration:
         cfg.speculative_solve = bool(doc["speculativeSolve"])
     if "streamSubwaves" in doc:
         cfg.stream_subwaves = bool(doc["streamSubwaves"])
+    if "sliceCarveoutPolicy" in doc:
+        cfg.slice_carveout_policy = str(doc["sliceCarveoutPolicy"])
+    if "sliceMaxDim" in doc:
+        cfg.slice_max_dim = int(doc["sliceMaxDim"])
     if "featureGates" in doc:
         cfg.feature_gates = {
             str(k): bool(v) for k, v in (doc["featureGates"] or {}).items()

@@ -25,6 +25,17 @@ Usage (out-of-band dict, kept for tests/embedding):
 Release is quorum-of-currently-waiting: a member that times out and
 requeues re-enters Permit on its retry, so stale arrivals can never
 release a partial gang.
+
+Slice carve-out preference (docs/scheduler_loop.md "TPU slice
+topology"): with a `node_lookup` wired, the release point additionally
+checks whether the gang's placements realize a contiguous carve-out —
+one slice, pairwise-distinct coordinates, bounding-box volume equal to
+the member count.  `carveout="prefer"` only counts the outcome
+(gang_contiguous_placements_total / slice_carveout_fallbacks_total
+when a metrics registry is given); `carveout="require"` REJECTS a
+non-contiguous gang instead of allowing it — every member requeues and
+re-solves (the solver's require-mode filter then steers the retry onto
+a contiguous sub-cuboid), so a fragmented release can never bind.
 """
 
 from __future__ import annotations
@@ -38,6 +49,35 @@ from .waitingpods import WaitingPodsMap
 DEFAULT_PERMIT_TIMEOUT = 30.0
 
 
+def carveout_contiguous(nodes) -> bool:
+    """True when the node set realizes a contiguous carve-out: every
+    node slice-labelled, one slice, pairwise-distinct coordinates, and
+    the axis-aligned bounding box exactly filled (volume == count) —
+    the host-policy half of the ops/slices.py semantics contract."""
+    infos = []
+    for node in nodes:
+        if node is None:
+            return False
+        labels = node.meta.labels
+        name = labels.get(api.LABEL_TPU_SLICE)
+        coords = api.parse_coords(labels.get(api.LABEL_TPU_COORDS))
+        if not name or coords is None:
+            return False
+        infos.append((name, coords))
+    if not infos:
+        return False
+    if len({name for name, _ in infos}) != 1:
+        return False
+    coords = [c for _, c in infos]
+    if len(set(coords)) != len(coords):
+        return False
+    vol = 1
+    for axis in range(3):
+        vals = [c[axis] for c in coords]
+        vol *= max(vals) - min(vals) + 1
+    return vol == len(coords)
+
+
 class CoschedulingPermit:
     def __init__(
         self,
@@ -45,12 +85,36 @@ class CoschedulingPermit:
         sizes: Optional[Dict[str, int]] = None,
         timeout: float = DEFAULT_PERMIT_TIMEOUT,
         directory=None,  # api.crd.PodGroupDirectory: sizes from PodGroups
+        carveout: str = "prefer",   # prefer | require | off
+        node_lookup=None,           # name -> api.Node, for carve-out checks
+        metrics=None,               # scheduler.metrics.Registry (optional)
     ):
         self.waiting = waiting
         self.sizes = dict(sizes or {})
         self.timeout = timeout
         self.directory = directory
+        if carveout not in ("prefer", "require", "off"):
+            raise ValueError(
+                f"carveout must be prefer|require|off, got {carveout!r}"
+            )
+        self.carveout = carveout
+        self.node_lookup = node_lookup
+        self.metrics = metrics
         self._lock = threading.Lock()
+
+    def _gang_shaped(self, pods) -> bool:
+        return any(api.parse_topology(p.spec.tpu_topology) for p in pods)
+
+    def _check_carveout(self, pods, node_names) -> Optional[bool]:
+        """None = not applicable (policy off / unshaped gang / no node
+        lookup); else whether the placements realize a carve-out."""
+        if self.carveout == "off" or self.node_lookup is None:
+            return None
+        if not self._gang_shaped(pods):
+            return None
+        return carveout_contiguous(
+            [self.node_lookup(name) for name in node_names]
+        )
 
     def _size_of(self, pod: api.Pod) -> Optional[int]:
         g = pod.spec.scheduling_group
@@ -114,6 +178,27 @@ class CoschedulingPermit:
                 for wp in claimed:
                     wp.release_claim()
                 return "wait", timeout
+            # carve-out check at the release point: the whole gang's
+            # placements are known only here
+            contiguous = self._check_carveout(
+                [wp.pod for wp in claimed] + [pod],
+                [wp.node for wp in claimed] + [node],
+            )
+            if contiguous is not None and self.metrics is not None:
+                if contiguous:
+                    self.metrics.gang_contiguous_placements.inc()
+                else:
+                    self.metrics.slice_carveout_fallbacks.inc()
+            if contiguous is False and self.carveout == "require":
+                # reject instead of binding a fragmented gang: claims
+                # roll back first (reject defers to a held claim), then
+                # every member requeues and re-solves under the
+                # require-mode carve-out filter
+                for wp in claimed:
+                    wp.release_claim()
+                for wp in claimed:
+                    wp.reject("slice carve-out not contiguous")
+                return "reject", 0.0
             for wp in claimed:
                 wp.allow()
             return "allow", 0.0

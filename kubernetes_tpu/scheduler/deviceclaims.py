@@ -73,6 +73,81 @@ def _pin(node_name: str) -> api.NodeSelector:
     )
 
 
+# -- topology-shaped claims ---------------------------------------------------
+#
+# A claim with spec.topology = "AxBxC" requests a contiguous carve-out
+# of one TPU slice instead of `count` loose devices.  The prospective
+# carrier solves WITH the shape (pod_shape -> SnapshotBuilder
+# pod_shape_hook), so the batched carve-out kernels steer it onto a
+# free-box corner; Reserve then records the carve-out anchored at the
+# landing node's coordinates and every consumer — carrier and sharers —
+# is pinned INSIDE the box by slice/coord label selector terms, which
+# the batched static-feasibility filter evaluates like any other
+# selector (no host Python on the match path).
+
+
+def format_carveout(slice_name: str, lo, shape) -> str:
+    return (
+        f"slice={slice_name};lo={lo[0]},{lo[1]},{lo[2]};"
+        f"shape={shape[0]}x{shape[1]}x{shape[2]}"
+    )
+
+
+def parse_carveout(text: str):
+    """(slice, (x,y,z), (a,b,c)) or None for empty/malformed."""
+    if not text:
+        return None
+    fields = dict(
+        part.split("=", 1) for part in text.split(";") if "=" in part
+    )
+    lo = api.parse_coords(fields.get("lo"))
+    shape = api.parse_topology(fields.get("shape"))
+    name = fields.get("slice")
+    if not name or lo is None or shape is None:
+        return None
+    return name, lo, shape
+
+
+def _pin_carveout(carve) -> api.NodeSelector:
+    """Selector pinning a consumer inside a recorded carve-out: slice
+    name + the enumerated coordinate strings of the box (the host-side
+    expansion into explicit value sets is exactly how every selector
+    reaches the device bitsets — ops/schema.py module docstring)."""
+    name, (x0, y0, z0), (a, b, c) = carve
+    coords = [
+        f"{x},{y},{z}"
+        for z in range(z0, z0 + c)
+        for y in range(y0, y0 + b)
+        for x in range(x0, x0 + a)
+    ]
+    return api.NodeSelector(
+        terms=[
+            api.NodeSelectorTerm(
+                match_expressions=[
+                    api.Requirement(api.LABEL_TPU_SLICE, api.OP_IN, [name]),
+                    api.Requirement(api.LABEL_TPU_COORDS, api.OP_IN, coords),
+                ]
+            )
+        ]
+    )
+
+
+def _node_slice_info(node: api.Node):
+    """(slice, coords) of a node's TPU labels, or None (the host half of
+    the ops/schema.py encode semantics — malformed degrades to absent)."""
+    labels = node.meta.labels
+    name = labels.get(api.LABEL_TPU_SLICE)
+    if not name:
+        return None
+    dims = api.parse_topology(labels.get(api.LABEL_TPU_TOPOLOGY))
+    coords = api.parse_coords(labels.get(api.LABEL_TPU_COORDS))
+    if dims is None or coords is None:
+        return None
+    if any(cc >= d for cc, d in zip(coords, dims)):
+        return None
+    return name, coords
+
+
 class DeviceClaimBinder:
     """Host-side DRA state + the Reserve/PreBind protocol."""
 
@@ -83,6 +158,9 @@ class DeviceClaimBinder:
         self._classes: Dict[str, api.DeviceClass] = {}
         # assume cache: claim key -> (node, carrier pod key) at Reserve
         self._assumed: Dict[str, Tuple[str, str]] = {}
+        # assumed carve-outs of topology-shaped claims: claim key ->
+        # formatted carveout string (written through at PreBind)
+        self._assumed_carve: Dict[str, str] = {}
         # consumer index: claim key -> live consumer pod keys (fed by
         # the scheduler's pod events; replaces O(pods) delete scans)
         self._consumers: Dict[str, set] = {}
@@ -95,11 +173,13 @@ class DeviceClaimBinder:
             if typ == st.DELETED:
                 self._claims.pop(key, None)
                 self._assumed.pop(key, None)
+                self._assumed_carve.pop(key, None)
             else:
                 self._claims[key] = claim
                 if claim.status.allocated_node:
                     # the written allocation supersedes the assume
                     self._assumed.pop(key, None)
+                    self._assumed_carve.pop(key, None)
 
     def on_class(self, typ: str, dc: api.DeviceClass, old) -> None:
         with self._mu:
@@ -116,6 +196,32 @@ class DeviceClaimBinder:
         if claim.status.allocated_node:
             return claim.status.allocated_node, claim.status.carrier
         return self._assumed.get(key, ("", ""))
+
+    def _carveout(self, key: str, claim):
+        """The claim's recorded carve-out (written status or the assume
+        cache), parsed, or None.  Callers hold self._mu."""
+        return parse_carveout(
+            claim.status.carveout or self._assumed_carve.get(key, "")
+        )
+
+    def pod_shape(self, pod: api.Pod):
+        """SnapshotBuilder.pod_shape_hook: the carve-out extent the
+        pod's FIRST unallocated topology-shaped claim requests, or None.
+        Once a carve-out is recorded, consumers pin inside it via the
+        box selector instead (pod_requirements) and solve unshaped."""
+        with self._mu:
+            for claim_name in pod.spec.resource_claims:
+                key = f"{pod.meta.namespace}/{claim_name}"
+                claim = self._claims.get(key)
+                if claim is None or not claim.spec.topology:
+                    continue
+                node, _carrier = self._allocation(key, claim)
+                if node:
+                    continue
+                shape = api.parse_topology(claim.spec.topology)
+                if shape is not None:
+                    return shape
+        return None
 
     def pod_requirements(
         self, pod: api.Pod
@@ -136,8 +242,13 @@ class DeviceClaimBinder:
                 if node:
                     # allocated: every consumer co-locates; the CARRIER
                     # keeps carrying the device count so the node's
-                    # usage stays accounted for the claim's lifetime
-                    selector = api.and_selectors(selector, _pin(node))
+                    # usage stays accounted for the claim's lifetime.
+                    # A topology-shaped allocation pins consumers INSIDE
+                    # the carve-out box (matched in the batched filter)
+                    # instead of onto the carrier's single node.
+                    carve = self._carveout(key, claim)
+                    pin = _pin_carveout(carve) if carve else _pin(node)
+                    selector = api.and_selectors(selector, pin)
                     if carrier == pkey:
                         requests[res] = (
                             requests.get(res, 0) + claim.spec.count
@@ -162,6 +273,7 @@ class DeviceClaimBinder:
             def rollback():
                 for k in picked:
                     self._assumed.pop(k, None)
+                    self._assumed_carve.pop(k, None)
 
             for claim_name in pod.spec.resource_claims:
                 key = f"{pod.meta.namespace}/{claim_name}"
@@ -171,11 +283,39 @@ class DeviceClaimBinder:
                     return False
                 alloc_node, _carrier = self._allocation(key, claim)
                 if alloc_node:
-                    if alloc_node != node.meta.name:
+                    carve = self._carveout(key, claim)
+                    if carve is not None:
+                        # topology-shaped allocation: any node INSIDE
+                        # the carve-out is the allocation's home
+                        info = _node_slice_info(node)
+                        sname, lo, shape = carve
+                        inside = (
+                            info is not None
+                            and info[0] == sname
+                            and all(
+                                l <= c < l + s
+                                for c, l, s in zip(info[1], lo, shape)
+                            )
+                        )
+                        if not inside:
+                            rollback()
+                            return False
+                    elif alloc_node != node.meta.name:
                         rollback()
                         return False
                     continue
                 self._assumed[key] = (node.meta.name, pkey)
+                if claim.spec.topology:
+                    # anchor the carve-out at the carrier's landing
+                    # coordinates (the carve-out kernels steered the
+                    # shaped solve onto a free-box corner); a claim
+                    # landing off-slice degrades to the plain node pin
+                    shape = api.parse_topology(claim.spec.topology)
+                    info = _node_slice_info(node)
+                    if shape is not None and info is not None:
+                        self._assumed_carve[key] = format_carveout(
+                            info[0], info[1], shape
+                        )
                 picked.append(key)
             return True
 
@@ -186,6 +326,7 @@ class DeviceClaimBinder:
                 key = f"{pod.meta.namespace}/{claim_name}"
                 if self._assumed.get(key, ("", ""))[1] == pkey:
                     self._assumed.pop(key, None)
+                    self._assumed_carve.pop(key, None)
 
     def prebind(self, pod: api.Pod, node_name: str) -> None:
         """Write assumed allocations through the API (the PreBind claim
@@ -194,6 +335,7 @@ class DeviceClaimBinder:
             key = f"{pod.meta.namespace}/{claim_name}"
             with self._mu:
                 assumed = self._assumed.get(key)
+                carve = self._assumed_carve.get(key, "")
             if assumed is None:
                 continue
             node, carrier = assumed
@@ -203,6 +345,7 @@ class DeviceClaimBinder:
             if not claim.status.allocated_node:
                 claim.status.allocated_node = node
                 claim.status.carrier = carrier
+                claim.status.carveout = carve
                 claim.status.phase = "Allocated"
                 self.store.update(claim)
             # the assume stays until the informer echoes the write back
@@ -247,6 +390,7 @@ class DeviceClaimBinder:
                 )
                 fresh.status.allocated_node = ""
                 fresh.status.carrier = ""
+                fresh.status.carveout = ""
                 fresh.status.phase = "Pending"
                 self.store.update(fresh)
             except (st.NotFound, st.Conflict):
@@ -328,6 +472,7 @@ class DeviceClaimBinder:
             )
             fresh.status.allocated_node = ""
             fresh.status.carrier = ""
+            fresh.status.carveout = ""
             fresh.status.phase = "Pending"
             self.store.update(fresh)
         except (st.NotFound, st.Conflict):

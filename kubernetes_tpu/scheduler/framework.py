@@ -219,12 +219,13 @@ class FrameworkRegistry:
         for profile in config.profiles:
             tpu = TPUBatchScheduler(
                 score_config=profile.effective_score_config(),
-                limits=config.limits if first is None else None,
+                limits=config.effective_limits() if first is None else None,
                 state=first.state if first is not None else state,
                 mode=mode,
                 use_mirror=use_mirror,
                 mesh=mesh,
                 arbiter=self.arbiter,
+                carveout_policy=config.slice_carveout_policy,
             )
             if first is None:
                 first = tpu

@@ -368,6 +368,24 @@ class Registry:
             "scheduler_subwave_stream_lead_ms",
             buckets=tuple(0.1 * 2 ** i for i in range(15)),
         )
+        # -- TPU slice-topology surface (docs/scheduler_loop.md) -----------
+        # cluster-wide fragmentation after the most recent slice-family
+        # solve: 1 - (per-slice largest placeable free cube volumes /
+        # free devices); 0 = every free device in a maximal cube
+        self.fragmentation_score = Gauge("scheduler_fragmentation_score")
+        # gangs that anchored a slice carve-out (running total across
+        # solves; CoschedulingPermit-released gangs count through the
+        # two outcome counters below instead)
+        self.slice_carveouts = Counter("scheduler_slice_carveouts_total")
+        # shaped gangs fully placed but NOT inside their carve-out
+        # (prefer-mode scattered fallbacks; require mode keeps this 0)
+        self.slice_carveout_fallbacks = Counter(
+            "scheduler_slice_carveout_fallbacks_total"
+        )
+        # shaped gangs fully placed inside their carved sub-cuboid
+        self.gang_contiguous_placements = Counter(
+            "scheduler_gang_contiguous_placements_total"
+        )
         # -- graftsched surface (docs/static_analysis.md) ------------------
         # deterministic interleaving schedules explored and yield points
         # scheduled across them (analysis/interleave.py TOTALS, mirrored

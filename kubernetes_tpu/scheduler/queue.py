@@ -50,6 +50,8 @@ EVENT_WAKES = {
         assign_ops.REASON_SPREAD,
         assign_ops.REASON_INTERPOD,
         assign_ops.REASON_GANG,
+        # freed devices can open a contiguous carve-out
+        assign_ops.REASON_SLICE,
     },
     # adding a pod can satisfy AFFINITY-direction inter-pod terms AND
     # raise a spread constraint's global minimum (a new match in the

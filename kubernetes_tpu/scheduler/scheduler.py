@@ -175,6 +175,7 @@ _REASON_TEXT = {
     assign_ops.REASON_SPREAD: "topology spread constraints violated",
     assign_ops.REASON_INTERPOD: "inter-pod (anti-)affinity rules",
     assign_ops.REASON_GANG: "gang not fully placeable",
+    assign_ops.REASON_SLICE: "no free contiguous slice carve-out",
 }
 
 
@@ -283,6 +284,10 @@ class Scheduler:
             transforms.append(self.volumes.pod_requirements)
         if gate.enabled("DynamicResourceAllocation"):
             transforms.append(self.devices.pod_requirements)
+            # topology-shaped claims hand their carve-out extent to the
+            # encoder (the batched carve-out kernels steer the carrier
+            # onto a free-box corner; scheduler/deviceclaims.py)
+            self.tpu.builder.pod_shape_hook = self.devices.pod_shape
         if transforms:
             self.tpu.builder.pod_transform = _combine_transforms(transforms)
         # default plugins on every profile: preemption (PostFilter) +
@@ -1411,6 +1416,17 @@ class Scheduler:
             self.metrics.solve_wave_count.observe(float(ds.wave_count))
             self.metrics.solve_wave_fallbacks.observe(
                 float(ds.wave_fallbacks or 0)
+            )
+        if ds.frag_score is not None:
+            # slice-family solve: mirror the carve-out telemetry (same
+            # coalesced readback as the names — no extra round-trip)
+            self.metrics.fragmentation_score.set(float(ds.frag_score))
+            self.metrics.slice_carveouts.inc(by=float(ds.carveouts or 0))
+            self.metrics.gang_contiguous_placements.inc(
+                by=float(ds.contiguous_gangs or 0)
+            )
+            self.metrics.slice_carveout_fallbacks.inc(
+                by=float(ds.carveout_fallbacks or 0)
             )
         # reasons come from the SAME readback as the names; after a gang
         # admission retry the solve result no longer aligns positionally

@@ -73,6 +73,12 @@ KNOWN_POINTS = frozenset({
     # a streamed per-store-shard sub-wave handed to the commit pool as
     # its slice of the wave finished staging (before the rest staged)
     "binder.stream_subwave",
+    # a gang carve-out batch dispatched to the device (slice family
+    # armed, gangs present) — fail-grade schedules kill the solve and
+    # ride the batch.solve retry/breaker containment; the carve-out
+    # chaos family (seeds 600-604) asserts no partially occupied
+    # carve-out survives quiesce
+    "solve.carveout",
     "leader.renew",
 })
 

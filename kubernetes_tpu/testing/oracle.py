@@ -68,16 +68,162 @@ class Oracle:
         nodes: Sequence[api.Node],
         bound_pods: Sequence[api.Pod] = (),
         fit_strategy: str = "LeastAllocated",
+        slice_policy: str = "prefer",
     ):
         self.states: List[_NodeState] = [
             _NodeState(node=n, allocatable=_units(n.status.allocatable)) for n in nodes
         ]
         self.fit_strategy = fit_strategy
+        # TPU slice carve-outs (ops/slices.py semantics contract):
+        # per-node slice info from labels, per-gang anchored carve-outs
+        self.slice_policy = slice_policy
+        self._slice_infos = [self._parse_slice(st) for st in self.states]
+        self._has_slices = any(i is not None for i in self._slice_infos)
+        self._gang_carve: Dict[str, Tuple[str, Tuple[int, int, int]]] = {}
         by_name = {s.node.meta.name: s for s in self.states}
         for p in bound_pods:
             st = by_name.get(p.spec.node_name)
             if st is not None:
                 st.add_pod(p)
+
+    # -- TPU slice carve-outs (ops/slices.py parity twin) -----------------
+    #
+    # The slow, obvious reimplementation of the carve-out semantics
+    # contract: python dict grids instead of value-space tensors.  Only
+    # the score WEIGHTS are shared (ops.slices constants) — they define
+    # the semantics, not the implementation.
+
+    @staticmethod
+    def _parse_slice(st: _NodeState):
+        labels = st.node.meta.labels
+        name = labels.get(api.LABEL_TPU_SLICE)
+        if not name:
+            return None
+        dims = api.parse_topology(labels.get(api.LABEL_TPU_TOPOLOGY))
+        coords = api.parse_coords(labels.get(api.LABEL_TPU_COORDS))
+        if dims is None or coords is None:
+            return None
+        if any(c >= d for c, d in zip(coords, dims)):
+            return None
+        return name, coords, dims
+
+    @staticmethod
+    def _node_free(st: _NodeState) -> bool:
+        return st.requested.get(api.PODS, 0) == 0
+
+    def _slice_grids(self):
+        """(cells, dims, free_nodes): per-slice coordinate→free map (a
+        coordinate shared by several nodes/cores is free only when all
+        are), declared extents, and free NODE counts (the best-fit
+        leftover signal)."""
+        cells: Dict[str, Dict[tuple, bool]] = {}
+        dims_of: Dict[str, tuple] = {}
+        free_nodes: Dict[str, int] = {}
+        for st, info in zip(self.states, self._slice_infos):
+            if info is None:
+                continue
+            name, coords, dims = info
+            free = self._node_free(st)
+            d = cells.setdefault(name, {})
+            d[coords] = d.get(coords, True) and free
+            prev = dims_of.get(name, (0, 0, 0))
+            dims_of[name] = tuple(max(a, b) for a, b in zip(prev, dims))
+            free_nodes[name] = free_nodes.get(name, 0) + (1 if free else 0)
+        return cells, dims_of, free_nodes
+
+    def _corner_ok(self, cells, dims_of, info, shape) -> bool:
+        name, (x, y, z), _dims = info
+        dx, dy, dz = dims_of[name]
+        a, b, c = shape
+        if x + a > dx or y + b > dy or z + c > dz:
+            return False
+        grid = cells[name]
+        for i in range(x, x + a):
+            for j in range(y, y + b):
+                for k in range(z, z + c):
+                    if not grid.get((i, j, k), False):
+                        return False
+        return True
+
+    def _carveout_ctx(self, pod: api.Pod):
+        """Per-cycle carve-out context: (shape, anchored carve-out or
+        None, grids) — None when the family is off for this pod."""
+        if self.slice_policy == "off" or not self._has_slices:
+            return None
+        shape = api.parse_topology(pod.spec.tpu_topology)
+        if shape is None:
+            return None
+        group = pod.spec.scheduling_group
+        carve = self._gang_carve.get(group) if group else None
+        cells, dims_of, free_nodes = self._slice_grids()
+        return {
+            "shape": shape,
+            "carve": carve,
+            "cells": cells,
+            "dims_of": dims_of,
+            "free_nodes": free_nodes,
+        }
+
+    def _carveout_ok(self, st_idx: int, sctx) -> bool:
+        """require-mode filter: anchors need a free-box corner, anchored
+        members the carved cuboid."""
+        info = self._slice_infos[st_idx]
+        if sctx["carve"] is not None:
+            sname, lo = sctx["carve"]
+            if info is None or info[0] != sname:
+                return False
+            if not self._node_free(self.states[st_idx]):
+                return False  # one member per device
+            coords, shape = info[1], sctx["shape"]
+            return all(
+                l <= c < l + s for c, l, s in zip(coords, lo, shape)
+            )
+        if info is None or not self._node_free(self.states[st_idx]):
+            return False
+        return self._corner_ok(
+            sctx["cells"], sctx["dims_of"], info, sctx["shape"]
+        )
+
+    def _carveout_bonus(self, st_idx: int, sctx) -> float:
+        from ..ops.slices import (
+            BONUS_CARVE, BONUS_SLICE, W_CORNER, W_HOP, W_LEFTOVER,
+        )
+
+        info = self._slice_infos[st_idx]
+        shape = sctx["shape"]
+        if sctx["carve"] is not None:
+            if info is None or not self._node_free(self.states[st_idx]):
+                return 0.0  # one member per device: occupied earns nothing
+            sname, lo = sctx["carve"]
+            name, coords, _dims = info
+            if name != sname:
+                return 0.0
+            hop = sum(abs(c - l) for c, l in zip(coords, lo))
+            if all(l <= c < l + s for c, l, s in zip(coords, lo, shape)):
+                return BONUS_CARVE + BONUS_SLICE - W_HOP * hop
+            return BONUS_SLICE - W_HOP * hop
+        if (
+            info is None
+            or not self._node_free(self.states[st_idx])
+            or not self._corner_ok(sctx["cells"], sctx["dims_of"], info, shape)
+        ):
+            return 0.0
+        vol = shape[0] * shape[1] * shape[2]
+        leftover = max(sctx["free_nodes"].get(info[0], 0) - vol, 0)
+        coordsum = sum(info[1])
+        return BONUS_CARVE - W_LEFTOVER * leftover - W_CORNER * coordsum
+
+    def _record_carve(self, pod: api.Pod, st_idx: int, sctx) -> None:
+        """Anchor the gang's carve-out at the first member's landing
+        coordinates (only when the node is slice-labelled — an
+        off-slice prefer-mode landing leaves the gang unanchored,
+        matching the kernel's -1 sentinel write)."""
+        group = pod.spec.scheduling_group
+        if not group or sctx["carve"] is not None:
+            return
+        info = self._slice_infos[st_idx]
+        if info is not None:
+            self._gang_carve[group] = (info[0], info[1])
 
     # -- topology spread (filtering.go) ----------------------------------
 
@@ -355,10 +501,16 @@ class Oracle:
 
     def schedule_one(self, pod: api.Pod) -> Optional[str]:
         ctx = self._pod_context(pod)
+        sctx = self._carveout_ctx(pod)
         feasible = [
             (i, st)
             for i, st in enumerate(self.states)
             if self._feasible(pod, st, ctx)
+            and (
+                sctx is None
+                or self.slice_policy != "require"
+                or self._carveout_ok(i, sctx)
+            )
         ]
         if not feasible:
             return None
@@ -374,10 +526,14 @@ class Oracle:
                 + 3 * taint[j]
                 + 2 * spread[j]
             )
+            if sctx is not None:
+                score += self._carveout_bonus(i, sctx)
             if best_score is None or score > best_score:
                 best_i, best_score = i, score
         st = self.states[best_i]
         st.add_pod(pod)
+        if sctx is not None:
+            self._record_carve(pod, best_i, sctx)
         return st.node.meta.name
 
     def schedule(self, pods: Sequence[api.Pod]) -> List[Optional[str]]:

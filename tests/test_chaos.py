@@ -68,6 +68,9 @@ def _fault_plan(rng: random.Random) -> faults.FaultRegistry:
     # seeds never reach PostFilter (every pod fits), so the dedicated
     # PREEMPT_SEEDS below are where it actually fires
     reg.fail("batch.preemption", n=1, probability=0.5)
+    # likewise the gang carve-out point: base seeds carry no shaped
+    # gangs, so CARVEOUT_SEEDS (600-604) are where it actually fires
+    reg.fail("solve.carveout", n=1, probability=0.5)
     return reg
 
 
@@ -1200,3 +1203,171 @@ def test_chaos_shard_crash_restart(seed, tmp_path):
                 assert rec.get(kind, {}).get(key) == (rv, wire_obj), (
                     f"seed {seed}: surviving shard {i} lost {kind} {key}"
                 )
+
+
+# -- gang carve-out chaos: slice topology under solve/commit faults ----------
+#
+# Seeds 600-604 drive the TPU slice subsystem (ops/slices.py,
+# docs/scheduler_loop.md "TPU slice topology"): shaped gangs
+# (scheduling_group_size + tpu_topology) scheduling onto slice-labelled
+# nodes while faults land on the NEW solve.carveout point (the gang
+# carve-out dispatch) layered over batch.solve corruption, binder
+# commit failures/crashes, wave-transaction faults and leader-renew
+# failures.  Invariants on top of the PR 3 set:
+#
+#   * carve-out all-or-nothing holds: at quiesce every gang is FULLY
+#     bound — no partially occupied carve-out survives (a gang the
+#     faults broke mid-flight must have been released whole and
+#     retried);
+#   * each gang's members occupy pairwise-distinct devices of ONE
+#     slice; under the require policy the occupied set is a contiguous
+#     sub-cuboid (bounding-box volume == member count);
+#   * bound exactly once (the event audit) and the assume set drains
+#     to empty at quiesce.
+
+CARVEOUT_SEEDS = list(range(600, 605))
+
+
+def _carveout_fault_plan(rng: random.Random) -> faults.FaultRegistry:
+    reg = faults.FaultRegistry(seed=rng.randint(0, 2 ** 31))
+    # the carve-out dispatch point itself: kill the solve, then latency
+    reg.fail("solve.carveout", n=rng.randint(1, 2))
+    reg.delay("solve.carveout", seconds=0.005, n=2, probability=0.5)
+    reg.fail("batch.solve", n=1, probability=0.5)
+    if rng.random() < 0.5:
+        reg.corrupt("batch.solve", n=1)
+    reg.fail("binder.commit_wave", n=rng.randint(1, 2))
+    if rng.random() < 0.5:
+        reg.crash("binder.commit_wave", n=1)
+    reg.fail("store.update_wave", n=1, probability=0.5)
+    reg.fail("store.journal.append", n=1, probability=0.5)
+    reg.fail("leader.renew", n=1, probability=0.5)
+    return reg
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+@pytest.mark.parametrize("seed", CARVEOUT_SEEDS)
+def test_chaos_gang_carveouts(seed, tmp_path):
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.testing.wrappers import make_node as _mk_node
+
+    rng = random.Random(seed)
+    reg = _carveout_fault_plan(rng)
+    policy = "require" if seed % 2 else "prefer"
+    store = st.Store(journal_path=str(tmp_path / "journal.jsonl"))
+    audit = _EventAudit(store)
+
+    # 2 slices of 2x2x2 = 16 devices; 4 gangs of 4 fill them exactly
+    dims = (2, 2, 2)
+    for s in range(2):
+        for z in range(dims[2]):
+            for y in range(dims[1]):
+                for x in range(dims[0]):
+                    store.create(
+                        _mk_node(f"s{s}-{x}{y}{z}")
+                        .capacity(cpu_milli=8000, mem=16 * GI, pods=110)
+                        .label(api.LABEL_TPU_SLICE, f"slice-{s}")
+                        .label(api.LABEL_TPU_TOPOLOGY, "2x2x2")
+                        .label(api.LABEL_TPU_COORDS, f"{x},{y},{z}")
+                        .obj()
+                    )
+    elector = LeaderElector(
+        store, "carve-sched", f"holder-{seed}",
+        lease_duration=1.0, renew_period=0.05,
+    ).start()
+    config = SchedulerConfiguration(
+        slice_carveout_policy=policy,
+        pod_initial_backoff_seconds=0.05,
+        pod_max_backoff_seconds=0.4,
+        batch_window_seconds=0.01,
+        unschedulable_flush_seconds=0.5,
+    )
+    sched = Scheduler(
+        store, assume_ttl=1.0, leader_elector=elector, config=config
+    )
+    gangs = {f"gang-{g}": 4 for g in range(4)}
+    try:
+        with faults.armed(reg):
+            sched.start()
+            assert elector.wait_for_leadership(10)
+            for g, (gname, size) in enumerate(gangs.items()):
+                for i in range(size):
+                    pod = (
+                        make_pod(f"{gname}-m{i}")
+                        .req(cpu_milli=rng.choice([50, 100]))
+                        .group(gname, size)
+                        .obj()
+                    )
+                    pod.spec.tpu_topology = "2x2x1"
+                    store.create(pod)
+                    if rng.random() < 0.3:
+                        time.sleep(rng.random() * 0.01)
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                pods, _ = store.list("Pod")
+                if pods and all(p.spec.node_name for p in pods):
+                    break
+                time.sleep(0.1)
+
+        # -- invariants (faults disarmed; residual schedules drained) ----
+        assert reg.fired.get("solve.carveout"), (
+            f"seed {seed}: the carve-out fault never fired "
+            f"(fired={reg.fired})"
+        )
+        pods, _ = store.list("Pod")
+        assert len(pods) == sum(gangs.values())
+        by_gang = {}
+        for p in pods:
+            by_gang.setdefault(p.spec.scheduling_group, []).append(p)
+        # no partially occupied carve-out survives quiesce: every gang
+        # fully bound (bounded faults => the pipeline must heal)
+        for gname, members in by_gang.items():
+            bound = [p for p in members if p.spec.node_name]
+            assert len(bound) == gangs[gname], (
+                f"seed {seed}: gang {gname} partially occupied past "
+                f"quiesce: {len(bound)}/{gangs[gname]} bound\n"
+                f"  queue: {sched.queue.stats()}\n"
+                f"  fired={reg.fired} pending={reg.pending()}"
+            )
+            nodes = [store.get("Node", p.spec.node_name) for p in bound]
+            slices_used = {
+                n.meta.labels[api.LABEL_TPU_SLICE] for n in nodes
+            }
+            assert len(slices_used) == 1, (
+                f"seed {seed}: gang {gname} spans slices {slices_used}"
+            )
+            coords = [
+                api.parse_coords(n.meta.labels[api.LABEL_TPU_COORDS])
+                for n in nodes
+            ]
+            assert len(set(coords)) == len(coords), (
+                f"seed {seed}: gang {gname} double-occupied a device"
+            )
+            if policy == "require":
+                vol = 1
+                for axis in range(3):
+                    vals = [c[axis] for c in coords]
+                    vol *= max(vals) - min(vals) + 1
+                assert vol == len(coords), (
+                    f"seed {seed}: gang {gname} not contiguous under "
+                    f"require: {sorted(coords)}"
+                )
+        assert not audit.violations, f"seed {seed}: {audit.violations[:5]}"
+        rebound = {
+            k: nodes for k, nodes in audit.bound_nodes.items()
+            if len(nodes) > 1
+        }
+        assert not rebound, f"seed {seed}: double binds {rebound}"
+        assert sched.flush_binds(15)
+        deadline = time.monotonic() + 10
+        while sched.cache.assumed_count() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sched.cache.assumed_count() == 0, (
+            f"seed {seed}: assume set not empty at quiesce"
+        )
+    finally:
+        faults.disarm()
+        sched.stop()
+        elector.stop()

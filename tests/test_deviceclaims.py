@@ -215,3 +215,87 @@ def test_carrier_death_hands_off_to_sharer(sched_store):
         lambda: store.get("Pod", "rival-pod").spec.node_name == "n0",
         timeout=60,
     )
+
+
+def test_carrier_handoff_across_store_shards():
+    """Regression (sharded store, ISSUE 13 satellite): the carrier dies
+    with surviving sharers while the claim-status write and the cache
+    re-account land on a DIFFERENT (kind, namespace) shard than the
+    pods.  The hand-off must still promote a survivor, keep the devices
+    charged, and deallocate only when the last consumer is gone —
+    per-shard locks/journals must not tear the carrier transfer."""
+    store = st.Store(shards=4)
+    # pick a namespace whose Pod shard differs from its ResourceClaim
+    # shard (crc32 over (kind, namespace) — kinds split them)
+    namespace = next(
+        ns
+        for ns in (f"ns-{i}" for i in range(64))
+        if store.shard_index("Pod", ns) != store.shard_index(
+            "ResourceClaim", ns
+        )
+    )
+    sched = Scheduler(store, batch_size=32)
+    sched.start()
+    try:
+        for i in range(1):
+            store.create(
+                make_node("n0")
+                .capacity(
+                    cpu_milli=8000, mem=16 * GI, pods=32,
+                    **{api.device_resource("gpu"): 1},
+                )
+                .obj()
+            )
+        store.create(api.DeviceClass(meta=api.ObjectMeta(name="gpu")))
+        claim = _claim("shared", "gpu")
+        claim.meta.namespace = namespace
+        store.create(claim)
+        for name in ("carrier", "sharer"):
+            p = make_pod(name, namespace=namespace).req(
+                cpu_milli=100, mem=MI
+            ).obj()
+            p.spec.resource_claims = ["shared"]
+            store.create(p)
+        assert _wait(lambda: sum(
+            1 for p in store.list("Pod", namespace=namespace)[0]
+            if p.spec.node_name
+        ) == 2)
+        got = store.get("ResourceClaim", "shared", namespace)
+        assert got.status.allocated_node == "n0"
+        dead = got.status.carrier.split("/", 1)[1]
+        surviving = "sharer" if dead == "carrier" else "carrier"
+        # a rival wants the only device — must stay parked through the
+        # cross-shard hand-off
+        rival_claim = _claim("rival", "gpu")
+        rival_claim.meta.namespace = namespace
+        store.create(rival_claim)
+        rp = make_pod("rival-pod", namespace=namespace).req(
+            cpu_milli=100, mem=MI
+        ).obj()
+        rp.spec.resource_claims = ["rival"]
+        store.create(rp)
+        time.sleep(0.5)
+
+        store.delete("Pod", dead, namespace)
+        assert _wait(
+            lambda: store.get(
+                "ResourceClaim", "shared", namespace
+            ).status.carrier == f"{namespace}/{surviving}"
+        )
+        # devices still charged on the claim's shard while the pod
+        # lives on another shard: the rival must not land
+        time.sleep(1.0)
+        assert not store.get("Pod", "rival-pod", namespace).spec.node_name
+        assert store.get(
+            "ResourceClaim", "shared", namespace
+        ).status.allocated_node == "n0"
+        # last consumer gone -> cross-shard deallocate -> rival lands
+        store.delete("Pod", surviving, namespace)
+        assert _wait(
+            lambda: store.get(
+                "Pod", "rival-pod", namespace
+            ).spec.node_name == "n0",
+            timeout=60,
+        )
+    finally:
+        sched.stop()

@@ -1,0 +1,675 @@
+"""TPU slice topology: torus-aware gang carve-outs.
+
+The acceptance surface of the slice subsystem (docs/scheduler_loop.md
+"TPU slice topology"):
+
+  * batched carve-out placement is bit-identical to the host per-pod
+    oracle on randomized topologies — gangs that cannot fit contiguously
+    included — under both the prefer and require policies;
+  * require mode parks unfittable gangs whole (all-or-nothing releases
+    the anchor too) with REASON_SLICE;
+  * the fragmentation kernel scores packing health;
+  * topology-shaped device claims record carve-outs and pin sharers
+    inside them through the batched filter;
+  * CoschedulingPermit's release-point carve-out check (prefer counts,
+    require rejects);
+  * the sharded-mesh twin is assignment-identical (multichip mark).
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.models.batch_scheduler import TPUBatchScheduler
+from kubernetes_tpu.ops import assign, schema, slices as slices_ops
+from kubernetes_tpu.testing.oracle import Oracle
+from kubernetes_tpu.testing.wrappers import GI, MI, make_node, make_pod
+
+
+def slice_node(slice_name, x, y, z, dims, name=None, cpu=4000, core=None):
+    nw = (
+        make_node(name or f"{slice_name}-{x}{y}{z}" + (f"c{core}" if core else ""))
+        .capacity(cpu_milli=cpu, mem=8 * GI, pods=16)
+        .label(api.LABEL_TPU_SLICE, slice_name)
+        .label(api.LABEL_TPU_TOPOLOGY, "x".join(map(str, dims)))
+        .label(api.LABEL_TPU_COORDS, f"{x},{y},{z}")
+    )
+    if core is not None:
+        nw.label(api.LABEL_TPU_CORE, str(core))
+    return nw.obj()
+
+
+def mk_slices(n_slices, dims, cpu=4000):
+    return [
+        slice_node(f"slice-{s}", x, y, z, dims, cpu=cpu)
+        for s in range(n_slices)
+        for z in range(dims[2])
+        for y in range(dims[1])
+        for x in range(dims[0])
+    ]
+
+
+def gang(name, size, shape, cpu=100, priority=0):
+    out = []
+    for i in range(size):
+        p = (
+            make_pod(f"{name}-{i}")
+            .req(cpu_milli=cpu)
+            .group(name)
+            .priority(priority)
+            .obj()
+        )
+        p.spec.tpu_topology = shape
+        out.append(p)
+    return out
+
+
+def host_gang_release(pods, names):
+    """The gang all-or-nothing post-pass, host-side (mirrors
+    TPUBatchScheduler._host_fallback)."""
+    groups = {}
+    for i, p in enumerate(pods):
+        g = p.spec.scheduling_group
+        if g:
+            groups.setdefault(g, []).append(i)
+    for idx in groups.values():
+        if any(names[i] is None for i in idx):
+            for i in idx:
+                names[i] = None
+    return names
+
+
+def solve_both(nodes, pods, policy, bound=()):
+    snap, meta = schema.SnapshotBuilder().build(nodes, pods, bound_pods=bound)
+    features = assign.features_of(snap, slice_policy=policy)
+    n_groups = schema.num_groups(snap)
+    result = assign.greedy_assign(snap, features=features, n_groups=n_groups)
+    got = [
+        meta.node_name(int(i))
+        for i in np.asarray(result.assignment)[: len(pods)]
+    ]
+    # the oracle consumes pods in the solver's pop order (priority desc,
+    # batch index asc); scatter its answers back to batch positions
+    order = sorted(
+        range(len(pods)), key=lambda i: (-pods[i].spec.priority, i)
+    )
+    oracle = Oracle(nodes, bound_pods=bound, slice_policy=policy)
+    want = [None] * len(pods)
+    for i in order:
+        want[i] = oracle.schedule_one(pods[i])
+    want = host_gang_release(pods, want)
+    return got, want, result, features
+
+
+# -- encode ------------------------------------------------------------------
+
+
+def test_encode_slice_tensors():
+    nodes = mk_slices(2, (2, 2, 2))
+    snap, _ = schema.SnapshotBuilder().build(nodes, [make_pod("p").obj()])
+    cl = snap.cluster
+    assert (cl.slice_id[:16] >= 0).all()
+    assert set(cl.slice_id[:16].tolist()) == {0, 1}
+    # linear in-slice position covers the slice exactly once
+    for s in (0, 1):
+        pos = cl.slice_pos[:16][cl.slice_id[:16] == s]
+        assert sorted(pos.tolist()) == list(range(8))
+    assert (cl.slice_dims[:16] == 2).all()
+    # padding rows are absent
+    assert (cl.slice_id[16:] == -1).all()
+
+
+def test_encode_malformed_labels_degrade_to_absent():
+    good = slice_node("s", 0, 0, 0, (2, 2, 2))
+    bad = (
+        make_node("bad")
+        .capacity(cpu_milli=4000, mem=8 * GI, pods=16)
+        .label(api.LABEL_TPU_SLICE, "s")
+        .label(api.LABEL_TPU_TOPOLOGY, "wat")
+        .label(api.LABEL_TPU_COORDS, "0,0,0")
+        .obj()
+    )
+    oob = slice_node("s", 0, 0, 0, (2, 2, 2), name="oob")
+    oob.meta.labels[api.LABEL_TPU_COORDS] = "5,0,0"  # outside the extent
+    snap, _ = schema.SnapshotBuilder().build(
+        [good, bad, oob], [make_pod("p").obj()]
+    )
+    assert snap.cluster.slice_id[0] == 0
+    assert snap.cluster.slice_id[1] == -1
+    assert snap.cluster.slice_id[2] == -1
+
+
+def test_encode_over_cap_extent_raises():
+    node = slice_node("s", 0, 0, 0, (32, 2, 2))
+    builder = schema.SnapshotBuilder(schema.SnapshotLimits(max_slice_dim=16))
+    with pytest.raises(OverflowError):
+        builder.build([node], [make_pod("p").obj()])
+
+
+def test_pod_shape_encode_and_class_split():
+    nodes = mk_slices(1, (2, 2, 2))
+    a = make_pod("a").req(cpu_milli=100).obj()
+    b = make_pod("b").req(cpu_milli=100).obj()
+    b.spec.tpu_topology = "2x1x1"
+    snap, _ = schema.SnapshotBuilder().build(nodes, [a, b])
+    assert snap.pods.pod_shape[0].tolist() == [0, 0, 0]
+    assert snap.pods.pod_shape[1].tolist() == [2, 1, 1]
+    # shaped and unshaped pods must not share a spec class
+    assert snap.pods.class_id[0] != snap.pods.class_id[1]
+
+
+# -- kernels -----------------------------------------------------------------
+
+
+def test_corner_mask_basic():
+    import jax.numpy as jnp
+
+    nodes = mk_slices(1, (2, 2, 2))
+    snap, meta = schema.SnapshotBuilder().build(nodes, [make_pod("p").obj()])
+    cl = snap.cluster
+    free = slices_ops.free_devices(
+        type(cl)(*[jnp.asarray(x) for x in cl])
+    )
+    corners = slices_ops.corner_mask(
+        type(cl)(*[jnp.asarray(x) for x in cl]), free,
+        jnp.asarray([2, 2, 1], jnp.int32), 1, 2,
+    )
+    got = {
+        meta.node_name(i)
+        for i in range(len(nodes))
+        if bool(np.asarray(corners)[i])
+    }
+    # a 2x2x1 box anchors at z=0 and z=1 origin corners only
+    assert got == {"slice-0-000", "slice-0-001"}
+
+
+def test_fragmentation_report():
+    nodes = mk_slices(2, (2, 2, 2))
+    sched = TPUBatchScheduler()
+    for nd in nodes:
+        sched.add_node(nd)
+    rep = slices_ops.fragmentation_report(sched.state.tensors())
+    assert rep["score"] == 0.0           # empty slices: two full 2-cubes
+    assert rep["largest_cube"] == [2, 2]
+    assert rep["free_count"] == [8.0, 8.0]
+    # occupy one device of slice 0: its largest cube drops to 1
+    pod = make_pod("x").req(cpu_milli=100).obj()
+    sched.assume(pod, "slice-0-000")
+    rep = slices_ops.fragmentation_report(sched.state.tensors())
+    assert rep["largest_cube"] == [1, 2]
+    assert rep["free_count"] == [7.0, 8.0]
+    assert rep["score"] > 0.0
+
+
+def test_multicore_coordinate_free_only_when_all_cores_free():
+    import jax.numpy as jnp
+
+    # two nodes share coordinate (0,0,0) (core 0/1); occupy one of them
+    nodes = [
+        slice_node("s", 0, 0, 0, (2, 1, 1), core=0),
+        slice_node("s", 0, 0, 0, (2, 1, 1), core=1),
+        slice_node("s", 1, 0, 0, (2, 1, 1)),
+    ]
+    bound = make_pod("b").req(cpu_milli=100).node_name(nodes[0].meta.name).obj()
+    snap, _ = schema.SnapshotBuilder().build(
+        nodes, [make_pod("p").obj()], bound_pods=[bound]
+    )
+    cl = type(snap.cluster)(*[jnp.asarray(x) for x in snap.cluster])
+    free = slices_ops.free_devices(cl)
+    corners = slices_ops.corner_mask(
+        cl, free, jnp.asarray([2, 1, 1], jnp.int32), 1, 2
+    )
+    assert not np.asarray(corners)[:3].any()  # (0,0,0) cell not fully free
+
+
+# -- solver parity -----------------------------------------------------------
+
+
+@pytest.mark.parametrize("policy", ["prefer", "require"])
+def test_gang_carveout_parity_basic(policy):
+    nodes = mk_slices(2, (2, 2, 2))
+    pods = gang("g0", 4, "2x2x1") + gang("g1", 8, "2x2x2") + gang(
+        "g2", 2, "2x1x1"
+    )
+    got, want, result, _ = solve_both(nodes, pods, policy)
+    assert got == want
+    # every gang landed whole and contiguous
+    assert int(result.contiguous_gangs) == 3
+    assert int(result.carveout_fallbacks) == 0
+
+
+@pytest.mark.parametrize("policy", ["prefer", "require"])
+def test_unfittable_gang_parity(policy):
+    """A 3x3x3 request cannot fit a 2x2x2 slice: require parks it whole;
+    prefer scatters it (carveout fallback) — both parity-identical."""
+    nodes = mk_slices(1, (2, 2, 2))
+    pods = gang("big", 4, "3x3x3")
+    got, want, result, _ = solve_both(nodes, pods, policy)
+    assert got == want
+    if policy == "require":
+        assert got == [None] * 4
+        reasons = np.asarray(result.reasons)[:4]
+        assert (reasons == assign.REASON_SLICE).all()
+        assert int(result.contiguous_gangs) == 0
+    else:
+        assert None not in got
+
+
+def test_prefer_mode_counts_fallbacks():
+    """Free devices exist but no contiguous 2x2x1 box: prefer scatters
+    and counts the gang as a carve-out fallback."""
+    nodes = mk_slices(1, (2, 2, 1))
+    # occupy one device so no 2x2x1 box is free
+    bound = make_pod("b").req(cpu_milli=100).node_name("slice-0-000").obj()
+    pods = gang("g", 2, "2x2x1")
+    got, want, result, _ = solve_both(nodes, pods, "prefer", bound=[bound])
+    assert got == want
+    assert None not in got
+    assert int(result.carveout_fallbacks) == 1
+    assert int(result.contiguous_gangs) == 0
+
+
+def test_require_holds_capacity_feasible_but_fragmented():
+    """Capacity fits the gang, but the free devices are not contiguous:
+    require must park the gang (the workload spread/affinity never
+    stresses — fragmentation-aware all-or-nothing)."""
+    nodes = mk_slices(1, (2, 2, 1))
+    bound = make_pod("b").req(cpu_milli=100).node_name("slice-0-000").obj()
+    pods = gang("g", 2, "2x1x1")  # a free 2x1x1 box still exists at y=1
+    got, want, result, _ = solve_both(nodes, pods, "require", bound=[bound])
+    assert got == want
+    assert set(got) == {"slice-0-010", "slice-0-110"}
+    # now occupy the diagonal so only scattered singles remain
+    bound2 = make_pod("b2").req(cpu_milli=100).node_name("slice-0-110").obj()
+    got2, want2, result2, _ = solve_both(
+        nodes, pods, "require", bound=[bound, bound2]
+    )
+    assert got2 == want2 == [None, None]
+
+
+def test_best_fit_prefers_tighter_slice():
+    """Two slices fit; the anchor best-fit (leftover minimization) picks
+    the one the gang fills exactly."""
+    nodes = mk_slices(1, (2, 2, 2)) + [
+        slice_node("small", x, y, 0, (2, 1, 1))
+        for x in range(2)
+        for y in range(1)
+    ]
+    pods = gang("g", 2, "2x1x1")
+    got, want, result, _ = solve_both(nodes, pods, "prefer")
+    assert got == want
+    assert all(n.startswith("small") for n in got)
+
+
+def test_off_policy_disarms_family():
+    nodes = mk_slices(1, (2, 2, 2))
+    pods = gang("g", 2, "3x3x3")  # unfittable shape, but family is off
+    snap, meta = schema.SnapshotBuilder().build(nodes, pods)
+    features = assign.features_of(snap, slice_policy="off")
+    assert not features.slices
+    result = assign.greedy_assign(
+        snap, features=features, n_groups=schema.num_groups(snap)
+    )
+    assert (np.asarray(result.assignment)[:2] >= 0).all()
+    assert result.frag_score is None
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_randomized_topology_parity(seed):
+    """Randomized slices/gangs/occupancy across both policies — the
+    acceptance parity suite (gangs that cannot fit included)."""
+    rng = np.random.default_rng(seed)
+    policy = ["prefer", "require"][seed % 2]
+    dims = tuple(int(d) for d in rng.choice([1, 2, 3], size=3) + 1)
+    n_slices = int(rng.integers(1, 4))
+    nodes = mk_slices(n_slices, dims)
+    # a few non-slice nodes ride along (prefer-mode fallback targets)
+    for i in range(int(rng.integers(0, 3))):
+        nodes.append(
+            make_node(f"plain-{i}")
+            .capacity(cpu_milli=4000, mem=8 * GI, pods=16)
+            .obj()
+        )
+    # random pre-bound occupancy
+    bound = []
+    for i, nd in enumerate(nodes):
+        if rng.random() < 0.2:
+            bound.append(
+                make_pod(f"bound-{i}")
+                .req(cpu_milli=100)
+                .node_name(nd.meta.name)
+                .obj()
+            )
+    pods = []
+    for g in range(int(rng.integers(1, 4))):
+        shape = [int(s) for s in rng.integers(1, 4, size=3)]
+        vol = shape[0] * shape[1] * shape[2]
+        size = int(rng.integers(1, vol + 1))
+        pods += gang(
+            f"g{g}", size, "x".join(map(str, shape)),
+            priority=int(rng.integers(0, 3)),
+        )
+    # unshaped singles mixed in
+    for i in range(int(rng.integers(0, 4))):
+        pods.append(make_pod(f"solo-{i}").req(cpu_milli=100).obj())
+    got, want, _result, features = solve_both(nodes, pods, policy)
+    assert features.slices
+    assert got == want, (
+        f"seed {seed} policy {policy} dims {dims}: {got} != {want}"
+    )
+
+
+def test_host_fallback_parity_with_device_solve():
+    """The breaker's host fallback (Oracle) must agree with the device
+    solve on slice batches — it IS the parity twin in degraded mode."""
+    nodes = mk_slices(2, (2, 2, 1))
+    pods = gang("g0", 4, "2x2x1") + gang("g1", 2, "2x1x1")
+    sched = TPUBatchScheduler(carveout_policy="require")
+    for nd in nodes:
+        sched.add_node(nd)
+    device_names = sched.schedule_pending(pods)
+    fallback = sched._host_fallback(pods)
+    assert fallback.names() == device_names
+
+
+# -- routing -----------------------------------------------------------------
+
+
+def test_route_pins_slice_batches_to_classic_greedy():
+    nodes = mk_slices(8, (2, 2, 2))
+    pods = []
+    for g in range(16):
+        pods += gang(f"g{g}", 4, "2x2x1")
+    sched = TPUBatchScheduler()
+    for nd in nodes:
+        sched.add_node(nd)
+    snap, meta = sched.encode_pending(pods)
+    assert meta.features.slices
+    # 64 pods with gangs would otherwise route wavefront/auction
+    assert meta.route == "greedy"
+    names = sched.finalize_pending(pods, sched.solve_encoded_async(snap, meta))
+    assert all(n is not None for n in names)
+
+
+def test_wavefront_rejects_slice_features():
+    nodes = mk_slices(1, (2, 2, 2))
+    pods = gang("g", 2, "2x1x1")
+    snap, _ = schema.SnapshotBuilder().build(nodes, pods)
+    features = assign.features_of(snap)
+    with pytest.raises(ValueError, match="classic greedy scan"):
+        assign.wavefront_assign(snap, None, features=features)
+
+
+def test_auction_declines_slice_features():
+    from kubernetes_tpu.ops.auction import auction_features_ok
+
+    assert not auction_features_ok(
+        assign.FeatureFlags(slices=True, slice_z=2, slice_dim=2)
+    )
+    assert auction_features_ok(assign.FeatureFlags())
+
+
+# -- incremental state / mirror ----------------------------------------------
+
+
+def test_mirror_tracks_slice_label_updates():
+    """A node's slice labels change (re-tessellation): the delta sync
+    must carry the new coordinates into the resident tensors."""
+    nodes = mk_slices(1, (2, 2, 1))
+    sched = TPUBatchScheduler(carveout_policy="require")
+    for nd in nodes:
+        sched.add_node(nd)
+    pods = gang("g", 4, "2x2x1")
+    assert all(n is not None for n in sched.schedule_pending(pods))
+    # the slice shrinks to 2x1x1: a 2x2x1 gang no longer fits
+    for nd in nodes:
+        x, y, _z = api.parse_coords(nd.meta.labels[api.LABEL_TPU_COORDS])
+        nd.meta.labels[api.LABEL_TPU_TOPOLOGY] = "2x1x1"
+        if y > 0:
+            del nd.meta.labels[api.LABEL_TPU_COORDS]
+            nd.meta.labels[api.LABEL_TPU_COORDS] = f"{x},5,0"  # out of extent
+        sched.update_node(nd)
+    got = sched.schedule_pending(gang("g2", 4, "2x2x1"))
+    assert got == [None] * 4
+
+
+# -- topology-shaped device claims -------------------------------------------
+
+
+def _wait(cond, timeout=30.0):
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.fixture
+def slice_store():
+    from kubernetes_tpu.api import store as st
+    from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+    store = st.Store()
+    sched = Scheduler(store, batch_size=32)
+    sched.start()
+    yield sched, store
+    sched.stop()
+
+
+def test_shaped_claim_records_carveout_and_pins_sharers(slice_store):
+    from kubernetes_tpu.scheduler.deviceclaims import parse_carveout
+
+    sched, store = slice_store
+    for nd in mk_slices(2, (2, 2, 1)):
+        nd.status.allocatable[api.device_resource("tpu")] = 1
+        store.create(nd)
+    store.create(api.DeviceClass(meta=api.ObjectMeta(name="tpu")))
+    claim = api.ResourceClaim(
+        meta=api.ObjectMeta(name="carve"),
+        spec=api.ResourceClaimSpec(
+            device_class_name="tpu", count=1, topology="2x2x1"
+        ),
+    )
+    store.create(claim)
+    carrier = make_pod("carrier").req(cpu_milli=100, mem=MI).obj()
+    carrier.spec.resource_claims = ["carve"]
+    store.create(carrier)
+    assert _wait(lambda: store.get("Pod", "carrier").spec.node_name)
+    got = store.get("ResourceClaim", "carve")
+    assert got.status.phase == "Allocated"
+    carve = parse_carveout(got.status.carveout)
+    assert carve is not None
+    sname, lo, shape = carve
+    assert shape == (2, 2, 1)
+    assert lo == (0, 0, 0)  # the carrier anchored a free-box corner
+    # a sharer pins INSIDE the carve-out (batched filter), not onto the
+    # carrier's node specifically
+    sharer = make_pod("sharer").req(cpu_milli=100, mem=MI).obj()
+    sharer.spec.resource_claims = ["carve"]
+    store.create(sharer)
+    assert _wait(lambda: store.get("Pod", "sharer").spec.node_name)
+    node = store.get(
+        "Node", store.get("Pod", "sharer").spec.node_name
+    )
+    assert node.meta.labels[api.LABEL_TPU_SLICE] == sname
+    x, y, z = api.parse_coords(node.meta.labels[api.LABEL_TPU_COORDS])
+    assert (lo[0] <= x < lo[0] + 2) and (lo[1] <= y < lo[1] + 2) and z == 0
+
+
+# -- CoschedulingPermit carve-out check --------------------------------------
+
+
+def _release_gang(permit, members, nodes_of):
+    """Drive a gang through Permit: all but the last park, the last
+    triggers the release.  Returns the verdicts."""
+    import threading
+
+    from kubernetes_tpu.scheduler.waitingpods import WaitingPod
+
+    verdicts = {}
+    threads = []
+    for pod, node in members[:-1]:
+        verdict, timeout = permit.permit(pod, node)
+        assert verdict == "wait"
+        wp = WaitingPod(pod, node, timeout)
+        permit.waiting.add(wp)
+
+        def waiter(wp=wp, pod=pod):
+            verdicts[pod.meta.name] = wp.wait()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        threads.append(t)
+    last_pod, last_node = members[-1]
+    verdicts[last_pod.meta.name] = permit.permit(last_pod, last_node)[0]
+    for t in threads:
+        t.join(timeout=5)
+    return verdicts
+
+
+@pytest.mark.parametrize("carveout", ["prefer", "require"])
+def test_coscheduling_carveout_release(carveout):
+    from kubernetes_tpu.scheduler.coscheduling import CoschedulingPermit
+    from kubernetes_tpu.scheduler.metrics import Registry
+    from kubernetes_tpu.scheduler.waitingpods import WaitingPodsMap
+
+    nodes = {n.meta.name: n for n in mk_slices(1, (2, 2, 1))}
+    metrics = Registry()
+    permit = CoschedulingPermit(
+        WaitingPodsMap(), sizes={"g": 2}, timeout=2.0,
+        carveout=carveout, node_lookup=nodes.get, metrics=metrics,
+    )
+    pods = gang("g", 2, "2x1x1")
+    # contiguous pair: released either way, counted contiguous
+    verdicts = _release_gang(
+        permit, list(zip(pods, ["slice-0-000", "slice-0-100"])), nodes
+    )
+    assert set(verdicts.values()) == {"allow"}
+    assert metrics.gang_contiguous_placements.total == 1
+    # fragmented pair (diagonal): prefer releases + counts a fallback,
+    # require rejects every member
+    pods2 = gang("g", 2, "2x1x1")
+    verdicts = _release_gang(
+        permit, list(zip(pods2, ["slice-0-000", "slice-0-110"])), nodes
+    )
+    if carveout == "prefer":
+        assert set(verdicts.values()) == {"allow"}
+        assert metrics.slice_carveout_fallbacks.total == 1
+    else:
+        assert "allow" not in verdicts.values()
+        assert metrics.slice_carveout_fallbacks.total == 1
+
+
+def test_carveout_contiguous_helper():
+    from kubernetes_tpu.scheduler.coscheduling import carveout_contiguous
+
+    nodes = {n.meta.name: n for n in mk_slices(2, (2, 2, 1))}
+    assert carveout_contiguous(
+        [nodes["slice-0-000"], nodes["slice-0-100"]]
+    )
+    assert not carveout_contiguous(
+        [nodes["slice-0-000"], nodes["slice-0-110"]]  # diagonal: bbox 4 != 2
+    )
+    assert not carveout_contiguous(
+        [nodes["slice-0-000"], nodes["slice-1-000"]]  # two slices
+    )
+    assert not carveout_contiguous(
+        [nodes["slice-0-000"], nodes["slice-0-000"]]  # duplicate device
+    )
+
+
+# -- config / scheduler threading --------------------------------------------
+
+
+def test_config_knob_reaches_solver():
+    from kubernetes_tpu.api import store as st
+    from kubernetes_tpu.scheduler.config import SchedulerConfiguration
+    from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+    config = SchedulerConfiguration(
+        slice_carveout_policy="require", slice_max_dim=8
+    )
+    sched = Scheduler(st.Store(), config=config)
+    assert sched.tpu.carveout_policy == "require"
+    assert sched.tpu.builder.limits.max_slice_dim == 8
+
+
+def test_scheduler_loop_places_gang_and_mirrors_metrics():
+    import time
+
+    from kubernetes_tpu.api import store as st
+    from kubernetes_tpu.scheduler.config import SchedulerConfiguration
+    from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+    store = st.Store()
+    for nd in mk_slices(2, (2, 2, 1)):
+        store.create(nd)
+    sched = Scheduler(
+        store,
+        batch_size=32,
+        config=SchedulerConfiguration(slice_carveout_policy="require"),
+    )
+    sched.start()
+    try:
+        for p in gang("g", 4, "2x2x1"):
+            p.spec.scheduling_group_size = 4
+            store.create(p)
+        assert _wait(
+            lambda: all(
+                q.spec.node_name for q in store.list("Pod")[0]
+            ),
+            timeout=60,
+        )
+        slices_used = {
+            store.get("Node", q.spec.node_name).meta.labels[
+                api.LABEL_TPU_SLICE
+            ]
+            for q in store.list("Pod")[0]
+        }
+        assert len(slices_used) == 1  # the gang landed in ONE slice
+        deadline = time.time() + 10
+        while (
+            sched.metrics.gang_contiguous_placements.total < 1
+            and time.time() < deadline
+        ):
+            time.sleep(0.05)
+        assert sched.metrics.slice_carveouts.total >= 1
+        assert sched.metrics.gang_contiguous_placements.total >= 1
+        assert sched.metrics.slice_carveout_fallbacks.total == 0
+    finally:
+        sched.stop()
+
+
+# -- sharded-mesh twin -------------------------------------------------------
+
+
+@pytest.mark.multichip
+@pytest.mark.parametrize("policy", ["prefer", "require"])
+def test_sharded_slice_parity(policy):
+    import jax
+
+    from kubernetes_tpu.parallel import sharded
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    nodes = mk_slices(2, (2, 2, 2))
+    pods = gang("g0", 4, "2x2x1") + gang("g1", 8, "2x2x2") + gang(
+        "gbig", 3, "3x3x3"
+    )
+    snap, _ = schema.SnapshotBuilder().build(nodes, pods)
+    features = assign.features_of(snap, slice_policy=policy)
+    n_groups = schema.num_groups(snap)
+    single = assign.greedy_assign(snap, features=features, n_groups=n_groups)
+    mesh = sharded.make_mesh(8)
+    multi = sharded.sharded_greedy_assign(
+        snap, mesh, features=features, n_groups=n_groups
+    )
+    np.testing.assert_array_equal(
+        np.asarray(single.assignment), np.asarray(multi.assignment)
+    )
+    assert float(single.frag_score) == float(multi.frag_score)
+    assert int(single.contiguous_gangs) == int(multi.contiguous_gangs)
+    assert int(single.carveout_fallbacks) == int(multi.carveout_fallbacks)
