@@ -40,9 +40,12 @@ race:
 # the fixed seed matrices live in tests/test_chaos.py: SEEDS = range(20)
 # for the full-pipeline plans plus the overload-protection scenarios
 # (SLOW_CONSUMER_SEEDS, RELIST_STORM_SEEDS — backpressured fan-out,
-# coalescing, relist-storm containment) and the mixed-priority
-# preemption churn (PREEMPT_SEEDS — batched-dry-run faults, PDB-guarded
-# victims); every seed replays byte-identically via FaultRegistry(seed)
+# coalescing, relist-storm containment), the mixed-priority preemption
+# churn (PREEMPT_SEEDS — batched-dry-run faults, PDB-guarded victims),
+# the gang carve-outs (CARVEOUT_SEEDS) and the incremental-solve
+# partials poison (PARTIALS_SEEDS = 700-704 — resident-store CORRUPT
+# must trip the parity gate, never be absorbed); every seed replays
+# byte-identically via FaultRegistry(seed)
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -m chaos -q \
 		-p no:cacheprovider

@@ -42,6 +42,14 @@ informer-fed cache.  `extra` carries all five configs:
        victims survive, a sustained preemption-throughput floor, zero
        steady recompiles in the planning phase, and a ≥5x exposed
        PostFilter planning speedup vs the per-pod walk on the same trace
+  c11  50k nodes / 64 pod classes  INCREMENTAL churn: <=1% of node rows
+       dirtied per cycle under a recurring service-shaped stream; the
+       warm-started solve (device-resident Filter/Score partials,
+       ISSUE 14) runs the same frozen trace as a cold scheduler — gates:
+       bit-identical placements, a ≥3x warm-vs-cold planning speedup,
+       zero steady recompiles, and the <=1% dirtied-rows contract;
+       reports the partials hit rate and rows re-evaluated (c6/c6s
+       report the same accounting for their live loops)
 
 Every scenario reports step-latency p50/p90/p99 (the windowed sampler:
 attempt-duration percentiles for the loop configs, timed-sample
@@ -506,6 +514,18 @@ def config6():
         # (retrace tracker mirror; churn legitimately walks buckets, so
         # this is reported, not gated)
         "solve_retrace_total": round(m.solve_retrace_total.total, 1),
+        # incremental-solve accounting (ISSUE 14): partials rows served
+        # warm vs re-evaluated across the run, and the resulting hit rate
+        "partials_hit_rows": int(m.partials_hit_rows.total),
+        "partials_recomputed_rows": int(m.partials_recomputed_rows.total),
+        "partials_hit_rate": round(
+            m.partials_hit_rows.total
+            / max(
+                m.partials_hit_rows.total + m.partials_recomputed_rows.total,
+                1.0,
+            ),
+            4,
+        ),
         "commit_s_total": round(commit_s, 4),
         "commit_overlap_s": round(overlap_s, 4),
         "commit_waves": m.commit_wave_size.n,
@@ -671,6 +691,18 @@ def config6_sustained():
             m.commit_subwave_overlap.total, 4
         ),
         "solve_s_total": round(m.batch_solve_duration.total, 4),
+        # incremental-solve accounting (ISSUE 14): warm-row hit rate and
+        # rows re-evaluated across the sustained stream
+        "partials_hit_rows": int(m.partials_hit_rows.total),
+        "partials_recomputed_rows": int(m.partials_recomputed_rows.total),
+        "partials_hit_rate": round(
+            m.partials_hit_rows.total
+            / max(
+                m.partials_hit_rows.total + m.partials_recomputed_rows.total,
+                1.0,
+            ),
+            4,
+        ),
         # pipelined multi-lane cycle (ISSUE 12): lanes in force,
         # per-lane share of the sustained rate, the speculation hit
         # rate (1 - invalidated/dispatched) and the commit lead
@@ -1291,6 +1323,136 @@ def config10():
     }
 
 
+# c11 incremental-churn gates (BENCH_STRICT=1): with <=1% of node rows
+# dirtied per cycle, the warm-started solve (device-resident partials,
+# ISSUE 14) must beat the cold solve by at least this factor on the
+# same frozen trace with bit-identical placements and zero steady
+# recompiles.  Measured 4-7x per steady cycle on a CPU host.
+STRICT_PARTIALS_SPEEDUP_MIN = 3.0
+STRICT_PARTIALS_DIRTY_FRAC_MAX = 0.01
+
+
+def config11():
+    """c11: incremental churn at 50k nodes — the warm-started solve as
+    a first-class workload.  A sustained service-shaped arrival stream
+    (64 distinct selector/preferred pod classes recurring every cycle)
+    against bounded churn: <=1% of node rows dirtied per cycle via
+    assumes walking the cluster.
+
+    Frozen-trace phase: the SAME (churn, batch) trace runs through a
+    warm scheduler (PartialsCache on) and a cold one (off, the
+    pre-ISSUE-14 path) sharing identical state mutations; every cycle's
+    placements must be bit-identical and the cold/warm wall ratio is
+    the gated speedup.  The warm side must also hold
+    steady_recompiles == 0 — the partials refresh/gather kernels stay
+    on their pad buckets."""
+    from kubernetes_tpu.analysis import retrace
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.models.batch_scheduler import TPUBatchScheduler
+    from kubernetes_tpu.testing.wrappers import MI, make_pod
+
+    n_nodes, n_pods, n_svc, dirty_rows = 50_000, 128, 64, 256
+    cycles = 4  # timed cycles after the warmup cycle
+    nodes = _mk_nodes(n_nodes, zones=64)
+    warm = TPUBatchScheduler(mode="greedy", use_partials=True)
+    cold = TPUBatchScheduler(mode="greedy", use_partials=False)
+    for nd in nodes:
+        warm.add_node(nd)
+        cold.add_node(nd)
+
+    def mk(r):
+        # the recurring service shapes: selector + preferred affinity
+        # per svc — the [S, T, E, K, N] matching the warm start hoists
+        pods = []
+        for i in range(n_pods):
+            svc = i % n_svc
+            pods.append(
+                make_pod(f"c11-r{r}-{i}")
+                .req(cpu_milli=100 + (svc % 5) * 100, mem=256 * MI)
+                .required_affinity(
+                    api.LABEL_ZONE, api.OP_IN,
+                    [f"zone-{svc % 64}", f"zone-{(svc + 1) % 64}",
+                     f"zone-{(svc + 32) % 64}"],
+                )
+                .preferred_affinity(
+                    10, api.LABEL_ZONE, api.OP_IN, [f"zone-{svc % 64}"]
+                )
+                .obj()
+            )
+        return pods
+
+    def churn(r):
+        # <=1% of rows dirtied: small binds walking the cluster (the
+        # usage-generation rows the partials refresh re-evaluates)
+        base = r * dirty_rows
+        for j in range(dirty_rows):
+            p = make_pod(f"c11-bind-r{r}-{j}").req(cpu_milli=10, mem=MI).obj()
+            nm = f"node-{(base + j * 97) % n_nodes}"
+            warm.assume(p, nm)
+            cold.assume(p, nm)
+
+    retrace.clear_steady()
+    # warmup WITH churn: compiles the warm/cold solver executables AND
+    # the partials kernels at their steady buckets.  Two warm solves on
+    # purpose: the first sync is a FULL reset (eval kernel), only the
+    # second hits the dirty-row refresh kernel the steady cycles use.
+    churn(0)
+    t0 = time.perf_counter()
+    warm.schedule_pending(mk(0))
+    warm_first = time.perf_counter() - t0
+    cold.schedule_pending(mk(0))
+    churn(100)
+    warm.schedule_pending(mk(100))
+    retrace.mark_steady()
+    steady0 = retrace.steady_total()
+    stats0 = dict(warm._partials.stats())
+    warm_walls, cold_walls, parity = [], [], True
+    for r in range(1, cycles + 1):
+        churn(r)
+        t0 = time.perf_counter()
+        names_w = warm.schedule_pending(mk(r))
+        warm_walls.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        names_c = cold.schedule_pending(mk(r))
+        cold_walls.append(time.perf_counter() - t0)
+        parity = parity and names_w == names_c
+    steady_recompiles = retrace.steady_total() - steady0
+    retrace.clear_steady()
+    stats = warm._partials.stats()
+    hit = stats["hit_rows_total"] - stats0["hit_rows_total"]
+    recomputed = (
+        stats["recomputed_rows_total"] - stats0["recomputed_rows_total"]
+    )
+    from kubernetes_tpu.kubemark import percentiles
+
+    pct = percentiles(list(warm_walls))
+    return {
+        "nodes": n_nodes, "pods": n_pods * cycles,
+        "pod_classes": n_svc, "cycles": cycles,
+        "dirtied_rows_per_cycle": dirty_rows,
+        "dirty_fraction": round(dirty_rows / n_nodes, 5),
+        "latency_s": round(min(warm_walls), 4),
+        "pods_per_s": round(n_pods / min(warm_walls), 1),
+        "latency_p50_s": round(pct["p50"], 4),
+        "latency_p90_s": round(pct["p90"], 4),
+        "latency_p99_s": round(pct["p99"], 4),
+        "commit_share_per_step": 0.0,
+        "first_step_s": round(warm_first, 4),
+        "steady_recompiles": steady_recompiles,
+        # the frozen-trace gates
+        "warm_walls_s": [round(w, 4) for w in warm_walls],
+        "cold_walls_s": [round(w, 4) for w in cold_walls],
+        "warm_parity": parity,
+        "warm_speedup": round(sum(cold_walls) / sum(warm_walls), 2),
+        # partials accounting over the timed window: rows served warm
+        # vs re-evaluated (the O(changes) claim in numbers)
+        "partials_hit_rows": hit,
+        "partials_recomputed_rows": recomputed,
+        "partials_hit_rate": round(hit / max(hit + recomputed, 1), 4),
+        "partials_full_recomputes": stats["full_recomputes"],
+    }
+
+
 def main() -> None:
     import sys
 
@@ -1320,6 +1482,7 @@ def main() -> None:
             "c8_store_100k": config8(),
             "c9_preempt_churn": config9(),
             "c10_slice_pack": config10(),
+            "c11_incremental_churn": config11(),
         }
     # every over-threshold schedule_batch cycle, with its per-step share
     # (commit- and solve-share per step are readable straight off the
@@ -1518,6 +1681,22 @@ def main() -> None:
             failures.append(
                 f"c10 contiguous-placement rate below floor: "
                 f"{c10['contiguous_rate']} < {STRICT_SLICE_CONTIG_MIN}"
+            )
+        c11 = extra["c11_incremental_churn"]
+        if not c11["warm_parity"]:
+            failures.append(
+                "c11 warm-started placements diverged from cold solves "
+                "(the partials parity gate)"
+            )
+        if c11["warm_speedup"] < STRICT_PARTIALS_SPEEDUP_MIN:
+            failures.append(
+                f"c11 warm-solve speedup {c11['warm_speedup']}x < "
+                f"{STRICT_PARTIALS_SPEEDUP_MIN}x on the frozen churn trace"
+            )
+        if c11["dirty_fraction"] > STRICT_PARTIALS_DIRTY_FRAC_MAX:
+            failures.append(
+                f"c11 dirtied {c11['dirty_fraction']} of rows per cycle > "
+                f"{STRICT_PARTIALS_DIRTY_FRAC_MAX} (the <=1% churn contract)"
             )
         if c10["frag_score_final"] > STRICT_SLICE_FRAG_MAX:
             failures.append(

@@ -730,6 +730,227 @@ def _check_slice_kernels(byclass, findings: List[Finding]) -> None:
             )
 
 
+#: (node bucket, slot-capacity bucket, dirty-row bucket, insert bucket,
+#: batch-class bucket) lattice the incremental-solve partials kernels
+#: are driven across (ops/partials.py; models/partials.py pads every
+#: index bucket with pad_dim)
+PARTIALS_LATTICE: Tuple[Tuple[int, int, int, int, int], ...] = (
+    (8, 32, 8, 1, 1), (16, 32, 8, 2, 2), (16, 64, 16, 2, 4),
+)
+
+
+def _check_partials_kernels(byclass, findings: List[Finding]) -> None:
+    """Drive the incremental-solve partials kernels (ops/partials.py)
+    through eval_shape across PARTIALS_LATTICE: outputs must match the
+    ClassSpecs/PartialsStore/ClassStatics contracts at every bucket,
+    the abstract signature set must be exactly one per lattice point,
+    and the WARM solver twin must (a) eval_shape to the same SolveResult
+    contracts as the cold one and (b) carry a compile key distinct from
+    it — warm and cold are different executables by construction (the
+    statics operands are part of the signature), single-chip and
+    sharded alike."""
+    import jax
+    import numpy as np
+
+    from ..ops import assign, partials as pops, schema
+    from ..parallel import sharded
+    from . import retrace
+
+    file = "kubernetes_tpu/ops/partials.py"
+    limits = schema.SnapshotLimits()
+    spec_fields = byclass.get("ClassSpecs", {})
+    store_fields = byclass.get("PartialsStore", {})
+    statics_fields = byclass.get("ClassStatics", {})
+    if not spec_fields or not store_fields or not statics_fields:
+        findings.append(
+            Finding(
+                CHECK, file, 1, "ClassSpecs",
+                "partials contracts missing (run the tensor-contract "
+                "pass first)",
+            )
+        )
+        return
+
+    def env_for(n, g, d, m, c):
+        return {
+            "N": n, "G": g, "D": d, "M": m, "C": c,
+            "T": limits.max_terms, "E": limits.max_exprs,
+            "K": limits.max_ids_per_expr, "MT": limits.max_preferred,
+            "TW": limits.taint_words, "PW": limits.port_words,
+        }
+
+    def abstract(cls, cfields, env):
+        vals = {}
+        for f in cls._fields:
+            contract = cfields.get(f)
+            if contract is None:
+                raise KeyError(f"{cls.__name__}.{f} has no contract")
+            vals[f] = jax.ShapeDtypeStruct(
+                contract.shape(env), np.dtype(contract.dtype)
+            )
+        return cls(**vals)
+
+    def check_out(result, cls_name, cfields, env, where):
+        for f in type(result)._fields:
+            contract = cfields.get(f)
+            val = getattr(result, f)
+            if contract is None:
+                continue
+            want = contract.shape(env)
+            if tuple(val.shape) != want or str(val.dtype) != contract.dtype:
+                findings.append(
+                    Finding(
+                        CHECK, file, contract.line, f"{cls_name}.{f}",
+                        f"{where}: eval_shape output {val.dtype}"
+                        f"{tuple(val.shape)} != contract "
+                        f"{contract.render()} (= {contract.dtype}{want})",
+                    )
+                )
+
+    signatures = {"eval": set(), "refresh": set(), "insert": set(),
+                  "gather": set()}
+    for n, g, d, m, c in PARTIALS_LATTICE:
+        env = env_for(n, g, d, m, c)
+        snap = abstract_snapshot(byclass, limits, n=n, p=8)
+        cluster = snap.cluster
+        specs = abstract(pops.ClassSpecs, spec_fields, env)
+        store = abstract(
+            pops.PartialsStore, store_fields, {"G": g, "N": n}
+        )
+        didx = jax.ShapeDtypeStruct((d,), "int32")
+        midx = jax.ShapeDtypeStruct((m,), "int32")
+        slots = jax.ShapeDtypeStruct((c,), "int32")
+        try:
+            out = jax.eval_shape(pops.eval_store, cluster, specs)
+            check_out(
+                out, "PartialsStore", store_fields, {"G": g, "N": n},
+                f"eval_store[{n}x{g}]",
+            )
+            signatures["eval"].add(retrace.signature((cluster, specs)))
+            out = jax.eval_shape(
+                pops.refresh_rows, store, specs, cluster, didx
+            )
+            check_out(
+                out, "PartialsStore", store_fields, {"G": g, "N": n},
+                f"refresh_rows[{n}x{g}x{d}]",
+            )
+            signatures["refresh"].add(
+                retrace.signature((store, specs, cluster, didx))
+            )
+            out = jax.eval_shape(
+                pops.insert_slots, store, specs, cluster, midx
+            )
+            check_out(
+                out, "PartialsStore", store_fields, {"G": g, "N": n},
+                f"insert_slots[{n}x{g}x{m}]",
+            )
+            signatures["insert"].add(
+                retrace.signature((store, specs, cluster, midx))
+            )
+            out = jax.eval_shape(pops.gather_statics, store, slots)
+            check_out(
+                out, "ClassStatics", statics_fields, {"C": c, "N": n},
+                f"gather_statics[{n}x{g}x{c}]",
+            )
+            signatures["gather"].add(retrace.signature((store, slots)))
+        except Exception as e:  # noqa: BLE001 — abstract eval failed
+            findings.append(
+                Finding(
+                    CHECK, file, 1, "partials",
+                    f"eval_shape failed at bucket "
+                    f"{(n, g, d, m, c)}: {e}",
+                )
+            )
+    for label, sigs in signatures.items():
+        if len(sigs) != len(PARTIALS_LATTICE):
+            findings.append(
+                Finding(
+                    CHECK, file, 1, label,
+                    f"{len(PARTIALS_LATTICE)} lattice points produced "
+                    f"{len(sigs)} distinct compile keys — the abstract "
+                    "signature set must be exactly the bucket set",
+                )
+            )
+
+    # WARM vs COLD solver twins: same SolveResult contracts, DISTINCT
+    # compile keys (single-chip and sharded — the statics operands and
+    # the mesh shape are both part of the signature)
+    n, p, c = 16, 8, 2
+    ff_off = assign.FeatureFlags()
+    snap = abstract_snapshot(byclass, limits, n=n, p=p)
+    statics = abstract(
+        pops.ClassStatics, statics_fields, {"C": c, "N": n}
+    )
+    cold_sig = retrace.signature(snap, (1, ff_off, 0))
+    warm_sig = retrace.signature((snap, statics), (1, ff_off, 0))
+    if warm_sig == cold_sig:
+        findings.append(
+            Finding(
+                CHECK, file, 1, "ClassStatics",
+                "warm compile key collides with the cold key (the "
+                "statics operands must be part of the signature)",
+            )
+        )
+    try:
+        res = jax.eval_shape(
+            lambda s, st: assign.greedy_assign(
+                s, topo_z=1, features=ff_off, n_groups=0, statics=st
+            ),
+            snap, statics,
+        )
+        _result_contract_check(
+            res, "SolveResult", byclass,
+            _class_env("ClusterTensors", limits, n, p, {}),
+            f"greedy-warm[{n}x{p}]", findings,
+            "kubernetes_tpu/ops/assign.py",
+        )
+    except Exception as e:  # noqa: BLE001
+        findings.append(
+            Finding(
+                CHECK, file, 1, "greedy_assign",
+                f"warm eval_shape failed at bucket {n}x{p}: {e}",
+            )
+        )
+    ndev = len(jax.devices())
+    size = 1
+    while size * 2 <= min(ndev, 8):
+        size *= 2
+    mesh = sharded.make_mesh(size)
+    mesh_sig = sharded.mesh_signature(mesh)
+    if retrace.signature(
+        (snap, statics), (1, ff_off, 0, mesh_sig)
+    ) == warm_sig:
+        findings.append(
+            Finding(
+                CHECK, file, 1, "ClassStatics",
+                "sharded warm compile key collides with the single-chip "
+                "warm key (mesh shape must be part of the signature)",
+            )
+        )
+    if n % size == 0:
+        try:
+            res = jax.eval_shape(
+                lambda s, st: sharded.sharded_greedy_assign(
+                    s, mesh, topo_z=1, features=ff_off, n_groups=0,
+                    statics=st,
+                ),
+                snap, statics,
+            )
+            _result_contract_check(
+                res, "SolveResult", byclass,
+                _class_env("ClusterTensors", limits, n, p, {}),
+                f"greedy-sharded-warm[{n}x{p}]", findings,
+                "kubernetes_tpu/parallel/sharded.py",
+            )
+        except Exception as e:  # noqa: BLE001
+            findings.append(
+                Finding(
+                    CHECK, file, 1, "sharded_greedy_assign",
+                    f"sharded warm eval_shape failed: {e}",
+                )
+            )
+
+
 def _check_gang_retry_closure(findings: List[Finding]) -> None:
     """The gang-admission binary search re-solves SUBSETS of the batch
     with num_pods_hint pinned to the full batch size: every subset must
@@ -1047,6 +1268,7 @@ def check(root: str, package: str = "kubernetes_tpu") -> List[Finding]:
     _check_preemption_kernel(byclass, findings)
     _check_mesh_kernels(byclass, findings)
     _check_slice_kernels(byclass, findings)
+    _check_partials_kernels(byclass, findings)
     _check_gang_retry_closure(findings)
     findings.sort(key=lambda f: (f.file, f.line, f.message))
     return findings

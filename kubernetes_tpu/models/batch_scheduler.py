@@ -44,6 +44,7 @@ from ..ops import schema
 from ..ops.scores import DEFAULT_SCORE_CONFIG, ScoreConfig
 from ..testing import faults
 from .mirror import DeviceClusterMirror
+from .partials import PartialsCache
 
 Result = Union[assign_ops.SolveResult, auction_ops.AuctionResult]
 
@@ -687,6 +688,8 @@ class TPUBatchScheduler:
         prewarm: Optional[bool] = None,  # None = auto (off on CPU backend)
         arbiter: Optional[DispatchArbiter] = None,  # shared across lanes
         carveout_policy: str = "prefer",  # slice carve-outs: prefer|require|off
+        use_partials: bool = True,  # PartialsCache (IncrementalSolve gate)
+        partials_resync_interval: int = PartialsCache.DEFAULT_RESYNC_INTERVAL,
     ):
         if state is not None:
             # shared-state instance: multiple scheduler PROFILES solve the
@@ -758,6 +761,20 @@ class TPUBatchScheduler:
         self.sharded_fallbacks = 0
         self._mirror = DeviceClusterMirror(self.state, mesh=mesh)
         self.use_mirror = use_mirror
+        # device-resident Filter/Score partials warm-starting each solve
+        # (the incremental O(changes) path, models/partials.py): keyed
+        # by pod-class signatures, scatter-refreshed from the same dirty
+        # rows the mirror scatters, invalidated/rolled back alongside
+        # it.  Needs the mirror (warm rows evaluate against the resident
+        # cluster tensors the solve consumes).
+        self._partials: Optional[PartialsCache] = (
+            PartialsCache(
+                self.state, mesh=mesh,
+                resync_interval=partials_resync_interval,
+            )
+            if use_partials and use_mirror
+            else None
+        )
         # multi-lane device admission: profile lanes sharing one
         # device/mesh pass ONE DispatchArbiter (FrameworkRegistry wires
         # it for multi-profile configs); None = uncontended single lane,
@@ -925,13 +942,16 @@ class TPUBatchScheduler:
 
     def _prewarm_neighbors(  # graftlint: disable=purity -- speculative compile bookkeeping; the pool mutex is uncontended and compiles run off-thread
         self, snap, route, topo_z, features, n_groups, wave_shape=None,
-        sharded: bool = False,
+        sharded: bool = False, statics=None,
     ) -> None:
         """On a first-seen executable key, speculatively compile the keys
         the workload will hit next (SolverPrewarmPool docstring).  The
         key carries the mesh size: sharded and single-chip solves of the
         same bucket are DIFFERENT executables (shard_map is part of the
-        program), and a mesh-mode scheduler prewarms the sharded twin."""
+        program), and a mesh-mode scheduler prewarms the sharded twin.
+        Warm-started solves (statics from the PartialsCache) are their
+        own executable family: the key carries the statics shapes and
+        the compiles target the `.jitted_warm` twin."""
         pool = self.prewarm_pool
         if pool is None or route == "auction":
             return
@@ -940,22 +960,33 @@ class TPUBatchScheduler:
         p_dim = snap.pods.req.shape[0]
         n_dim = snap.cluster.allocatable.shape[0]
         mesh_key = self._mesh_size if sharded else 0
+        statics_key = (
+            None
+            if statics is None
+            else tuple(
+                (tuple(a.shape), str(a.dtype)) for a in statics
+            )
+        )
         key = (
             route, mesh_key, n_dim, p_dim, topo_z, features, n_groups,
-            wave_shape,
+            wave_shape, statics_key,
         )
         if not pool.mark_seen(key):
             return
         shapes = self._shapes_of(snap)
+        statics_shapes = (
+            None if statics is None else self._shapes_of(statics)
+        )
         if sharded:
-            fn = (
+            solver = (
                 self._wavefront_sharded if route == "wavefront"
                 else self._greedy_sharded
-            ).jitted
+            )
         else:
-            fn = (
+            solver = (
                 self._wavefront if route == "wavefront" else self._greedy
-            ).jitted
+            )
+        fn = solver.jitted if statics is None else solver.jitted_warm
 
         def offer(p_variant, feats):
             wshape = wave_shape
@@ -975,9 +1006,14 @@ class TPUBatchScheduler:
                     self._shapes_with_pod_dim(shapes, p_variant)
                     if p_variant != p_dim else shapes,
                 )
+            if statics_shapes is not None:
+                # the warm twin takes the statics triple right after the
+                # array args; the class axis tracks the batch's class
+                # set, not its pod bucket, so neighbor variants reuse it
+                args_shapes = args_shapes + (statics_shapes,)
             nkey = (
                 route, mesh_key, n_dim, p_variant, topo_z, feats, n_groups,
-                wshape,
+                wshape, statics_key,
             )
 
             def compile_fn(args_shapes=args_shapes, feats=feats):
@@ -1059,17 +1095,21 @@ class TPUBatchScheduler:
             self._prewarm_neighbors(
                 snap, route, topo_z, features, n_groups,
                 wave_shape=plan.members.shape, sharded=sharded,
+                statics=meta.statics,
             )
             solver = self._wavefront_sharded if sharded else self._wavefront
             return solver(
                 snap, wave_members=plan.members, topo_z=topo_z,
-                features=features, n_groups=n_groups,
+                features=features, n_groups=n_groups, statics=meta.statics,
             )
         self._prewarm_neighbors(
-            snap, route, topo_z, features, n_groups, sharded=sharded
+            snap, route, topo_z, features, n_groups, sharded=sharded,
+            statics=meta.statics,
         )
         solver = self._greedy_sharded if sharded else self._greedy
-        return solver(snap, topo_z, features, n_groups=n_groups)
+        return solver(
+            snap, topo_z, features, n_groups=n_groups, statics=meta.statics
+        )
 
     def encode_pending(
         self,
@@ -1144,7 +1184,30 @@ class TPUBatchScheduler:
             # per-batch host→device traffic stays O(changed rows) in
             # both layouts.
             if self.use_mirror:
-                snap = snap._replace(cluster=self._mirror.sync())
+                dev_cluster = self._mirror.sync()
+                if (
+                    self._partials is not None
+                    and meta.route in ("greedy", "wavefront")
+                ):
+                    # warm-start statics for the greedy-family routes:
+                    # re-evaluate only the rows dirtied since the last
+                    # sync (plus first-seen classes) against the SAME
+                    # resident tensors the solve consumes.  The cache is
+                    # an optimization layer: any failure inside it
+                    # (including injected solve.partials faults) falls
+                    # back to the cold in-program class_statics path and
+                    # invalidates the residents.
+                    try:
+                        meta.statics = self._partials.sync(
+                            dev_cluster, snap, meta
+                        )
+                    except Exception:  # noqa: BLE001 — cold solve instead
+                        self._partials.invalidate()
+                        logging.getLogger(__name__).exception(
+                            "partials sync failed; cold solve for this "
+                            "batch"
+                        )
+                snap = snap._replace(cluster=dev_cluster)
                 snap = _device_fill_shortcut(
                     snap, self._fill_cache, no_bound_pods=no_bound,
                     features=meta.features, put=self._put,
@@ -1255,6 +1318,9 @@ class TPUBatchScheduler:
                 ds = self.solve_encoded_async(snap, meta)
             except Exception:  # noqa: BLE001
                 self.breaker.record_failure()
+                if self._partials is not None:
+                    with lock if lock is not None else contextlib.nullcontext():
+                        self._partials.invalidate()
                 logging.getLogger(__name__).exception(
                     "device solve retry failed; breaker open, host fallback"
                 )
@@ -1296,6 +1362,13 @@ class TPUBatchScheduler:
                 "device solve readback failed; retrying once"
             )
             try:
+                # resident partials are a fault suspect (a poisoned
+                # store surfaces exactly here, as SolveUnhealthy NaN
+                # scores): drop them so the retry's encode performs a
+                # full recompute — the parity gate's recovery wire
+                if self._partials is not None:
+                    with lock if lock is not None else contextlib.nullcontext():
+                        self._partials.invalidate()
                 snap, meta = self.encode_pending(
                     pending, lock=lock, reservations=reservations
                 )

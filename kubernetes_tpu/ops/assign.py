@@ -473,23 +473,41 @@ def _eval_pod(
 
 def _solver_prep(
     snapshot: Snapshot, cfg: ScoreConfig, topo_z: int, features: FeatureFlags,
-    axis_name: Optional[str] = None,
+    axis_name: Optional[str] = None, statics=None,
 ):
     """Per-batch device prep shared by the scan and wavefront solvers:
     materialized tensors, class-hoisted static tables, and the spread /
     inter-pod prep states (the PreFilter/PreScore analogue).  Under
     shard_map the hoisted tables cover the local node shard; the
     value-space count preps and normalizers span shards via psum/pmax
-    inside prep_spread/prep_terms/static_extra."""
+    inside prep_spread/prep_terms/static_extra.
+
+    statics: a precomputed (sfeas, aff, taint) triple
+    (ops.partials.ClassStatics) warm-started from the device-resident
+    PartialsCache — bit-identical to what class_statics would compute
+    here (the cache's parity gate pins it), so the whole [C, N]
+    selector/taint/affinity re-evaluation is skipped.  The selector
+    mask is still computed when the spread family needs it
+    (prep_spread's owner-eligibility input)."""
     (cluster, pods, sel, pref, spread, terms, prefpod, images) = jax.tree.map(
         jnp.asarray, tuple(snapshot)
     )
     n = cluster.allocatable.shape[0]
     p = pods.req.shape[0]
 
-    sel_mask = selector_match(cluster, sel)
-    pref_mask = preferred_match(cluster, pref)
-    sfeas_c, aff_c, taint_c = class_statics(cluster, pods, sel_mask, pref_mask)
+    if statics is None:
+        sel_mask = selector_match(cluster, sel)
+        pref_mask = preferred_match(cluster, pref)
+        sfeas_c, aff_c, taint_c = class_statics(
+            cluster, pods, sel_mask, pref_mask
+        )
+    else:
+        sfeas_c = jnp.asarray(statics.sfeas)
+        aff_c = jnp.asarray(statics.aff)
+        taint_c = jnp.asarray(statics.taint)
+        sel_mask = (
+            selector_match(cluster, sel) if features.spread else None
+        )
     c_dim = sfeas_c.shape[0]
     extra_c = None
     if features.interpod_pref or features.images:
@@ -578,6 +596,7 @@ def greedy_assign(
     features: Optional[FeatureFlags] = None,
     n_groups: int = 0,
     axis_name: Optional[str] = None,
+    statics=None,
 ) -> SolveResult:
     """Sequential-greedy solve of the whole pending batch on device.
 
@@ -618,7 +637,8 @@ def greedy_assign(
         raise ValueError("keyed (tie_seed) solves are single-chip only")
     (cluster, pods, spread, terms, sfeas_c, aff_c, taint_c, extra_c,
      sp0, tm0, c_dim, n, p) = _solver_prep(
-        snapshot, cfg, topo_z, features, axis_name=axis_name
+        snapshot, cfg, topo_z, features, axis_name=axis_name,
+        statics=statics,
     )
     offset, n_total, node_rows, node_col = _shard_layout(axis_name, n)
     order = solve_order(pods)
@@ -807,7 +827,12 @@ def greedy_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
     """A jitted closure over the (static, hashable) score config.
     topo_z and the feature gates are static: one executable per
     (shape-bucket, topo_z, features).  Features are auto-detected
-    host-side when not supplied."""
+    host-side when not supplied.
+
+    `statics` (ops.partials.ClassStatics) selects the WARM twin: a
+    distinct executable (three extra [C, N] operands, no in-program
+    selector/taint/affinity re-evaluation) warm-started from the
+    device-resident PartialsCache — the incremental O(changes) solve."""
 
     @partial(jax.jit, static_argnums=(1, 2, 3))
     def run(
@@ -817,11 +842,22 @@ def greedy_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
             snapshot, cfg, topo_z=topo_z, features=features, n_groups=n_groups
         )
 
+    @partial(jax.jit, static_argnums=(2, 3, 4))
+    def run_warm(
+        snapshot: Snapshot, statics, topo_z: int, features: FeatureFlags,
+        n_groups: int,
+    ) -> SolveResult:
+        return greedy_assign(
+            snapshot, cfg, topo_z=topo_z, features=features,
+            n_groups=n_groups, statics=statics,
+        )
+
     def call(
         snapshot: Snapshot,
         topo_z: Optional[int] = None,
         features: Optional[FeatureFlags] = None,
         n_groups: Optional[int] = None,
+        statics=None,
     ) -> SolveResult:
         if features is None:
             features = features_of(snapshot)
@@ -839,6 +875,15 @@ def greedy_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
             from ..utils.vocab import pad_dim
 
             n_groups = pad_dim(n_groups, 1)
+        if statics is not None:
+            out = run_warm(snapshot, statics, topo_z, features, n_groups)
+            retrace.note(
+                "greedy-warm", run_warm,
+                lambda: retrace.signature(
+                    (snapshot, statics), (topo_z, features, n_groups)
+                ),
+            )
+            return out
         out = run(snapshot, topo_z, features, n_groups)
         retrace.note(
             "greedy", run,
@@ -847,6 +892,7 @@ def greedy_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
         return out
 
     call.jitted = run  # raw jit, for AOT prewarm (lower().compile())
+    call.jitted_warm = run_warm
     return call
 
 
@@ -1049,6 +1095,7 @@ def wavefront_assign(
     features: Optional[FeatureFlags] = None,
     n_groups: int = 0,
     axis_name: Optional[str] = None,
+    statics=None,
 ) -> SolveResult:
     """Wave-parallel greedy solve with exact scan parity (see module
     section comment).  wave_members: i32[W, K] pod indices covering every
@@ -1082,7 +1129,8 @@ def wavefront_assign(
         topo_z = required_topo_z(snapshot)
     (cluster, pods, spread, terms, sfeas_c, aff_c, taint_c, extra_c,
      sp0, tm0, c_dim, n, p) = _solver_prep(
-        snapshot, cfg, topo_z, features, axis_name=axis_name
+        snapshot, cfg, topo_z, features, axis_name=axis_name,
+        statics=statics,
     )
     offset, n_total, node_rows, node_col = _shard_layout(axis_name, n)
     wave_members = jnp.asarray(wave_members, jnp.int32)
@@ -1504,6 +1552,16 @@ def wavefront_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
             n_groups=n_groups,
         )
 
+    @partial(jax.jit, static_argnums=(3, 4, 5))
+    def run_warm(
+        snapshot: Snapshot, wave_members, statics, topo_z: int,
+        features: FeatureFlags, n_groups: int,
+    ) -> SolveResult:
+        return wavefront_assign(
+            snapshot, wave_members, cfg, topo_z=topo_z, features=features,
+            n_groups=n_groups, statics=statics,
+        )
+
     def call(
         snapshot: Snapshot,
         wave_members=None,
@@ -1511,6 +1569,7 @@ def wavefront_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
         features: Optional[FeatureFlags] = None,
         n_groups: Optional[int] = None,
         wave_cap: int = DEFAULT_WAVE_CAP,
+        statics=None,
     ) -> SolveResult:
         if features is None:
             features = features_of(snapshot)
@@ -1527,6 +1586,17 @@ def wavefront_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
                 snapshot, features=features, wave_cap=wave_cap
             ).members
         members = jnp.asarray(wave_members, jnp.int32)
+        if statics is not None:
+            out = run_warm(snapshot, members, statics, topo_z, features,
+                           n_groups)
+            retrace.note(
+                "wavefront-warm", run_warm,
+                lambda: retrace.signature(
+                    (snapshot, members, statics),
+                    (topo_z, features, n_groups),
+                ),
+            )
+            return out
         out = run(snapshot, members, topo_z, features, n_groups)
         retrace.note(
             "wavefront", run,
@@ -1537,6 +1607,7 @@ def wavefront_assign_jit(cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
         return out
 
     call.jitted = run  # raw jit, for AOT prewarm (lower().compile())
+    call.jitted_warm = run_warm
     return call
 
 

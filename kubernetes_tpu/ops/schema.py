@@ -327,6 +327,17 @@ class SnapshotMeta:
     # batches, the host-planned wave partition (assign.WavePlan)
     route: Optional[str] = None
     wave_plan: Optional[object] = None
+    # persistent content-signature ids of this batch's selector /
+    # preferred table rows (SnapshotBuilder._stable_id): batch-local
+    # row INDICES are not comparable across batches, these are — the
+    # PartialsCache keys pod classes on them (models/partials.py)
+    sel_stable: Tuple[int, ...] = ()
+    pref_stable: Tuple[int, ...] = ()
+    # warm-start per-class statics gathered from the device-resident
+    # PartialsCache (ops.partials.ClassStatics; set by
+    # TPUBatchScheduler.encode_pending, consumed by _dispatch — None
+    # means cold: the solver recomputes class_statics in-program)
+    statics: Optional[object] = None
 
     def node_name(self, idx: int) -> Optional[str]:
         if 0 <= idx < self.num_nodes:
@@ -361,6 +372,15 @@ class SnapshotBuilder:
         # slice/pool names (api.LABEL_TPU_SLICE) -> dense slice ids for
         # ClusterTensors.slice_id; append-only like every other vocab
         self.slice_vocab = vb.Vocab()
+        # persistent selector/preferred signature registry: a content
+        # signature's id is stable across batches (append-only), so
+        # consumers keying on selector CONTENT (the PartialsCache's
+        # class signatures) survive the per-batch table rebuild
+        self._sig_registry: Dict[tuple, int] = {}
+        # (sel row -> stable id, pref row -> stable id) of the most
+        # recent _build_pods — read under the same cache lock by
+        # build/build_from_state into SnapshotMeta
+        self._last_stable: Tuple[tuple, tuple] = ((), ())
         self.scalar_resources: List[str] = []
         self._scalar_index: Dict[str, int] = {}
         # Optional per-pod requirement hook: (pod) -> (extra required
@@ -380,6 +400,15 @@ class SnapshotBuilder:
         if self.pod_transform is None:
             return None, None
         return self.pod_transform(pod)
+
+    def _stable_id(self, sig: tuple) -> int:
+        """Append-only id of a content signature (selector / preferred
+        term) — stable for the builder's lifetime, unlike the per-batch
+        dedup table indices."""
+        i = self._sig_registry.get(sig)
+        if i is None:
+            i = self._sig_registry[sig] = len(self._sig_registry)
+        return i
 
     def pod_carveout_shape(self, pod: api.Pod) -> Tuple[int, int, int]:
         """The pod's requested carve-out extent: pod.spec.tpu_topology,
@@ -692,6 +721,7 @@ class SnapshotBuilder:
             limits=lim,
             topo_z=self._topo_z(),
         )
+        meta.sel_stable, meta.pref_stable = self._last_stable
         return Snapshot(
             cluster, pods, sel, pref, spread, terms, prefpod, images
         ), meta
@@ -737,6 +767,7 @@ class SnapshotBuilder:
             limits=self.limits,
             topo_z=self._topo_z(),
         )
+        meta.sel_stable, meta.pref_stable = self._last_stable
         return Snapshot(
             cluster, pods, sel, pref, spread, terms, prefpod, images
         ), meta
@@ -1085,6 +1116,19 @@ class SnapshotBuilder:
             pref.expr_op[f] = ops
             pref.expr_slot[f] = slots
             pref.valid[f] = True
+
+        # stable content-signature ids for this batch's dedup rows (the
+        # PartialsCache's cross-batch class keys; see _stable_id)
+        sel_sigs: List[tuple] = [()] * len(sel_rows)
+        for sig, idx in sel_index.items():
+            sel_sigs[idx] = sig
+        pref_sigs: List[tuple] = [()] * len(pref_rows)
+        for sig, idx in pref_index.items():
+            pref_sigs[idx] = sig
+        self._last_stable = (
+            tuple(self._stable_id(("sel", s)) for s in sel_sigs),
+            tuple(self._stable_id(("pref", s)) for s in pref_sigs),
+        )
 
         class_id, class_rep = _pod_classes(
             valid, name_id, sel_idx, tol_bits, tol_all, port_bits,

@@ -69,6 +69,7 @@ from ..ops.auction import (
     auction_features_ok,
     default_tie_k,
 )
+from ..ops.partials import ClassStatics
 from ..ops.schema import (
     ClusterTensors,
     PrefPodTable,
@@ -98,6 +99,15 @@ CLUSTER_SPECS = ClusterTensors(
     torus_coords=P(AXIS, None),
     slice_dims=P(AXIS, None),
     slice_pos=P(AXIS),
+)
+
+
+# Warm-start statics ([C, N] per-class triples gathered from the
+# device-resident PartialsCache): node axis sharded like every other
+# [·, N] table — the resident store carries exactly this layout, so a
+# warm mesh solve consumes it without resharding.
+STATICS_SPECS = ClassStatics(
+    sfeas=P(None, AXIS), aff=P(None, AXIS), taint=P(None, AXIS)
 )
 
 
@@ -170,6 +180,7 @@ def sharded_greedy_assign(
     topo_z: Optional[int] = None,
     features: Optional[FeatureFlags] = None,
     n_groups: int = 0,
+    statics: Optional[ClassStatics] = None,
 ) -> SolveResult:
     """greedy_assign with the node axis sharded over `mesh`.
 
@@ -209,21 +220,41 @@ def sharded_greedy_assign(
         cluster=CLUSTER_SPECS, reasons=rep, **slice_specs,
     )
 
+    if statics is None:
+
+        @partial(
+            _shard_map,
+            mesh=mesh,
+            in_specs=_snapshot_in_specs(parts),
+            out_specs=out_specs,
+            check_vma=False,
+        )
+        def run(cl, pods, sel, pref, spread, terms, prefpod, images):
+            local = Snapshot(
+                cl, pods, sel, pref, spread, terms, prefpod, images
+            )
+            return greedy_assign(
+                local, cfg, topo_z=topo_z, features=features,
+                n_groups=n_groups, axis_name=AXIS,
+            )
+
+        return run(*parts)
+
     @partial(
         _shard_map,
         mesh=mesh,
-        in_specs=_snapshot_in_specs(parts),
+        in_specs=_snapshot_in_specs(parts) + (STATICS_SPECS,),
         out_specs=out_specs,
         check_vma=False,
     )
-    def run(cl, pods, sel, pref, spread, terms, prefpod, images):
+    def run_warm(cl, pods, sel, pref, spread, terms, prefpod, images, st):
         local = Snapshot(cl, pods, sel, pref, spread, terms, prefpod, images)
         return greedy_assign(
             local, cfg, topo_z=topo_z, features=features,
-            n_groups=n_groups, axis_name=AXIS,
+            n_groups=n_groups, axis_name=AXIS, statics=st,
         )
 
-    return run(*parts)
+    return run_warm(*parts, jax.tree.map(jnp.asarray, statics))
 
 
 def sharded_wavefront_assign(
@@ -234,6 +265,7 @@ def sharded_wavefront_assign(
     topo_z: Optional[int] = None,
     features: Optional[FeatureFlags] = None,
     n_groups: int = 0,
+    statics: Optional[ClassStatics] = None,
 ) -> SolveResult:
     """wavefront_assign with the node axis sharded over `mesh` — the
     production mesh route for large greedy batches: ~P/W wave steps
@@ -263,21 +295,41 @@ def sharded_wavefront_assign(
         wave_fallbacks=rep,
     )
 
+    if statics is None:
+
+        @partial(
+            _shard_map,
+            mesh=mesh,
+            in_specs=_snapshot_in_specs(parts) + (rep,),
+            out_specs=out_specs,
+            check_vma=False,
+        )
+        def run(cl, pods, sel, pref, spread, terms, prefpod, images, mem):
+            local = Snapshot(
+                cl, pods, sel, pref, spread, terms, prefpod, images
+            )
+            return wavefront_assign(
+                local, mem, cfg, topo_z=topo_z, features=features,
+                n_groups=n_groups, axis_name=AXIS,
+            )
+
+        return run(*parts, members)
+
     @partial(
         _shard_map,
         mesh=mesh,
-        in_specs=_snapshot_in_specs(parts) + (rep,),
+        in_specs=_snapshot_in_specs(parts) + (rep, STATICS_SPECS),
         out_specs=out_specs,
         check_vma=False,
     )
-    def run(cl, pods, sel, pref, spread, terms, prefpod, images, mem):
+    def run_warm(cl, pods, sel, pref, spread, terms, prefpod, images, mem, st):
         local = Snapshot(cl, pods, sel, pref, spread, terms, prefpod, images)
         return wavefront_assign(
             local, mem, cfg, topo_z=topo_z, features=features,
-            n_groups=n_groups, axis_name=AXIS,
+            n_groups=n_groups, axis_name=AXIS, statics=st,
         )
 
-    return run(*parts, members)
+    return run_warm(*parts, members, jax.tree.map(jnp.asarray, statics))
 
 
 def sharded_auction_assign(
@@ -370,11 +422,22 @@ def sharded_greedy_jit(mesh: Mesh, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
             n_groups=n_groups,
         )
 
+    @partial(jax.jit, static_argnums=(2, 3, 4))
+    def run_warm(
+        snapshot: Snapshot, statics, topo_z: int, features: FeatureFlags,
+        n_groups: int,
+    ) -> SolveResult:
+        return sharded_greedy_assign(
+            snapshot, mesh, cfg, topo_z=topo_z, features=features,
+            n_groups=n_groups, statics=statics,
+        )
+
     def call(
         snapshot: Snapshot,
         topo_z: Optional[int] = None,
         features: Optional[FeatureFlags] = None,
         n_groups: Optional[int] = None,
+        statics=None,
     ) -> SolveResult:
         if features is None:
             features = features_of(snapshot)
@@ -388,6 +451,16 @@ def sharded_greedy_jit(mesh: Mesh, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
             from ..utils.vocab import pad_dim
 
             n_groups = pad_dim(n_groups, 1)
+        if statics is not None:
+            out = run_warm(snapshot, statics, topo_z, features, n_groups)
+            retrace.note(
+                "greedy-sharded-warm", run_warm,
+                lambda: retrace.signature(
+                    (snapshot, statics),
+                    (topo_z, features, n_groups, mesh_sig),
+                ),
+            )
+            return out
         out = run(snapshot, topo_z, features, n_groups)
         retrace.note(
             "greedy-sharded", run,
@@ -398,6 +471,7 @@ def sharded_greedy_jit(mesh: Mesh, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
         return out
 
     call.jitted = run  # raw jit, for AOT prewarm (lower().compile())
+    call.jitted_warm = run_warm
     return call
 
 
@@ -417,6 +491,16 @@ def sharded_wavefront_jit(mesh: Mesh, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
             features=features, n_groups=n_groups,
         )
 
+    @partial(jax.jit, static_argnums=(3, 4, 5))
+    def run_warm(
+        snapshot: Snapshot, wave_members, statics, topo_z: int,
+        features: FeatureFlags, n_groups: int,
+    ) -> SolveResult:
+        return sharded_wavefront_assign(
+            snapshot, wave_members, mesh, cfg, topo_z=topo_z,
+            features=features, n_groups=n_groups, statics=statics,
+        )
+
     def call(
         snapshot: Snapshot,
         wave_members=None,
@@ -424,6 +508,7 @@ def sharded_wavefront_jit(mesh: Mesh, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
         features: Optional[FeatureFlags] = None,
         n_groups: Optional[int] = None,
         wave_cap: int = DEFAULT_WAVE_CAP,
+        statics=None,
     ) -> SolveResult:
         if features is None:
             features = features_of(snapshot)
@@ -442,6 +527,17 @@ def sharded_wavefront_jit(mesh: Mesh, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
                 snapshot, features=features, wave_cap=wave_cap
             ).members
         members = jnp.asarray(wave_members, jnp.int32)
+        if statics is not None:
+            out = run_warm(snapshot, members, statics, topo_z, features,
+                           n_groups)
+            retrace.note(
+                "wavefront-sharded-warm", run_warm,
+                lambda: retrace.signature(
+                    (snapshot, members, statics),
+                    (topo_z, features, n_groups, mesh_sig),
+                ),
+            )
+            return out
         out = run(snapshot, members, topo_z, features, n_groups)
         retrace.note(
             "wavefront-sharded", run,
@@ -452,6 +548,7 @@ def sharded_wavefront_jit(mesh: Mesh, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
         return out
 
     call.jitted = run  # raw jit, for AOT prewarm (lower().compile())
+    call.jitted_warm = run_warm
     return call
 
 

@@ -208,6 +208,13 @@ class MetricsCollector:
         "scheduler_mirror_resync_total",
         "scheduler_mirror_delta_rows",
         "scheduler_sharded_solve_fallbacks",
+        # incremental O(changes) solving: resident-partials hit/recompute
+        # accounting, full recomputes, and speculation rollbacks
+        # (docs/scheduler_loop.md incremental-solve section)
+        "scheduler_partials_hit_rows",
+        "scheduler_partials_recomputed_rows",
+        "scheduler_partials_full_recomputes_total",
+        "scheduler_partials_rollbacks_total",
         "scheduler_binder_restarts_total",
         "scheduler_binder_poison_waves_total",
         "scheduler_journal_recovered_records",

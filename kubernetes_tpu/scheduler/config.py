@@ -129,6 +129,13 @@ class SchedulerConfiguration:
     # (SnapshotLimits.max_slice_dim — bounds the carve-out grid);
     # 0 keeps the SnapshotLimits default
     slice_max_dim: int = 0
+    # Incremental O(changes) solving (docs/scheduler_loop.md
+    # "Incremental solve: resident partials"): forced full recompute of
+    # the device-resident Filter/Score partials every this many delta
+    # syncs — the periodic half of the cache's resync/parity discipline
+    # (struct/vocab invalidation and the decode-side parity gate are
+    # unconditional).  Armed by the IncrementalSolve feature gate.
+    partials_resync_interval: int = 1024
     # parity-only knobs (see module docstring)
     parallelism: int = 16
     percentage_of_nodes_to_score: int = 100
@@ -244,6 +251,11 @@ class SchedulerConfiguration:
             raise ValueError(
                 "slice_max_dim must be >= 0 (0 = SnapshotLimits default)"
             )
+        if self.partials_resync_interval < 1:
+            raise ValueError(
+                "partials_resync_interval must be >= 1 (every delta sync "
+                "may force a full recompute, never none)"
+            )
         self.gate()  # unknown/locked gate overrides raise here
         return self
 
@@ -266,7 +278,7 @@ _TOP_KEYS = {
     "adaptiveBatchWindow", "batchWindowMinSeconds", "batchWindowMaxSeconds",
     "batchLatencySLOSeconds", "meshDevices", "commitSubwaveConcurrency",
     "schedulerLanes", "speculativeSolve", "streamSubwaves",
-    "sliceCarveoutPolicy", "sliceMaxDim",
+    "sliceCarveoutPolicy", "sliceMaxDim", "partialsResyncInterval",
 }
 
 
@@ -339,6 +351,8 @@ def load_config(source: Any) -> SchedulerConfiguration:
         cfg.slice_carveout_policy = str(doc["sliceCarveoutPolicy"])
     if "sliceMaxDim" in doc:
         cfg.slice_max_dim = int(doc["sliceMaxDim"])
+    if "partialsResyncInterval" in doc:
+        cfg.partials_resync_interval = int(doc["partialsResyncInterval"])
     if "featureGates" in doc:
         cfg.feature_gates = {
             str(k): bool(v) for k, v in (doc["featureGates"] or {}).items()

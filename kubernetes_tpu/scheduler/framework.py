@@ -186,6 +186,10 @@ class FrameworkRegistry:
         # plugin registry (plugins/registry.go:58-70)
         mode = "auto" if self.gate.enabled("AuctionSolver") else "greedy"
         use_mirror = self.gate.enabled("DeviceClusterMirror")
+        # incremental O(changes) solving: per-profile PartialsCache
+        # warm-starting the greedy/wavefront solves from the mirror's
+        # resident tensors (models/partials.py; needs the mirror)
+        use_partials = use_mirror and self.gate.enabled("IncrementalSolve")
         # meshDevices + the ShardedSolve gate make mesh mode a
         # config-constructible production configuration: one mesh shared
         # by every profile, node axis sharded in all three solver
@@ -226,6 +230,8 @@ class FrameworkRegistry:
                 mesh=mesh,
                 arbiter=self.arbiter,
                 carveout_policy=config.slice_carveout_policy,
+                use_partials=use_partials,
+                partials_resync_interval=config.partials_resync_interval,
             )
             if first is None:
                 first = tpu

@@ -299,6 +299,28 @@ class Registry:
         self.sharded_solve_fallbacks = Gauge(
             "scheduler_sharded_solve_fallbacks"
         )
+        # -- incremental-solve surface (docs/scheduler_loop.md) ------------
+        # [class, node-row] partials entries served from the resident
+        # cache instead of re-evaluated (running total, mirrored from
+        # the PartialsCache each cycle)
+        self.partials_hit_rows = Gauge("scheduler_partials_hit_rows")
+        # node rows re-evaluated by the warm path: dirty-row refreshes
+        # plus full rows for first-seen classes — per-batch recompute is
+        # O(this delta), not O(C x N)
+        self.partials_recomputed_rows = Gauge(
+            "scheduler_partials_recomputed_rows"
+        )
+        # full partials-store recomputes (first sync, struct/vocab
+        # invalidation, periodic resync, parity-gate trips); steady
+        # state should not move outside the periodic interval
+        self.partials_full_recomputes = Gauge(
+            "scheduler_partials_full_recomputes_total"
+        )
+        # speculation rollbacks of the resident partials (invalidated
+        # speculative batches — rolled back alongside the mirror)
+        self.partials_rollbacks = Gauge(
+            "scheduler_partials_rollbacks_total"
+        )
         # -- overload-protection surface (docs/robustness.md) -------------
         # deepest per-watcher coalescing backlog at the last cycle mirror
         self.watch_queue_depth = Gauge("scheduler_watch_queue_depth")

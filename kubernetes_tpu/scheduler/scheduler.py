@@ -149,7 +149,7 @@ class _Cycle:
 
     __slots__ = ("stats", "trace", "reservations", "failed", "wave",
                  "pending", "solved_any", "batch", "handled",
-                 "spec_token", "mirror_points")
+                 "spec_token", "mirror_points", "partials_points")
 
     def __init__(self, stats, trace, reservations, batch):
         self.stats = stats
@@ -163,9 +163,11 @@ class _Cycle:
         self.handled: set = set()
         # speculative dispatch: the wave-failure generation this cycle's
         # solves were dispatched under (None = not speculative), plus
-        # per-profile mirror bookmarks for the invalidation rollback
+        # per-profile mirror AND partials-cache bookmarks for the
+        # invalidation rollback (the two resident buffers roll together)
         self.spec_token = None
         self.mirror_points: Dict[str, tuple] = {}
+        self.partials_points: Dict[str, tuple] = {}
 
 
 _REASON_TEXT = {
@@ -650,9 +652,16 @@ class Scheduler:
         for fwk in self.profiles:
             tpu = fwk.tpu
             mirror = getattr(tpu, "_mirror", None)
+            partials = getattr(tpu, "_partials", None)
             if mirror is not None:
                 with self.cache.lock:
                     mirror.invalidate()
+                    if partials is not None:
+                        # the resident partials belong to the same
+                        # generation history as the mirror: a new leader
+                        # recomputes them whole (warm failover must not
+                        # inherit a predecessor's warm rows)
+                        partials.invalidate()
             breaker = getattr(tpu, "breaker", None)
             if breaker is not None:
                 breaker.reset()
@@ -1300,11 +1309,18 @@ class Scheduler:
             # resident buffer (the double-buffer base) so invalidation
             # can drop the speculative delta chain whole
             mirror = getattr(fwk.tpu, "_mirror", None)
+            partials = getattr(fwk.tpu, "_partials", None)
             if mirror is not None and sched_name not in cycle.mirror_points:
                 with self.cache.lock:
                     cycle.mirror_points[sched_name] = (
                         mirror, mirror.speculation_point()
                     )
+                    if partials is not None:
+                        # the resident partials double-buffer with the
+                        # mirror: one bookmark pair, taken atomically
+                        cycle.partials_points[sched_name] = (
+                            partials, partials.speculation_point()
+                        )
         pods = [info.pod for info in group]
         try:
             ds = fwk.tpu.schedule_pending_async(
@@ -1352,6 +1368,13 @@ class Scheduler:
             mirror, bookmark = point
             with self.cache.lock:
                 mirror.rollback(bookmark)
+                ppoint = cycle.partials_points.get(sched_name)
+                if ppoint is not None:
+                    # partials roll back WITH the mirror: warm rows must
+                    # never outlive the resident tensors they were
+                    # evaluated against (partials_rollbacks_total)
+                    partials, pbookmark = ppoint
+                    partials.rollback(pbookmark)
         self.metrics.misspeculation_total.inc()
         logging.getLogger(__name__).info(
             "mis-speculation: requeueing %d pod(s) of profile %s "
@@ -1557,6 +1580,27 @@ class Scheduler:
             self.metrics.mirror_resync_total.set(float(mirror.resync_total))
             self.metrics.mirror_delta_rows.set(
                 float(mirror.delta_rows_total)
+            )
+        # incremental-solve surface: resident-partials hit/recompute
+        # accounting across every profile's cache (summed — profiles
+        # sync independently, the surface is one control plane)
+        p_stats = [
+            fwk.tpu._partials.stats()
+            for fwk in self.profiles
+            if getattr(fwk.tpu, "_partials", None) is not None
+        ]
+        if p_stats:
+            self.metrics.partials_hit_rows.set(
+                float(sum(s["hit_rows_total"] for s in p_stats))
+            )
+            self.metrics.partials_recomputed_rows.set(
+                float(sum(s["recomputed_rows_total"] for s in p_stats))
+            )
+            self.metrics.partials_full_recomputes.set(
+                float(sum(s["full_recomputes"] for s in p_stats))
+            )
+            self.metrics.partials_rollbacks.set(
+                float(sum(s["rollbacks"] for s in p_stats))
             )
         recovered = getattr(self.store, "journal_recovered_records", None)
         if recovered is not None:

@@ -32,6 +32,11 @@ DEFAULT_FEATURES: Dict[str, FeatureSpec] = {
     "AuctionSolver": FeatureSpec(True, BETA),
     # device-resident cluster mirror with delta sync (models/mirror.py)
     "DeviceClusterMirror": FeatureSpec(True, BETA),
+    # incremental O(changes) solving: device-resident Filter/Score
+    # partials warm-starting every greedy/wavefront solve, scatter-
+    # refreshed from the mirror's dirty rows (models/partials.py).
+    # Requires DeviceClusterMirror — disabled along with it.
+    "IncrementalSolve": FeatureSpec(True, BETA),
     # node-axis-sharded multichip solve when the config names a mesh
     # (SchedulerConfiguration.mesh_devices; parallel/sharded.py) — off
     # pins every profile to the single chip regardless of meshDevices

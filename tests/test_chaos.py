@@ -71,6 +71,11 @@ def _fault_plan(rng: random.Random) -> faults.FaultRegistry:
     # likewise the gang carve-out point: base seeds carry no shaped
     # gangs, so CARVEOUT_SEEDS (600-604) are where it actually fires
     reg.fail("solve.carveout", n=1, probability=0.5)
+    # the incremental-solve partials sync fires on every warm encode —
+    # a fail-grade fault here degrades that batch to a cold solve
+    # (contained inside encode); the CORRUPT poison-and-heal family is
+    # PARTIALS_SEEDS (700-704)
+    reg.fail("solve.partials", n=1, probability=0.5)
     return reg
 
 
@@ -1354,6 +1359,134 @@ def test_chaos_gang_carveouts(seed, tmp_path):
                     f"seed {seed}: gang {gname} not contiguous under "
                     f"require: {sorted(coords)}"
                 )
+        assert not audit.violations, f"seed {seed}: {audit.violations[:5]}"
+        rebound = {
+            k: nodes for k, nodes in audit.bound_nodes.items()
+            if len(nodes) > 1
+        }
+        assert not rebound, f"seed {seed}: double binds {rebound}"
+        assert sched.flush_binds(15)
+        deadline = time.monotonic() + 10
+        while sched.cache.assumed_count() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sched.cache.assumed_count() == 0, (
+            f"seed {seed}: assume set not empty at quiesce"
+        )
+    finally:
+        faults.disarm()
+        sched.stop()
+        elector.stop()
+
+
+# -- incremental-solve partials: poison-and-heal (ISSUE 14) ----------------
+#
+# The PartialsCache warm-starts every greedy/wavefront solve from
+# device-resident Filter/Score partials.  These seeds CORRUPT the
+# resident store (solve.partials poisons the raw score rows) and mix in
+# fail-grade partials/solve/commit faults: the parity gate must trip —
+# the poisoned solve's NaN scores hit the decode health check, the
+# retry invalidates the cache and fully recomputes (or the breaker's
+# host fallback places the batch) — and the pipeline must heal to the
+# standing invariants: every pod bound, bound-exactly-once, assume set
+# empty at quiesce.
+
+PARTIALS_SEEDS = list(range(700, 705))
+
+
+def _partials_fault_plan(rng: random.Random) -> faults.FaultRegistry:
+    reg = faults.FaultRegistry(seed=rng.randint(0, 2 ** 31))
+    # the poison: CORRUPT leaves NaN-generating score rows resident
+    reg.corrupt("solve.partials", n=rng.randint(1, 2))
+    # fail-grade partials faults degrade a batch to a cold solve
+    reg.fail("solve.partials", n=1, probability=0.5)
+    reg.fail("batch.solve", n=1, probability=0.5)
+    reg.fail("binder.commit_wave", n=rng.randint(1, 2))
+    reg.fail("store.update_wave", n=1, probability=0.5)
+    reg.fail("store.journal.append", n=1, probability=0.5)
+    reg.fail("leader.renew", n=1, probability=0.5)
+    return reg
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+@pytest.mark.parametrize("seed", PARTIALS_SEEDS)
+def test_chaos_partials_poison(seed, tmp_path):
+    rng = random.Random(seed)
+    reg = _partials_fault_plan(rng)
+    store = st.Store(journal_path=str(tmp_path / "journal.jsonl"))
+    audit = _EventAudit(store)
+    for i in range(24):
+        store.create(
+            make_node(f"n-{i}")
+            .capacity(cpu_milli=16000, mem=32 * GI, pods=110)
+            .zone(f"z-{i % 3}")
+            .obj()
+        )
+    elector = LeaderElector(
+        store, "partials-sched", f"holder-{seed}",
+        lease_duration=1.0, renew_period=0.05,
+    ).start()
+    config = SchedulerConfiguration(
+        pod_initial_backoff_seconds=0.05,
+        pod_max_backoff_seconds=0.4,
+        batch_window_seconds=0.01,
+        unschedulable_flush_seconds=0.5,
+    )
+    sched = Scheduler(
+        store, assume_ttl=1.0, leader_elector=elector, config=config
+    )
+    n_pods = 48
+    try:
+        with faults.armed(reg):
+            sched.start()
+            assert elector.wait_for_leadership(10)
+            for i in range(n_pods):
+                pod = (
+                    make_pod(f"p-{i}", namespace=f"team-{i % 2}")
+                    .req(cpu_milli=rng.choice([50, 100, 200]))
+                )
+                if i % 4 == 0:
+                    pod.node_selector_kv(
+                        "topology.kubernetes.io/zone", f"z-{i % 3}"
+                    )
+                store.create(pod.obj())
+                if rng.random() < 0.3:
+                    time.sleep(rng.random() * 0.01)
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                pods, _ = store.list("Pod")
+                if pods and all(p.spec.node_name for p in pods):
+                    break
+                time.sleep(0.1)
+
+        # -- invariants (faults disarmed; residual schedules drained) ----
+        assert reg.fired.get("solve.partials"), (
+            f"seed {seed}: the partials fault never fired "
+            f"(fired={reg.fired})"
+        )
+        pods, _ = store.list("Pod")
+        assert len(pods) == n_pods
+        unbound = [p.meta.name for p in pods if not p.spec.node_name]
+        assert not unbound, (
+            f"seed {seed}: pods never bound past quiesce: {unbound[:5]}\n"
+            f"  queue: {sched.queue.stats()}\n"
+            f"  fired={reg.fired} pending={reg.pending()}"
+        )
+        # the parity gate tripped to a full recompute (invalidate +
+        # reseed) or the breaker's host fallback placed the batch —
+        # the CORRUPT poison must never be absorbed silently
+        gate_evidence = sum(
+            fwk.tpu._partials.full_recomputes
+            for fwk in sched.profiles
+            if getattr(fwk.tpu, "_partials", None) is not None
+        ) + sum(
+            fwk.tpu.breaker.fallback_count() for fwk in sched.profiles
+        )
+        assert gate_evidence >= 2, (  # >= first sync + the recovery
+            f"seed {seed}: no parity-gate trip after CORRUPT "
+            f"(evidence={gate_evidence}, fired={reg.fired})"
+        )
         assert not audit.violations, f"seed {seed}: {audit.violations[:5]}"
         rebound = {
             k: nodes for k, nodes in audit.bound_nodes.items()

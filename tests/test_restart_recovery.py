@@ -523,9 +523,15 @@ def test_restart_under_mesh_mirror_resync():
         assert sched.schedule_batch(timeout=2)["scheduled"] == 8
         assert sched.flush_binds(30)
         mirror = tpu._mirror
+        partials = tpu._partials
+        assert partials is not None and partials._store is not None
+        p_fulls0 = partials.full_recomputes
         resyncs0 = mirror.resync_total
         sched._reconcile_leadership()
         assert mirror._dev is None  # invalidated: next sync re-uploads
+        # the resident partials invalidate WITH the mirror (warm rows
+        # must never outlive the tensors they were evaluated against)
+        assert partials._store is None and not partials._slots
         for i in range(8):
             store.create(make_pod(f"b{i}").req(cpu_milli=100).obj())
         assert sched.schedule_batch(timeout=2)["scheduled"] == 8
@@ -533,6 +539,44 @@ def test_restart_under_mesh_mirror_resync():
         assert mirror.resync_total == resyncs0 + 1, (
             "reconcile did not force a full mirror re-upload"
         )
+        assert partials.full_recomputes == p_fulls0 + 1, (
+            "reconcile did not force a full partials recompute"
+        )
+        assert all(p.spec.node_name for p in store.list("Pod")[0])
+    finally:
+        sched.stop()
+
+
+def test_reconcile_invalidates_partials_cache():
+    """Warm failover regression (ISSUE 14): _reconcile_leadership drops
+    the resident Filter/Score partials alongside the mirror — a new
+    leader must not inherit warm rows from the predecessor's generation
+    history — and the next solve performs a full recompute yet still
+    places every pod."""
+    store = st.Store()
+    for i in range(4):
+        store.create(
+            make_node(f"n{i}")
+            .capacity(cpu_milli=8000, mem=16 * GI, pods=110)
+            .obj()
+        )
+    sched = _mk_scheduler(store)
+    try:
+        for i in range(6):
+            store.create(make_pod(f"a{i}").req(cpu_milli=100).obj())
+        assert sched.schedule_batch(timeout=2)["scheduled"] == 6
+        assert sched.flush_binds(30)
+        partials = sched.tpu._partials
+        assert partials is not None and partials._store is not None
+        assert partials._slots
+        fulls0 = partials.full_recomputes
+        sched._reconcile_leadership()
+        assert partials._store is None and not partials._slots
+        for i in range(6):
+            store.create(make_pod(f"b{i}").req(cpu_milli=100).obj())
+        assert sched.schedule_batch(timeout=2)["scheduled"] == 6
+        assert sched.flush_binds(30)
+        assert partials.full_recomputes == fulls0 + 1
         assert all(p.spec.node_name for p in store.list("Pod")[0])
     finally:
         sched.stop()
