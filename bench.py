@@ -50,6 +50,18 @@ informer-fed cache.  `extra` carries all five configs:
        zero steady recompiles, and the <=1% dirtied-rows contract;
        reports the partials hit rate and rows re-evaluated (c6/c6s
        report the same accounting for their live loops)
+  c12  50k nodes  AUTOSCALE churn: a kubemark NodeGroupScaler drives
+       ±1% node add/remove per cycle plus deliberate oscillation around
+       the 65536 pad-bucket boundary against the ELASTIC node axis
+       (ISSUE 15) — gates: placements bit-identical to the
+       full-RESHARDED-rebuild oracle, zero resyncs/recompiles under
+       within-bucket churn AND under boundary oscillation (the shrink
+       dwell), crossings absorbed by in-place resident grows with exact
+       pad-row accounting, ≥90% of partials class rows warm across the
+       grow, and the post-dwell drain shrink served; plus a LIVE phase
+       (HPA + CA-shaped scaler reconcile over a hollow fleet) gating
+       zero unbound pods at peak, ≥1 live in-place grow, and
+       watchers_terminated == 0
 
 Every scenario reports step-latency p50/p90/p99 (the windowed sampler:
 attempt-duration percentiles for the loop configs, timed-sample
@@ -1453,6 +1465,408 @@ def config11():
     }
 
 
+# c12 autoscale-churn gates (BENCH_STRICT=1): under steady WITHIN-bucket
+# node churn (±1% nodes/cycle at 50k nodes) the elastic node axis must
+# hold zero full mirror re-uploads and zero steady recompiles;
+# bucket-boundary oscillation under the shrink dwell must add zero
+# resyncs AND zero recompiles (the hysteresis claim); the crossing
+# itself must be absorbed by in-place resident grows whose device-side
+# pad rows account exactly for the bucket deltas (mirror_grow_rows —
+# host→device stays O(changed rows) throughout, gated like c7), at
+# least STRICT_AUTOSCALE_WARM_SLOTS_MIN of the partials class rows must
+# stay warm across the grow, and every cycle's placements must be
+# bit-identical to the full-RESHARDED-rebuild oracle (incremental_grow
+# valves off — every transition re-uploads and reseeds from scratch).
+STRICT_AUTOSCALE_WARM_SLOTS_MIN = 0.9
+
+
+def config12():
+    """c12: autoscaler churn at 50k nodes — the elastic node axis as a
+    first-class workload.
+
+    Frozen-trace phase: a kubemark NodeGroupScaler generates the node
+    add/remove stream (scale-ups, drains, deliberate oscillation around
+    the 65536 pad-bucket boundary) and the SAME stream drives an
+    elastic scheduler (in-place mirror/partials grows) and the
+    full-RESHARDED-rebuild oracle (incremental_grow valves off); every
+    cycle solves a recurring service-shaped batch and placements must
+    match bit-for-bit.  Measured: steady within-bucket churn (zero
+    resyncs, zero recompiles), the boundary crossing (grow events, not
+    re-uploads; partials class rows stay warm), oscillation under the
+    shrink dwell (bucket pinned — zero shape flips), and the post-dwell
+    drain shrink.
+
+    Live phase: the existing HPA scales a Deployment against synthetic
+    PodMetrics while the NodeGroupScaler (store-backed, CA-shaped
+    reconcile policy) adds nodes under pending-pod pressure and drains
+    them when idle — sustained node add/remove against the live
+    scheduler loop, crossing pad buckets in both directions."""
+    from kubernetes_tpu.analysis import retrace
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.kubemark import NodeGroupScaler
+    from kubernetes_tpu.models.batch_scheduler import TPUBatchScheduler
+    from kubernetes_tpu.testing.wrappers import MI, make_pod
+
+    base, n_pods, n_svc = 50_000, 128, 32
+    churn_half = base // 200  # 250 removed + 250 added = ±1% rows/cycle
+    steady_cycles, osc_cycles = 4, 6
+    boundary = 65_536  # pad_dim(50_000) — the oscillation axis
+    over, under = boundary + 1_024, boundary - 1_024
+
+    elastic = TPUBatchScheduler(mode="greedy", use_partials=True)
+    oracle = TPUBatchScheduler(mode="greedy", use_partials=True)
+    # the oracle: every node-axis transition takes the full (RESHARDED)
+    # re-upload / full-reseed safety path — the parity reference
+    oracle._mirror.incremental_grow = False
+    oracle._partials.incremental_grow = False
+    pair = (elastic, oracle)
+
+    trace_scaler = NodeGroupScaler(group="node", zones=64)
+
+    def apply(added, removed):
+        for nd in added:
+            for s in pair:
+                s.add_node(nd)
+        for name in removed:
+            for s in pair:
+                s.remove_node(name)
+
+    apply(*trace_scaler.scale_to(base))
+
+    def mk(r):
+        pods = []
+        for i in range(n_pods):
+            svc = i % n_svc
+            pods.append(
+                make_pod(f"c12-r{r}-{i}")
+                .req(cpu_milli=100 + (svc % 5) * 100, mem=256 * MI)
+                .required_affinity(
+                    api.LABEL_ZONE, api.OP_IN,
+                    [f"zone-{svc % 64}", f"zone-{(svc + 1) % 64}",
+                     f"zone-{(svc + 32) % 64}"],
+                )
+                .preferred_affinity(
+                    10, api.LABEL_ZONE, api.OP_IN, [f"zone-{svc % 64}"]
+                )
+                .obj()
+            )
+        return pods
+
+    parity = True
+
+    def cycle(r):
+        nonlocal parity
+        names_e = elastic.schedule_pending(mk(r))
+        names_o = oracle.schedule_pending(mk(r))
+        parity = parity and names_e == names_o
+        # assume the placements (the next cycle's usage churn — the
+        # previous wave's picks are dirty rows, ISSUE 14's contract)
+        for p, nm in zip(mk(r), names_e):
+            if nm is not None and nm in elastic.state._rows:
+                elastic.assume(p, nm)
+                oracle.assume(p, nm)
+        return names_e
+
+    def churn(r):
+        # ±1% membership churn: drain churn_half newest, add churn_half
+        # fresh (scale down then up through the scaler so the node-name
+        # stream is reproducible)
+        apply(*trace_scaler.scale_to(trace_scaler.size() - churn_half))
+        apply(*trace_scaler.scale_to(trace_scaler.size() + churn_half))
+
+    retrace.clear_steady()
+    # warmup: compile the 65536-bucket executables + the partials
+    # eval/refresh kernels (two cycles — the refresh kernel only runs
+    # once the store exists, the c11 discipline)
+    churn(0)
+    t0 = time.perf_counter()
+    cycle(0)
+    first_step_s = time.perf_counter() - t0
+    churn(1)
+    cycle(1)
+
+    # -- phase S: steady WITHIN-bucket churn --------------------------------
+    e0 = dict(elastic._mirror.stats())
+    retrace.mark_steady()
+    steady0 = retrace.steady_total()
+    walls = []
+    for r in range(2, 2 + steady_cycles):
+        churn(r)
+        t0 = time.perf_counter()
+        cycle(r)
+        walls.append(time.perf_counter() - t0)
+    steady_recompiles = retrace.steady_total() - steady0
+    retrace.clear_steady()
+    eS = dict(elastic._mirror.stats())
+    steady_resyncs = eS["resync_total"] - e0["resync_total"]
+    steady_delta_rows = eS["delta_rows_total"] - e0["delta_rows_total"]
+    # dirtied per steady cycle: removals + adds (static+usage gens each)
+    # + the assumed placements of the previous cycle
+    steady_dirtied = steady_cycles * (2 * 2 * churn_half + n_pods)
+
+    # -- phase X: cross the boundary, then oscillate under the dwell --------
+    slots_before = set(elastic._partials._slots.keys())
+    full0 = elastic._partials.stats()["full_recomputes"]
+    apply(*trace_scaler.scale_to(over))  # the crossing (one sync)
+    cycle(100)
+    grow_after_cross = dict(elastic._mirror.stats())
+    for k in range(osc_cycles):
+        apply(*trace_scaler.scale_to(under if k % 2 == 0 else over))
+        cycle(101 + k)
+    eX = dict(elastic._mirror.stats())
+    osc_resyncs = eX["resync_total"] - grow_after_cross["resync_total"]
+    # the dwell must pin the bucket across the oscillation: the crossing
+    # is the ONLY shape change (grow_syncs moves once, then holds)
+    osc_grows = eX["grow_syncs"] - grow_after_cross["grow_syncs"]
+    slots_after = set(elastic._partials._slots.keys())
+    warm_slots_frac = (
+        len(slots_before & slots_after) / max(len(slots_before), 1)
+    )
+    partials_reseeds_x = (
+        elastic._partials.stats()["full_recomputes"] - full0
+    )
+
+    # -- phase D: drain home; the shrink fires only after the dwell ---------
+    apply(*trace_scaler.scale_to(base))
+    pre_shrink_bucket = elastic.state.node_axis_bucket
+    for k in range(elastic.state.bucket_shrink_dwell + 1):
+        churn(200 + k)
+        cycle(200 + k)
+    post_shrink_bucket = elastic.state.node_axis_bucket
+    eD = dict(elastic._mirror.stats())
+    pD = dict(elastic._partials.stats())
+
+    from kubernetes_tpu.kubemark import percentiles
+
+    pct = percentiles(list(walls))
+    live = _c12_live_phase()
+    return {
+        "nodes": base, "pods": n_pods, "pod_classes": n_svc,
+        "churn_frac_per_cycle": round(2 * churn_half / base, 4),
+        "latency_s": round(min(walls), 4),
+        "pods_per_s": round(n_pods / min(walls), 1),
+        "latency_p50_s": round(pct["p50"], 4),
+        "latency_p90_s": round(pct["p90"], 4),
+        "latency_p99_s": round(pct["p99"], 4),
+        "commit_share_per_step": 0.0,
+        "first_step_s": round(first_step_s, 4),
+        "steady_recompiles": steady_recompiles,
+        # the elastic-axis gates
+        "oracle_parity": parity,
+        "steady_resyncs": steady_resyncs,
+        "steady_delta_rows": steady_delta_rows,
+        "steady_dirtied_rows": steady_dirtied,
+        "steady_delta_bounded": steady_delta_rows <= steady_dirtied,
+        "grow_syncs": eD["grow_syncs"],
+        "mirror_grow_rows": eD["grow_rows_total"],
+        # every grow's device-side pad rows must account exactly for the
+        # bucket deltas (one 65536->131072 crossing; the drain shrink
+        # adds no rows) — anything more means a hidden re-upload
+        "grow_rows_expected": 131_072 - 65_536,
+        "grow_bounded": eD["grow_rows_total"] == 131_072 - 65_536,
+        "osc_resyncs": osc_resyncs,
+        "osc_grows": osc_grows,
+        "warm_slots_frac": round(warm_slots_frac, 4),
+        "partials_reseeds_in_osc": partials_reseeds_x,
+        "partials_grows": pD["grows"],
+        "pre_shrink_bucket": pre_shrink_bucket,
+        "post_shrink_bucket": post_shrink_bucket,
+        "shrink_served": post_shrink_bucket == boundary,
+        "mirror_resync_total": eD["resync_total"],
+        "compactions_total": elastic.state.compactions_total,
+        "compaction_moved_rows": elastic.state.compaction_moved_rows_total,
+        "scaler_nodes_added": trace_scaler.nodes_added,
+        "scaler_nodes_removed": trace_scaler.nodes_removed,
+        # top-level so the generic terminated gate sees the live phase
+        "watchers_terminated": live["watchers_terminated"],
+        **{f"live_{k}": v for k, v in live.items()},
+    }
+
+
+def _c12_live_phase():
+    """The autoscaler-in-the-loop half of c12: a live Scheduler over a
+    journal-less store while the existing HorizontalPodAutoscaler
+    scales a Deployment (synthetic PodMetrics drive utilization) and a
+    store-backed NodeGroupScaler reacts to pending-pod pressure / idle
+    capacity — sustained node add/remove, pad buckets crossed in both
+    directions, zero destructive watcher terminations."""
+    import threading
+
+    from kubernetes_tpu import kubemark
+    from kubernetes_tpu.api import store as st
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.controllers import ControllerManager
+    from kubernetes_tpu.controllers.deployment import DeploymentController
+    from kubernetes_tpu.controllers.podautoscaler import (
+        HorizontalPodAutoscalerController,
+    )
+    from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing.wrappers import MI
+
+    store = st.Store()
+    # the permanent fleet sits just UNDER the 512 pad bucket, so the
+    # autoscaler's scale-up crosses a large boundary where the dirty
+    # fraction is small enough for the in-place grow path (tiny fleets
+    # cross small buckets in over-fraction bulk, which correctly takes
+    # the full-upload safety path instead).  Hollow kubelets run the
+    # status half (-> Running) for every hollow-* node; the scaler's
+    # group shares the prefix so scaled-up nodes' pods run too.
+    # base nodes are deliberately too small for the web pods (100m vs
+    # 2000m requests): every replica PENDS until the scaler provisions
+    # group capacity — the pressure signal the CA policy keys on
+    hollow = kubemark.HollowCluster(
+        store, 504, cpu_milli=100, heartbeat_interval=10.0
+    ).start()
+    scaler = kubemark.NodeGroupScaler(
+        store, group="hollow-asg", cpu_milli=32000, mem=64 * kubemark.GI,
+        max_nodes=64,
+    )
+    pods_per_node = 16  # 32000m / 2000m requests
+
+    def hpa_factory(*args, **kw):
+        return HorizontalPodAutoscalerController(
+            *args, downscale_stabilization_s=0.2, **kw
+        )
+
+    hpa_factory.KIND = "HorizontalPodAutoscaler"
+    mgr = ControllerManager(
+        store,
+        controllers=[DeploymentController, ReplicaSetController, hpa_factory],
+    ).start()
+    sched = Scheduler(store, batch_size=256)
+    sched.start()
+    stop = threading.Event()
+
+    def autoscale_loop():
+        # the CA-shaped reconcile: pending pods scale the group up,
+        # idle group capacity drains it one step at a time
+        while not stop.wait(0.05):
+            pods, _ = store.list("Pod")
+            pending = sum(1 for p in pods if not p.spec.node_name)
+            used = {p.spec.node_name for p in pods if p.spec.node_name}
+            idle = sum(
+                1 for i in range(scaler.size())
+                if f"{scaler.group}-{i}" not in used
+            )
+            try:
+                scaler.reconcile(
+                    pending, pods_per_node, idle_nodes=idle,
+                    step=2, idle_headroom=1, up_step_cap=4,
+                )
+            except Exception:  # noqa: BLE001 — reconcile is best-effort
+                pass
+
+    ca = threading.Thread(target=autoscale_loop, daemon=True)
+    ca.start()
+
+    labels = {"app": "web"}
+    deployment = api.Deployment(
+        meta=api.ObjectMeta(name="web"),
+        spec=api.DeploymentSpec(
+            replicas=8,
+            selector=api.LabelSelector(match_labels=labels),
+            template=api.PodTemplateSpec(
+                meta=api.ObjectMeta(labels=labels),
+                spec=api.PodSpec(
+                    containers=[
+                        api.Container(
+                            requests={api.CPU: 2000, api.MEMORY: 64 * MI}
+                        )
+                    ]
+                ),
+            ),
+        ),
+    )
+    peak_target, idle_target = 192, 8
+    unbound_at_peak = 0
+    grow_syncs = 0
+    replicas = 0
+    peak_nodes = 0
+    try:
+        store.create(deployment)
+        store.create(
+            api.HorizontalPodAutoscaler(
+                meta=api.ObjectMeta(name="web-hpa"),
+                spec=api.HorizontalPodAutoscalerSpec(
+                    scale_target_ref=api.ScaleTargetRef("Deployment", "web"),
+                    min_replicas=idle_target,
+                    max_replicas=peak_target,
+                    target_cpu_utilization_percentage=50,
+                ),
+            )
+        )
+
+        def feed_metrics(cpu):
+            for p in store.list("Pod")[0]:
+                m = api.PodMetrics(
+                    meta=api.ObjectMeta(
+                        name=p.meta.name, namespace=p.meta.namespace
+                    ),
+                    usage={api.CPU: cpu},
+                    timestamp=time.time(),
+                )
+                try:
+                    store.create(m)
+                except st.AlreadyExists:
+                    cur = store.get("PodMetrics", p.meta.name, p.meta.namespace)
+                    cur.usage = {api.CPU: cpu}
+                    store.update(cur, force=True)
+
+        # scale-up half: hot metrics drive the HPA toward max_replicas,
+        # pending pods drive the scaler up with it
+        deadline = time.monotonic() + 120
+        replicas = 8
+        while time.monotonic() < deadline:
+            feed_metrics(2000)  # 100% utilization vs the 50% target
+            pods, _ = store.list("Pod")
+            replicas = sum(1 for p in pods if p.meta.name.startswith("web-"))
+            bound = sum(
+                1
+                for p in pods
+                if p.meta.name.startswith("web-") and p.spec.node_name
+            )
+            if replicas >= peak_target and bound >= replicas:
+                break
+            time.sleep(0.1)
+        pods, _ = store.list("Pod")
+        unbound_at_peak = sum(
+            1
+            for p in pods
+            if p.meta.name.startswith("web-") and not p.spec.node_name
+        )
+        peak_nodes = scaler.size()
+        # scale-down half: idle metrics shrink the deployment, the
+        # ReplicaSet deletes pods, idle capacity drains the node group
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            feed_metrics(100)  # 5% utilization
+            pods, _ = store.list("Pod")
+            n_web = sum(1 for p in pods if p.meta.name.startswith("web-"))
+            if n_web <= idle_target * 2 and scaler.size() < peak_nodes:
+                break
+            time.sleep(0.1)
+        grow_syncs = sched.tpu._mirror.grow_syncs
+    finally:
+        stop.set()
+        ca.join(timeout=5)
+        sched.stop()
+        mgr.stop()
+        hollow.stop()
+    return {
+        "replicas_peak": replicas,
+        "unbound_at_peak": unbound_at_peak,
+        "nodes_peak": peak_nodes,
+        "nodes_final": scaler.size(),
+        "scaler_nodes_added": scaler.nodes_added,
+        "scaler_nodes_removed": scaler.nodes_removed,
+        "mirror_grow_syncs": grow_syncs,
+        "mirror_resync_total": sched.tpu._mirror.resync_total,
+        "node_axis_bucket": sched.tpu.state.node_axis_bucket,
+        "watchers_terminated": store.watchers_terminated,
+    }
+
+
 def main() -> None:
     import sys
 
@@ -1483,6 +1897,7 @@ def main() -> None:
             "c9_preempt_churn": config9(),
             "c10_slice_pack": config10(),
             "c11_incremental_churn": config11(),
+            "c12_autoscale_churn": config12(),
         }
     # every over-threshold schedule_batch cycle, with its per-step share
     # (commit- and solve-share per step are readable straight off the
@@ -1702,6 +2117,73 @@ def main() -> None:
             failures.append(
                 f"c10 fragmentation above ceiling: "
                 f"{c10['frag_score_final']} > {STRICT_SLICE_FRAG_MAX}"
+            )
+        # elastic-node-axis gates: within-bucket autoscaler churn must
+        # never force a full mirror re-upload, boundary oscillation
+        # under the shrink dwell must not flip shapes, bucket crossings
+        # must be absorbed by in-place grows with exact pad-row
+        # accounting (transfer stays O(changed rows)), the partials
+        # class rows must stay warm across the grow, and the elastic
+        # placements must match the full-RESHARDED-rebuild oracle
+        # bit-for-bit (steady_recompiles rides the generic gate)
+        c12 = extra["c12_autoscale_churn"]
+        if not c12["oracle_parity"]:
+            failures.append(
+                "c12 elastic placements diverged from the full-rebuild "
+                "oracle"
+            )
+        if c12["steady_resyncs"]:
+            failures.append(
+                f"c12 within-bucket churn forced {c12['steady_resyncs']} "
+                "full mirror re-upload(s)"
+            )
+        if not c12["steady_delta_bounded"]:
+            failures.append(
+                "c12 steady host→device transfer not O(changed rows): "
+                f"{c12['steady_delta_rows']} delta rows for "
+                f"{c12['steady_dirtied_rows']} dirtied"
+            )
+        if c12["osc_resyncs"] or c12["osc_grows"]:
+            failures.append(
+                "c12 bucket-boundary oscillation escaped the shrink "
+                f"dwell: {c12['osc_resyncs']} resyncs / "
+                f"{c12['osc_grows']} shape changes during oscillation"
+            )
+        if not c12["grow_bounded"]:
+            failures.append(
+                "c12 bucket crossing not absorbed in place: "
+                f"{c12['mirror_grow_rows']} grow rows != "
+                f"{c12['grow_rows_expected']} expected "
+                f"({c12['mirror_resync_total']} resyncs total)"
+            )
+        if c12["warm_slots_frac"] < STRICT_AUTOSCALE_WARM_SLOTS_MIN:
+            failures.append(
+                f"c12 partials class rows went cold across the grow: "
+                f"{c12['warm_slots_frac']} warm < "
+                f"{STRICT_AUTOSCALE_WARM_SLOTS_MIN}"
+            )
+        if c12["partials_reseeds_in_osc"] or not c12["partials_grows"]:
+            failures.append(
+                "c12 partials did not stay warm through the crossing: "
+                f"{c12['partials_reseeds_in_osc']} reseed(s) during "
+                f"oscillation, {c12['partials_grows']} in-place grow(s) "
+                "— node churn must not flush the cache (the per-key "
+                "expansion watermark)"
+            )
+        if not c12["shrink_served"]:
+            failures.append(
+                "c12 post-dwell drain never shrank the bucket "
+                f"(still {c12['post_shrink_bucket']})"
+            )
+        if c12["live_unbound_at_peak"]:
+            failures.append(
+                f"c12 live autoscale left {c12['live_unbound_at_peak']} "
+                "pod(s) unbound at peak"
+            )
+        if not c12["live_mirror_grow_syncs"]:
+            failures.append(
+                "c12 live autoscale crossing never took the in-place "
+                "grow path (0 grow syncs)"
             )
         if failures:
             print("BENCH_STRICT: " + "; ".join(failures), file=sys.stderr)

@@ -951,6 +951,230 @@ def _check_partials_kernels(byclass, findings: List[Finding]) -> None:
             )
 
 
+def _check_axis_transitions(byclass, findings: List[Finding]) -> None:
+    """Elastic node axis (ISSUE 15): drive a REAL ClusterState through
+    growth and shrink across pad buckets and prove the compile-key
+    story end to end:
+
+      * every exposed bucket is a pad bucket and growth is eager
+        (monotone while adding);
+      * WITHIN-bucket growth — more rows in the same bucket, or a
+        backing-array realloc — provably reuses the existing keys (the
+        exposed shapes are identical) and never bumps the struct
+        generation;
+      * each bucket CROSSING yields exactly one new compile key per
+        kernel family — greedy cold, greedy WARM (partials statics) and
+        the SHARDED twin included — i.e. the abstract-signature set
+        equals the observed-bucket set for every family;
+      * the lattice is closed under node-axis growth AND shrink: the
+        post-dwell shrink lands exactly on a previously observed
+        bucket, so the shrink re-uses an existing key instead of
+        minting one (and the dwell pins the bucket until it is
+        served)."""
+    import jax
+    import numpy as np
+
+    from ..api import types as api
+    from ..ops import assign, partials as pops, schema
+    from ..parallel import sharded
+    from ..utils import vocab as vbu
+    from . import retrace
+
+    file = "kubernetes_tpu/ops/schema.py"
+    limits = schema.SnapshotLimits()
+    state = schema.ClusterState(schema.SnapshotBuilder(limits))
+    dwell = 3
+    state.configure_elastic_axis(shrink_dwell=dwell)
+    start = vbu.pad_dim(0, limits.min_nodes)
+
+    def mk_node(i):
+        node = api.Node(meta=api.ObjectMeta(name=f"ax-{i}", namespace=""))
+        node.meta.labels[api.LABEL_HOSTNAME] = f"ax-{i}"
+        node.status.allocatable = {
+            api.CPU: 1000, api.MEMORY: 1 << 20, api.PODS: 16,
+        }
+        node.status.capacity = dict(node.status.allocatable)
+        return node
+
+    # -- growth walk: eager, pad-bucketed, shape-stable within a bucket --
+    struct0 = state.struct_generation
+    buckets: List[int] = []
+    prev_shapes = None
+    total = 4 * start + 1  # two crossings past the floor bucket
+    for i in range(total):
+        state.add_node(mk_node(i))
+        t = state.tensors()
+        n = int(t.allocatable.shape[0])
+        shapes = tuple(np.shape(leaf) for leaf in t)
+        if not vbu.is_pad_bucket(n, 1):
+            findings.append(
+                Finding(
+                    CHECK, file, 1, "ClusterState.tensors",
+                    f"exposed node axis {n} at {i + 1} nodes is not a "
+                    "pad bucket",
+                )
+            )
+            return
+        if buckets and n < buckets[-1]:
+            findings.append(
+                Finding(
+                    CHECK, file, 1, "ClusterState.tensors",
+                    f"bucket shrank {buckets[-1]} -> {n} while ADDING "
+                    "nodes (growth must be eager)",
+                )
+            )
+        if buckets and n == buckets[-1] and shapes != prev_shapes:
+            findings.append(
+                Finding(
+                    CHECK, file, 1, "ClusterState.tensors",
+                    f"within-bucket add at {i + 1} nodes changed the "
+                    "exposed shapes — the existing compile keys must be "
+                    "reused",
+                )
+            )
+        if not buckets or n != buckets[-1]:
+            buckets.append(n)
+        prev_shapes = shapes
+    if state.struct_generation != struct0:
+        findings.append(
+            Finding(
+                CHECK, file, 1, "ClusterState._grow",
+                "node-axis growth bumped the struct generation — "
+                "row-preserving reallocs must not force full resyncs",
+            )
+        )
+    if len(buckets) < 3:
+        findings.append(
+            Finding(
+                CHECK, file, 1, "ClusterState.tensors",
+                f"growth walk observed buckets {buckets}; expected at "
+                "least two crossings",
+            )
+        )
+        return
+
+    # -- within-bucket backing realloc: shapes and struct gen both hold --
+    shapes0 = tuple(np.shape(leaf) for leaf in state.tensors())
+    g0 = state.struct_generation
+    state._grow(state._cap * 2)
+    if state.struct_generation != g0:
+        findings.append(
+            Finding(
+                CHECK, file, 1, "ClusterState._grow",
+                "explicit backing-array grow bumped the struct "
+                "generation",
+            )
+        )
+    if tuple(np.shape(leaf) for leaf in state.tensors()) != shapes0:
+        findings.append(
+            Finding(
+                CHECK, file, 1, "ClusterState._grow",
+                "backing-array grow changed the exposed shapes without "
+                "a bucket crossing",
+            )
+        )
+
+    # -- one compile key per kernel family per observed bucket -----------
+    p = 8
+    ff_off = assign.FeatureFlags()
+    spec_fields = byclass.get("ClassStatics", {})
+    ndev = len(jax.devices())
+    size = 1
+    while size * 2 <= min(ndev, 8):
+        size *= 2
+    mesh = sharded.make_mesh(size)
+    mesh_sig = sharded.mesh_signature(mesh)
+    sigs = {"greedy": set(), "greedy-warm": set(), "greedy-sharded": set()}
+    for n in buckets:
+        snap = abstract_snapshot(byclass, limits, n=n, p=p)
+        sigs["greedy"].add(retrace.signature(snap, (1, ff_off, 0)))
+        if spec_fields:
+            statics = pops.ClassStatics(
+                **{
+                    f: jax.ShapeDtypeStruct(
+                        spec_fields[f].shape({"C": 2, "N": n}),
+                        np.dtype(spec_fields[f].dtype),
+                    )
+                    for f in pops.ClassStatics._fields
+                }
+            )
+            sigs["greedy-warm"].add(
+                retrace.signature((snap, statics), (1, ff_off, 0))
+            )
+        sigs["greedy-sharded"].add(
+            retrace.signature(snap, (1, ff_off, 0, mesh_sig))
+        )
+        try:
+            res = jax.eval_shape(
+                lambda s: assign.greedy_assign(
+                    s, topo_z=1, features=ff_off, n_groups=0
+                ),
+                snap,
+            )
+            _result_contract_check(
+                res, "SolveResult", byclass,
+                _class_env("ClusterTensors", limits, n, p, {}),
+                f"greedy-axis[{n}x{p}]", findings,
+                "kubernetes_tpu/ops/assign.py",
+            )
+        except Exception as e:  # noqa: BLE001 — abstract eval failed
+            findings.append(
+                Finding(
+                    CHECK, file, 1, "greedy_assign",
+                    f"eval_shape failed at grown bucket {n}: {e}",
+                )
+            )
+    for fam, got in sigs.items():
+        if fam == "greedy-warm" and not spec_fields:
+            continue
+        if len(got) != len(buckets):
+            findings.append(
+                Finding(
+                    CHECK, file, 1, fam,
+                    f"{len(buckets)} observed buckets produced "
+                    f"{len(got)} {fam} compile keys — a bucket crossing "
+                    "must mint exactly one new key per kernel family",
+                )
+            )
+
+    # -- shrink: dwell pins the bucket, then lands on a KNOWN bucket -----
+    peak = buckets[-1]
+    for i in range(total - start):
+        state.remove_node(f"ax-{i}")
+    for k in range(dwell + 1):
+        # one generation per tick (the dwell counts generations, not
+        # tensors() calls)
+        state.add_node(mk_node(10_000 + k))
+        state.remove_node(f"ax-{10_000 + k}")
+        t = state.tensors()
+        n = int(t.allocatable.shape[0])
+        if k < dwell - 1 and n != peak:
+            findings.append(
+                Finding(
+                    CHECK, file, 1, "ClusterState.tensors",
+                    f"bucket moved to {n} after only {k + 1} "
+                    f"below-bucket generation(s); the dwell is {dwell}",
+                )
+            )
+    final = int(state.tensors().allocatable.shape[0])
+    if final == peak:
+        findings.append(
+            Finding(
+                CHECK, file, 1, "ClusterState.tensors",
+                f"post-dwell shrink never served: bucket still {peak}",
+            )
+        )
+    elif final not in buckets:
+        findings.append(
+            Finding(
+                CHECK, file, 1, "ClusterState.tensors",
+                f"shrink landed on {final}, never observed during "
+                f"growth ({buckets}) — shrink must REUSE an existing "
+                "compile key (lattice closure)",
+            )
+        )
+
+
 def _check_gang_retry_closure(findings: List[Finding]) -> None:
     """The gang-admission binary search re-solves SUBSETS of the batch
     with num_pods_hint pinned to the full batch size: every subset must
@@ -1269,6 +1493,7 @@ def check(root: str, package: str = "kubernetes_tpu") -> List[Finding]:
     _check_mesh_kernels(byclass, findings)
     _check_slice_kernels(byclass, findings)
     _check_partials_kernels(byclass, findings)
+    _check_axis_transitions(byclass, findings)
     _check_gang_retry_closure(findings)
     findings.sort(key=lambda f: (f.file, f.line, f.message))
     return findings

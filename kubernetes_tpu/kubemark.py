@@ -16,6 +16,12 @@ Two layers:
                  instead of O(batch) single-object writes, and the tick
                  is jittered so a 100k-node fleet doesn't monopolize the
                  Node shard in phase-locked bursts.
+  NodeGroupScaler  the autoscaler-in-the-loop half (bench
+                 ``c12_autoscale_churn``): a named node group scaled
+                 up/down through the API (or replayed as a frozen
+                 trace), with a cluster-autoscaler-shaped reconcile
+                 policy — the sustained node add/remove stream the
+                 elastic node axis exists to absorb.
   FleetHarness   the first-class fleet driver (bench ``c8_store_100k``):
                  registers up to 100k hollow nodes, runs a SUSTAINED
                  pod-lifecycle soak (create → bind via per-shard
@@ -198,6 +204,128 @@ class HollowCluster:
                     self.store.update(fresh, force=True)
             except st.NotFound:
                 pass
+
+
+class NodeGroupScaler:
+    """Autoscaler-in-the-loop node-group driver — the cluster-autoscaler
+    half kubemark didn't model.  Owns a named group of hollow nodes and
+    scales it toward a target: `scale_to` creates the missing members
+    (highest index first to appear, lowest removed last) and deletes the
+    surplus, returning the (added nodes, removed names) so a
+    frozen-trace harness can replay the exact churn against a solver
+    pair; with a Store attached the membership changes also commit
+    through the API (create/delete → informers → scheduler cache), the
+    live-loop shape bench c12 drives.
+
+    `reconcile` is the bundled scale policy (the CA loop's core):
+    scale UP by ceil(pending / pods_per_node) when pods are pending,
+    scale DOWN one `step` at a time once idle capacity exceeds a full
+    step plus `idle_headroom` nodes — asymmetric on purpose, like the
+    reference autoscaler's eager-up / conservative-down posture (the
+    ClusterState's bucket-shrink dwell provides the second layer of
+    hysteresis underneath)."""
+
+    def __init__(
+        self,
+        store: Optional[st.Store] = None,
+        group: str = "autoscale",
+        cpu_milli: int = 32000,
+        mem: int = 64 * GI,
+        pods_cap: int = 110,
+        zones: int = 8,
+        max_nodes: int = 1 << 20,
+        taints: Optional[List[tuple]] = None,
+    ):
+        self.store = store
+        self.group = group
+        self.cpu_milli = cpu_milli
+        self.mem = mem
+        self.pods_cap = pods_cap
+        self.zones = zones
+        self.max_nodes = max_nodes
+        self.taints = list(taints or [])
+        self._size = 0
+        self._next_id = 0
+        self._members: List[str] = []  # creation order; drain from the tail
+        # observability (bench c12 reports them)
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.nodes_added = 0
+        self.nodes_removed = 0
+
+    def size(self) -> int:
+        return self._size
+
+    def _make_node(self, i: int):
+        w = (
+            make_node(f"{self.group}-{i}")
+            .capacity(
+                cpu_milli=self.cpu_milli, mem=self.mem, pods=self.pods_cap
+            )
+            .zone(f"zone-{i % self.zones}")
+        )
+        for key, value, effect in self.taints:
+            w = w.taint(key, value, effect)
+        return w.obj()
+
+    def scale_to(self, target: int):
+        """Drive the group to `target` members.  Returns
+        (added_node_objects, removed_node_names); store-backed groups
+        also commit the changes through the API."""
+        target = max(0, min(int(target), self.max_nodes))
+        added, removed = [], []
+        while self._size < target:
+            node = self._make_node(self._next_id)
+            self._next_id += 1
+            if self.store is not None:
+                try:
+                    self.store.create(node)
+                except st.AlreadyExists:
+                    pass
+            self._members.append(node.meta.name)
+            added.append(node)
+            self._size += 1
+        while self._size > target:
+            name = self._members.pop()  # newest first: oldest nodes pin
+            if self.store is not None:
+                try:
+                    self.store.delete("Node", name)
+                except st.NotFound:
+                    pass
+            removed.append(name)
+            self._size -= 1
+        if added:
+            self.scale_ups += 1
+            self.nodes_added += len(added)
+        if removed:
+            self.scale_downs += 1
+            self.nodes_removed += len(removed)
+        return added, removed
+
+    def reconcile(
+        self,
+        pending: int,
+        pods_per_node: int,
+        idle_nodes: int = 0,
+        step: int = 1,
+        idle_headroom: int = 0,
+        up_step_cap: int = 0,
+    ):
+        """One autoscaler pass: returns scale_to()'s (added, removed)
+        for the policy's chosen target (no-op → ([], [])).
+        `up_step_cap` (0 = unbounded) bounds one pass's scale-up so a
+        tight reconcile loop ramps instead of bursting — bursts dirty
+        more rows than the mirror's delta/grow path can absorb and
+        force full re-uploads (the over-fraction safety path)."""
+        per = max(1, int(pods_per_node))
+        if pending > 0:
+            up = (pending + per - 1) // per
+            if up_step_cap > 0:
+                up = min(up, up_step_cap)
+            return self.scale_to(min(self._size + up, self.max_nodes))
+        if idle_nodes > max(0, idle_headroom) + max(1, step):
+            return self.scale_to(max(0, self._size - max(1, step)))
+        return [], []
 
 
 class _LifecycleAudit:

@@ -1362,13 +1362,17 @@ class TPUBatchScheduler:
                 "device solve readback failed; retrying once"
             )
             try:
-                # resident partials are a fault suspect (a poisoned
-                # store surfaces exactly here, as SolveUnhealthy NaN
-                # scores): drop them so the retry's encode performs a
-                # full recompute — the parity gate's recovery wire
-                if self._partials is not None:
-                    with lock if lock is not None else contextlib.nullcontext():
+                # resident partials AND the resident mirror are fault
+                # suspects (a poisoned store/grow surfaces exactly here,
+                # as SolveUnhealthy NaN scores): drop both so the
+                # retry's encode performs a full recompute / full
+                # (RESHARDED) re-upload — the parity gate's recovery
+                # wire (solve.partials and mirror.grow CORRUPT grades)
+                with lock if lock is not None else contextlib.nullcontext():
+                    if self._partials is not None:
                         self._partials.invalidate()
+                    if self.use_mirror:
+                        self._mirror.invalidate()
                 snap, meta = self.encode_pending(
                     pending, lock=lock, reservations=reservations
                 )

@@ -13,10 +13,17 @@ This mirror keeps the last-uploaded cluster tensors resident on device
 and applies ClusterState's generation-tracked row deltas with jitted
 scatter-sets — the device-side completion of the reference's
 incremental UpdateSnapshot design (internal/cache/cache.go:185-260:
-walk nodes by generation, stop at the first unchanged one).  Full
-re-upload happens only when the backing arrays were reallocated
-(growth past the padded bucket, resource-axis widening — ClusterState
-.struct_generation) or the padded shape changed.
+walk nodes by generation, stop at the first unchanged one).  The node
+axis is ELASTIC: a pad-bucket crossing (autoscaler growth or a
+post-dwell shrink) resizes the resident arrays IN PLACE — a device-side
+pad/concat (or slice) carries every old row over and the new rows'
+content rides the ordinary delta scatter, so a bucket crossing costs
+O(new rows) host→device, not a full re-upload.  Full re-upload happens
+only for genuine identity changes (resource-axis widening —
+ClusterState.struct_generation — or invalidate()), for over-fraction
+deltas, and as the safety path whenever the incremental resize
+declines (sharded↔replicated layout flips, the incremental_grow valve,
+injected mirror.grow faults).
 
 Under a device mesh (mesh not None) the resident tensors carry a
 NamedSharding over the node axis — the same layout the sharded solvers'
@@ -40,12 +47,16 @@ bench's c7 gates on steady-state transfer being O(changed rows).
 
 from __future__ import annotations
 
+import logging
+from functools import partial
 from typing import Optional, Tuple
 
 import jax
 import numpy as np
 
+from ..analysis import retrace
 from ..ops import schema
+from ..testing import faults
 from ..utils import vocab as vb
 
 # Leaves of ClusterTensors grouped by which mutation family dirties
@@ -57,6 +68,15 @@ _STATIC_LEAVES = (
 )
 _USAGE_LEAVES = ("requested", "nonzero_requested", "port_bits")
 
+# Pad-row fill per leaf for the incremental resident grow: MUST match
+# ClusterState._alloc's defaults — rows beyond the watermark the host
+# never wrote read these values, and the grow carries them on device
+# without any host transfer (leaves absent here fill with 0).
+_GROW_FILLS = {
+    "name_id": -1, "topo_ids": -1, "slice_id": -1, "torus_coords": -1,
+    "slice_pos": -1,
+}
+
 
 @jax.jit
 def _set_rows(arr, idx, vals):
@@ -66,6 +86,37 @@ def _set_rows(arr, idx, vals):
 @jax.jit
 def _set_rows_ax1(arr, idx, vals):
     return arr.at[:, idx].set(vals)
+
+
+# Elastic node-axis kernels: grow pads default-valued rows onto the
+# resident arrays ON DEVICE (one concat per leaf, zero host transfer —
+# the O(new rows) content follows through the ordinary delta scatter),
+# shrink slices them.  dn / n / fill are static: one executable per
+# (leaf shape, transition), reused across repeat crossings.
+@partial(jax.jit, static_argnums=(1, 2))
+def _grow_rows(arr, dn, fill):
+    import jax.numpy as jnp
+
+    pad = jnp.full((dn,) + arr.shape[1:], fill, arr.dtype)
+    return jnp.concatenate([arr, pad], axis=0)
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _grow_rows_ax1(arr, dn, fill):
+    import jax.numpy as jnp
+
+    pad = jnp.full(arr.shape[:1] + (dn,) + arr.shape[2:], fill, arr.dtype)
+    return jnp.concatenate([arr, pad], axis=1)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _shrink_rows(arr, n):
+    return arr[:n]
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _shrink_rows_ax1(arr, n):
+    return arr[:, :n]
 
 
 def _pad_idx(idx: np.ndarray, bucket: int) -> np.ndarray:
@@ -100,6 +151,17 @@ class DeviceClusterMirror:
         self.resync_total = 0      # full uploads (first sync included)
         self.delta_rows_total = 0  # real dirty rows scattered
         self.delta_syncs = 0       # syncs served by the delta path
+        # elastic node axis (docs/scheduler_loop.md): pad-bucket
+        # crossings absorbed IN PLACE — a device-side pad/concat (grow)
+        # or slice (shrink) carries the old resident rows over, and the
+        # new rows' content rides the ordinary delta scatter.  Mirrored
+        # into scheduler_mirror_grow_total / scheduler_mirror_grow_rows.
+        self.grow_syncs = 0        # in-place resident grows/shrinks
+        self.grow_rows_total = 0   # axis rows added without a re-upload
+        # safety valve: False restores the pre-elastic behavior — every
+        # shape change performs the full (RESHARDED under a mesh)
+        # re-upload; the parity oracle tests and bench c12 drive it
+        self.incremental_grow = True
         # whether the resident copy is node-axis sharded (False when no
         # mesh, or when the padded bucket doesn't split across it — the
         # same batches TPUBatchScheduler solves single-chip)
@@ -108,6 +170,10 @@ class DeviceClusterMirror:
             self._shardings = None
             self._set = _set_rows
             self._set_ax1 = _set_rows_ax1
+            self._grow = _grow_rows
+            self._grow_ax1 = _grow_rows_ax1
+            self._shrink = _shrink_rows
+            self._shrink_ax1 = _shrink_rows_ax1
             self._put_small = jax.device_put
         else:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -138,6 +204,33 @@ class DeviceClusterMirror:
             self._set_ax1 = jax.jit(
                 lambda a, i, v: a.at[:, i].set(v), out_shardings=ax1_sh
             )
+            # sharded twins of the elastic-axis kernels: the grown /
+            # shrunk resident keeps the NamedSharding node-axis layout
+            # (out_shardings pin it — GSPMD re-pads each shard in place,
+            # no host round-trip, and the executable key never drifts)
+            import jax.numpy as jnp
+
+            self._grow = jax.jit(
+                lambda a, dn, fill: jnp.concatenate(
+                    [a, jnp.full((dn,) + a.shape[1:], fill, a.dtype)], axis=0
+                ),
+                static_argnums=(1, 2), out_shardings=row_sh,
+            )
+            self._grow_ax1 = jax.jit(
+                lambda a, dn, fill: jnp.concatenate(
+                    [a, jnp.full(a.shape[:1] + (dn,) + a.shape[2:], fill,
+                                 a.dtype)],
+                    axis=1,
+                ),
+                static_argnums=(1, 2), out_shardings=ax1_sh,
+            )
+            self._shrink = jax.jit(
+                lambda a, n: a[:n], static_argnums=(1,), out_shardings=row_sh
+            )
+            self._shrink_ax1 = jax.jit(
+                lambda a, n: a[:, :n], static_argnums=(1,),
+                out_shardings=ax1_sh,
+            )
             # index/value uploads replicate over the mesh: they are a
             # few KB, and replication keeps every jit operand on the
             # same device set (mixing single-device-committed arrays
@@ -155,9 +248,13 @@ class DeviceClusterMirror:
         stale_struct = (
             self._dev is None
             or self._struct_gen < state.struct_generation
-            or self._shape != shape
         )
-        if not stale_struct and self._synced_gen == state.generation:
+        shape_moved = not stale_struct and self._shape != shape
+        if (
+            not stale_struct
+            and not shape_moved
+            and self._synced_gen == state.generation
+        ):
             return self._dev
         if stale_struct:
             dev = self._full_upload(host)
@@ -168,6 +265,18 @@ class DeviceClusterMirror:
                 > self.FULL_SYNC_FRACTION * n
             ):
                 dev = self._full_upload(host)
+            elif shape_moved:
+                # elastic node axis: the padded bucket moved while row
+                # identity held (growth is no longer a struct event) —
+                # resize the resident arrays in place and let the delta
+                # scatter carry the changed rows' content: O(new rows)
+                # host→device, not a full re-upload
+                resized = self._resize_resident(shape)
+                if resized is None:
+                    dev = self._full_upload(host)  # the safety path
+                else:
+                    self._dev = resized
+                    dev = self._apply_deltas(host, static_idx, usage_idx)
             else:
                 dev = self._apply_deltas(host, static_idx, usage_idx)
         self._dev = dev
@@ -176,11 +285,90 @@ class DeviceClusterMirror:
         self._shape = shape
         return dev
 
+    def _resize_resident(self, shape) -> Optional[schema.ClusterTensors]:
+        """Grow (device-side pad) or shrink (device-side slice) the
+        resident tensors to the new padded bucket, preserving every
+        carried row — one on-device copy per leaf, zero host transfer.
+        Returns None to decline (layout flip under a mesh, a non-node
+        axis moved, the safety valve, or an injected mirror.grow
+        fault), in which case the caller takes the full (RESHARDED)
+        re-upload safety path."""
+        old_n = self._shape[0][0]
+        new_n = shape[0][0]
+        if not self.incremental_grow or new_n == old_n:
+            return None
+        # only the node axis may differ: every other dim change is an
+        # identity change the struct generation should have declared
+        for f, old_s, new_s in zip(
+            schema.ClusterTensors._fields, self._shape, shape
+        ):
+            ax = 1 if f == "taint_bits" else 0
+            if (
+                old_s[:ax] + old_s[ax + 1:] != new_s[:ax] + new_s[ax + 1:]
+                or old_s[ax] != old_n or new_s[ax] != new_n
+            ):
+                return None
+        if self._shardings is not None:
+            sharded = new_n % self.mesh.devices.size == 0
+            if sharded != self._resident_sharded:
+                return None  # layout flip: full RESHARDED re-upload
+        try:
+            act = faults.fire("mirror.grow", old_n=old_n, new_n=new_n)
+        except Exception:  # noqa: BLE001 — injected grow fault: contained
+            logging.getLogger(__name__).warning(
+                "mirror.grow fault injected; falling back to full resync"
+            )
+            return None
+        grow, grow1, shrink, shrink1 = (
+            self._grow, self._grow_ax1, self._shrink, self._shrink_ax1,
+        )
+        if self._shardings is not None and not self._resident_sharded:
+            # replicated small-bucket resident: the pinned-sharding
+            # kernels don't apply (models/mirror._apply_deltas, same)
+            grow, grow1 = _grow_rows, _grow_rows_ax1
+            shrink, shrink1 = _shrink_rows, _shrink_rows_ax1
+        updates = {}
+        dn = new_n - old_n
+        for f in schema.ClusterTensors._fields:
+            leaf = getattr(self._dev, f)
+            if f == "taint_bits":
+                updates[f] = (
+                    grow1(leaf, dn, _GROW_FILLS.get(f, 0))
+                    if dn > 0 else shrink1(leaf, new_n)
+                )
+            else:
+                updates[f] = (
+                    grow(leaf, dn, _GROW_FILLS.get(f, 0))
+                    if dn > 0 else shrink(leaf, new_n)
+                )
+        self.grow_syncs += 1
+        if dn > 0:
+            self.grow_rows_total += dn
+        kernel = grow if dn > 0 else shrink
+        retrace.note(
+            "mirror-grow", kernel,
+            lambda: ("mirror-grow", old_n, new_n, self._resident_sharded),
+        )
+        dev = schema.ClusterTensors(**updates)
+        if act == faults.CORRUPT:
+            # poison the carried rows so the solve's fit scores go
+            # (inf - req) / inf = NaN: the decode health check trips and
+            # the retry's mirror invalidation heals via full resync —
+            # the elastic axis's parity-gate wire (chaos seeds 800-804)
+            import jax.numpy as jnp
+
+            dev = dev._replace(
+                allocatable=jnp.full_like(dev.allocatable, jnp.inf)
+            )
+        return dev
+
     def stats(self) -> dict:
         return {
             "resync_total": self.resync_total,
             "delta_rows_total": self.delta_rows_total,
             "delta_syncs": self.delta_syncs,
+            "grow_syncs": self.grow_syncs,
+            "grow_rows_total": self.grow_rows_total,
         }
 
     def speculation_point(self) -> tuple:

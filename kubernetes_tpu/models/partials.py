@@ -133,6 +133,11 @@ class PartialsCache:
         self.full_recomputes = 0        # full store recomputes (any cause)
         self.rollbacks = 0              # speculation rollbacks
         self.delta_syncs = 0
+        self.grows = 0                  # in-place node-axis grows/shrinks
+        # safety valve (the mirror's, same contract): False restores the
+        # pre-elastic behavior — any node-axis change reseeds the whole
+        # store, dropping every warm class row
+        self.incremental_grow = True
         if mesh is None:
             self._put = jax.device_put
             self._eval = pops.eval_store_jit
@@ -140,6 +145,8 @@ class PartialsCache:
             self._insert = pops.insert_slots_jit
             self._gather = pops.gather_statics_jit
             self._set_specs = pops.set_spec_rows_jit
+            self._grow_cols = pops.grow_store_cols_jit
+            self._shrink_cols = pops.shrink_store_cols_jit
         else:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -167,10 +174,20 @@ class PartialsCache:
                 pops.gather_statics, out_shardings=statics_sh
             )
             self._set_specs = pops.set_spec_rows_jit
+            self._grow_cols = jax.jit(
+                pops.grow_store_cols, static_argnums=(1,),
+                out_shardings=store_sh,
+            )
+            self._shrink_cols = jax.jit(
+                pops.shrink_store_cols, static_argnums=(1,),
+                out_shardings=store_sh,
+            )
             self._eval_rep = pops.eval_store_jit
             self._refresh_rep = pops.refresh_rows_jit
             self._insert_rep = pops.insert_slots_jit
             self._gather_rep = pops.gather_statics_jit
+            self._grow_cols_rep = pops.grow_store_cols_jit
+            self._shrink_cols_rep = pops.shrink_store_cols_jit
         self._resident_sharded = False
 
     # -- bookkeeping -------------------------------------------------------
@@ -183,6 +200,7 @@ class PartialsCache:
             "rollbacks": self.rollbacks,
             "delta_syncs": self.delta_syncs,
             "slots": len(self._slots),
+            "grows": self.grows,
         }
 
     def speculation_point(self) -> tuple:
@@ -232,14 +250,16 @@ class PartialsCache:
         """Selector/preferred rows expand Exists/NotIn/Gt/Lt against the
         CURRENT vocabularies at encode time (schema._expand_requirement)
         — a grown vocab changes what a cached row should contain without
-        changing its signature, so vocab growth flushes the cache whole.
-        Toleration re-expansions are self-keying (the expanded bitset
-        bytes are part of the class key), so the taint vocab is not
-        watermarked."""
-        b = self.state.builder
-        return (len(b.label_vocab),) + tuple(
-            len(v) for v in b.topo_vocabs.values()
-        )
+        changing its signature, so growth flushes the cache whole.  The
+        watermark is PER REFERENCED KEY (builder.expansion_watermark):
+        only keys some encoded requirement actually expanded against
+        count, so the label pairs every autoscaled node interns (its
+        hostname, fresh zone values under unreferenced keys) do NOT
+        flush warm rows — sustained node churn keeps the cache hot (the
+        elastic-node-axis contract; bench c12 gates it).  Toleration
+        re-expansions are self-keying (the expanded bitset bytes are
+        part of the class key), so the taint vocab is not watermarked."""
+        return self.state.builder.expansion_watermark()
 
     # -- signature keying --------------------------------------------------
 
@@ -419,9 +439,12 @@ class PartialsCache:
         stale = (
             self._store is None
             or self._struct_gen < state.struct_generation
-            or self._n != n
             or self._vocab_key != vkey
             or self._resident_sharded != sharded
+            # the incremental_grow valve off: any node-axis change
+            # reseeds the store (the pre-elastic behavior, kept as the
+            # oracle/safety path)
+            or (self._n != n and not self.incremental_grow)
         )
         # distinct first-seen keys (two classes differing only in
         # requests share one slot — requests are not in the key)
@@ -446,6 +469,12 @@ class PartialsCache:
             if dirty.shape[0] > self.FULL_SYNC_FRACTION * n:
                 self._full_reset(cluster, snap, keys, n, vkey, ev)
             else:
+                if self._n != n:
+                    # elastic node axis: the padded bucket moved while
+                    # struct/vocab identity held — resize the resident
+                    # [G, N] columns in place, keeping every cached
+                    # class row warm across the crossing
+                    self._resize_store(cluster, n, rf)
                 miss_set = set(misses)
                 hits = sum(1 for k in keys if k not in miss_set)
                 if misses:
@@ -520,6 +549,46 @@ class PartialsCache:
                      self._resident_sharded),
         )
         return statics
+
+    def _grow_kernels(self):
+        """(grow_cols, shrink_cols): the pinned-sharding twins when the
+        resident layout is node-axis sharded, the plain ones otherwise
+        (the _kernels() convention)."""
+        if self.mesh is not None and not self._resident_sharded:
+            return self._grow_cols_rep, self._shrink_cols_rep
+        return self._grow_cols, self._shrink_cols
+
+    def _resize_store(self, cluster, n: int, rf) -> None:
+        """In-place node-axis resize of the resident store (the elastic
+        node axis): grow pads zero columns on device and immediately
+        re-evaluates the new column range against the grown cluster —
+        every cached class row stays warm across the pad-bucket
+        crossing, at O(new columns) device work and O(new rows) index
+        transfer; shrink slices (live rows are always below the new
+        bucket by the watermark invariant)."""
+        grow_c, shrink_c = self._grow_kernels()
+        old_n = self._n
+        if n > old_n:
+            self._store = grow_c(self._store, n - old_n)
+            gidx = np.arange(old_n, n, dtype=np.int32)
+            chunk = vb.pad_dim(int(gidx.shape[0]), 1)
+            idx = self._put(_pad_idx(gidx, chunk))
+            self._store = rf(self._store, self._specs, cluster, idx)
+            self.recomputed_rows_total += int(gidx.shape[0])
+            retrace.note(
+                "partials-grow", grow_c,
+                lambda: ("partials-grow", self._cap, old_n, n,
+                         self._resident_sharded),
+            )
+        else:
+            self._store = shrink_c(self._store, n)
+            retrace.note(
+                "partials-shrink", shrink_c,
+                lambda: ("partials-shrink", self._cap, old_n, n,
+                         self._resident_sharded),
+            )
+        self.grows += 1
+        self._n = n
 
     def _full_reset(self, cluster, snap, keys, n, vkey, ev) -> None:
         """Reseed the cache from this batch's classes and recompute the

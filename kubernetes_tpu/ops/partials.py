@@ -246,6 +246,36 @@ def insert_slots(
     )
 
 
+def grow_store_cols(store: PartialsStore, dn: int) -> PartialsStore:
+    """Pad `dn` zero node-columns onto every resident row — the elastic
+    node axis's in-place partials grow (one on-device concat per leaf,
+    zero host transfer).  The caller immediately refresh_rows()-es the
+    new column range against the grown cluster, so the pad value never
+    reaches a solve: every class row stays warm across the bucket
+    crossing."""
+    import jax.numpy as jnp
+
+    def pad(arr):
+        return jnp.concatenate(
+            [arr, jnp.zeros(arr.shape[:1] + (dn,), arr.dtype)], axis=1
+        )
+
+    return PartialsStore(
+        sfeas=pad(store.sfeas), aff=pad(store.aff), taint=pad(store.taint)
+    )
+
+
+def shrink_store_cols(store: PartialsStore, n: int) -> PartialsStore:
+    """Slice the resident rows to the first `n` node columns — the
+    post-dwell bucket shrink (every live row index is < n by the
+    watermark invariant)."""
+    return PartialsStore(
+        sfeas=store.sfeas[:, :n],
+        aff=store.aff[:, :n],
+        taint=store.taint[:, :n],
+    )
+
+
 def gather_statics(store: PartialsStore, slots) -> ClassStatics:
     """The batch-ordered [C, N] statics view: store rows at `slots`
     (one slot id per joint class; padded classes alias class 0's slot,
@@ -267,3 +297,5 @@ refresh_rows_jit = jax.jit(refresh_rows)
 insert_slots_jit = jax.jit(insert_slots)
 gather_statics_jit = jax.jit(gather_statics)
 set_spec_rows_jit = jax.jit(set_spec_rows)
+grow_store_cols_jit = jax.jit(grow_store_cols, static_argnums=(1,))
+shrink_store_cols_jit = jax.jit(shrink_store_cols, static_argnums=(1,))

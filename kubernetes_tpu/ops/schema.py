@@ -26,6 +26,7 @@ solves at similar scale hit the XLA compile cache.
 
 from __future__ import annotations
 
+import heapq
 import warnings
 from dataclasses import dataclass, field
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
@@ -381,6 +382,14 @@ class SnapshotBuilder:
         # recent _build_pods — read under the same cache lock by
         # build/build_from_state into SnapshotMeta
         self._last_stable: Tuple[tuple, tuple] = ((), ())
+        # label/topology keys any encoded requirement has ever expanded
+        # against (append-only).  Expansion results depend on the CURRENT
+        # id set under the requirement's key (_expand_requirement), so a
+        # consumer caching expanded rows (the PartialsCache) goes stale
+        # exactly when one of THESE keys gains ids — not when an
+        # unreferenced vocab entry (e.g. a new node's hostname pair)
+        # lands.  expansion_watermark() is the cache's flush key.
+        self._expansion_keys: set = set()
         self.scalar_resources: List[str] = []
         self._scalar_index: Dict[str, int] = {}
         # Optional per-pod requirement hook: (pod) -> (extra required
@@ -409,6 +418,27 @@ class SnapshotBuilder:
         if i is None:
             i = self._sig_registry[sig] = len(self._sig_registry)
         return i
+
+    def expansion_watermark(self) -> tuple:
+        """Per-key id counts for every label/topology key some encoded
+        requirement has expanded against — the exact staleness key for
+        consumers caching expanded selector/preferred rows (the
+        PartialsCache).  Grows only when (a) a referenced key gains ids
+        (its Exists/In/NotIn/Gt/Lt expansions may now differ) or (b) a
+        new key becomes referenced; vocab growth under UNREFERENCED keys
+        — e.g. the hostname pair every autoscaled node interns — leaves
+        the watermark unchanged, so sustained node churn does not flush
+        warm caches."""
+        parts = []
+        for key in sorted(self._expansion_keys):
+            voc = self.topo_vocabs.get(key)
+            if voc is not None:
+                parts.append((key, len(voc)))
+            else:
+                parts.append(
+                    (key, len(self.label_vocab.ids_for_key(key)))
+                )
+        return tuple(parts)
 
     def pod_carveout_shape(self, pod: api.Pod) -> Tuple[int, int, int]:
         """The pod's requested carve-out extent: pod.spec.tpu_topology,
@@ -555,6 +585,7 @@ class SnapshotBuilder:
 
         Expressions over topology keys evaluate against topo_ids[:, slot]
         (see DOMAIN_LABELS); everything else against the label bitset."""
+        self._expansion_keys.add(r.key)
         try:
             slot = self.limits.topology_keys.index(r.key)
             voc = self.topo_vocabs[r.key]
@@ -1503,7 +1534,34 @@ class ClusterState:
     remove_pod: an assumed pod's resources are added immediately and
     subtracted again on Forget (cache.go AssumePod/ForgetPod); expiry
     policy lives in the host cache (kubernetes_tpu.scheduler), not here.
+
+    ELASTIC NODE AXIS (docs/scheduler_loop.md "Elastic node axis"):
+    backing-array identity and device-axis identity are split.  A
+    host-side `_grow` preserves row indices, so it is NOT a struct
+    event — new rows are just dirty rows for the mirror's delta-scatter
+    path.  `struct_generation` moves only for genuine identity changes
+    (resource-axis widening; `force_struct_event`).  The padded bucket
+    `tensors()` exposes follows a grow-eager / shrink-lazy hysteresis:
+    it rises the moment `_high` crosses a power-of-two boundary, and
+    falls only after occupancy has sat below the lower bucket for
+    `bucket_shrink_dwell` consecutive snapshot generations — so
+    autoscaler oscillation around a boundary never flip-flops compile
+    keys or resident-array shapes in either direction.
     """
+
+    # class defaults for the elastic-axis knobs (overridden per instance
+    # by FrameworkRegistry from SchedulerConfiguration):
+    #   node_axis_headroom     backing-capacity growth factor on realloc
+    #                          (rounded up to the next power of two);
+    #   bucket_shrink_dwell    snapshot generations occupancy must sit
+    #                          below the lower pad bucket before the
+    #                          exposed bucket shrinks;
+    #   compaction_batch_rows  max rows a single _maybe_compact
+    #                          invocation relocates (amortized trigger —
+    #                          a 10k-node drain does O(live) total work).
+    NODE_AXIS_HEADROOM = 2.0
+    BUCKET_SHRINK_DWELL = 8
+    COMPACTION_BATCH_ROWS = 512
 
     def __init__(self, builder: Optional[SnapshotBuilder] = None):
         self.builder = builder or SnapshotBuilder()
@@ -1511,8 +1569,27 @@ class ClusterState:
         self._cap = max(lim.min_nodes, 8)
         self._r = max(len(self.builder.resource_names), len(FIXED_RESOURCES))
         self._rows: Dict[str, int] = {}
+        # free rows below the high watermark: a lowest-first heap plus a
+        # membership set (heap entries invalidated by compaction are
+        # discarded lazily on pop) — reusing the LOWEST hole keeps the
+        # live set naturally packed toward row 0
         self._free: List[int] = []
+        self._free_set: set = set()
         self._high = 0  # rows in use (high watermark after frees are reused)
+        self.node_axis_headroom = float(self.NODE_AXIS_HEADROOM)
+        self.bucket_shrink_dwell = int(self.BUCKET_SHRINK_DWELL)
+        self.compaction_batch_rows = int(self.COMPACTION_BATCH_ROWS)
+        # pad-bucket hysteresis state: the bucket currently exposed by
+        # tensors(), the consecutive below-bucket generations seen, and
+        # the generation the last dwell tick was counted at (so several
+        # tensors() calls within one encode count once)
+        self._bucket = vb.pad_dim(0, lim.min_nodes)
+        self._dwell = 0
+        self._dwell_gen = 0
+        # compaction observability (mirrored into scheduler_compactions_
+        # total / scheduler_compaction_moved_rows each cycle)
+        self.compactions_total = 0
+        self.compaction_moved_rows_total = 0
         self.node_names: List[Optional[str]] = []
         # the api objects behind the rows, retained like _pods below: the
         # host-fallback solver (models.batch_scheduler._host_fallback)
@@ -1562,7 +1639,19 @@ class ClusterState:
         self._static_gen = np.zeros(cap, dtype=np.int64)  # graftlint: disable=tensor-contract -- host-only generation counter, never device-resident
         self._usage_gen = np.zeros(cap, dtype=np.int64)  # graftlint: disable=tensor-contract -- host-only generation counter, never device-resident
 
-    def _grow(self, cap: int) -> None:
+    def _grow(self, cap: Optional[int] = None) -> None:
+        """Reallocate the backing arrays with headroom.  Row indices are
+        PRESERVED and the padded bucket is derived by tensors() from
+        `_high`, so a grow is NOT a struct event: the device mirrors see
+        new rows as ordinary dirty rows (or a pad-bucket crossing they
+        absorb with an in-place resident grow) — never a forced full
+        resync.  `struct_generation` is reserved for genuine identity
+        changes (resource-axis widening, force_struct_event)."""
+        if cap is None:
+            cap = vb.pad_dim(
+                max(int(self._cap * self.node_axis_headroom), self._high + 1),
+                self.builder.limits.min_nodes,
+            )
         old = self.tensors(pad=False)
         old_sg, old_ug = self._static_gen, self._usage_gen
         self._alloc(cap, self._r)
@@ -1584,7 +1673,6 @@ class ClusterState:
         self._static_gen[:h] = old_sg[:h]
         self._usage_gen[:h] = old_ug[:h]
         self._cap = cap
-        self._struct_gen = self._bump()
 
     def ensure_resources(self) -> None:
         """Widen the resource axis after new scalar resources appeared in
@@ -1609,11 +1697,10 @@ class ClusterState:
             return
         self.builder._resource_vector(node.status.allocatable, 0, grow=True)
         self.ensure_resources()
-        if self._free:
-            i = self._free.pop()
-        else:
+        i = self._pop_free()
+        if i is None:
             if self._high == self._cap:
-                self._grow(self._cap * 2)
+                self._grow()
             i = self._high
             self._high += 1
             self.node_names.append(None)
@@ -1643,6 +1730,16 @@ class ClusterState:
         )
         self._static_gen[i] = self._bump()
 
+    def _pop_free(self) -> Optional[int]:
+        """Lowest free row below the watermark, or None.  Heap entries
+        compaction consumed are discarded lazily here."""
+        while self._free:
+            i = heapq.heappop(self._free)
+            if i in self._free_set:
+                self._free_set.discard(i)
+                return i
+        return None
+
     def remove_node(self, name: str) -> None:
         i = self._rows.pop(name)
         self._node_objs.pop(name, None)
@@ -1650,7 +1747,8 @@ class ClusterState:
             self._pods.pop(pk, None)
             self._pod_node.pop(pk, None)
         self._clear_row(i)
-        self._free.append(i)
+        heapq.heappush(self._free, i)
+        self._free_set.add(i)
         self._maybe_compact()
 
     def _clear_row(self, i: int) -> None:
@@ -1692,22 +1790,59 @@ class ClusterState:
         self._static_gen[dst] = self._usage_gen[dst] = self._bump()
         self._clear_row(src)
 
+    def _trim_tail(self) -> int:
+        """Lower the high watermark past trailing holes (free — no row
+        moves).  Amortized O(1) per removal: each trimmed row was freed
+        exactly once."""
+        trimmed = 0
+        while self._high > 0 and not self.node_valid[self._high - 1]:
+            self._high -= 1
+            self._free_set.discard(self._high)
+            self.node_names.pop()
+            trimmed += 1
+        return trimmed
+
     def _maybe_compact(self) -> None:
-        """Shrink the high watermark once occupancy drops below half of it:
-        move tail rows into free slots so snapshots return to a smaller
-        shape bucket instead of staying padded at the historical peak."""
+        """Deferred, bounded compaction: once occupancy drops below half
+        the watermark, relocate at most `compaction_batch_rows` tail rows
+        into the lowest holes per invocation (plus free trailing-hole
+        trims), so snapshots return to a smaller shape bucket WITHOUT an
+        O(live) sorted scan on every remove_node.  A scale-down storm
+        triggers this repeatedly; each live row moves at most once per
+        drain, so a full 10k-node drain does O(live) total work.  Moved
+        rows bump their generations — they are ordinary dirty rows for
+        the device mirrors, not a struct event; the exposed pad bucket
+        follows later through tensors()'s shrink-dwell hysteresis."""
         live = len(self._rows)
+        # trailing holes trim unconditionally (free, amortized O(1) per
+        # removal): a newest-first drain must lower the watermark even
+        # when occupancy never falls below half — otherwise the pad
+        # bucket can't follow the fleet back down
+        trimmed = self._trim_tail()
         if self._high <= max(2 * live, self.builder.limits.min_nodes):
+            if trimmed:
+                self.compactions_total += 1
             return
-        occupied_tail = sorted(
-            (i for i in self._rows.values() if i >= live), reverse=True
-        )
-        holes = sorted(i for i in self._free if i < live)
-        for src, dst in zip(occupied_tail, holes):
-            self._move_row(src, dst)
-        self._high = live
-        self._free = []
-        del self.node_names[live:]
+        moved = 0
+        floor = max(live, self.builder.limits.min_nodes)
+        budget = self.compaction_batch_rows
+        while moved < budget and self._high > floor:
+            dst = self._pop_free()
+            if dst is None or dst >= self._high - 1:
+                # no hole strictly below the tail row (a >= hole can
+                # only be a race-free artifact of the floor clamp)
+                if dst is not None:
+                    heapq.heappush(self._free, dst)
+                    self._free_set.add(dst)
+                break
+            self._move_row(self._high - 1, dst)
+            moved += 1
+            self._high -= 1
+            self.node_names.pop()
+            trimmed += self._trim_tail()
+        if moved or trimmed:
+            self.compactions_total += 1
+            self.compaction_moved_rows_total += moved
 
     # -- pod (bound/assumed) lifecycle ------------------------------------
 
@@ -1772,13 +1907,41 @@ class ClusterState:
     def num_nodes(self) -> int:
         return len(self._rows)
 
+    @property
+    def node_axis_bucket(self) -> int:
+        """The pad bucket tensors() currently exposes (post-hysteresis)
+        — mirrored into scheduler_node_axis_bucket each cycle."""
+        return min(self._bucket, self._cap)
+
     def tensors(self, pad: bool = True) -> ClusterTensors:
         """Current cluster tensors; O(1) views into the backing arrays
         (padded to the power-of-two bucket so jit cache keys are stable).
         The views alias live state — solvers transfer to device
         immediately, so mutate-after-snapshot is safe in practice; copy()
-        if you need isolation."""
-        n = vb.pad_dim(self._high, self.builder.limits.min_nodes) if pad else self._cap
+        if you need isolation.
+
+        The exposed bucket follows grow-eager / shrink-lazy hysteresis:
+        it rises to pad_dim(_high) immediately, but falls only after
+        occupancy has sat below the lower bucket for
+        `bucket_shrink_dwell` consecutive snapshot GENERATIONS (several
+        tensors() calls against one unchanged generation count once), so
+        add/remove oscillation around a bucket boundary never thrashes
+        the compile-key lattice or the resident device arrays."""
+        if pad:
+            want = vb.pad_dim(self._high, self.builder.limits.min_nodes)
+            if want >= self._bucket:
+                self._bucket = want  # grow eagerly: rows must fit NOW
+                self._dwell = 0
+                self._dwell_gen = self._gen
+            elif self._gen != self._dwell_gen:
+                self._dwell_gen = self._gen
+                self._dwell += 1
+                if self._dwell >= self.bucket_shrink_dwell:
+                    self._bucket = want  # dwell served: shrink to fit
+                    self._dwell = 0
+            n = self._bucket
+        else:
+            n = self._cap
         n = min(n, self._cap)
         return ClusterTensors(
             allocatable=self.allocatable[:n],
@@ -1799,6 +1962,34 @@ class ClusterState:
 
     # -- device-mirror sync protocol --------------------------------------
 
+    def configure_elastic_axis(
+        self,
+        headroom: Optional[float] = None,
+        shrink_dwell: Optional[int] = None,
+        compaction_batch_rows: Optional[int] = None,
+    ) -> None:
+        """Apply the elastic-node-axis knobs (SchedulerConfiguration's
+        nodeAxisHeadroom / bucketShrinkDwell / compactionBatchRows —
+        FrameworkRegistry threads them onto the shared state)."""
+        if headroom is not None:
+            if headroom < 1.0:
+                raise ValueError("node_axis_headroom must be >= 1.0")
+            self.node_axis_headroom = float(headroom)
+        if shrink_dwell is not None:
+            if shrink_dwell < 1:
+                raise ValueError("bucket_shrink_dwell must be >= 1")
+            self.bucket_shrink_dwell = int(shrink_dwell)
+        if compaction_batch_rows is not None:
+            if compaction_batch_rows < 1:
+                raise ValueError("compaction_batch_rows must be >= 1")
+            self.compaction_batch_rows = int(compaction_batch_rows)
+
+    def force_struct_event(self) -> None:
+        """Declare a genuine axis-identity change: every mirror must
+        full-resync.  The escape hatch for mutations outside the row
+        protocol (tests, external surgery on the backing arrays)."""
+        self._struct_gen = self._bump()
+
     @property
     def generation(self) -> int:
         return self._gen
@@ -1806,7 +1997,12 @@ class ClusterState:
     @property
     def struct_generation(self) -> int:
         """Mirrors synced before this generation must full-resync: the
-        backing arrays were reallocated or re-axised since."""
+        backing arrays were re-axised since (resource widening,
+        force_struct_event).  Backing-array GROWTH and pad-bucket moves
+        are deliberately NOT struct events — row indices survive them,
+        so mirrors absorb the shape change in place (models/mirror.py
+        incremental grow) with the full RESHARDED re-upload kept as the
+        safety path."""
         return self._struct_gen
 
     def dirty_rows(self, synced_gen: int, n: int) -> Tuple[np.ndarray, np.ndarray]:

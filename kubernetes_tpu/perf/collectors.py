@@ -208,6 +208,14 @@ class MetricsCollector:
         "scheduler_mirror_resync_total",
         "scheduler_mirror_delta_rows",
         "scheduler_sharded_solve_fallbacks",
+        # elastic node axis: in-place resident grows (vs full resyncs),
+        # the rows they added, the hysteresis-governed pad bucket, and
+        # deferred-compaction work (docs/scheduler_loop.md)
+        "scheduler_mirror_grow_total",
+        "scheduler_mirror_grow_rows",
+        "scheduler_node_axis_bucket",
+        "scheduler_compactions_total",
+        "scheduler_compaction_moved_rows",
         # incremental O(changes) solving: resident-partials hit/recompute
         # accounting, full recomputes, and speculation rollbacks
         # (docs/scheduler_loop.md incremental-solve section)

@@ -136,6 +136,22 @@ class SchedulerConfiguration:
     # (struct/vocab invalidation and the decode-side parity gate are
     # unconditional).  Armed by the IncrementalSolve feature gate.
     partials_resync_interval: int = 1024
+    # Elastic node axis (docs/scheduler_loop.md "Elastic node axis"):
+    # nodeAxisHeadroom is the backing-array growth factor applied when
+    # ClusterState reallocates under autoscaler growth (rounded up to
+    # the next power-of-two bucket; >= 1.0 — larger values amortize
+    # host-side reallocs across more node adds);
+    node_axis_headroom: float = 2.0
+    # bucketShrinkDwell is the number of consecutive snapshot
+    # generations occupancy must sit below the lower pad bucket before
+    # tensors() shrinks the exposed bucket — the hysteresis that keeps
+    # scale-up/down oscillation around a boundary from flip-flopping
+    # compile keys and resident device arrays;
+    bucket_shrink_dwell: int = 8
+    # compactionBatchRows caps the rows one deferred-compaction
+    # invocation relocates during scale-down (amortized trigger: a
+    # full drain does O(live) total work, never O(live^2)).
+    compaction_batch_rows: int = 512
     # parity-only knobs (see module docstring)
     parallelism: int = 16
     percentage_of_nodes_to_score: int = 100
@@ -256,6 +272,21 @@ class SchedulerConfiguration:
                 "partials_resync_interval must be >= 1 (every delta sync "
                 "may force a full recompute, never none)"
             )
+        if self.node_axis_headroom < 1.0:
+            raise ValueError(
+                "node_axis_headroom must be >= 1.0 (the backing arrays "
+                "must at least fit the rows that forced the realloc)"
+            )
+        if self.bucket_shrink_dwell < 1:
+            raise ValueError(
+                "bucket_shrink_dwell must be >= 1 (a 1-generation dwell "
+                "is the minimum hysteresis; 0 would shrink mid-encode)"
+            )
+        if self.compaction_batch_rows < 1:
+            raise ValueError(
+                "compaction_batch_rows must be >= 1 (a 0 budget would "
+                "never relocate a row and the watermark could only trim)"
+            )
         self.gate()  # unknown/locked gate overrides raise here
         return self
 
@@ -279,6 +310,7 @@ _TOP_KEYS = {
     "batchLatencySLOSeconds", "meshDevices", "commitSubwaveConcurrency",
     "schedulerLanes", "speculativeSolve", "streamSubwaves",
     "sliceCarveoutPolicy", "sliceMaxDim", "partialsResyncInterval",
+    "nodeAxisHeadroom", "bucketShrinkDwell", "compactionBatchRows",
 }
 
 
@@ -353,6 +385,12 @@ def load_config(source: Any) -> SchedulerConfiguration:
         cfg.slice_max_dim = int(doc["sliceMaxDim"])
     if "partialsResyncInterval" in doc:
         cfg.partials_resync_interval = int(doc["partialsResyncInterval"])
+    if "nodeAxisHeadroom" in doc:
+        cfg.node_axis_headroom = float(doc["nodeAxisHeadroom"])
+    if "bucketShrinkDwell" in doc:
+        cfg.bucket_shrink_dwell = int(doc["bucketShrinkDwell"])
+    if "compactionBatchRows" in doc:
+        cfg.compaction_batch_rows = int(doc["compactionBatchRows"])
     if "featureGates" in doc:
         cfg.feature_gates = {
             str(k): bool(v) for k, v in (doc["featureGates"] or {}).items()

@@ -237,6 +237,14 @@ class FrameworkRegistry:
                 first = tpu
             self.frameworks[profile.scheduler_name] = Framework(profile, tpu)
         self.default = next(iter(self.frameworks.values()))
+        # elastic node axis: the knobs live on the ONE ClusterState all
+        # profiles share (tensors()'s bucket hysteresis and remove_node's
+        # deferred compaction are state-side, not per-profile)
+        self.state.configure_elastic_axis(
+            headroom=config.node_axis_headroom,
+            shrink_dwell=config.bucket_shrink_dwell,
+            compaction_batch_rows=config.compaction_batch_rows,
+        )
 
     @property
     def state(self) -> schema.ClusterState:

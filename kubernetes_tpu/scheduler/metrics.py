@@ -299,6 +299,25 @@ class Registry:
         self.sharded_solve_fallbacks = Gauge(
             "scheduler_sharded_solve_fallbacks"
         )
+        # -- elastic node axis (docs/scheduler_loop.md) --------------------
+        # pad-bucket crossings the mirror absorbed with an in-place
+        # resident resize (device-side pad/slice) instead of a full
+        # re-upload — autoscaler growth should move THIS, not resyncs
+        self.mirror_grow_total = Gauge("scheduler_mirror_grow_total")
+        # node-axis rows added by in-place grows (running total): the
+        # bucket-crossing transfer is O(this delta + dirty rows), not
+        # O(N) — bench c12 gates on it
+        self.mirror_grow_rows = Gauge("scheduler_mirror_grow_rows")
+        # the pad bucket ClusterState currently exposes (post-hysteresis:
+        # rises eagerly, falls only after bucketShrinkDwell generations)
+        self.node_axis_bucket = Gauge("scheduler_node_axis_bucket")
+        # deferred-compaction invocations that did work (trim or move)
+        self.compactions_total = Gauge("scheduler_compactions_total")
+        # rows relocated by deferred compaction (running total; bounded
+        # per invocation by compactionBatchRows — a drain is O(live))
+        self.compaction_moved_rows = Gauge(
+            "scheduler_compaction_moved_rows"
+        )
         # -- incremental-solve surface (docs/scheduler_loop.md) ------------
         # [class, node-row] partials entries served from the resident
         # cache instead of re-evaluated (running total, mirrored from

@@ -1581,6 +1581,18 @@ class Scheduler:
             self.metrics.mirror_delta_rows.set(
                 float(mirror.delta_rows_total)
             )
+            # elastic node axis: in-place resident resizes vs re-uploads
+            self.metrics.mirror_grow_total.set(float(mirror.grow_syncs))
+            self.metrics.mirror_grow_rows.set(
+                float(mirror.grow_rows_total)
+            )
+        est = getattr(self.tpu, "state", None)
+        if est is not None:
+            self.metrics.node_axis_bucket.set(float(est.node_axis_bucket))
+            self.metrics.compactions_total.set(float(est.compactions_total))
+            self.metrics.compaction_moved_rows.set(
+                float(est.compaction_moved_rows_total)
+            )
         # incremental-solve surface: resident-partials hit/recompute
         # accounting across every profile's cache (summed — profiles
         # sync independently, the surface is one control plane)

@@ -86,6 +86,14 @@ KNOWN_POINTS = frozenset({
     # fail-grade schedules make the batch solve cold instead — the
     # partials chaos family (seeds 700-704)
     "solve.partials",
+    # the elastic node axis's in-place resident resize (models/mirror.py
+    # _resize_resident, a pad-bucket crossing absorbed without a full
+    # re-upload): fail-grade schedules decline the resize — the mirror
+    # takes the full (RESHARDED) re-upload safety path; CORRUPT poisons
+    # the carried rows so the decode health check trips and the retry's
+    # invalidation heals via full resync — the node-churn chaos family
+    # (seeds 800-804)
+    "mirror.grow",
     "leader.renew",
 })
 

@@ -76,6 +76,10 @@ def _fault_plan(rng: random.Random) -> faults.FaultRegistry:
     # (contained inside encode); the CORRUPT poison-and-heal family is
     # PARTIALS_SEEDS (700-704)
     reg.fail("solve.partials", n=1, probability=0.5)
+    # the elastic-axis resident resize: base seeds hold a fixed node set
+    # (no pad-bucket crossings), so NODE_CHURN_SEEDS (800-804) are where
+    # it actually fires; registered here for point coverage
+    reg.fail("mirror.grow", n=1, probability=0.5)
     return reg
 
 
@@ -1501,6 +1505,196 @@ def test_chaos_partials_poison(seed, tmp_path):
             f"seed {seed}: assume set not empty at quiesce"
         )
     finally:
+        faults.disarm()
+        sched.stop()
+        elector.stop()
+
+
+# -- elastic node axis: autoscaler churn mid-solve (ISSUE 15) --------------
+#
+# The node axis is elastic: a pad-bucket crossing is absorbed by an
+# in-place resident resize (mirror.grow) instead of a full re-upload,
+# remove_node compaction is deferred and bounded, and the exposed bucket
+# follows shrink-dwell hysteresis.  These seeds drive sustained node
+# add/remove ACROSS a bucket boundary while a pod burst schedules, with
+# grow faults injected: fail-grade declines the resize (the mirror must
+# take the full-resync safety path), CORRUPT poisons the carried rows
+# (the decode health check must trip and the retry's invalidation heal
+# via full resync).  Churn nodes are NoSchedule-tainted so the solver
+# must never place a pod on them — which also pins the "no placement on
+# a removed node's row" invariant exactly: any pod observed on a churn-*
+# node would be a placement onto a row whose node was (or is about to
+# be) removed.  Standing invariants ride along: every pod binds, bound
+# exactly once, rv monotonic, assume set empty at quiesce.
+
+NODE_CHURN_SEEDS = list(range(800, 805))
+
+
+def _node_churn_fault_plan(rng: random.Random) -> faults.FaultRegistry:
+    reg = faults.FaultRegistry(seed=rng.randint(0, 2 ** 31))
+    # the poison first (consumed by the first crossing), then a decline
+    reg.corrupt("mirror.grow", n=1)
+    reg.fail("mirror.grow", n=1)
+    reg.fail("batch.solve", n=1, probability=0.5)
+    reg.fail("binder.commit_wave", n=rng.randint(1, 2))
+    reg.fail("store.update_wave", n=1, probability=0.5)
+    reg.fail("store.journal.append", n=1, probability=0.5)
+    reg.fail("leader.renew", n=1, probability=0.5)
+    return reg
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+@pytest.mark.parametrize("seed", NODE_CHURN_SEEDS)
+def test_chaos_node_churn(seed, tmp_path):
+    from kubernetes_tpu.kubemark import NodeGroupScaler
+
+    rng = random.Random(seed)
+    reg = _node_churn_fault_plan(rng)
+    store = st.Store(journal_path=str(tmp_path / "journal.jsonl"))
+    audit = _EventAudit(store)
+    n_base = 24
+    for i in range(n_base):
+        store.create(
+            make_node(f"n-{i}")
+            .capacity(cpu_milli=16000, mem=32 * GI, pods=110)
+            .zone(f"z-{i % 3}")
+            .obj()
+        )
+    # the churn group: tainted so nothing ever lands on its rows
+    scaler = NodeGroupScaler(
+        store, group="churn", zones=3,
+        taints=[("dedicated", "churn", "NoSchedule")],
+    )
+    elector = LeaderElector(
+        store, "churn-sched", f"holder-{seed}",
+        lease_duration=1.0, renew_period=0.05,
+    ).start()
+    config = SchedulerConfiguration(
+        pod_initial_backoff_seconds=0.05,
+        pod_max_backoff_seconds=0.4,
+        batch_window_seconds=0.01,
+        unschedulable_flush_seconds=0.5,
+        # a short dwell so the oscillation produces several crossings
+        # (several mirror.grow fire opportunities) inside the run
+        bucket_shrink_dwell=2,
+    )
+    sched = Scheduler(
+        store, assume_ttl=1.0, leader_elector=elector, config=config
+    )
+    n_pods = 48
+    stop_churn = threading.Event()
+
+    def churn_loop():
+        # oscillate the group across the 32-node pad bucket boundary
+        # (24 base + 0..20 churn members: buckets 32 <-> 64) until the
+        # grow point fired twice (poison + decline) or the run ends
+        hi, lo = 20, 0
+        while not stop_churn.wait(0.05):
+            try:
+                scaler.scale_to(hi if scaler.size() <= lo else lo)
+            except Exception:  # noqa: BLE001 — store faults are in play
+                pass
+            for _ in range(40):
+                if stop_churn.wait(0.05):
+                    return
+                if reg.fired.get("mirror.grow", 0) >= 2:
+                    return
+
+    churn = threading.Thread(target=churn_loop, daemon=True)
+    try:
+        with faults.armed(reg):
+            sched.start()
+            assert elector.wait_for_leadership(10)
+            churn.start()
+            for i in range(n_pods):
+                pod = (
+                    make_pod(f"p-{i}", namespace=f"team-{i % 2}")
+                    .req(cpu_milli=rng.choice([50, 100, 200]))
+                )
+                if i % 4 == 0:
+                    pod.node_selector_kv(
+                        "topology.kubernetes.io/zone", f"z-{i % 3}"
+                    )
+                store.create(pod.obj())
+                if rng.random() < 0.3:
+                    time.sleep(rng.random() * 0.01)
+            # an encode only observes a crossing when a batch solves:
+            # while the grow faults haven't both fired, keep feeding
+            # tiny driver pods so the oscillating node set keeps being
+            # re-encoded (bounded — the churn loop stops at 2 fires)
+            extras = 0
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                pods, _ = store.list("Pod")
+                quiesced = pods and all(p.spec.node_name for p in pods)
+                fired = reg.fired.get("mirror.grow", 0)
+                if quiesced and (fired >= 2 or extras >= 40):
+                    break
+                if quiesced and fired < 2:
+                    store.create(
+                        make_pod(
+                            f"drv-{extras}", namespace=f"team-{extras % 2}"
+                        ).req(cpu_milli=10).obj()
+                    )
+                    extras += 1
+                    time.sleep(0.25)
+                    continue
+                time.sleep(0.1)
+            stop_churn.set()
+            churn.join(timeout=10)
+
+        # -- invariants (faults disarmed; residual schedules drained) ----
+        assert reg.fired.get("mirror.grow"), (
+            f"seed {seed}: the grow fault never fired "
+            f"(fired={reg.fired}, scaler size={scaler.size()}, "
+            f"extras={extras})"
+        )
+        pods, _ = store.list("Pod")
+        assert len(pods) == n_pods + extras
+        unbound = [p.meta.name for p in pods if not p.spec.node_name]
+        assert not unbound, (
+            f"seed {seed}: pods never bound past quiesce: {unbound[:5]}\n"
+            f"  queue: {sched.queue.stats()}\n"
+            f"  fired={reg.fired} pending={reg.pending()}"
+        )
+        # no placement on a removed (or removable) node's row: churn
+        # members are NoSchedule-tainted, so ANY pod on one means the
+        # solver consumed a stale/corrupt resident row
+        on_churn = [
+            p.meta.name for p in pods
+            if p.spec.node_name and p.spec.node_name.startswith("churn-")
+        ]
+        assert not on_churn, (
+            f"seed {seed}: pods placed on churn rows: {on_churn[:5]}"
+        )
+        # the declined/poisoned grows healed through the full-resync
+        # safety path: the mirror re-uploaded at least once past the
+        # initial sync (the fail-grade decline and the CORRUPT heal
+        # both land there)
+        resyncs = sum(
+            fwk.tpu._mirror.resync_total for fwk in sched.profiles
+        )
+        assert resyncs >= 2, (
+            f"seed {seed}: no full-resync heal after grow faults "
+            f"(resyncs={resyncs}, fired={reg.fired})"
+        )
+        assert not audit.violations, f"seed {seed}: {audit.violations[:5]}"
+        rebound = {
+            k: nodes for k, nodes in audit.bound_nodes.items()
+            if len(nodes) > 1
+        }
+        assert not rebound, f"seed {seed}: double binds {rebound}"
+        assert sched.flush_binds(15)
+        deadline = time.monotonic() + 10
+        while sched.cache.assumed_count() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sched.cache.assumed_count() == 0, (
+            f"seed {seed}: assume set not empty at quiesce"
+        )
+    finally:
+        stop_churn.set()
         faults.disarm()
         sched.stop()
         elector.stop()

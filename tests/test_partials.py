@@ -224,8 +224,12 @@ def test_vocab_growth_flushes_cache():
 
 
 def test_struct_growth_invalidates():
-    """Growth past the padded node bucket (struct generation) forces a
-    full recompute, exactly like the mirror's RESHARDED re-upload."""
+    """A BULK load crossing the padded node bucket forces a full
+    recompute through the over-fraction path (most rows dirtied at
+    once).  Incremental crossings — few dirty rows — are absorbed in
+    place instead (tests/test_elastic_axis.py); the elastic node axis
+    reserves the full reseed for genuine struct events and bulk
+    loads."""
     warm, cold = _mk_sched(True), _mk_sched(False)
     _add_nodes((warm, cold), 8, seed=11)
     _solve_both(warm, cold, _mk_pods(0, 8, seed=2))

@@ -88,6 +88,34 @@ def test_gang_retry_bucket_closure():
         )
 
 
+def test_axis_transition_coverage():
+    """The elastic-node-axis check (ISSUE 15) runs clean on the real
+    tree AND actually detects its failure modes (not vacuously green):
+    a broken shrink dwell — the bucket moving before the dwell is
+    served — must produce findings."""
+    byclass = shapes._schema_contracts(REPO_ROOT)
+    findings = []
+    shapes._check_axis_transitions(byclass, findings)
+    assert findings == []
+
+    from kubernetes_tpu.ops import schema
+
+    orig = schema.ClusterState.configure_elastic_axis
+
+    def no_dwell(self, headroom=None, shrink_dwell=None,
+                 compaction_batch_rows=None):
+        orig(self, headroom, 1, compaction_batch_rows)
+
+    schema.ClusterState.configure_elastic_axis = no_dwell
+    try:
+        findings = []
+        shapes._check_axis_transitions(byclass, findings)
+        assert findings, "a broken shrink dwell must be detected"
+        assert any("dwell" in f.message for f in findings)
+    finally:
+        schema.ClusterState.configure_elastic_axis = orig
+
+
 def test_abstract_snapshot_matches_real_encode():
     """The contract-built abstract snapshot has exactly the shapes and
     dtypes the real encoder produces for the same buckets — the two
