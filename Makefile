@@ -5,7 +5,8 @@
 
 PY ?= python
 
-.PHONY: test chaos chaos-restart bench lint lint-shapes multichip race
+.PHONY: test chaos chaos-restart bench lint lint-shapes multichip race \
+	native-ext test-journal
 
 # graftlint: the project-native static analysis suite (guarded-by,
 # hot-path purity, registry drift, lock-order, tensor-contract,
@@ -70,3 +71,28 @@ multichip:
 
 bench:
 	JAX_PLATFORMS=cpu BENCH_STRICT=1 $(PY) bench.py
+
+# optional _hostplane C extension (native/hostplane.c): journal frame
+# trailer splice + CRC and proto wire framing.  Pure accelerator —
+# api/framing.py is the contract and the fallback, so this target is
+# best-effort: no compiler, no extension, everything still runs.
+native-ext:
+	@cc=$$($(PY) -c "import sysconfig; print(sysconfig.get_config_var('CC') or 'cc')" | cut -d' ' -f1); \
+	if command -v $$cc >/dev/null 2>&1; then \
+		inc=$$($(PY) -c "import sysconfig; print(sysconfig.get_paths()['include'])"); \
+		ext=$$($(PY) -c "import sysconfig; print(sysconfig.get_config_var('EXT_SUFFIX'))"); \
+		$$cc -O2 -Wall -shared -fPIC -I$$inc native/hostplane.c \
+			-o _hostplane$$ext && echo "built _hostplane$$ext"; \
+	else \
+		echo "no C compiler; skipping _hostplane (pure-Python fallback)"; \
+	fi
+
+# journal/framing tests in BOTH modes: with the native extension if it
+# builds, and with the pure-Python fallback forced — the fallback must
+# stay green on machines with no compiler at all.
+test-journal: native-ext
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_journal_framing.py \
+		tests/test_restart_recovery.py tests/test_durability_leaderelection.py \
+		-q -p no:cacheprovider
+	HOSTPLANE_DISABLE=1 JAX_PLATFORMS=cpu $(PY) -m pytest \
+		tests/test_journal_framing.py -q -p no:cacheprovider

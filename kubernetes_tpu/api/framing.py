@@ -1,0 +1,105 @@
+"""Journal frame codec: one line, one CRC pass per commit sub-wave.
+
+The per-line journal (`store._encode_record`) serializes and checksums
+every record independently — a 1k-pod bind wave pays 1k `json.dumps` +
+1k `zlib.crc32` calls and hands the journal 1k separate lines.  A frame
+collapses the whole sub-wave into ONE line::
+
+    {"f": 1, "w": <wave id>, "recs": [<record>, ...], "crc": <crc32>}
+
+with a single serialization pass and a single crc32 over the crc-less
+body — the trailer splice is the same shape as the per-record codec, so
+replay's "parse, pop crc, re-serialize, compare" check covers frames
+with no second code path.  A frame IS a wave: it carries the wave id,
+needs no terminator record, and replay applies it atomically (a torn
+frame fails the line parse or the CRC and is dropped whole, exactly the
+PR 8 wave-atomicity contract).  Frames interleave freely with legacy
+per-line records — each is still one journal line.
+
+Unlike legacy lines, a frame with a MISSING crc is rejected: the
+crc-less acceptance in `store._record_crc_ok` exists only for journals
+written before the CRC trailer landed, and no such journal can contain
+a frame.
+
+The splice + checksum hot path is optionally served by the `_hostplane`
+C extension (native/hostplane.c, built by `make native-ext`); the pure
+Python implementation below is the contract and stays the fallback —
+both produce byte-identical lines.
+"""
+
+from __future__ import annotations
+
+import json
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+try:  # optional C extension; pure Python below is the reference.
+    # HOSTPLANE_DISABLE=1 forces the fallback (make test-journal runs
+    # the journal suite in both modes).
+    import os as _os
+
+    if _os.environ.get("HOSTPLANE_DISABLE"):
+        _hostplane = None
+    else:
+        import _hostplane  # type: ignore
+except ImportError:  # pragma: no cover - depends on build environment
+    _hostplane = None
+
+FRAME_KEY = "f"
+FRAME_VERSION = 1
+
+
+def native_available() -> bool:
+    return _hostplane is not None
+
+
+def crc_line(s: str) -> str:
+    """Append the CRC trailer to a serialized JSON object and terminate
+    the line: ``{...}`` -> ``{..., "crc": N}\\n``.  Byte-compatible with
+    store._encode_record's trailer."""
+    if _hostplane is not None:
+        return _hostplane.crc_line(s.encode()).decode()
+    return '%s, "crc": %d}\n' % (s[:-1], zlib.crc32(s.encode()))
+
+
+def encode_frame(wid: int, recs: List[Dict[str, Any]]) -> str:
+    """One journal line for a whole sub-wave: single json.dumps pass,
+    single crc32 pass."""
+    return crc_line(json.dumps({FRAME_KEY: FRAME_VERSION, "w": wid,
+                                "recs": recs}))
+
+
+def is_frame(rec: Dict[str, Any]) -> bool:
+    """True when a parsed (crc-popped) journal record is a frame."""
+    return bool(rec.get(FRAME_KEY)) and isinstance(rec.get("recs"), list)
+
+
+def frame_crc_ok(rec: Dict[str, Any], crc: Optional[int]) -> bool:
+    """Frames REQUIRE their crc — the legacy crc-less acceptance is an
+    upgrade path for pre-CRC journals, which predate framing."""
+    if crc is None:
+        return False
+    return zlib.crc32(json.dumps(rec).encode()) == crc
+
+
+def length_prefix(payload: bytes) -> bytes:
+    """4-byte big-endian length header + payload: the proto transport's
+    wire framing (api/protoserver, native/proto_client.cpp)."""
+    if _hostplane is not None:
+        return _hostplane.length_prefix(payload)
+    return len(payload).to_bytes(4, "big") + payload
+
+
+def split_length_prefixed(buf: bytes) -> Tuple[List[bytes], bytes]:
+    """Split a byte stream into complete length-prefixed payloads plus
+    the unconsumed tail (partial header or partial payload)."""
+    out: List[bytes] = []
+    off = 0
+    n = len(buf)
+    while n - off >= 4:
+        ln = int.from_bytes(buf[off:off + 4], "big")
+        if n - off - 4 < ln:
+            break
+        out.append(buf[off + 4:off + 4 + ln])
+        off += 4 + ln
+    return out, buf[off:]

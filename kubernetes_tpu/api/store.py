@@ -80,6 +80,7 @@ from typing import (
 )
 
 from ..testing import faults
+from . import framing
 from . import types as api
 
 ADDED = "ADDED"
@@ -287,6 +288,53 @@ class Watch:
             self._mu.notify_all()
             return OFFER_OK
 
+    def _offer_batch(self, events: List["Event"]) -> str:
+        """Deliver a committed chunk under ONE ``_mu`` acquisition — the
+        fan-out thread's batched half of the watch path.  Per-event
+        semantics (fault point, per-shard horizon dedup, coalescing
+        rules, capacity expiry) are identical to ``_offer``; only the
+        locking is chunked: one acquire + one notify per chunk instead
+        of per event."""
+        armed = faults._registry is not None
+        store = self._store
+        with self._mu:
+            for ev in events:
+                if armed and faults.fire("watch.offer") == faults.DROP:
+                    # injected overload: as if coalescing overflowed
+                    self._expire_locked()
+                    return OFFER_EXPIRED
+                if self.expired:
+                    return OFFER_EXPIRED
+                if self.stopped:
+                    return OFFER_STOPPED
+                sid = store._hash_index(ev.kind, ev.obj.meta.namespace)
+                if ev.rv <= self._horizons[sid]:
+                    continue  # exactly-once dedup (see _offer)
+                key = _key(ev.obj.meta.namespace, ev.obj.meta.name)
+                cur = self._pending.get(key)
+                if cur is None:
+                    if len(self._pending) >= self._capacity:
+                        self._expire_locked()
+                        return OFFER_EXPIRED
+                    self._pending[key] = ev
+                elif cur.type == ADDED and ev.type == DELETED:
+                    del self._pending[key]
+                    self.coalesced += 2
+                else:
+                    typ = ev.type
+                    if cur.type == ADDED and ev.type == MODIFIED:
+                        typ = ADDED          # still unseen: stays a create
+                    elif cur.type == DELETED and ev.type == ADDED:
+                        typ = MODIFIED       # delete+recreate: latest-wins
+                    self._pending[key] = Event(typ, ev.kind, ev.obj, ev.rv)
+                    self._pending.move_to_end(key)
+                    self.coalesced += 1
+                self._horizons[sid] = ev.rv
+                if ev.rv > self._last_rv:
+                    self._last_rv = ev.rv
+            self._mu.notify_all()
+            return OFFER_OK
+
     def _expire_locked(self) -> None:
         if self.expired:
             return
@@ -423,6 +471,8 @@ class _StoreShard:
         "journal_tail_truncations": "_lock",
         "journal_write_errors": "_lock",
         "journal_torn_waves": "_lock",
+        "journal_frames": "_lock",
+        "journal_frame_bytes": "_lock",
         "_dispatch_backlog": "_dispatch_cv",
         "_dispatch_inflight": "_dispatch_cv",
         "_dispatch_thread": "_dispatch_cv",
@@ -448,6 +498,7 @@ class _StoreShard:
         journal_sync: str,
         checkpoint_records: Optional[int],
         checkpoint_interval_seconds: float,
+        journal_framing: bool = True,
     ):
         self.index = index
         self._lock = threading.RLock()
@@ -481,6 +532,12 @@ class _StoreShard:
         self.journal_tail_truncations = 0
         self.journal_write_errors = 0
         self.journal_torn_waves = 0
+        # sub-wave frame mode (api/framing.py): one line + one CRC pass
+        # per commit sub-wave; off reproduces the legacy per-line wave
+        # format (which replay accepts forever — upgrade path)
+        self._journal_framing = journal_framing
+        self.journal_frames = 0
+        self.journal_frame_bytes = 0
         # checkpoint / recovery state (docs/robustness.md recovery
         # contract): the snapshot sits next to the shard's journal;
         # recovery loads it and replays only the journal suffix past
@@ -594,13 +651,40 @@ class _StoreShard:
                     if not isinstance(rec, dict):
                         raise ValueError("journal record is not an object")
                     crc = rec.pop("crc", None)
-                    if not _record_crc_ok(rec, crc):
-                        raise ValueError("journal record crc mismatch")
-                    op, rv, kind = rec["op"], rec["rv"], rec["kind"]
-                    key = rec["key"]
-                    obj = (
-                        None if op == DELETED else wire.from_wire(rec["obj"])
-                    )
+                    if framing.is_frame(rec):
+                        # one-line sub-wave frame (api/framing.py): its
+                        # single CRC covers every record, its crc is
+                        # MANDATORY (no pre-CRC frames exist), and the
+                        # whole frame decodes up front so structural
+                        # damage anywhere inside drops it atomically
+                        try:
+                            if not framing.frame_crc_ok(rec, crc):
+                                raise ValueError("journal frame crc mismatch")
+                            frame = [
+                                (
+                                    sub["op"], sub["rv"], sub["kind"],
+                                    sub["key"],
+                                    None if sub["op"] == DELETED
+                                    else wire.from_wire(sub["obj"]),
+                                )
+                                for sub in rec["recs"]
+                            ]
+                        except (ValueError, KeyError, TypeError):
+                            # unlike a plain corrupt line we KNOW this
+                            # was a wave — count it as one
+                            self.journal_torn_waves += 1
+                            raise
+                        op = rv = kind = key = obj = None
+                    else:
+                        frame = None
+                        if not _record_crc_ok(rec, crc):
+                            raise ValueError("journal record crc mismatch")
+                        op, rv, kind = rec["op"], rec["rv"], rec["kind"]
+                        key = rec["key"]
+                        obj = (
+                            None if op == DELETED
+                            else wire.from_wire(rec["obj"])
+                        )
                 except (json.JSONDecodeError, ValueError, KeyError, TypeError):
                     # undecodable, CRC-failing, OR structurally-corrupt
                     # record (a line that parses as JSON but lost its
@@ -640,6 +724,16 @@ class _StoreShard:
                 wid = rec.get("w")
                 if wid is not None:
                     self._wave_seq = max(self._wave_seq, int(wid))
+                if frame is not None:
+                    # the frame IS its wave: no terminator protocol, no
+                    # buffering — apply atomically.  A legacy wave left
+                    # open before it never terminated: atomicity wins.
+                    drop_pending("unterminated wave before frame")
+                    for entry in frame:
+                        if entry[1] > min_rv:
+                            apply(*entry)
+                    good_offset += len(raw)
+                    continue
                 if wid is not None and wid in dead_waves:
                     good_offset += len(raw)
                     continue  # straggler of a dropped wave
@@ -877,6 +971,21 @@ class _StoreShard:
 
         self._wave_seq += 1
         wid = self._wave_seq
+        if self._journal_framing:
+            # frame mode: ONE line, one json.dumps pass, one crc32 pass
+            # for the whole sub-wave (api/framing.py) — same atomicity
+            # (the frame is the wave), ~records× fewer codec calls
+            recs = []
+            for op, key, obj, rv in records:
+                rec = {"op": op, "rv": rv, "kind": kind, "key": key}
+                if op != DELETED:
+                    rec["obj"] = wire.to_wire(obj)
+                recs.append(rec)
+            line = framing.encode_frame(wid, recs)
+            self.journal_frames += 1
+            self.journal_frame_bytes += len(line)
+            self._journal_commit([line])
+            return
         lines = []
         for i, (op, key, obj, rv) in enumerate(records):
             rec = {"op": op, "rv": rv, "kind": kind, "key": key, "w": wid}
@@ -930,6 +1039,8 @@ class Store:
         "watch_expired_total": "_rv_lock",
         "_watch_coalesced_closed": "_rv_lock",
         "fenced_writes_total": "_rv_lock",
+        "fanout_chunks": "_rv_lock",
+        "fanout_chunk_events": "_rv_lock",
     }
     # reviewed lock-free / caller-holds-the-publish-lock helpers
     LOCKED_METHODS = frozenset({
@@ -963,6 +1074,11 @@ class Store:
         # DEFAULT_SHARDS.  1 reproduces the legacy single-lock layout
         # (journal at `journal_path` itself).
         shards: Optional[int] = None,
+        # journal sub-waves as one-line frames (api/framing.py): one
+        # serialization + one CRC pass per commit sub-wave.  False
+        # writes the legacy per-line wave format; replay accepts BOTH,
+        # interleaved, regardless of this flag (upgrade path).
+        journal_framing: bool = True,
     ):
         inferred = (
             self._infer_shards(journal_path) if journal_path else None
@@ -994,6 +1110,11 @@ class Store:
         # update_wave sub-waves rejected because the caller's FenceToken
         # no longer matched the Lease (a deposed leader's late wave)
         self.fenced_writes_total = 0
+        # batched fan-out accounting: chunks handed to watchers and the
+        # events they carried (mean = fanout chunk size — mirrored into
+        # the Registry's scheduler_fanout_chunk_size)
+        self.fanout_chunks = 0
+        self.fanout_chunk_events = 0
         # optional api.admission.AdmissionChain: mutate-then-validate on
         # every create/update before the commit (the apiserver admission
         # chain's position in the write path, server/config.go:983).
@@ -1019,6 +1140,7 @@ class Store:
                 journal_sync,
                 checkpoint_records,
                 checkpoint_interval_seconds,
+                journal_framing=journal_framing,
             )
             for i in range(n)
         ]
@@ -1212,6 +1334,14 @@ class Store:
         return self._sum("journal_torn_waves")
 
     @property
+    def journal_frames(self) -> int:
+        return self._sum("journal_frames")
+
+    @property
+    def journal_frame_bytes(self) -> int:
+        return self._sum("journal_frame_bytes")
+
+    @property
     def snapshot_fallbacks(self) -> int:
         return self._sum("snapshot_fallbacks")
 
@@ -1310,19 +1440,19 @@ class Store:
         """Deliver one committed batch to every watcher of `kind` — a
         shard dispatch thread's half of the watch path, running OFF
         every store lock so per-watcher coalescing work never blocks
-        writers."""
+        writers.  The chunk reaches each watcher through ONE
+        ``Watch._mu`` acquisition (``_offer_batch``) instead of a
+        per-event lock round-trip."""
         with self._rv_lock:
             watchers = list(self._watchers.get(kind, ()))
+            if watchers:
+                self.fanout_chunks += 1
+                self.fanout_chunk_events += len(events)
         expired: List[Watch] = []
         for w in watchers:
             try:
-                for ev in events:
-                    verdict = w._offer(ev)
-                    if verdict is OFFER_EXPIRED:
-                        expired.append(w)
-                        break
-                    if verdict is OFFER_STOPPED:
-                        break  # _drop_watch unregisters it; skip the rest
+                if w._offer_batch(events) is OFFER_EXPIRED:
+                    expired.append(w)
             except Exception:  # noqa: BLE001 — per-watcher containment
                 # a poisoned offer (fault-schedule exception, corrupt
                 # payload) must cost only THIS watcher, and it must cost

@@ -715,6 +715,10 @@ class TPUBatchScheduler:
                 f"{carveout_policy!r}"
             )
         self.carveout_policy = carveout_policy
+        # throughput of the most recent snapshot encode (pods/s over the
+        # build_from_state wall time) — mirrored into the Registry's
+        # scheduler_encode_rows_per_s each cycle
+        self.last_encode_rows_per_s = 0.0
         self._greedy = assign_ops.greedy_assign_jit(score_config)
         self._wavefront = assign_ops.wavefront_assign_jit(score_config)
         self._auction = auction_ops.auction_assign_jit(score_config)
@@ -1141,9 +1145,13 @@ class TPUBatchScheduler:
         runtime/framework.go:962).  The overlay is applied to the device
         copy; live state is untouched."""
         with lock if lock is not None else contextlib.nullcontext():
+            t_enc = time.perf_counter()
             snap, meta = self.builder.build_from_state(
                 self.state, pending, num_pods_hint=num_pods_hint
             )
+            dt_enc = time.perf_counter() - t_enc
+            if pending and dt_enc > 0.0:
+                self.last_encode_rows_per_s = len(pending) / dt_enc
             rows, reqs, nzs = [], [], []
             for node_name, pod in reservations:
                 row = self.state._rows.get(node_name)

@@ -1096,10 +1096,22 @@ def wavefront_assign(
     n_groups: int = 0,
     axis_name: Optional[str] = None,
     statics=None,
+    pod_axis_name: Optional[str] = None,
 ) -> SolveResult:
     """Wave-parallel greedy solve with exact scan parity (see module
     section comment).  wave_members: i32[W, K] pod indices covering every
     batch position in solve order (-1 pads), from plan_waves.
+
+    pod_axis_name: mesh axis when called under shard_map with the POD
+    axis sharded (parallel.sharded.podsharded_wavefront_assign) — the
+    twin of the node-axis layout for wide-wave batches: node tables stay
+    replicated, wave_members arrives K-sharded, and each device runs the
+    heavy batched [K, N] evaluation only for its K/D member slice; one
+    all_gather per wave rebuilds the full [K, N] score block, after
+    which the top-(K+1), wave-safety, and O(K) mini-scan math runs
+    replicated-identically on every device (node offset 0, no
+    elections).  Placements are bit-identical to the single-shard
+    wavefront.  Mutually exclusive with axis_name.
 
     axis_name: mesh axis when called under shard_map with the NODE axis
     sharded (parallel.sharded.sharded_wavefront_assign).  The batched
@@ -1134,6 +1146,21 @@ def wavefront_assign(
     )
     offset, n_total, node_rows, node_col = _shard_layout(axis_name, n)
     wave_members = jnp.asarray(wave_members, jnp.int32)
+    if pod_axis_name is not None:
+        if axis_name is not None:
+            raise ValueError(
+                "axis_name (node shard) and pod_axis_name (pod shard) "
+                "are mutually exclusive in one wavefront call"
+            )
+        # wave_members arrives K-sharded: rebuild the full [W, K] plan
+        # once up front (shard-major reshape matches shard_map's
+        # contiguous blocks; psum of a constant folds to the static
+        # axis size, so k_dim stays a Python int)
+        d_pods = jax.lax.psum(1, pod_axis_name)
+        k_local = wave_members.shape[1]
+        wave_members = jnp.moveaxis(
+            jax.lax.all_gather(wave_members, pod_axis_name), 0, 1
+        ).reshape(wave_members.shape[0], k_local * d_pods)
     k_dim = wave_members.shape[1]
     # local and GLOBAL top-(K+1) widths: each shard's list must be wide
     # enough that the merged global list still holds the best unpicked
@@ -1209,7 +1236,30 @@ def wavefront_assign(
                 )
                 return masked, found, reason, cnt
 
-            masked_k, found_k, reason_k, cnt_k = jax.vmap(eval_one)(mk)
+            if pod_axis_name is None:
+                masked_k, found_k, reason_k, cnt_k = jax.vmap(eval_one)(mk)
+            else:
+                # pod-axis twin: each device evaluates only its K/D
+                # member slice against the replicated node tables; one
+                # all_gather rebuilds the full [K, N] block, and every
+                # shard runs the identical downstream math
+                k_loc = k_dim // d_pods
+                mk_l = jax.lax.dynamic_slice_in_dim(
+                    mk, jax.lax.axis_index(pod_axis_name) * k_loc, k_loc
+                )
+                m_l, f_l, r_l, c_l = jax.vmap(eval_one)(mk_l)
+                masked_k = jax.lax.all_gather(
+                    m_l, pod_axis_name
+                ).reshape(k_dim, -1)
+                found_k = jax.lax.all_gather(
+                    f_l, pod_axis_name
+                ).reshape(k_dim)
+                reason_k = jax.lax.all_gather(
+                    r_l, pod_axis_name
+                ).reshape(k_dim)
+                cnt_k = jax.lax.all_gather(
+                    c_l, pod_axis_name
+                ).reshape(k_dim)
             topv, topi = jax.lax.top_k(masked_k, kk)
             if axis_name is not None:
                 # merge the per-shard top-(K+1) lists into the global

@@ -31,6 +31,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -77,6 +78,11 @@ from ..ops.schema import (
     SpreadTable,
     TermTable,
     num_groups,
+)
+from ..ops.preemption import (
+    BatchDryRunResult,
+    PreemptionBatch,
+    batched_dry_run,
 )
 from ..ops.scores import DEFAULT_SCORE_CONFIG, ScoreConfig
 
@@ -588,3 +594,282 @@ def sharded_auction_jit(mesh: Mesh, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG):
 
     call.jitted = run  # raw jit, for AOT prewarm (lower().compile())
     return call
+
+
+# -- pod-axis sharding -------------------------------------------------------
+#
+# The node axis has been elastic since the mesh wrappers above; the POD
+# axis is the other long dimension of a 12k+ pods/s burst, and three
+# kernels are wide on it: the wavefront's per-wave [K, N] evaluation
+# (K members per wave), and the PostFilter pass's [P, N] batched
+# dry-run / static-feasibility sweeps.  These twins shard THAT axis:
+# node tensors stay replicated (they fit — the node mesh exists for the
+# opposite regime), each device evaluates its contiguous pod/member
+# block, and the only boundary crossing is one all_gather of the
+# per-pod result rows.  Placements are bit-identical to the
+# single-shard kernels: the wavefront runs its top-k/mini-scan math
+# replicated after the gather (see wavefront_assign's pod_axis_name
+# docstring), and the preemption kernels are pod-row independent, so a
+# row block computed locally IS the global row slice.
+
+POD_AXIS = "pods"
+
+
+def make_pod_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()[: n_devices or len(jax.devices())]
+    return Mesh(devices, (POD_AXIS,))
+
+
+def _check_divisible_pods(p: int, mesh: Mesh, what: str) -> None:
+    n_dev = mesh.devices.size
+    if p % n_dev:
+        raise ValueError(
+            f"{what} {p} not divisible by pod-mesh size {n_dev}"
+        )
+
+
+def pad_wave_columns(wave_members, mesh: Mesh) -> np.ndarray:
+    """Pad the wave plan's member axis with -1 columns to a multiple of
+    the pod-mesh size.  -1 members are the same inert pads plan_waves
+    already emits for ragged waves — masked out of every eval, dropped
+    by the out-of-bounds final scatter — so padded plans place
+    identically to the originals."""
+    members = np.asarray(wave_members, np.int32)
+    d = mesh.devices.size
+    pad = (-members.shape[1]) % d
+    if pad:
+        members = np.concatenate(
+            [members, np.full((members.shape[0], pad), -1, np.int32)],
+            axis=1,
+        )
+    return members
+
+
+def podsharded_wavefront_assign(
+    snapshot: Snapshot,
+    wave_members,
+    mesh: Mesh,
+    cfg: ScoreConfig = DEFAULT_SCORE_CONFIG,
+    topo_z: Optional[int] = None,
+    features: Optional[FeatureFlags] = None,
+    n_groups: int = 0,
+    statics: Optional[ClassStatics] = None,
+) -> SolveResult:
+    """wavefront_assign with the WAVE-MEMBER axis sharded over `mesh` —
+    the twin of sharded_wavefront_assign for the wide-batch/modest-node
+    regime, where waves are K-wide but every chip can hold the full
+    cluster: each device evaluates K/D members per wave against the
+    replicated node tables, one all_gather per wave rebuilds the [K, N]
+    score block, and the candidate merge / wave-safety / mini-scan math
+    runs replicated-identically everywhere (no elections, node offset
+    0).  Pads the member axis with inert -1 columns when K is not
+    divisible by the mesh size.  Placements are bit-identical to the
+    single-chip wavefront."""
+    if features is None:
+        features = features_of(snapshot)
+    if topo_z is None:
+        topo_z = required_topo_z(snapshot)
+    parts = jax.tree.map(jnp.asarray, tuple(snapshot))
+    # pad with jnp so the wrapper also traces under the jitted dispatch
+    # (the K axis is static, so the pad width is a Python int either way)
+    members = jnp.asarray(wave_members, jnp.int32)
+    pad = (-members.shape[1]) % mesh.devices.size
+    if pad:
+        members = jnp.concatenate(
+            [
+                members,
+                jnp.full((members.shape[0], pad), -1, jnp.int32),
+            ],
+            axis=1,
+        )
+
+    rep = P()
+    rep_parts = tuple(jax.tree.map(lambda _: rep, part) for part in parts)
+    rep_cluster = ClusterTensors(*([rep] * len(CLUSTER_SPECS)))
+    out_specs = SolveResult(
+        assignment=rep, scores=rep, feasible_counts=rep,
+        cluster=rep_cluster, reasons=rep, wave_count=rep,
+        wave_fallbacks=rep,
+    )
+
+    if statics is None:
+
+        @partial(
+            _shard_map,
+            mesh=mesh,
+            in_specs=rep_parts + (P(None, POD_AXIS),),
+            out_specs=out_specs,
+            check_vma=False,
+        )
+        def run(cl, pods, sel, pref, spread, terms, prefpod, images, mem):
+            local = Snapshot(
+                cl, pods, sel, pref, spread, terms, prefpod, images
+            )
+            return wavefront_assign(
+                local, mem, cfg, topo_z=topo_z, features=features,
+                n_groups=n_groups, pod_axis_name=POD_AXIS,
+            )
+
+        return run(*parts, members)
+
+    statics_rep = ClassStatics(sfeas=rep, aff=rep, taint=rep)
+
+    @partial(
+        _shard_map,
+        mesh=mesh,
+        in_specs=rep_parts + (P(None, POD_AXIS), statics_rep),
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    def run_warm(cl, pods, sel, pref, spread, terms, prefpod, images, mem, st):
+        local = Snapshot(cl, pods, sel, pref, spread, terms, prefpod, images)
+        return wavefront_assign(
+            local, mem, cfg, topo_z=topo_z, features=features,
+            n_groups=n_groups, pod_axis_name=POD_AXIS, statics=st,
+        )
+
+    return run_warm(*parts, members, jax.tree.map(jnp.asarray, statics))
+
+
+def podsharded_wavefront_jit(
+    mesh: Mesh, cfg: ScoreConfig = DEFAULT_SCORE_CONFIG
+):
+    """Jitted pod-sharded wavefront: one executable per (shape bucket,
+    topo_z, features, n_groups, wave shape, mesh shape), same discipline
+    as sharded_wavefront_jit."""
+    mesh_sig = mesh_signature(mesh)
+
+    @partial(jax.jit, static_argnums=(2, 3, 4))
+    def run(
+        snapshot: Snapshot, wave_members, topo_z: int,
+        features: FeatureFlags, n_groups: int,
+    ) -> SolveResult:
+        return podsharded_wavefront_assign(
+            snapshot, wave_members, mesh, cfg, topo_z=topo_z,
+            features=features, n_groups=n_groups,
+        )
+
+    def call(
+        snapshot: Snapshot,
+        wave_members=None,
+        topo_z: Optional[int] = None,
+        features: Optional[FeatureFlags] = None,
+        n_groups: Optional[int] = None,
+        wave_cap: int = DEFAULT_WAVE_CAP,
+    ) -> SolveResult:
+        if features is None:
+            features = features_of(snapshot)
+        if topo_z is None:
+            topo_z = (
+                required_topo_z(snapshot) if needs_topo(features) else 1
+            )
+        if n_groups is None:
+            n_groups = num_groups(snapshot)
+        if n_groups > 0:
+            from ..utils.vocab import pad_dim
+
+            n_groups = pad_dim(n_groups, 1)
+        if wave_members is None:
+            wave_members = plan_waves(
+                snapshot, features=features, wave_cap=wave_cap
+            ).members
+        members = jnp.asarray(pad_wave_columns(wave_members, mesh))
+        out = run(snapshot, members, topo_z, features, n_groups)
+        retrace.note(
+            "wavefront-podsharded", run,
+            lambda: retrace.signature(
+                (snapshot, members), (topo_z, features, n_groups, mesh_sig)
+            ),
+        )
+        return out
+
+    call.jitted = run  # raw jit, for AOT prewarm (lower().compile())
+    return call
+
+
+def sharded_batched_dry_run(
+    batch: PreemptionBatch, mesh: Mesh
+) -> BatchDryRunResult:
+    """batched_dry_run with the PREEMPTOR axis sharded over `mesh`: the
+    per-node victim tensors (free/victim_req/perm/elig_len/viol) stay
+    replicated — each shard redundantly recomputes the per-LEVEL
+    cumulative eviction tensors, which are shared across pods anyway —
+    and the [P, N, K+1] broadcast fit test, the dominant term, runs on
+    P/D pod rows per device.  Every row is computed exactly as in the
+    single-shard kernel (pure per-pod gathers), so the stitched [P, N]
+    result is bit-identical."""
+    parts = jax.tree.map(jnp.asarray, batch)
+    _check_divisible_pods(
+        int(parts.pods_req.shape[0]), mesh, "preemptor count"
+    )
+
+    rep = P()
+    in_specs = PreemptionBatch(
+        free=rep, victim_req=rep, perm=rep, elig_len=rep, viol=rep,
+        pods_req=P(POD_AXIS, None), pod_level=P(POD_AXIS),
+    )
+    out_specs = BatchDryRunResult(
+        feasible=P(POD_AXIS, None), min_k=P(POD_AXIS, None),
+        viol_k=P(POD_AXIS, None),
+    )
+
+    @partial(
+        _shard_map,
+        mesh=mesh,
+        in_specs=(in_specs,),
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    def run(b):
+        return batched_dry_run(b)
+
+    return run(parts)
+
+
+def sharded_static_feasible_batch(
+    cluster, pods, selectors, mesh: Mesh
+) -> jnp.ndarray:
+    """static_feasible_batch with the preemptor axis sharded: the
+    PodBatch stays replicated (pod views gather class/spec rows from
+    shared tables, so slicing the structure itself would tear them) and
+    each device evaluates its contiguous index block, axis_index-offset
+    into the global pod range.  Output rows are bit-identical to the
+    single-shard sweep."""
+    from ..ops.filters import (
+        pod_view,
+        selector_match,
+        static_feasible_for_pod,
+    )
+
+    p = int(pods.req.shape[0])
+    _check_divisible_pods(p, mesh, "preemptor count")
+    p_local = p // mesh.devices.size
+
+    rep = P()
+    in_specs = tuple(
+        jax.tree.map(lambda _: rep, part)
+        for part in (cluster, pods, selectors)
+    )
+
+    @partial(
+        _shard_map,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=P(POD_AXIS, None),
+        check_vma=False,
+    )
+    def run(cl, pd, sel):
+        sel_mask = selector_match(cl, sel)
+        i0 = jax.lax.axis_index(POD_AXIS) * p_local
+
+        def one(i):
+            return static_feasible_for_pod(cl, pod_view(pd, i), sel_mask)
+
+        return jax.vmap(one)(i0 + jnp.arange(p_local, dtype=jnp.int32))
+
+    return run(
+        jax.tree.map(jnp.asarray, cluster),
+        jax.tree.map(jnp.asarray, pods),
+        jax.tree.map(jnp.asarray, selectors),
+    )

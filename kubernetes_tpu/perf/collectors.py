@@ -258,6 +258,13 @@ class MetricsCollector:
         "scheduler_lane_count",
         "scheduler_speculative_solves_total",
         "scheduler_misspeculation_total",
+        # columnar host plane: encode throughput, framed journal bytes,
+        # fan-out chunking, and the c6s ramp knee
+        # (docs/scheduler_loop.md host plane section)
+        "scheduler_encode_rows_per_s",
+        "scheduler_journal_frame_bytes",
+        "scheduler_fanout_chunk_size",
+        "scheduler_c6s_arrival_knee_pods_per_s",
         # graftsched: interleaving schedules explored / yield points
         # scheduled (analysis/interleave.py) and static atomicity
         # findings at the last mirrored run (docs/static_analysis.md)

@@ -427,6 +427,22 @@ class Registry:
         self.gang_contiguous_placements = Counter(
             "scheduler_gang_contiguous_placements_total"
         )
+        # -- columnar host plane (docs/scheduler_loop.md host plane) -------
+        # pod rows encoded per second by the most recent snapshot build
+        # (the columnar spec-row fast path; the host encode's share of
+        # the sustained-rate budget)
+        self.encode_rows_per_s = Gauge("scheduler_encode_rows_per_s")
+        # running bytes of framed journal writes (one serialization +
+        # one crc + one write/fsync per commit sub-wave), store mirror
+        self.journal_frame_bytes = Gauge("scheduler_journal_frame_bytes")
+        # mean events per watch fan-out chunk (batched per-watcher
+        # hand-off under one publish-lock hold), store mirror
+        self.fanout_chunk_size = Gauge("scheduler_fanout_chunk_size")
+        # the c6s ramp hunt's capacity knee: highest arrival rate whose
+        # backlog stayed bounded (0 until a ramp-mode bench run sets it)
+        self.c6s_arrival_knee = Gauge(
+            "scheduler_c6s_arrival_knee_pods_per_s"
+        )
         # -- graftsched surface (docs/static_analysis.md) ------------------
         # deterministic interleaving schedules explored and yield points
         # scheduled across them (analysis/interleave.py TOTALS, mirrored

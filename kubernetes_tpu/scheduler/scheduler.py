@@ -1614,6 +1614,27 @@ class Scheduler:
             self.metrics.partials_rollbacks.set(
                 float(sum(s["rollbacks"] for s in p_stats))
             )
+        # columnar host plane: encode throughput of the most recent
+        # snapshot build (summed across profiles would double-count the
+        # shared builder — the max is the live figure), framed journal
+        # bytes and mean fan-out chunk size mirrored from the store
+        enc = max(
+            (
+                getattr(fwk.tpu, "last_encode_rows_per_s", 0.0)
+                for fwk in self.profiles
+            ),
+            default=0.0,
+        )
+        if enc:
+            self.metrics.encode_rows_per_s.set(float(enc))
+        frame_bytes = getattr(self.store, "journal_frame_bytes", None)
+        if frame_bytes is not None:
+            self.metrics.journal_frame_bytes.set(float(frame_bytes))
+        chunks = getattr(self.store, "fanout_chunks", 0)
+        if chunks:
+            self.metrics.fanout_chunk_size.set(
+                float(self.store.fanout_chunk_events) / float(chunks)
+            )
         recovered = getattr(self.store, "journal_recovered_records", None)
         if recovered is not None:
             self.metrics.journal_recovered_records.set(float(recovered))

@@ -48,6 +48,30 @@ class Vocab:
     def get(self, item: Hashable, default: int = -1) -> int:
         return self._ids.get(item, default)
 
+    def intern_many(self, items: Sequence[Hashable]) -> np.ndarray:
+        """Bulk intern: one pass, one returned id vector (int32).  Ids
+        are assigned in item order, identical to looping intern() —
+        this is the columnar encode's batch interning primitive, hoisting
+        the per-call overhead out of hot per-object loops."""
+        get = self._ids.get
+        out = np.empty(len(items), dtype=np.int32)
+        for j, item in enumerate(items):
+            i = get(item)
+            if i is None:
+                i = self.intern(item)
+            out[j] = i
+        return out
+
+    def get_many(self, items: Sequence[Hashable], default: int = -1) -> np.ndarray:
+        """Bulk lookup without growth: int32 id vector, `default` where
+        absent."""
+        get = self._ids.get
+        return np.fromiter(
+            (get(item, default) for item in items),
+            dtype=np.int32,
+            count=len(items),
+        )
+
     def alias(self, item: Hashable, ident: int) -> None:
         """Map an additional name onto an existing id (image tags/digests
         aliasing one image).  Does not grow the id space."""

@@ -1,0 +1,123 @@
+/* _hostplane: optional CPython extension for the columnar host plane's
+ * byte-level hot paths — journal frame trailer splice + CRC, and the
+ * proto transport's length-prefix wire framing.
+ *
+ * kubernetes_tpu/api/framing.py is the contract: it holds the pure
+ * Python reference implementations and falls back to them whenever
+ * this module is absent, so the extension is a pure accelerator —
+ * every function here must be byte-identical to its Python twin
+ * (tests/test_journal_framing.py asserts that when the module is
+ * importable).
+ *
+ * Build (no dependencies beyond the CPython headers; CRC-32 is the
+ * self-contained IEEE/zlib polynomial so we never link zlib):
+ *   make native-ext        # top-level Makefile, skips without a compiler
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <stdint.h>
+#include <string.h>
+
+/* zlib-compatible CRC-32 (reflected 0xEDB88320), table generated once. */
+static uint32_t crc_table[256];
+static int crc_table_ready = 0;
+
+static void crc_init(void) {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+  crc_table_ready = 1;
+}
+
+static uint32_t crc32_ieee(const unsigned char *buf, Py_ssize_t len) {
+  if (!crc_table_ready) crc_init();
+  uint32_t c = 0xFFFFFFFFu;
+  for (Py_ssize_t i = 0; i < len; i++)
+    c = crc_table[(c ^ buf[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+/* crc32(data: bytes) -> int  (zlib.crc32 twin) */
+static PyObject *hp_crc32(PyObject *self, PyObject *args) {
+  Py_buffer view;
+  if (!PyArg_ParseTuple(args, "y*", &view)) return NULL;
+  uint32_t c = crc32_ieee((const unsigned char *)view.buf, view.len);
+  PyBuffer_Release(&view);
+  return PyLong_FromUnsignedLong(c);
+}
+
+/* crc_line(s: bytes) -> bytes
+ * Splice the CRC trailer onto a serialized JSON object in one pass:
+ *   b'{...}'  ->  b'{..., "crc": N}\n'
+ * Byte-identical to framing.crc_line / store._encode_record's trailer. */
+static PyObject *hp_crc_line(PyObject *self, PyObject *args) {
+  Py_buffer view;
+  if (!PyArg_ParseTuple(args, "y*", &view)) return NULL;
+  if (view.len < 2) {
+    PyBuffer_Release(&view);
+    PyErr_SetString(PyExc_ValueError, "not a serialized JSON object");
+    return NULL;
+  }
+  uint32_t c = crc32_ieee((const unsigned char *)view.buf, view.len);
+  char trailer[32];
+  int tn = snprintf(trailer, sizeof(trailer), ", \"crc\": %u}\n", c);
+  PyObject *out = PyBytes_FromStringAndSize(NULL, view.len - 1 + tn);
+  if (out == NULL) {
+    PyBuffer_Release(&view);
+    return NULL;
+  }
+  char *dst = PyBytes_AS_STRING(out);
+  memcpy(dst, view.buf, (size_t)(view.len - 1)); /* drop closing '}' */
+  memcpy(dst + view.len - 1, trailer, (size_t)tn);
+  PyBuffer_Release(&view);
+  return out;
+}
+
+/* length_prefix(payload: bytes) -> bytes
+ * 4-byte big-endian length header + payload (the proto transport's
+ * framing; native/proto_client.cpp speaks the same header). */
+static PyObject *hp_length_prefix(PyObject *self, PyObject *args) {
+  Py_buffer view;
+  if (!PyArg_ParseTuple(args, "y*", &view)) return NULL;
+  if (view.len > (Py_ssize_t)0xFFFFFFFF) {
+    PyBuffer_Release(&view);
+    PyErr_SetString(PyExc_OverflowError, "payload exceeds u32 framing");
+    return NULL;
+  }
+  PyObject *out = PyBytes_FromStringAndSize(NULL, view.len + 4);
+  if (out == NULL) {
+    PyBuffer_Release(&view);
+    return NULL;
+  }
+  unsigned char *dst = (unsigned char *)PyBytes_AS_STRING(out);
+  uint32_t n = (uint32_t)view.len;
+  dst[0] = (unsigned char)(n >> 24);
+  dst[1] = (unsigned char)(n >> 16);
+  dst[2] = (unsigned char)(n >> 8);
+  dst[3] = (unsigned char)(n);
+  memcpy(dst + 4, view.buf, (size_t)view.len);
+  PyBuffer_Release(&view);
+  return out;
+}
+
+static PyMethodDef hp_methods[] = {
+    {"crc32", hp_crc32, METH_VARARGS, "zlib-compatible CRC-32"},
+    {"crc_line", hp_crc_line, METH_VARARGS,
+     "splice the journal CRC trailer onto a serialized JSON object"},
+    {"length_prefix", hp_length_prefix, METH_VARARGS,
+     "u32 big-endian length framing for the proto transport"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef hp_module = {
+    PyModuleDef_HEAD_INIT, "_hostplane",
+    "byte-level host-plane hot paths (journal framing, wire framing)",
+    -1, hp_methods,
+};
+
+PyMODINIT_FUNC PyInit__hostplane(void) { return PyModule_Create(&hp_module); }

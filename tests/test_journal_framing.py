@@ -1,0 +1,286 @@
+"""Batched journal framing (api/framing.py): crash-replay parity with
+the per-line journal, upgrade-path interleaving with legacy records,
+frame atomicity under corruption/truncation, and native/pure codec
+byte-identity.
+
+The frame is the tentpole's durability half: one line + one CRC pass
+per commit sub-wave.  Its replay contract is the PR 8 wave-atomicity
+contract verbatim — a damaged frame drops WHOLE, never half-applies —
+and legacy per-line waves (and pre-CRC lines) must keep replaying
+forever, interleaved freely with frames.
+"""
+
+import json
+import os
+import zlib
+
+import pytest
+
+from kubernetes_tpu.api import framing
+from kubernetes_tpu.api import store as st
+from kubernetes_tpu.testing.wrappers import GI, make_node, make_pod
+
+
+def _binder(node):
+    def mutate(pod):
+        pod.spec.node_name = node
+        pod.status.phase = "Running"
+
+    return mutate
+
+
+def _wave_store(path, n_pods=4, framing_on=True, shards=1):
+    s = st.Store(journal_path=path, shards=shards,
+                 journal_framing=framing_on)
+    s.create(make_node("n0").capacity(cpu_milli=64000, mem=64 * GI).obj())
+    for i in range(n_pods):
+        s.create(make_pod(f"p{i}").req(cpu_milli=100).obj())
+    applied, errors = s.update_wave(
+        "Pod", [(f"p{i}", "default", _binder("n0")) for i in range(n_pods)]
+    )
+    assert len(applied) == n_pods and not errors
+    return s
+
+
+def _fp(s):
+    return s.state_fingerprint()
+
+
+# -- crash-replay parity: framed vs per-line ---------------------------------
+
+
+def test_framed_replay_matches_per_line_oracle(tmp_path):
+    """The same write sequence journaled as frames and as per-line wave
+    records recovers to the identical store state."""
+    pf = str(tmp_path / "framed.jsonl")
+    pl = str(tmp_path / "lines.jsonl")
+    sf = _wave_store(pf, framing_on=True)
+    sl = _wave_store(pl, framing_on=False)
+    assert sf.journal_frames >= 1
+    assert sl.journal_frames == 0
+    want_f, want_l = _fp(sf), _fp(sl)
+
+    def bindings(s):
+        return {
+            p.meta.name: (p.spec.node_name, p.status.phase)
+            for p in s.list("Pod")[0]
+        }
+
+    rf = st.Store(journal_path=pf, shards=1)
+    rl = st.Store(journal_path=pl, shards=1)
+    # each journal recovers to ITS pre-crash state bit-for-bit
+    assert _fp(rf) == want_f
+    assert _fp(rl) == want_l
+    # and the two recoveries agree on the scheduling-visible state
+    # (fingerprints differ only in creation timestamps)
+    assert bindings(rf) == bindings(rl)
+    assert rf._rv == rl._rv
+
+
+def test_frame_is_one_journal_line(tmp_path):
+    """A framed sub-wave is ONE line carrying every record + one crc."""
+    path = str(tmp_path / "j.jsonl")
+    s = _wave_store(path, n_pods=8)
+    s.close()
+    waves = [
+        json.loads(ln) for ln in open(path)
+        if '"f":' in ln or '"w":' in ln
+    ]
+    frames = [w for w in waves if framing.is_frame(w)]
+    assert len(frames) == 1
+    assert len(frames[0]["recs"]) == 8
+    assert isinstance(frames[0]["crc"], int)
+
+
+def test_upgrade_path_legacy_then_framed_interleaved(tmp_path):
+    """A journal holding legacy per-line waves, pre-CRC lines, AND new
+    frames replays completely — the upgrade path never strands an old
+    journal."""
+    path = str(tmp_path / "j.jsonl")
+    s1 = _wave_store(path, n_pods=3, framing_on=False)  # legacy waves
+    s1.close()
+    # hand-append a pre-CRC record (the oldest format: no crc field)
+    rec = {"op": "ADDED", "rv": s1._rv + 1, "kind": "ConfigMap",
+           "key": "default/old", "obj": {
+               "kind": "ConfigMap",
+               "meta": {"name": "old", "namespace": "default"}}}
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    # reopen WITH framing and write a framed wave on top
+    s2 = st.Store(journal_path=path, shards=1, journal_framing=True)
+    assert s2.get("ConfigMap", "old") is not None  # pre-CRC line applied
+    for i in range(3, 6):
+        s2.create(make_pod(f"p{i}").req(cpu_milli=100).obj())
+    applied, errors = s2.update_wave(
+        "Pod", [(f"p{i}", "default", _binder("n0")) for i in range(3, 6)]
+    )
+    assert len(applied) == 3 and not errors
+    want = _fp(s2)
+    s2.close()
+    s3 = st.Store(journal_path=path, shards=1)
+    assert _fp(s3) == want
+    bound = {p.meta.name for p in s3.list("Pod")[0] if p.spec.node_name}
+    assert bound == {f"p{i}" for i in range(6)}
+
+
+# -- corruption / truncation of the new framing ------------------------------
+
+
+def test_torn_frame_tail_dropped_whole(tmp_path):
+    """A frame torn mid-line (the crash-mid-append case) replays as if
+    the wave never happened: nothing half-applied, journal truncated
+    back to the frame's start, appends continue cleanly."""
+    path = str(tmp_path / "j.jsonl")
+    _wave_store(path).close()
+    raw = open(path, "rb").read()
+    lines = raw.splitlines(keepends=True)
+    assert b'"recs"' in lines[-1]
+    torn = b"".join(lines[:-1]) + lines[-1][: len(lines[-1]) // 2]
+    with open(path, "wb") as f:
+        f.write(torn)
+    s2 = st.Store(journal_path=path, shards=1)
+    assert all(not p.spec.node_name for p in s2.list("Pod")[0])
+    assert s2.journal_tail_truncations == 1
+    s2.create(make_pod("later").obj())
+    s3 = st.Store(journal_path=path, shards=1)
+    assert s3.journal_tail_truncations == 0
+    assert "later" in {p.meta.name for p in s3.list("Pod")[0]}
+
+
+def test_corrupt_frame_mid_file_dropped_whole_keeps_later(tmp_path):
+    """Mid-file frame damage that still parses as JSON (bit flip inside
+    a string) fails the frame CRC: the wave drops WHOLE, is counted as
+    a torn wave, and later acknowledged records survive."""
+    path = str(tmp_path / "j.jsonl")
+    s = _wave_store(path)
+    s.create(make_pod("after").obj())
+    s.close()
+    lines = open(path, "rb").read().splitlines(keepends=True)
+    # the frame is the second-to-last line ("after" follows it)
+    assert b'"recs"' in lines[-2]
+    lines[-2] = lines[-2].replace(b"Running", b"Runnimg", 1)
+    with open(path, "wb") as f:
+        f.writelines(lines)
+    s2 = st.Store(journal_path=path, shards=1)
+    names = {p.meta.name for p in s2.list("Pod")[0]}
+    assert "after" in names, "record after the corrupt frame was lost"
+    assert all(not p.spec.node_name for p in s2.list("Pod")[0]), (
+        "corrupt frame was half-applied"
+    )
+    assert s2.journal_torn_waves == 1
+
+
+def test_crcless_frame_rejected(tmp_path):
+    """`_record_crc_ok`'s crc-less acceptance is an upgrade path for
+    PRE-CRC journals only — a frame stripped of its crc must NOT ride
+    through that hole (no pre-CRC journal can contain a frame)."""
+    path = str(tmp_path / "j.jsonl")
+    _wave_store(path).close()
+    lines = open(path, "rb").read().splitlines(keepends=True)
+    frame = json.loads(lines[-1])
+    assert framing.is_frame(frame)
+    frame.pop("crc")
+    lines[-1] = (json.dumps(frame) + "\n").encode()
+    with open(path, "wb") as f:
+        f.writelines(lines)
+    s2 = st.Store(journal_path=path, shards=1)
+    assert all(not p.spec.node_name for p in s2.list("Pod")[0]), (
+        "crc-less frame slipped through the legacy acceptance"
+    )
+    # while plain crc-less records (the real upgrade path) still apply
+    assert {p.meta.name for p in s2.list("Pod")[0]} == {
+        f"p{i}" for i in range(4)
+    }
+
+
+def test_framed_waves_replay_across_shards(tmp_path):
+    """Frames carry per-shard wave ids; a multi-shard store's framed
+    journals recover shard-independently to the pre-crash state."""
+    path = str(tmp_path / "j.jsonl")
+    s = st.Store(journal_path=path, shards=4, journal_framing=True)
+    s.create(make_node("n0").capacity(cpu_milli=64000, mem=64 * GI).obj())
+    for ns in ("a", "b", "c"):
+        for i in range(4):
+            s.create(make_pod(f"p{i}", namespace=ns).req(cpu_milli=10).obj())
+    for ns in ("a", "b", "c"):
+        applied, errors = s.update_wave(
+            "Pod", [(f"p{i}", ns, _binder("n0")) for i in range(4)]
+        )
+        assert len(applied) == 4 and not errors
+    want = _fp(s)
+    s.close()
+    s2 = st.Store(journal_path=path)
+    assert _fp(s2) == want
+
+
+# -- batched fan-out ---------------------------------------------------------
+
+
+def test_fanout_chunks_deliver_wave_intact(tmp_path):
+    """The chunked fan-out (_offer_batch under one Watch._mu) delivers
+    every event of a wave in order, and the chunk accounting moves."""
+    s = st.Store(shards=1)
+    s.create(make_node("n0").capacity(cpu_milli=64000, mem=64 * GI).obj())
+    for i in range(16):
+        s.create(make_pod(f"p{i}").req(cpu_milli=10).obj())
+    w = s.watch("Pod")
+    applied, errors = s.update_wave(
+        "Pod", [(f"p{i}", "default", _binder("n0")) for i in range(16)]
+    )
+    assert len(applied) == 16 and not errors
+    seen = []
+    for _ in range(16):
+        ev = w.get(timeout=5.0)
+        assert ev is not None
+        seen.append(ev.obj.meta.name)
+    assert sorted(seen) == sorted(f"p{i}" for i in range(16))
+    assert w._last_rv == s._rv
+    stats = s.watch_stats()
+    assert s.fanout_chunks > 0
+    assert s.fanout_chunk_events >= 16
+    assert stats["watchers_terminated"] == 0
+    w.stop()
+    s.close()
+
+
+# -- codec: native extension vs pure Python ----------------------------------
+
+
+def test_frame_codec_pure_python_roundtrip():
+    recs = [{"op": "ADDED", "rv": i, "kind": "Pod", "key": f"d/p{i}"}
+            for i in range(5)]
+    line = framing.encode_frame(7, recs)
+    assert line.endswith("}\n")
+    rec = json.loads(line)
+    crc = rec.pop("crc")
+    assert framing.is_frame(rec)
+    assert framing.frame_crc_ok(rec, crc)
+    assert not framing.frame_crc_ok(rec, None)   # crc mandatory on frames
+    assert not framing.frame_crc_ok(rec, crc ^ 1)
+    assert rec["w"] == 7 and rec["recs"] == recs
+
+
+def test_native_extension_byte_identity():
+    """When _hostplane is importable its outputs must be byte-identical
+    to the pure-Python contract (it is a pure accelerator)."""
+    if not framing.native_available():
+        pytest.skip("_hostplane not built (pure-Python fallback active)")
+    import _hostplane
+
+    s = json.dumps({"f": 1, "w": 9, "recs": [{"op": "ADDED", "rv": 1,
+                                              "kind": "Pod", "key": "a/b"}]})
+    pure = '%s, "crc": %d}\n' % (s[:-1], zlib.crc32(s.encode()))
+    assert _hostplane.crc_line(s.encode()).decode() == pure
+    assert _hostplane.crc32(s.encode()) == zlib.crc32(s.encode())
+    payload = b"\x01\x02\x03\x04payload"
+    assert _hostplane.length_prefix(payload) == (
+        len(payload).to_bytes(4, "big") + payload
+    )
+
+
+def test_length_prefix_split_roundtrip():
+    msgs = [b"alpha", b"", b"x" * 1000]
+    buf = b"".join(framing.length_prefix(m) for m in msgs)
+    out, rest = framing.split_length_prefixed(buf + b"\x00\x00")
+    assert out == msgs
+    assert rest == b"\x00\x00"

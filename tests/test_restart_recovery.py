@@ -132,7 +132,12 @@ def _binder(node):
 
 
 def _setup_wave_journal(path, n_pods=4):
-    s = st.Store(journal_path=path, shards=1)
+    # legacy per-line wave format: these tests perform line-level
+    # surgery on the wave's individual records, which only exist
+    # pre-framing (framed waves are one line; tests/test_journal_framing
+    # covers their torn/corrupt variants).  Replay must accept this
+    # format forever regardless of the writer's framing flag.
+    s = st.Store(journal_path=path, shards=1, journal_framing=False)
     s.create(make_node("n0").capacity(cpu_milli=8000, mem=16 * GI).obj())
     for i in range(n_pods):
         s.create(make_pod(f"p{i}").req(cpu_milli=100).obj())
