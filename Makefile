@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test chaos chaos-restart bench lint lint-shapes multichip race \
+.PHONY: test chaos chaos-restart chaos-serving bench lint lint-shapes multichip race \
 	native-ext test-journal
 
 # graftlint: the project-native static analysis suite (guarded-by,
@@ -58,6 +58,17 @@ chaos:
 # recovery bit-identical to a full-journal-replay oracle
 chaos-restart:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -m restart -q \
+		-p no:cacheprovider
+
+# the serving-plane subset (SERVING_SEEDS = range(900, 910) plus the
+# journal-frame native/fallback parity seed and the pod-axis breaker
+# fallback): pods created THROUGH the read-replica HTTP plane under
+# injected request failures, torn watch frames and admission stalls,
+# with a replica killed and restarted mid-run — no watcher terminated,
+# no pinned handler thread, informer caches converge on the store's
+# bindings exactly once
+chaos-serving:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -m serving -q \
 		-p no:cacheprovider
 
 # the sharded multichip suite on a FORCED 8-device host-platform mesh:

@@ -1927,6 +1927,68 @@ def _c12_live_phase():
     }
 
 
+# c13 serving-fleet gates (BENCH_STRICT=1): the serving plane must hold
+# ≥1000 concurrent multiplexed HTTP informers over ≥2 read replicas, a
+# mid-soak replica kill must recover (every stream failed over and
+# caught up on a post-kill marker) inside the shared restart budget
+# with NO wedged watcher, delivery must stay rv-monotonic per shard
+# segment with zero lost pods and zero double binds, and p99
+# watch-delivery latency is always reported.
+STRICT_SERVING_INFORMERS = 1_000
+STRICT_SERVING_REPLICAS = 2
+STRICT_SERVING_SOAK_PODS = 4_096
+
+
+def config13():
+    """c13: the fleet-scale serving plane — an APIServerReplicaSet over
+    the sharded store, a thousand informers multiplexed over HTTP
+    (client/watchmux.py, a few selector loops instead of a thousand
+    threads), pods created THROUGH the HTTP path and bound via the
+    store's wave path while hollow kubelets run them, and a mid-soak
+    replica kill + restart.  Measures p99 watch-delivery latency
+    (create-call → event delivery), failover/recovery health, and the
+    adaptive-APF serving gauges the scheduler mirrors.
+
+    Env knobs (smoke-scale a laptop run):
+      BENCH_C13_INFORMERS=<n>  informer count   (default 1000)
+      BENCH_C13_REPLICAS=<n>   replica count    (default 2)
+      BENCH_C13_PODS=<n>       soak pods        (default 4096)
+    """
+    from kubernetes_tpu import kubemark
+    from kubernetes_tpu.api import store as st
+
+    informers = int(
+        os.environ.get("BENCH_C13_INFORMERS", STRICT_SERVING_INFORMERS)
+    )
+    replicas = int(
+        os.environ.get("BENCH_C13_REPLICAS", STRICT_SERVING_REPLICAS)
+    )
+    soak_pods = int(
+        os.environ.get("BENCH_C13_PODS", STRICT_SERVING_SOAK_PODS)
+    )
+    store = st.Store(shards=8)
+    fleet = kubemark.FleetHarness(
+        store, n_nodes=256, namespaces=8, heartbeat_interval=60.0,
+        bind_concurrency=4,
+    )
+    fleet.start()
+    terminated0 = store.watchers_terminated
+    try:
+        report = fleet.serve(
+            replicas=replicas,
+            informers=informers,
+            soak_pods=soak_pods,
+            round_pods=min(1_024, soak_pods),
+            recovery_budget_s=STRICT_RECOVERY_BUDGET_MS / 1000.0,
+        )
+    finally:
+        fleet.stop()
+    report["watchers_terminated"] = (
+        store.watchers_terminated - terminated0
+    )
+    return report
+
+
 def main() -> None:
     import sys
 
@@ -1958,6 +2020,7 @@ def main() -> None:
             "c10_slice_pack": config10(),
             "c11_incremental_churn": config11(),
             "c12_autoscale_churn": config12(),
+            "c13_serving_fleet": config13(),
         }
     # every over-threshold schedule_batch cycle, with its per-step share
     # (commit- and solve-share per step are readable straight off the
@@ -2250,6 +2313,47 @@ def main() -> None:
                 "c12 live autoscale crossing never took the in-place "
                 "grow path (0 grow syncs)"
             )
+        # serving-plane gates: the replica-set soak must run at fleet
+        # scale (>=1000 informers over >=2 replicas), the mid-soak
+        # replica kill must recover inside the shared restart budget
+        # with no wedged watcher, delivery must stay rv-monotonic with
+        # zero lost pods / double binds, and p99 delivery latency must
+        # be reported (NaN-free) for the SLO trendline
+        c13 = extra["c13_serving_fleet"]
+        if (
+            c13["informers"] < STRICT_SERVING_INFORMERS
+            or c13["replicas"] < STRICT_SERVING_REPLICAS
+        ):
+            failures.append(
+                f"c13 ran under scale: {c13['informers']} informers / "
+                f"{c13['replicas']} replicas < "
+                f"{STRICT_SERVING_INFORMERS}/{STRICT_SERVING_REPLICAS}"
+            )
+        if c13["recovery_ms"] is None:
+            failures.append("c13 never exercised the mid-soak replica kill")
+        elif c13["recovery_ms"] > STRICT_RECOVERY_BUDGET_MS:
+            failures.append(
+                f"c13 replica-kill recovery over budget: "
+                f"{c13['recovery_ms']}ms > {STRICT_RECOVERY_BUDGET_MS}ms"
+            )
+        if c13["wedged_watchers"]:
+            failures.append(
+                f"c13 left {c13['wedged_watchers']} watcher(s) wedged "
+                "after the replica kill"
+            )
+        if c13["rv_violations"]:
+            failures.append(
+                f"c13 rv-monotonic delivery violated {c13['rv_violations']}"
+                " time(s)"
+            )
+        if c13["lost_watch_pods"] or c13["double_bound_pods"]:
+            failures.append(
+                f"c13 lost {c13['lost_watch_pods']} pod(s) / "
+                f"double-bound {c13['double_bound_pods']} through the "
+                "serving path"
+            )
+        if not (c13["watch_p99_ms"] == c13["watch_p99_ms"]):
+            failures.append("c13 p99 watch-delivery latency not measured")
         if failures:
             print("BENCH_STRICT: " + "; ".join(failures), file=sys.stderr)
             sys.exit(1)

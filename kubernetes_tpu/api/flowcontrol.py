@@ -21,62 +21,98 @@ protects the store.
 Watches hold a seat for their (long) lifetime in the reference too;
 here they are classified but acquire with a short timeout so a full
 level sheds them quickly instead of hanging the handler thread.
+
+Dispatch discipline (one gate-wide lock, not per-level ones):
+
+  * FIFO within a level — a fresh arrival never takes a seat while the
+    same level has queued waiters (no barging);
+  * priority across levels — every freed seat re-runs a dispatch scan
+    in level-declaration order (system first), so a higher-priority
+    waiter claims capacity before any lower level's arrival;
+  * borrow DOWNWARD only — a higher-priority level out of its own
+    seats may execute on a lower level's idle effective capacity, but
+    never the reverse: a catch-all flood can never consume system
+    seats (the isolation property the flood tests pin).
+
+On top of the static knobs sits :class:`AdaptiveAPF`: the scheduler's
+OverloadController level and the store's watch/dispatch depth feed a
+pressure ladder that shrinks every non-system level's effective seats
+and queue limits under overload (halving per pressure step) and
+restores the configured values with hysteresis — the serving-plane
+mirror of the solve side's shed ladder.  Load-shed responses carry a
+Retry-After that widens with pressure (``retry_after_s``).
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass, field
+from collections import deque
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from . import auth as authmod
+from ..testing import faults
+
+
+class _Ticket:
+    """One queued request: granted under the gate lock by the dispatch
+    scan, then observed by its waiting thread."""
+
+    __slots__ = ("granted", "donor")
+
+    def __init__(self):
+        self.granted = False
+        self.donor: Optional["PriorityLevel"] = None
+
+
+class Seat:
+    """A held admission: release() returns the capacity to whichever
+    level lent it (the request's own level, or a lower-priority donor
+    on the borrow-downward path)."""
+
+    __slots__ = ("_gate", "level", "donor", "_released")
+
+    def __init__(self, gate: "APFGate", level: "PriorityLevel",
+                 donor: "PriorityLevel"):
+        self._gate = gate
+        self.level = level
+        self.donor = donor
+        self._released = False
+
+    # compat: callers that logged the old PriorityLevel return value's
+    # name keep working
+    @property
+    def name(self) -> str:
+        return self.level.name
+
+    def release(self) -> None:
+        self._gate._release(self)
 
 
 class PriorityLevel:
-    """One level's seats + bounded waiting room (apf_filter.go's
-    queueSet reduced to a single FIFO-ish queue per level)."""
+    """One level's seats + bounded FIFO waiting room (apf_filter.go's
+    queueSet reduced to one queue per level).  All mutable state is
+    guarded by the owning gate's ``_cond`` — the level itself holds no
+    lock (single-lock dispatch is what makes cross-level fairness
+    decidable atomically)."""
 
     def __init__(self, name: str, seats: int, queue_limit: int):
         self.name = name
-        self.seats = seats
-        self.queue_limit = queue_limit
-        self.in_flight = 0
-        self.queued = 0
+        self.seats = seats                    # configured
+        self.queue_limit = queue_limit        # configured
+        self.seats_effective = seats          # adaptive (<= seats)
+        self.queue_limit_effective = queue_limit
+        self.rank = 0                         # 0 = highest priority
+        self.in_flight = 0       # requests of THIS level executing
+        self.seats_used = 0      # capacity charged here (own + lent)
         self.rejected_total = 0
         self.dispatched_total = 0
-        self._cond = threading.Condition()
+        self._waiters: deque = deque()
 
-    def acquire(self, timeout: float) -> bool:
-        """Take a seat, waiting up to `timeout` in the queue; False =
-        shed (queue full or wait expired) — reply 429."""
-        with self._cond:
-            if self.in_flight < self.seats:
-                self.in_flight += 1
-                self.dispatched_total += 1
-                return True
-            if self.queued >= self.queue_limit:
-                self.rejected_total += 1
-                return False
-            self.queued += 1
-            deadline = time.monotonic() + timeout
-            try:
-                while self.in_flight >= self.seats:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        self.rejected_total += 1
-                        return False
-                    self._cond.wait(remaining)
-                self.in_flight += 1
-                self.dispatched_total += 1
-                return True
-            finally:
-                self.queued -= 1
-
-    def release(self) -> None:
-        with self._cond:
-            self.in_flight -= 1
-            self._cond.notify()
+    @property
+    def queued(self) -> int:
+        return len(self._waiters)
 
 
 @dataclass
@@ -101,7 +137,8 @@ class FlowSchema:
 
 DEFAULT_LEVELS = {
     # seats sized like the reference defaults' spirit: system traffic
-    # gets guaranteed headroom, the catch-all gets a small slice
+    # gets guaranteed headroom, the catch-all gets a small slice.
+    # Declaration order IS priority order (system highest).
     "system": (16, 128),
     "workload-high": (16, 128),
     "catch-all": (4, 16),
@@ -164,7 +201,15 @@ def levels_from_config(doc: dict) -> Dict[str, Tuple[int, int]]:
 
 class APFGate:
     """The filter the server calls around every request
-    (apf_filter.go Handle): classify -> acquire -> handle -> release."""
+    (apf_filter.go Handle): classify -> acquire -> handle -> release.
+
+    One lock for the whole gate: every grant decision (fresh arrival,
+    freed seat, pressure change) runs the same priority-ordered FIFO
+    dispatch scan, so fairness holds atomically across levels."""
+
+    GUARDED_FIELDS = {
+        "pressure": "_cond",
+    }
 
     def __init__(
         self,
@@ -172,12 +217,17 @@ class APFGate:
         schemas: Optional[List[FlowSchema]] = None,
         queue_wait_s: float = 5.0,
     ):
+        self._cond = threading.Condition()
         self.levels = {
             name: PriorityLevel(name, seats, qlen)
             for name, (seats, qlen) in (levels or DEFAULT_LEVELS).items()
         }
+        for rank, lv in enumerate(self.levels.values()):
+            lv.rank = rank
+        self._by_rank = sorted(self.levels.values(), key=lambda l: l.rank)
         self.schemas = list(schemas or DEFAULT_SCHEMAS)
         self.queue_wait_s = queue_wait_s
+        self.pressure = 0
 
     @classmethod
     def from_config(cls, source) -> "APFGate":
@@ -214,14 +264,135 @@ class APFGate:
                 return self.levels[schema.level]
         return self.levels["catch-all"]
 
+    # -- dispatch core (all *_locked: caller holds self._cond) -----------
+
+    def _find_capacity_locked(
+        self, level: PriorityLevel
+    ) -> Optional[PriorityLevel]:
+        """The level that will lend a seat to `level`, or None.  Own
+        effective capacity first; then borrow DOWNWARD from a
+        lower-priority level with idle effective seats and no waiters
+        of its own.  Never upward — lower levels cannot touch
+        higher-priority capacity."""
+        if level.seats_used < level.seats_effective:
+            return level
+        for donor in self._by_rank[level.rank + 1:]:
+            if (
+                donor.seats_used < donor.seats_effective
+                and not donor._waiters
+            ):
+                return donor
+        return None
+
+    def _grant_locked(
+        self, level: PriorityLevel, donor: PriorityLevel
+    ) -> None:
+        donor.seats_used += 1
+        level.in_flight += 1
+        level.dispatched_total += 1
+
+    def _dispatch_locked(self) -> bool:
+        """Serve queued waiters while capacity exists: levels in
+        priority order, FIFO within each.  Returns True if anything was
+        granted (caller must notify_all)."""
+        granted = False
+        for level in self._by_rank:
+            while level._waiters:
+                donor = self._find_capacity_locked(level)
+                if donor is None:
+                    break
+                ticket = level._waiters.popleft()
+                ticket.granted = True
+                ticket.donor = donor
+                self._grant_locked(level, donor)
+                granted = True
+        return granted
+
+    # -- the request path -------------------------------------------------
+
     def acquire(
         self, subject: authmod.Subject, verb: str
-    ) -> Optional[PriorityLevel]:
+    ) -> Optional[Seat]:
         """Seat for this request, or None → reply 429."""
         level = self.classify(subject, verb)
-        if level.acquire(self.queue_wait_s):
-            return level
-        return None
+        faults.fire("apf.admit", level=level.name, verb=verb)
+        with self._cond:
+            # fresh arrivals never barge past their level's FIFO
+            if not level._waiters:
+                donor = self._find_capacity_locked(level)
+                if donor is not None:
+                    self._grant_locked(level, donor)
+                    return Seat(self, level, donor)
+            if len(level._waiters) >= level.queue_limit_effective:
+                level.rejected_total += 1
+                return None
+            ticket = _Ticket()
+            level._waiters.append(ticket)
+            deadline = time.monotonic() + self.queue_wait_s
+            while not ticket.granted:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            if ticket.granted:
+                return Seat(self, level, ticket.donor)
+            # timed out: a grant can no longer race in — we hold the lock
+            try:
+                level._waiters.remove(ticket)
+            except ValueError:
+                pass
+            level.rejected_total += 1
+            return None
+
+    def _release(self, seat: Seat) -> None:
+        with self._cond:
+            if seat._released:
+                return
+            seat._released = True
+            seat.level.in_flight -= 1
+            seat.donor.seats_used -= 1
+            if self._dispatch_locked():
+                self._cond.notify_all()
+
+    # -- adaptive pressure -------------------------------------------------
+
+    def set_pressure(self, pressure: int) -> None:
+        """Apply an overload pressure step: every non-system level's
+        effective seats and queue limit halve per step (floor 1 seat /
+        0 queue); the system level keeps its full configured seats so
+        control traffic (scheduler, kubelets, leader leases) always has
+        headroom.  Recovery (pressure falling) restores the configured
+        values and re-runs dispatch — capacity that reappears goes to
+        the queue heads immediately."""
+        pressure = max(0, int(pressure))
+        with self._cond:
+            if pressure == self.pressure:
+                return
+            self.pressure = pressure
+            for lv in self._by_rank:
+                if lv.name == "system" or pressure == 0:
+                    lv.seats_effective = lv.seats
+                    lv.queue_limit_effective = lv.queue_limit
+                else:
+                    lv.seats_effective = max(1, lv.seats >> pressure)
+                    lv.queue_limit_effective = lv.queue_limit >> pressure
+            if self._dispatch_locked():
+                self._cond.notify_all()
+
+    def retry_after_s(self) -> float:
+        """The Retry-After a 429 should carry: widens with pressure so
+        shed clients back off harder the deeper the overload."""
+        with self._cond:
+            return float(1 << self.pressure)
+
+    def seats_current(self) -> int:
+        """Effective seats across all levels (apf_seats_current)."""
+        with self._cond:
+            return sum(lv.seats_effective for lv in self._by_rank)
+
+    def rejected_total(self) -> int:
+        with self._cond:
+            return sum(lv.rejected_total for lv in self._by_rank)
 
     def metrics(self) -> str:
         """Prometheus text exposition of per-level state (the reference's
@@ -242,6 +413,14 @@ class APFGate:
                 "apiserver_flowcontrol_current_executing_requests"
                 f'{{priority_level="{lv.name}"}} {lv.in_flight}'
             )
+        lines.append(
+            "# TYPE apiserver_flowcontrol_current_limit_seats gauge"
+        )
+        for lv in self.levels.values():
+            lines.append(
+                "apiserver_flowcontrol_current_limit_seats"
+                f'{{priority_level="{lv.name}"}} {lv.seats_effective}'
+            )
         lines.append("# TYPE apiserver_flowcontrol_rejected_requests_total counter")
         for lv in self.levels.values():
             lines.append(
@@ -255,3 +434,63 @@ class APFGate:
                 f'{{priority_level="{lv.name}"}} {lv.dispatched_total}'
             )
         return "\n".join(lines) + "\n"
+
+
+class AdaptiveAPF:
+    """The serving-plane shed ladder: overload observations in,
+    pressure steps out (mirroring OverloadController's rise-fast /
+    recover-slow shape).
+
+    ``note()`` takes the scheduler's overload level (0/1/2) and the
+    store's watch/dispatch backlog depths; the raw pressure is the max
+    of the overload level and the depth ladder (>= threshold → 1,
+    >= 4x threshold → 2).  Rising pressure applies IMMEDIATELY (shed
+    now, ask questions later); falling pressure needs ``recover_after``
+    consecutive lower observations and then steps down ONE level at a
+    time — the hysteresis that keeps a flapping signal from thrashing
+    the seat limits."""
+
+    def __init__(
+        self,
+        gate: APFGate,
+        depth_threshold: int = 256,
+        recover_after: int = 3,
+    ):
+        self.gate = gate
+        self.depth_threshold = depth_threshold
+        self.recover_after = recover_after
+        self._level = 0
+        self._below = 0
+        self._lock = threading.Lock()
+
+    @property
+    def level(self) -> int:
+        return self._level
+
+    def note(
+        self,
+        overload_level: int = 0,
+        watch_depth: int = 0,
+        dispatch_depth: int = 0,
+    ) -> int:
+        depth = max(int(watch_depth), int(dispatch_depth))
+        from_depth = 0
+        if depth >= self.depth_threshold:
+            from_depth = 1
+        if depth >= 4 * self.depth_threshold:
+            from_depth = 2
+        raw = max(int(overload_level), from_depth)
+        with self._lock:
+            if raw > self._level:
+                self._level = raw
+                self._below = 0
+            elif raw < self._level:
+                self._below += 1
+                if self._below >= self.recover_after:
+                    self._level -= 1
+                    self._below = 0
+            else:
+                self._below = 0
+            level = self._level
+        self.gate.set_pressure(level)
+        return level

@@ -982,6 +982,21 @@ class _StoreShard:
                     rec["obj"] = wire.to_wire(obj)
                 recs.append(rec)
             line = framing.encode_frame(wid, recs)
+            if faults._registry is not None:
+                action = faults.fire(
+                    "journal.frame",
+                    shard=self.index, wid=wid, records=len(recs),
+                )
+                if action is faults.CORRUPT:
+                    # poison one byte in the middle of the encoded frame
+                    # (trailing newline intact, so later lines survive):
+                    # replay must reject the whole wave through the CRC
+                    # check — torn, never half-applied.  Exercised with
+                    # the native _hostplane splice AND the pure-Python
+                    # fallback (the chaos parity seed).
+                    mid = len(line) // 2
+                    flip = "0" if line[mid] != "0" else "1"
+                    line = line[:mid] + flip + line[mid + 1:]
             self.journal_frames += 1
             self.journal_frame_bytes += len(line)
             self._journal_commit([line])
@@ -1971,11 +1986,26 @@ class Store:
                 self._watch_coalesced_closed += w.coalesced
                 w.coalesced = 0
 
+    def dispatch_depth(self) -> int:
+        """Committed-but-undelivered watch events queued at the shard
+        fan-out threads — the store-side overload signal the adaptive
+        APF controller reads (a deep backlog means watchers cannot keep
+        up with the commit rate, so admission should shed)."""
+        total = 0
+        for shard in self._shards:
+            with shard._dispatch_cv:
+                total += sum(
+                    len(evs) for _, evs in shard._dispatch_backlog
+                )
+        return total
+
     def watch_stats(self) -> Dict[str, int]:
         """Fan-out observability snapshot: deepest per-watcher pending
-        backlog, total compacted events, expiries, and (legacy)
-        destructive terminations — mirrored into the scheduler Registry
-        as scheduler_watch_* gauges every cycle."""
+        backlog, fan-out dispatch backlog, total compacted events,
+        expiries, and (legacy) destructive terminations — mirrored into
+        the scheduler Registry as scheduler_watch_* gauges every
+        cycle."""
+        dispatch_depth = self.dispatch_depth()
         with self._rv_lock:
             depth = 0
             coalesced = self._watch_coalesced_closed
@@ -1986,6 +2016,7 @@ class Store:
                         coalesced += w.coalesced
             return {
                 "watch_queue_depth": depth,
+                "watch_dispatch_depth": dispatch_depth,
                 "watch_coalesced_total": coalesced,
                 "watch_expired_total": self.watch_expired_total,
                 "watchers_terminated": self.watchers_terminated,

@@ -681,7 +681,8 @@ class TPUBatchScheduler:
         limits: Optional[schema.SnapshotLimits] = None,
         mode: str = "auto",  # auto | greedy | auction
         state: Optional[schema.ClusterState] = None,
-        mesh=None,  # jax.sharding.Mesh: shard the node axis across chips
+        mesh=None,  # jax.sharding.Mesh: shard the solve axis across chips
+        solve_shard_axis: str = "node",  # node | pod (wavefront-only twin)
         use_mirror: bool = True,  # DeviceClusterMirror feature gate
         use_wavefront: bool = True,  # wave-parallel greedy feature gate
         wave_cap: int = assign_ops.DEFAULT_WAVE_CAP,
@@ -703,6 +704,12 @@ class TPUBatchScheduler:
         self.score_config = score_config
         self.mode = mode
         self.mesh = mesh
+        if solve_shard_axis not in ("node", "pod"):
+            raise ValueError(
+                f"solve_shard_axis must be node|pod, got "
+                f"{solve_shard_axis!r}"
+            )
+        self.solve_shard_axis = solve_shard_axis
         self.use_wavefront = use_wavefront
         self.wave_cap = wave_cap
         # TPU slice carve-out policy (ops/slices.py): "prefer" biases
@@ -741,15 +748,28 @@ class TPUBatchScheduler:
             from jax.sharding import NamedSharding, PartitionSpec
             from ..parallel import sharded as _sharded
 
-            self._greedy_sharded = _sharded.sharded_greedy_jit(
-                mesh, score_config
-            )
-            self._wavefront_sharded = _sharded.sharded_wavefront_jit(
-                mesh, score_config
-            )
-            self._auction_sharded = _sharded.sharded_auction_jit(
-                mesh, score_config
-            )
+            if solve_shard_axis == "pod":
+                # pod-axis mesh (PR 16's wide-batch regime): only the
+                # wavefront family has a pod-sharded twin — wave members
+                # split across chips against replicated node tables and
+                # the member axis pads itself to the mesh, so there is
+                # no divisibility precondition.  Greedy/auction batches
+                # stay single-chip under this axis.
+                self._greedy_sharded = self._greedy
+                self._wavefront_sharded = _sharded.podsharded_wavefront_jit(
+                    mesh, score_config
+                )
+                self._auction_sharded = self._auction
+            else:
+                self._greedy_sharded = _sharded.sharded_greedy_jit(
+                    mesh, score_config
+                )
+                self._wavefront_sharded = _sharded.sharded_wavefront_jit(
+                    mesh, score_config
+                )
+                self._auction_sharded = _sharded.sharded_auction_jit(
+                    mesh, score_config
+                )
             self._mesh_size = int(mesh.devices.size)
             # every host→device transfer in mesh mode targets the mesh's
             # replicated sharding: the solve jits consume the sharded
@@ -875,13 +895,19 @@ class TPUBatchScheduler:
             route = "wavefront"
         return route
 
-    def _sharded_ok(self, snap: schema.Snapshot) -> bool:
-        """True when this batch solves on the mesh: a mesh is configured
-        and the padded node bucket splits evenly across it.  A bucket
-        smaller than the mesh (tiny cluster under a wide mesh) falls
-        back to the single chip and counts a sharded_solve_fallback."""
+    def _sharded_ok(self, snap: schema.Snapshot, route: str = "greedy") -> bool:
+        """True when this batch solves on the mesh.  Node axis: any
+        route, but the padded node bucket must split evenly across the
+        mesh — a bucket smaller than the mesh (tiny cluster under a
+        wide mesh) falls back to the single chip and counts a
+        sharded_solve_fallback.  Pod axis: wavefront only (the one
+        family with a pod-sharded twin); its member axis pads itself to
+        the mesh, so there is no divisibility check, and non-wavefront
+        routes run single-chip by design rather than as a fallback."""
         if self.mesh is None:
             return False
+        if self.solve_shard_axis == "pod":
+            return route == "wavefront"
         if snap.cluster.allocatable.shape[0] % self._mesh_size == 0:
             return True
         self.sharded_fallbacks += 1
@@ -1077,7 +1103,7 @@ class TPUBatchScheduler:
             else schema.num_groups(snap)
         )
         route = meta.route or self._route(snap, features, topo_split, n_groups)
-        sharded = self._sharded_ok(snap)
+        sharded = self._sharded_ok(snap, route)
         if route == "auction":
             solver = self._auction_sharded if sharded else self._auction
             self._prewarm_neighbors(snap, route, None, features, n_groups)

@@ -750,6 +750,16 @@ def podsharded_wavefront_jit(
             features=features, n_groups=n_groups,
         )
 
+    @partial(jax.jit, static_argnums=(3, 4, 5))
+    def run_warm(
+        snapshot: Snapshot, wave_members, statics, topo_z: int,
+        features: FeatureFlags, n_groups: int,
+    ) -> SolveResult:
+        return podsharded_wavefront_assign(
+            snapshot, wave_members, mesh, cfg, topo_z=topo_z,
+            features=features, n_groups=n_groups, statics=statics,
+        )
+
     def call(
         snapshot: Snapshot,
         wave_members=None,
@@ -757,6 +767,7 @@ def podsharded_wavefront_jit(
         features: Optional[FeatureFlags] = None,
         n_groups: Optional[int] = None,
         wave_cap: int = DEFAULT_WAVE_CAP,
+        statics=None,
     ) -> SolveResult:
         if features is None:
             features = features_of(snapshot)
@@ -775,6 +786,17 @@ def podsharded_wavefront_jit(
                 snapshot, features=features, wave_cap=wave_cap
             ).members
         members = jnp.asarray(pad_wave_columns(wave_members, mesh))
+        if statics is not None:
+            out = run_warm(snapshot, members, statics, topo_z, features,
+                           n_groups)
+            retrace.note(
+                "wavefront-podsharded-warm", run_warm,
+                lambda: retrace.signature(
+                    (snapshot, members, statics),
+                    (topo_z, features, n_groups, mesh_sig),
+                ),
+            )
+            return out
         out = run(snapshot, members, topo_z, features, n_groups)
         retrace.note(
             "wavefront-podsharded", run,
@@ -785,6 +807,7 @@ def podsharded_wavefront_jit(
         return out
 
     call.jitted = run  # raw jit, for AOT prewarm (lower().compile())
+    call.jitted_warm = run_warm
     return call
 
 

@@ -265,6 +265,13 @@ class MetricsCollector:
         "scheduler_journal_frame_bytes",
         "scheduler_fanout_chunk_size",
         "scheduler_c6s_arrival_knee_pods_per_s",
+        # serving plane: adaptive APF seat/shed accounting, write-
+        # deadline stalls, and replica failovers
+        # (docs/robustness.md serving-plane section)
+        "scheduler_apf_seats_current",
+        "scheduler_apf_rejected_total",
+        "scheduler_server_watch_write_stalls_total",
+        "scheduler_replica_failovers_total",
         # graftsched: interleaving schedules explored / yield points
         # scheduled (analysis/interleave.py) and static atomicity
         # findings at the last mirrored run (docs/static_analysis.md)

@@ -443,6 +443,23 @@ class Registry:
         self.c6s_arrival_knee = Gauge(
             "scheduler_c6s_arrival_knee_pods_per_s"
         )
+        # -- serving plane (docs/robustness.md serving-plane section) ------
+        # effective APF seats across all priority levels (shrinks under
+        # adaptive pressure, recovers with hysteresis) — mirrored from
+        # the replica set's shared gate each cycle
+        self.apf_seats_current = Gauge("scheduler_apf_seats_current")
+        # requests shed by APF across all levels (429 + Retry-After)
+        self.apf_rejected_total = Gauge("scheduler_apf_rejected_total")
+        # watch streams expired by the per-watcher HTTP write deadline
+        # (stalled TCP consumers), cumulative across killed replicas
+        self.server_watch_write_stalls_total = Gauge(
+            "scheduler_server_watch_write_stalls_total"
+        )
+        # replica instances killed out of the serving set (clients fail
+        # over to the survivors and re-watch from their last rv)
+        self.replica_failovers_total = Gauge(
+            "scheduler_replica_failovers_total"
+        )
         # -- graftsched surface (docs/static_analysis.md) ------------------
         # deterministic interleaving schedules explored and yield points
         # scheduled across them (analysis/interleave.py TOTALS, mirrored

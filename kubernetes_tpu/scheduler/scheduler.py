@@ -1672,6 +1672,33 @@ class Scheduler:
                 getattr(self.store, "terminated_by_kind", {})
             ).items():
                 self.metrics.watch_terminated_total.set(float(n), kind)
+        # serving plane: feed the adaptive APF ladder (overload level +
+        # store depths) and mirror the fleet-wide serving gauges.  The
+        # store carries a weakref to the replica set (set by
+        # APIServerReplicaSet); exception-contained — serving-plane
+        # trouble must never take the scheduling loop down with it.
+        plane_ref = getattr(self.store, "serving_plane", None)
+        plane = plane_ref() if plane_ref is not None else None
+        if plane is not None:
+            try:
+                plane.note_scheduler(level, self.store)
+                sp = plane.serving_stats()
+                self.metrics.apf_seats_current.set(
+                    float(sp["apf_seats_current"])
+                )
+                self.metrics.apf_rejected_total.set(
+                    float(sp["apf_rejected_total"])
+                )
+                self.metrics.server_watch_write_stalls_total.set(
+                    float(sp["server_watch_write_stalls_total"])
+                )
+                self.metrics.replica_failovers_total.set(
+                    float(sp["replica_failovers_total"])
+                )
+            except Exception:  # noqa: BLE001 — mirror-only containment
+                logging.getLogger(__name__).exception(
+                    "serving-plane mirror failed"
+                )
         self._inflight_set(None)
         return stats
 

@@ -95,6 +95,27 @@ KNOWN_POINTS = frozenset({
     # (seeds 800-804)
     "mirror.grow",
     "leader.renew",
+    # -- serving-plane points (api/server.py, api/flowcontrol.py) -------
+    # every authorized HTTP request, fired before dispatch: fail-grade
+    # schedules surface as 4xx/5xx to the client (retry containment),
+    # delay-grade as server-side latency — the serving chaos family
+    # (seeds 900-909)
+    "server.request",
+    # one chunked frame written to a watch stream: delay-grade models a
+    # stalled TCP consumer (full socket buffer), fail-grade a mid-frame
+    # client disconnect, torn-grade a partial frame write then error —
+    # the per-watcher write deadline must expire the watch, never pin
+    # the handler thread
+    "server.watch.write",
+    # APF admission (flowcontrol.APFGate.acquire): delay-grade stalls
+    # admission (queue-wait coverage), fail-grade rejects the request
+    # at the gate (surfaced as a 4xx by the handler's containment)
+    "apf.admit",
+    # one framed journal wave line (store._append_journal_wave after
+    # framing.encode_frame): CORRUPT poisons the encoded frame bytes so
+    # replay must drop it as a torn wave — exercised against BOTH the
+    # native _hostplane CRC path and the pure-Python fallback (parity)
+    "journal.frame",
 })
 
 # caller-interpreted actions returned by fire()

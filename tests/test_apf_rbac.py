@@ -277,6 +277,238 @@ def test_apf_config_validation_rejects_bad_knobs():
         flowcontrol.APFGate.from_config({"levels": {}})
 
 
+def test_apf_fifo_within_level_no_barging():
+    """Queue-drain fairness, half 1: FIFO within a level.  Two queued
+    waiters on a 1-seat level are served in arrival order, and a fresh
+    arrival never barges past them when the seat frees."""
+    gate = flowcontrol.APFGate(
+        levels={
+            "system": (1, 8), "workload-high": (1, 8), "catch-all": (1, 8),
+        },
+        queue_wait_s=5.0,
+    )
+    nobody = auth.ANONYMOUS
+    hold = gate.acquire(nobody, "list")
+    assert hold is not None
+    # exhaust every borrowable donor so catch-all arrivals must queue
+    # (catch-all is the lowest level, so there is nothing below it —
+    # but keep the gate saturated for symmetry with the cross-level pin)
+    order = []
+
+    def waiter(tag):
+        seat = gate.acquire(nobody, "list")
+        assert seat is not None, f"waiter {tag} timed out"
+        order.append(tag)
+        time.sleep(0.02)
+        seat.release()
+
+    t_a = threading.Thread(target=waiter, args=("A",), daemon=True)
+    t_a.start()
+    deadline = time.monotonic() + 2
+    while gate.levels["catch-all"].queued < 1:
+        assert time.monotonic() < deadline, "waiter A never queued"
+        time.sleep(0.005)
+    t_b = threading.Thread(target=waiter, args=("B",), daemon=True)
+    t_b.start()
+    while gate.levels["catch-all"].queued < 2:
+        assert time.monotonic() < deadline, "waiter B never queued"
+        time.sleep(0.005)
+    # a fresh arrival with waiters queued must not barge: it joins the
+    # queue behind B (granted == False until the scan reaches it)
+    t_c = threading.Thread(target=waiter, args=("C",), daemon=True)
+    t_c.start()
+    while gate.levels["catch-all"].queued < 3:
+        assert time.monotonic() < deadline, "waiter C never queued"
+        time.sleep(0.005)
+    hold.release()
+    for t in (t_a, t_b, t_c):
+        t.join(timeout=5)
+    assert order == ["A", "B", "C"]
+
+
+def test_apf_priority_across_levels_and_borrow_downward():
+    """Queue-drain fairness, half 2: priority across levels.  When a
+    seat frees, the dispatch scan serves the HIGHEST-priority waiting
+    level first (system before workload-high), and capacity is borrowed
+    DOWNWARD only — the system waiter executes on the idle catch-all
+    seat while the workload-high waiter keeps waiting."""
+    gate = flowcontrol.APFGate(
+        levels={
+            "system": (1, 8), "workload-high": (1, 8), "catch-all": (1, 8),
+        },
+        queue_wait_s=5.0,
+    )
+    sys_subj = auth.Subject("system:kube-scheduler", ("system:schedulers",))
+    wh_subj = auth.Subject("dev", ("system:authenticated",))
+    nobody = auth.ANONYMOUS
+    s_hold = gate.acquire(sys_subj, "update")
+    w_hold = gate.acquire(wh_subj, "list")
+    c_hold = gate.acquire(nobody, "list")
+    assert (s_hold, w_hold, c_hold) != (None, None, None)
+    grants = []
+
+    def queued_acquire(subject, tag):
+        seat = gate.acquire(subject, "list")
+        assert seat is not None, f"{tag} timed out"
+        grants.append((tag, seat.donor.name))
+
+    # workload-high waiter queues FIRST, system waiter second: the scan
+    # must still serve system first when capacity appears
+    t_w = threading.Thread(
+        target=queued_acquire, args=(wh_subj, "wh"), daemon=True
+    )
+    t_w.start()
+    deadline = time.monotonic() + 2
+    while gate.levels["workload-high"].queued < 1:
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+    t_s = threading.Thread(
+        target=queued_acquire, args=(sys_subj, "system"), daemon=True
+    )
+    t_s.start()
+    while gate.levels["system"].queued < 1:
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+    # free the CATCH-ALL seat (no catch-all waiters): the system waiter
+    # takes it via borrow-downward; workload-high stays queued
+    c_hold.release()
+    t_s.join(timeout=5)
+    assert grants == [("system", "catch-all")]
+    assert gate.levels["workload-high"].queued == 1
+    # freeing the SYSTEM seat does not help the workload-high waiter —
+    # borrowing never goes upward, so it keeps waiting
+    s_hold.release()
+    time.sleep(0.05)
+    assert gate.levels["workload-high"].queued == 1
+    # its own seat freeing is what serves it
+    w_hold.release()
+    t_w.join(timeout=5)
+    assert grants == [("system", "catch-all"), ("wh", "workload-high")]
+
+
+def test_apf_catch_all_never_borrows_system_seats():
+    """Borrow-downward only: with every system seat idle, a saturated
+    catch-all level sheds rather than touching higher-priority
+    capacity (the flood-isolation property)."""
+    gate = flowcontrol.APFGate(
+        levels={
+            "system": (4, 8), "workload-high": (1, 0), "catch-all": (1, 0),
+        },
+        queue_wait_s=0.05,
+    )
+    nobody = auth.ANONYMOUS
+    a = gate.acquire(nobody, "list")
+    assert a is not None and a.donor.name == "catch-all"
+    assert gate.acquire(nobody, "list") is None
+    assert gate.levels["system"].seats_used == 0
+    assert gate.levels["workload-high"].seats_used == 0
+    assert gate.levels["catch-all"].rejected_total == 1
+    a.release()
+
+
+def test_adaptive_apf_sheds_and_recovers_with_hysteresis():
+    """The adaptive ladder: overload level 2 shrinks every non-system
+    level's effective seats/queue immediately (system keeps full
+    headroom), Retry-After widens with pressure, and recovery needs
+    `recover_after` consecutive calm observations per single step
+    down — the hysteresis that keeps a flapping signal from thrashing
+    the seat limits."""
+    gate = flowcontrol.APFGate(
+        levels={
+            "system": (8, 16), "workload-high": (8, 16), "catch-all": (4, 8),
+        },
+        queue_wait_s=0.05,
+    )
+    adaptive = flowcontrol.AdaptiveAPF(gate, recover_after=3)
+    base = gate.seats_current()
+    assert base == 20
+    assert gate.retry_after_s() == 1.0
+
+    # rising pressure applies immediately
+    assert adaptive.note(overload_level=2) == 2
+    assert gate.levels["system"].seats_effective == 8        # untouched
+    assert gate.levels["workload-high"].seats_effective == 2  # 8 >> 2
+    assert gate.levels["catch-all"].seats_effective == 1      # floor 1
+    assert gate.levels["catch-all"].queue_limit_effective == 2
+    assert gate.seats_current() == 11
+    assert gate.retry_after_s() == 4.0
+
+    # the shrunken level demonstrably sheds: 1 effective seat + queue 2
+    nobody = auth.ANONYMOUS
+    held = [gate.acquire(nobody, "list")]
+    assert held[0] is not None
+    # no free seat, and the 0.05s queue wait expires -> shed
+    assert gate.acquire(nobody, "list") is None
+    assert gate.levels["catch-all"].rejected_total >= 1
+
+    # recovery: three calm observations per downward step, one step at
+    # a time; a blip in between resets the streak
+    assert adaptive.note(0) == 2
+    assert adaptive.note(0) == 2
+    assert adaptive.note(overload_level=2) == 2  # blip: streak resets
+    assert adaptive.note(0) == 2
+    assert adaptive.note(0) == 2
+    assert adaptive.note(0) == 1                 # step down ONE level
+    assert gate.levels["workload-high"].seats_effective == 4
+    assert gate.retry_after_s() == 2.0
+    assert adaptive.note(0) == 1
+    assert adaptive.note(0) == 1
+    assert adaptive.note(0) == 0                 # fully recovered
+    assert gate.seats_current() == base
+    assert gate.levels["catch-all"].seats_effective == 4
+    assert gate.levels["catch-all"].queue_limit_effective == 8
+    assert gate.retry_after_s() == 1.0
+    held[0].release()
+
+
+def test_adaptive_apf_depth_ladder():
+    """The store's watch/dispatch backlog depth drives pressure too:
+    >= threshold is one step, >= 4x threshold is two, and the larger of
+    (overload level, depth step) wins."""
+    gate = flowcontrol.APFGate(queue_wait_s=0.05)
+    adaptive = flowcontrol.AdaptiveAPF(
+        gate, depth_threshold=256, recover_after=2
+    )
+    assert adaptive.note(watch_depth=255) == 0
+    assert adaptive.note(watch_depth=256) == 1
+    assert adaptive.note(dispatch_depth=1024) == 2
+    assert adaptive.note(overload_level=1, watch_depth=0) == 2  # falling: 1st
+    assert adaptive.note(overload_level=1) == 1  # 2nd calm step: down one
+    assert gate.levels["catch-all"].seats_effective == (
+        flowcontrol.DEFAULT_LEVELS["catch-all"][0] >> 1
+    )
+
+
+def test_apf_shed_carries_adaptive_retry_after():
+    """End to end through the HTTP path: under pressure 2 a shed
+    catch-all request answers 429 with the WIDENED Retry-After (2^p
+    seconds), and recovery restores the 1s floor."""
+    import urllib.error
+    import urllib.request
+
+    store = st.Store()
+    srv, apf = _apf_server(store, catch_all=(1, 4))
+    try:
+        apf.set_pressure(2)
+        seat = apf.acquire(auth.ANONYMOUS, "list")
+        assert seat is not None
+        req = urllib.request.Request(
+            f"{srv.url}/api/v1/Pod",
+            headers={"Authorization": "Bearer viewer-token"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 429
+        assert ei.value.headers["Retry-After"] == "4"
+        seat.release()
+        apf.set_pressure(0)
+        # recovered: the same request is admitted again
+        body = urllib.request.urlopen(req, timeout=5).read()
+        assert b"items" in body
+    finally:
+        srv.stop()
+
+
 def test_apf_metrics_endpoint():
     store = st.Store()
     srv, apf = _apf_server(store)

@@ -1698,3 +1698,346 @@ def test_chaos_node_churn(seed, tmp_path):
         faults.disarm()
         sched.stop()
         elector.stop()
+
+
+# -- serving-plane chaos: HTTP faults + replica failover (PR 18) -------------
+#
+# These seeds drive the WHOLE serving path under fault load: pods are
+# created THROUGH the read-replica HTTP plane (injected 5xx/latency on
+# server.request, torn/failed chunk frames on server.watch.write,
+# admission stalls on apf.admit), a replica is killed and restarted
+# mid-run, and a multiplexed informer fleet (client/watchmux.py) must
+# fail over and converge.  Invariants on top of the pipeline ones: no
+# watcher destructively terminated, no pinned server handler thread at
+# quiesce, per-namespace rv-monotonic delivery across the failover
+# (mux.violations), and bound-exactly-once AS SEEN THROUGH HTTP — every
+# informer cache converges on the store's bindings.
+
+SERVING_SEEDS = list(range(900, 910))
+
+
+def _serving_fault_plan(rng: random.Random) -> faults.FaultRegistry:
+    reg = faults.FaultRegistry(seed=rng.randint(0, 2 ** 31))
+    reg.fail("server.request", n=rng.randint(1, 3), probability=0.5)
+    reg.delay("server.request", seconds=0.002, n=5, probability=0.5)
+    reg.torn_write("server.watch.write", frac=rng.random(), n=1)
+    reg.fail("server.watch.write", n=rng.randint(1, 2), probability=0.5)
+    reg.delay("server.watch.write", seconds=0.002, n=5, probability=0.5)
+    reg.delay("apf.admit", seconds=0.002, n=5, probability=0.5)
+    # a light dose of the pipeline plan: the serving plane must stay
+    # healthy while the scheduler is healing its own faults
+    reg.fail("batch.solve", n=1, probability=0.5)
+    reg.fail("binder.commit_wave", n=1, probability=0.5)
+    return reg
+
+
+@pytest.mark.serving
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+@pytest.mark.parametrize("seed", SERVING_SEEDS)
+def test_chaos_serving_plane(seed):
+    from kubernetes_tpu.api.server import APIServerReplicaSet
+    from kubernetes_tpu.client.rest import RestClient
+    from kubernetes_tpu.client.watchmux import HttpWatchMux
+
+    rng = random.Random(seed)
+    reg = _serving_fault_plan(rng)
+    store = st.Store()
+    audit = _EventAudit(store)
+    terminated0 = store.watchers_terminated
+    for i in range(4):
+        store.create(
+            make_node(f"n{i}")
+            .capacity(cpu_milli=16000, mem=32 * GI, pods=110)
+            .obj()
+        )
+    plane = APIServerReplicaSet(store, replicas=2)
+    mux = HttpWatchMux(plane.urls(), threads=2)
+    config = SchedulerConfiguration(
+        pod_initial_backoff_seconds=0.05,
+        pod_max_backoff_seconds=0.4,
+        batch_window_seconds=0.01,
+        unschedulable_flush_seconds=0.5,
+    )
+    sched = Scheduler(store, assume_ttl=1.0, config=config)
+    n_pods = rng.randint(24, 40)
+    kill_at = rng.randint(n_pods // 3, 2 * n_pods // 3)
+    try:
+        infs = [mux.add_informer("Pod") for _ in range(6)]
+        mux.start()
+        with faults.armed(reg):
+            sched.start()
+            for i in range(n_pods):
+                if i == kill_at:
+                    victim = rng.randint(0, 1)
+                    plane.kill(victim)
+                    plane.restart(victim)
+                    mux.set_urls(plane.urls())
+                # create THROUGH the HTTP plane; injected 5xx and the
+                # mid-run kill surface as client errors — retry, and
+                # treat AlreadyExists as success (the failure can land
+                # after the store committed)
+                urls = plane.urls()
+                for _ in range(50):
+                    try:
+                        RestClient(urls[i % len(urls)], timeout=5).create(
+                            make_pod(f"sp{i}").req(
+                                cpu_milli=rng.choice([50, 100, 200]),
+                                mem=rng.choice([GI // 4, GI // 2]),
+                            ).obj()
+                        )
+                        break
+                    except st.AlreadyExists:
+                        break
+                    except Exception:  # noqa: BLE001 — injected 5xx
+                        time.sleep(0.02)
+                else:
+                    raise AssertionError(f"seed {seed}: create sp{i} stuck")
+                if rng.random() < 0.3:
+                    time.sleep(rng.random() * 0.005)
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                pods, _ = store.list("Pod")
+                if len(pods) == n_pods and all(
+                    p.spec.node_name for p in pods
+                ):
+                    break
+                time.sleep(0.1)
+
+        # -- invariants (faults disarmed; the mux keeps converging) ------
+        assert reg.fired, f"seed {seed}: no fault ever fired"
+        pods, _ = store.list("Pod")
+        assert len(pods) == n_pods
+        unbound = [p.meta.name for p in pods if not p.spec.node_name]
+        assert not unbound, (
+            f"seed {seed}: pods never bound: {unbound[:5]}\n"
+            f"  queue: {sched.queue.stats()}\n"
+            f"  fired={reg.fired} pending={reg.pending()}"
+        )
+        # bound-exactly-once through the HTTP path: every informer's
+        # cache converges on the store's bindings despite the torn
+        # frames and the replica failover
+        want = {
+            f"{p.meta.namespace}/{p.meta.name}": p.spec.node_name
+            for p in pods
+        }
+
+        def _converged():
+            for inf in infs:
+                cache = dict(inf.cache)
+                if len(cache) != len(want):
+                    return False
+                for key, obj in cache.items():
+                    if obj.spec.node_name != want.get(key):
+                        return False
+            return True
+
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not _converged():
+            time.sleep(0.1)
+        assert _converged(), (
+            f"seed {seed}: informer caches diverged from store\n"
+            f"  sizes={[len(i.cache) for i in infs]} want={len(want)}\n"
+            f"  failovers={[i.failovers for i in infs]} "
+            f"relists={[i.relists for i in infs]}"
+        )
+        assert mux.violations() == [], (
+            f"seed {seed}: {mux.violations()[:5]}"
+        )
+        assert not audit.violations, f"seed {seed}: {audit.violations[:5]}"
+        rebound = {
+            k: nodes for k, nodes in audit.bound_nodes.items()
+            if len(nodes) > 1
+        }
+        assert not rebound, f"seed {seed}: double binds {rebound}"
+        # overload protection never tore a watcher down destructively
+        assert store.watchers_terminated == terminated0, (
+            f"seed {seed}: {store.watchers_terminated - terminated0} "
+            f"watchers terminated"
+        )
+    finally:
+        faults.disarm()
+        mux.stop()
+        sched.stop()
+        plane.stop()
+    # no pinned server handler thread once the clients are gone
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and plane.active_handlers():
+        time.sleep(0.05)
+    assert plane.active_handlers() == 0, (
+        f"seed {seed}: server handler threads pinned at shutdown"
+    )
+
+
+# -- journal frame corruption: native vs pure-Python parity ------------------
+
+
+def _frame_recovery(tmp_path, tag, native):
+    """Bind three fixed 4-pod waves with the first two journal frames
+    poisoned (CORRUPT flips one mid-frame byte), then replay.  Returns
+    the recovery fingerprint; the parity test runs it against the
+    native _hostplane CRC path and the pure-Python fallback and demands
+    byte-identical outcomes."""
+    from kubernetes_tpu.api import framing
+
+    path = str(tmp_path / f"journal-{tag}.jsonl")
+    saved = framing._hostplane
+    if not native:
+        framing._hostplane = None
+    try:
+        reg = faults.FaultRegistry(seed=7)
+        reg.corrupt("journal.frame", n=2)
+        store = st.Store(journal_path=path, journal_framing=True)
+        names = [f"p{i}" for i in range(12)]
+        for n in names:
+            store.create(make_pod(n).obj())
+        with faults.armed(reg):
+            for w in range(3):
+                batch = names[w * 4:(w + 1) * 4]
+
+                def _bind(node):
+                    def mutate(obj):
+                        obj.spec.node_name = node
+                    return mutate
+
+                applied, errors = store.update_wave(
+                    "Pod",
+                    [(n, "default", _bind(f"n{j}"))
+                     for j, n in enumerate(batch)],
+                )
+                assert not errors and len(applied) == 4
+        assert reg.fired.get("journal.frame") == 2
+        replayed = st.Store(journal_path=path)
+        # each poisoned frame is rejected on exactly one of two paths:
+        # flip landed in a string -> JSON still parses, the frame CRC
+        # trips (torn wave); flip broke the JSON -> corrupt-record skip
+        # (recovered).  Either way the wave drops WHOLE.
+        dropped = (
+            replayed.journal_torn_waves
+            + replayed.journal_recovered_records
+        )
+        assert dropped == 2, (
+            f"poisoned frames not rejected: torn="
+            f"{replayed.journal_torn_waves} recovered="
+            f"{replayed.journal_recovered_records}"
+        )
+        return {
+            "bound": sorted(
+                (p.meta.name, p.spec.node_name)
+                for p in replayed.list("Pod")[0]
+            ),
+        }
+    finally:
+        faults.disarm()
+        framing._hostplane = saved
+
+
+@pytest.mark.serving
+def test_chaos_journal_frame_native_fallback_parity(tmp_path):
+    from kubernetes_tpu.api import framing
+
+    native = _frame_recovery(tmp_path, "native", native=True)
+    fallback = _frame_recovery(tmp_path, "fallback", native=False)
+    # identical recovery either way: both drop EXACTLY the two poisoned
+    # waves atomically (no half-applied bind) and keep the third
+    assert native == fallback, f"native {native} != fallback {fallback}"
+    bound = dict(native["bound"])
+    for n in [f"p{i}" for i in range(8)]:
+        assert bound[n] == "", f"poisoned-wave bind {n} leaked into replay"
+    for j, n in enumerate(f"p{i}" for i in range(8, 12)):
+        assert bound[n] == f"n{j}"
+    if framing._hostplane is not None:
+        # cross-compatibility: a native-encoded journal replays to the
+        # same state through the pure-Python decode path
+        saved = framing._hostplane
+        framing._hostplane = None
+        try:
+            replayed = st.Store(
+                journal_path=str(tmp_path / "journal-native.jsonl")
+            )
+            assert sorted(
+                (p.meta.name, p.spec.node_name)
+                for p in replayed.list("Pod")[0]
+            ) == native["bound"]
+            assert (
+                replayed.journal_torn_waves
+                + replayed.journal_recovered_records
+            ) == 2
+        finally:
+            framing._hostplane = saved
+
+
+# -- pod-axis sharded solve under the circuit breaker ------------------------
+
+
+@pytest.mark.serving
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_chaos_pod_axis_breaker_host_fallback():
+    """PR 16's pod-sharded wavefront under device failure: a wide batch
+    (>= WAVEFRONT_MIN_PODS, so it routes through the pod-sharded twin)
+    hits two injected solve failures — retry, then the breaker trips and
+    the batch heals on the host fallback.  Every pod still binds exactly
+    once."""
+    from kubernetes_tpu.models.batch_scheduler import TPUBatchScheduler
+    from kubernetes_tpu.parallel import sharded
+
+    rng = random.Random(910)
+    reg = faults.FaultRegistry(seed=910)
+    reg.fail("batch.solve", n=2)  # first retries, second trips the breaker
+    store = st.Store()
+    audit = _EventAudit(store)
+    for i in range(8):
+        store.create(
+            make_node(f"n{i}")
+            .capacity(cpu_milli=64000, mem=128 * GI, pods=110)
+            .obj()
+        )
+    mesh = sharded.make_pod_mesh(8)
+    tpu = TPUBatchScheduler(mesh=mesh, solve_shard_axis="pod")
+    assert tpu.solve_shard_axis == "pod"
+    config = SchedulerConfiguration(
+        pod_initial_backoff_seconds=0.05,
+        pod_max_backoff_seconds=0.4,
+        batch_window_seconds=0.05,
+        unschedulable_flush_seconds=0.5,
+    )
+    sched = Scheduler(store, tpu=tpu, assume_ttl=1.0, config=config)
+    n_pods = 96  # one wide batch: routes wavefront on the pod axis
+    for i in range(n_pods):
+        store.create(
+            make_pod(f"p{i}").req(
+                cpu_milli=rng.choice([50, 100]), mem=GI // 4
+            ).obj()
+        )
+    try:
+        with faults.armed(reg):
+            sched.start()
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                pods, _ = store.list("Pod")
+                if pods and all(p.spec.node_name for p in pods):
+                    break
+                time.sleep(0.1)
+        assert reg.fired.get("batch.solve") == 2
+        pods, _ = store.list("Pod")
+        unbound = [p.meta.name for p in pods if not p.spec.node_name]
+        assert not unbound, (
+            f"pods never bound past the breaker fallback: {unbound[:5]}\n"
+            f"  breaker={sched.tpu.breaker.state} "
+            f"fallbacks={sched.tpu.breaker.fallback_count()}\n"
+            f"  queue: {sched.queue.stats()}"
+        )
+        # the healing path WAS the host fallback, on the pod-axis solver
+        assert sched.tpu.breaker.fallback_count() > 0
+        assert not audit.violations, audit.violations[:5]
+        rebound = {
+            k: nodes for k, nodes in audit.bound_nodes.items()
+            if len(nodes) > 1
+        }
+        assert not rebound, f"double binds {rebound}"
+    finally:
+        faults.disarm()
+        sched.stop()

@@ -365,3 +365,67 @@ def test_terminated_kinds_is_bounded_counter_dict():
 def test_new_fault_points_registered():
     assert "watch.consume" in faults.KNOWN_POINTS
     assert "store.list" in faults.KNOWN_POINTS
+
+
+# -- read-replica bounded staleness ------------------------------------------
+
+
+def test_replica_bounded_staleness_contract():
+    """The replica-set staleness contract: a list at rv R from ANY
+    replica followed by watch?from_rv=R against any OTHER replica —
+    including a freshly restarted one — replays exactly the events
+    committed after R (the shared event ring), converging on exact
+    leader state; an rv that fell out of the ring still answers 410 so
+    the client relists (the single-server Expired semantics,
+    unchanged)."""
+    from kubernetes_tpu.api.server import APIServerReplicaSet
+    from kubernetes_tpu.client.rest import RestClient
+
+    store = st.Store(buffer_size=64)
+    plane = APIServerReplicaSet(store, replicas=2)
+    try:
+        a, b = (RestClient(u) for u in plane.urls())
+        for i in range(5):
+            a.create(make_pod(f"pre-{i}").obj())
+        # list from the OTHER replica: rv R is a consistent cut
+        items, rv = b.list("Pod")
+        assert len(items) == 5
+        # leader state advances past R through replica a
+        for i in range(5, 10):
+            a.create(make_pod(f"pre-{i}").obj())
+        # watch?from_rv=R on replica b replays exactly the gap
+        gen = b.watch("Pod", from_rv=rv)
+        seen = {}
+        for typ, obj, erv in gen:
+            assert typ == "ADDED"
+            seen[obj.meta.name] = erv
+            if len(seen) == 5:
+                break
+        gen.close()
+        assert set(seen) == {f"pre-{i}" for i in range(5, 10)}
+        assert all(erv > rv for erv in seen.values())
+        # a replica killed and RESTARTED serves the same contract: the
+        # fresh instance shares the store, so the old rv still replays
+        plane.kill(1)
+        plane.restart(1)
+        c = RestClient(plane.urls()[1])
+        items2, rv2 = c.list("Pod")
+        assert {o.meta.name for o in items2} == {
+            f"pre-{i}" for i in range(10)
+        }
+        assert rv2 >= max(seen.values())
+        gen2 = c.watch("Pod", from_rv=rv)
+        names = set()
+        for typ, obj, erv in gen2:
+            names.add(obj.meta.name)
+            if len(names) == 5:
+                break
+        gen2.close()
+        assert names == {f"pre-{i}" for i in range(5, 10)}
+        # relist-on-Expired preserved: age R out of the (small) ring
+        for i in range(200):
+            a.create(make_pod(f"age-{i}").obj())
+        with pytest.raises(st.Expired):
+            next(c.watch("Pod", from_rv=rv))
+    finally:
+        plane.stop()
