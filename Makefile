@@ -5,14 +5,14 @@
 
 PY ?= python
 
-.PHONY: test chaos chaos-restart chaos-serving bench lint lint-shapes multichip race \
-	native-ext test-journal
+.PHONY: test chaos chaos-restart chaos-serving bench lint lint-shapes \
+	lint-coherence multichip race native-ext test-journal
 
 # graftlint: the project-native static analysis suite (guarded-by,
 # hot-path purity, registry drift, lock-order, tensor-contract,
-# atomicity — docs/static_analysis.md).  Exits non-zero on any finding
-# outside kubernetes_tpu/analysis/baseline.json and on stale baseline
-# entries.  Import-light: no JAX init.
+# atomicity, coherence — docs/static_analysis.md).  Exits non-zero on
+# any finding outside kubernetes_tpu/analysis/baseline.json and on
+# stale baseline entries.  Import-light: no JAX init.
 lint:
 	$(PY) -m kubernetes_tpu.analysis
 
@@ -21,6 +21,12 @@ lint:
 # separate mode — `make lint` must stay import-light.
 lint-shapes:
 	JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.analysis --shapes
+
+# graftcoh focused mode: the resident-cache discipline matrix alone
+# (analysis/coherence.py; it also rides `make lint`).  The runtime half
+# is the GRAFTLINT_COHERENCE=1 epoch auditor (analysis/epochs.py).
+lint-coherence:
+	$(PY) -m kubernetes_tpu.analysis --coherence
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow and not chaos' \

@@ -1418,7 +1418,7 @@ def config11():
     the gated speedup.  The warm side must also hold
     steady_recompiles == 0 — the partials refresh/gather kernels stay
     on their pad buckets."""
-    from kubernetes_tpu.analysis import retrace
+    from kubernetes_tpu.analysis import epochs, retrace
     from kubernetes_tpu.api import types as api
     from kubernetes_tpu.models.batch_scheduler import TPUBatchScheduler
     from kubernetes_tpu.testing.wrappers import MI, make_pod
@@ -1478,6 +1478,7 @@ def config11():
     retrace.mark_steady()
     steady0 = retrace.steady_total()
     stats0 = dict(warm._partials.stats())
+    audits0, violations0 = epochs.audits_total(), epochs.violations_total()
     warm_walls, cold_walls, parity = [], [], True
     for r in range(1, cycles + 1):
         churn(r)
@@ -1522,6 +1523,10 @@ def config11():
         "partials_recomputed_rows": recomputed,
         "partials_hit_rate": round(hit / max(hit + recomputed, 1), 4),
         "partials_full_recomputes": stats["full_recomputes"],
+        # graftcoh epoch audits over the timed window (main() arms the
+        # auditor; 0/0 when run standalone-disarmed)
+        "coherence_audits": epochs.audits_total() - audits0,
+        "coherence_violations": epochs.violations_total() - violations0,
     }
 
 
@@ -1992,7 +1997,7 @@ def config13():
 def main() -> None:
     import sys
 
-    from kubernetes_tpu.analysis import retrace
+    from kubernetes_tpu.analysis import epochs, retrace
     from kubernetes_tpu.utils import trace as tracemod
 
     tracemod.drain_overruns()  # measure only this run's traces
@@ -2002,8 +2007,11 @@ def main() -> None:
     # scheduler_solve_retrace_total (perf/collectors SCALAR_METRICS).
     # c6 deliberately has no steady window — churn walks the pod-bucket
     # ladder by design, so its first-seen buckets are not steady-state
-    # retraces.
-    with retrace.tracked():
+    # retraces.  The graftcoh epoch auditor is armed alongside it: every
+    # resident buffer a solve consumes is audited against the scheduler
+    # cache's current generations, and BENCH_STRICT fails on any
+    # violation (docs/static_analysis.md coherence section).
+    with retrace.tracked(), epochs.tracked() as coh:
         extra = {
             "c1_fit_500": config1(),
             "c2_balanced_5k": config2(),
@@ -2071,6 +2079,14 @@ def main() -> None:
         if isinstance(cfg, dict) and cfg.get("steady_recompiles")
     }
     extra["steady_retraces"] = steady_retraces
+    # graftcoh epoch-auditor totals for the whole run (the warm-path
+    # configs — c11/c12 — drive the audited consume sites)
+    extra["coherence"] = {
+        "audits_total": coh.audits_total,
+        "violations_total": coh.violations_total,
+        "rollbacks_blocked": coh.rollbacks_blocked,
+        "violations": coh.violations[:5],
+    }
     c5 = extra["c5_gang_50k"]
     pods_per_s = 10_000 / c5["latency_s"]
     print(
@@ -2105,6 +2121,19 @@ def main() -> None:
                 + ", ".join(
                     f"{name}={n}" for name, n in sorted(steady_retraces.items())
                 )
+            )
+        # graftcoh gate: the armed auditor must have observed the warm
+        # path (audits > 0) and found every consumed resident epoch
+        # consistent (violations == 0)
+        if coh.violations_total:
+            failures.append(
+                f"{coh.violations_total} resident-epoch coherence "
+                "violation(s): " + "; ".join(coh.violations[:3])
+            )
+        if not coh.audits_total:
+            failures.append(
+                "coherence auditor armed but performed 0 audits (warm "
+                "path never reached an audited consume site)"
             )
         # overload-protection gates: NO scenario may destructively
         # terminate a watcher (backpressure must absorb the load), and

@@ -1,8 +1,8 @@
 """graftlint — project-native static analysis for the scheduler tree.
 
-Six import-light passes (plus the JAX-backed ``--shapes`` mode) enforce
-the conventions the solve→assume→bind pipeline's correctness rests on
-(docs/static_analysis.md):
+Seven import-light passes (plus the JAX-backed ``--shapes`` mode)
+enforce the conventions the solve→assume→bind pipeline's correctness
+rests on (docs/static_analysis.md):
 
   guarded-by   fields declared guarded (``GUARDED_FIELDS`` class attr or
                a ``# guarded_by: _lock`` comment in ``__init__``) may
@@ -37,6 +37,17 @@ the conventions the solve→assume→bind pipeline's correctness rests on
                ``Condition.wait`` sits in a while-predicate loop inside
                its ``with``.  The runtime complement is the interleaving
                explorer (analysis/interleave.py + analysis/scenarios.py).
+  coherence    device-resident caches (``# resident:`` annotated fields
+               — DeviceClusterMirror, PartialsCache) must implement the
+               full discipline matrix: speculation_point/rollback/
+               invalidate (+ verify or a declared oracle twin), a
+               registered fault point and chaos-seed family, all-
+               residents parity at every bookmark/rollback/invalidate
+               choke point, no direct resident-field reads from
+               ``@hot_path`` code, and per-solve prep rebuilds declared
+               ``# coherence: rebuilt-per-solve``.  The runtime half is
+               the GRAFTLINT_COHERENCE=1 epoch auditor
+               (analysis/epochs.py).
   recompile-discipline
                (``--shapes`` mode / ``make lint-shapes``: imports JAX)
                every @hot_path kernel driven through ``jax.eval_shape``
@@ -65,18 +76,18 @@ import re
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-#: every check id the suppression syntax accepts.  The first five run in
-#: the default import-light CLI; "recompile-discipline" imports JAX and
-#: runs only under `python -m kubernetes_tpu.analysis --shapes`.
+#: every check id the suppression syntax accepts.  The first seven run
+#: in the default import-light CLI; "recompile-discipline" imports JAX
+#: and runs only under `python -m kubernetes_tpu.analysis --shapes`.
 CHECK_IDS = (
     "guarded-by", "purity", "registry", "lock-order", "tensor-contract",
-    "atomicity", "recompile-discipline",
+    "atomicity", "coherence", "recompile-discipline",
 )
 
 #: the stdlib-ast subset run_all executes (no JAX initialization)
 STATIC_CHECK_IDS = (
     "guarded-by", "purity", "registry", "lock-order", "tensor-contract",
-    "atomicity",
+    "atomicity", "coherence",
 )
 
 # check ids after `disable=`, comma-separated; anything after the ids
@@ -256,11 +267,14 @@ def run_all(
     checks: Optional[Sequence[str]] = None,
     package: str = "kubernetes_tpu",
 ) -> List[Finding]:
-    """Run the selected static passes (default: all six import-light
+    """Run the selected static passes (default: all seven import-light
     checks) over root/<package>.  The JAX-backed recompile-discipline
     pass is NOT run here — it lives behind the CLI's ``--shapes`` mode
     (analysis/shapes.py) so ``make lint`` stays import-light."""
-    from . import atomicity, guarded, lockorder, purity, registry, tensorcontract
+    from . import (
+        atomicity, coherence, guarded, lockorder, purity, registry,
+        tensorcontract,
+    )
 
     files = load_sources(root, [package])
     selected = set(checks or STATIC_CHECK_IDS)
@@ -277,5 +291,7 @@ def run_all(
         findings.extend(tensorcontract.check(files))
     if "atomicity" in selected:
         findings.extend(atomicity.check(files))
+    if "coherence" in selected:
+        findings.extend(coherence.check(files))
     findings.sort(key=lambda f: (f.file, f.line, f.check, f.message))
     return findings

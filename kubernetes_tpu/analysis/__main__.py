@@ -1,9 +1,10 @@
 """graftlint CLI: ``python -m kubernetes_tpu.analysis`` (or ``make lint``).
 
-Default mode runs the six import-light static passes over the
-repository's ``kubernetes_tpu`` tree, subtracts the reviewed baseline,
-and exits non-zero on any new finding OR any stale baseline entry (the
-baseline only shrinks).
+Default mode runs the seven import-light static passes (guarded-by,
+purity, registry, lock-order, tensor-contract, atomicity, coherence)
+over the repository's ``kubernetes_tpu`` tree, subtracts the reviewed
+baseline, and exits non-zero on any new finding OR any stale baseline
+entry (the baseline only shrinks).
 
 ``--shapes`` mode (``make lint-shapes``) runs the JAX-backed
 recompile-discipline pass instead — eval_shape over the pad-bucket
@@ -14,6 +15,13 @@ a separate mode on purpose: the default lint must never initialize JAX.
 explorer over the scenario library (analysis/interleave.py +
 analysis/scenarios.py; ``make race`` is the deep pytest driver) — also
 its own mode because the scheduler scenarios import JAX.
+
+``--coherence`` mode (``make lint-coherence``) runs graftcoh's static
+half alone — the resident-cache discipline matrix (analysis/
+coherence.py).  It stays import-light and also rides the default mode;
+the focused mode exists for triage symmetry with ``--shapes`` /
+``--interleave``.  The runtime half is the GRAFTLINT_COHERENCE=1 epoch
+auditor (analysis/epochs.py).
 """
 
 from __future__ import annotations
@@ -58,6 +66,13 @@ def main(argv=None) -> int:
         "JAX_PLATFORMS=cpu for a hardware-free run)",
     )
     parser.add_argument(
+        "--coherence",
+        action="store_true",
+        help="run only the coherence (graftcoh) static pass — the "
+        "resident-cache discipline matrix (import-light; it also rides "
+        "the default mode)",
+    )
+    parser.add_argument(
         "--interleave",
         action="store_true",
         help="run the graftsched interleaving explorer over the scenario "
@@ -99,6 +114,9 @@ def main(argv=None) -> int:
 
         checks = ["recompile-discipline"]
         findings = shapes.check(root)
+    elif args.coherence:
+        checks = ["coherence"]
+        findings = run_all(root, checks=checks)
     else:
         checks = [c.strip() for c in args.checks.split(",") if c.strip()]
         unknown = [c for c in checks if c not in CHECK_IDS]

@@ -1,0 +1,582 @@
+"""coherence (graftcoh): device-resident caches must be wired whole.
+
+The incremental solve is only correct if every device-resident cache
+(DeviceClusterMirror's cluster tensors, PartialsCache's [G, N] partial
+scores — and the warm-start residents the ROADMAP plans next) provably
+tracks the scheduler cache's generations.  Each resident must be
+hand-wired into ~7 discipline surfaces, and a missed wire is a silent
+stale-read bug.  This pass makes the wiring a checked contract.
+
+A class declares its device-resident state inline, next to the
+``GUARDED_FIELDS`` convention (models/mirror.py, models/partials.py)::
+
+    self._dev = None  # resident: fault=mirror.grow chaos=NODE_CHURN_SEEDS
+
+Grammar: ``# resident:`` followed by space-separated ``key=value``
+tokens — ``fault=<point>`` (the resident's registered chaos fault
+point), ``chaos=<FAMILY_SEEDS>`` (its seed family in tests/
+test_chaos.py), optional ``oracle=<name>`` (the oracle-parity twin when
+the class has no ``verify()`` — e.g. the mirror's incremental_grow=False
+full-resync path).  Free text after `` -- `` is justification.  Keys
+may be split across several annotated fields of one class; the class
+union counts.
+
+The discipline matrix, verified per resident class:
+
+  * the class implements ``speculation_point`` / ``rollback`` /
+    ``invalidate``, and ``verify`` or a declared ``oracle=`` twin;
+  * every choke point that bookmarks / rolls back / invalidates ONE
+    resident does it for ALL registered residents (the ``_Cycle``
+    bookmark sites, ``_misspeculate_group``, ``_reconcile_leadership``,
+    the finalize_pending heal wire) — a site that legitimately touches
+    one resident alone carries a justified
+    ``# graftlint: disable=coherence`` on the call line;
+  * the ``fault=`` point is declared in testing/faults.py KNOWN_POINTS
+    and the ``chaos=`` family exists in tests/test_chaos.py;
+  * no ``@hot_path`` solver reads a resident field directly — residents
+    are consumed through ``sync()`` / gather accessors only.
+
+Per-solve prep grids that are NOT resident (yet) declare it::
+
+    # coherence: rebuilt-per-solve -- <why>
+    def prep_spread(...):
+
+The pass fails if a declared rebuild silently starts caching across
+solves (attribute/global stores inside it, a caching decorator, or its
+call result stored on an attribute anywhere in the tree), and requires
+the declaration on the known prep builders so the warm-start PRs
+convert declarations to residents instead of discovering them.
+
+The runtime half is the epoch auditor (analysis/epochs.py,
+GRAFTLINT_COHERENCE=1).  Import-light: stdlib ``ast`` only.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Finding, SourceFile, dotted_name, str_constants
+
+CHECK = "coherence"
+
+FAULTS_FILE = "testing/faults.py"
+CHAOS_FILE = os.path.join("tests", "test_chaos.py")
+
+#: classes known to hold device-resident state: the tree must declare
+#: them (a silent un-annotation would retire the whole matrix for them)
+REQUIRED_RESIDENTS = frozenset({"DeviceClusterMirror", "PartialsCache"})
+
+#: per-solve prep builders the warm-start ROADMAP item will convert to
+#: residents: they must carry the rebuilt-per-solve declaration today
+REQUIRED_REBUILDS = frozenset({"prep_spread", "prep_terms", "_cell_grid"})
+
+#: the wiring trio every choke point must apply to ALL residents at once
+DISCIPLINE_METHODS = ("speculation_point", "rollback", "invalidate")
+
+_RESIDENT_RE = re.compile(
+    r"self\.(\w+)\s*(?::[^=]+)?=.*#\s*resident:\s*(.*)$"
+)
+_REBUILD_RE = re.compile(r"#\s*coherence:\s*rebuilt-per-solve")
+_KV_RE = re.compile(r"(\w+)=(\S+)")
+
+
+class ResidentClass:
+    """One discovered resident-holding class."""
+
+    def __init__(self, src: SourceFile, node: ast.ClassDef):
+        self.src = src
+        self.node = node
+        self.name = node.name
+        self.fields: Dict[str, int] = {}   # resident field -> decl line
+        self.fault: Optional[str] = None
+        self.chaos: Optional[str] = None
+        self.oracle: Optional[str] = None
+        self.methods = {
+            stmt.name
+            for stmt in node.body
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+
+
+def _parse_annotation(rc: ResidentClass, field: str, line: int, text: str):
+    rc.fields[field] = line
+    text = text.split("--", 1)[0]
+    for key, value in _KV_RE.findall(text):
+        if key == "fault":
+            rc.fault = value
+        elif key == "chaos":
+            rc.chaos = value
+        elif key == "oracle":
+            rc.oracle = value
+
+
+def _discover_residents(files: List[SourceFile]) -> List[ResidentClass]:
+    out: List[ResidentClass] = []
+    for src in files:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            rc = ResidentClass(src, node)
+            end = getattr(node, "end_lineno", None) or node.lineno
+            for lineno in range(node.lineno, end + 1):
+                if lineno - 1 >= len(src.lines):
+                    break
+                m = _RESIDENT_RE.search(src.lines[lineno - 1])
+                if m:
+                    _parse_annotation(rc, m.group(1), lineno, m.group(2))
+            if rc.fields:
+                out.append(rc)
+    return out
+
+
+def _known_points(files: List[SourceFile]) -> Optional[Set[str]]:
+    for src in files:
+        if src.relpath.replace("\\", "/").endswith(FAULTS_FILE):
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "KNOWN_POINTS"
+                    for t in node.targets
+                ):
+                    return set(str_constants(node.value))
+    return None
+
+
+def _chaos_families(files: List[SourceFile]) -> Optional[Set[str]]:
+    """``*_SEEDS`` names assigned in tests/test_chaos.py — read from
+    disk next to the scanned tree (the tests live outside the package
+    the lint scans).  None when unavailable (fixture runs)."""
+    for src in files:
+        if not src.path.endswith(src.relpath):
+            continue
+        root = src.path[: len(src.path) - len(src.relpath)]
+        path = os.path.join(root, CHAOS_FILE)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (SyntaxError, OSError):
+            return None
+        return {
+            t.id
+            for node in ast.walk(tree)
+            if isinstance(node, ast.Assign)
+            for t in node.targets
+            if isinstance(t, ast.Name) and t.id.endswith("_SEEDS")
+        }
+    return None
+
+
+# -- binding resolution ------------------------------------------------------
+
+def _constructor_bindings(
+    files: List[SourceFile], classes: Set[str]
+) -> Dict[str, str]:
+    """attr/name -> resident class, from ``<t> = ClassName(...)`` sites."""
+    bindings: Dict[str, str] = {}
+    for src in files:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            # unwrap `X(...) if cond else None` gate idioms
+            values = [node.value]
+            if isinstance(node.value, ast.IfExp):
+                values = [node.value.body, node.value.orelse]
+            cls = None
+            for value in values:
+                if not isinstance(value, ast.Call):
+                    continue
+                cname = dotted_name(value.func)
+                if cname is not None and cname.split(".")[-1] in classes:
+                    cls = cname.split(".")[-1]
+                    break
+            if cls is None:
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for tgt in targets:
+                if isinstance(tgt, ast.Attribute):
+                    bindings[tgt.attr] = cls
+                elif isinstance(tgt, ast.Name):
+                    bindings[tgt.id] = cls
+    return bindings
+
+
+def _local_bindings(
+    fn: ast.AST, global_bindings: Dict[str, str]
+) -> Dict[str, str]:
+    """Names bound inside one function: ``x = getattr(o, "_mirror", ..)``
+    and ``x = self._mirror`` forms, resolved through the constructor
+    binding map."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        value = node.value
+        attr: Optional[str] = None
+        if (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Name)
+            and value.func.id == "getattr"
+            and len(value.args) >= 2
+            and isinstance(value.args[1], ast.Constant)
+            and isinstance(value.args[1].value, str)
+        ):
+            attr = value.args[1].value
+        elif isinstance(value, ast.Attribute):
+            attr = value.attr
+        if attr is not None and attr in global_bindings:
+            out[tgt.id] = global_bindings[attr]
+    return out
+
+
+def _resolve_receiver(
+    recv: ast.AST,
+    global_bindings: Dict[str, str],
+    local_bindings: Dict[str, str],
+) -> Optional[str]:
+    """Resident class a receiver expression denotes, or None."""
+    if isinstance(recv, ast.Attribute):
+        return global_bindings.get(recv.attr)
+    if isinstance(recv, ast.Name):
+        if recv.id in local_bindings:
+            return local_bindings[recv.id]
+        if recv.id in global_bindings:
+            return global_bindings[recv.id]
+        # convention fallback: a local unpacked from a bookmark tuple
+        # named after the binding attr ("mirror" for "_mirror")
+        return global_bindings.get("_" + recv.id)
+    return None
+
+
+# -- rules -------------------------------------------------------------------
+
+def _check_discipline_methods(
+    rc: ResidentClass, findings: List[Finding]
+) -> None:
+    line = min(rc.fields.values())
+    for m in DISCIPLINE_METHODS:
+        if m not in rc.methods and not rc.src.suppressed(line, CHECK):
+            findings.append(
+                Finding(
+                    CHECK, rc.src.relpath, line, rc.name,
+                    f"resident class missing discipline method '{m}' "
+                    "(speculation/rollback/invalidate wiring)",
+                )
+            )
+    if (
+        "verify" not in rc.methods
+        and rc.oracle is None
+        and not rc.src.suppressed(line, CHECK)
+    ):
+        findings.append(
+            Finding(
+                CHECK, rc.src.relpath, line, rc.name,
+                "resident class defines neither verify() nor a declared "
+                "'oracle=' twin (no parity gate)",
+            )
+        )
+
+
+def _check_registrations(
+    rc: ResidentClass,
+    known_points: Optional[Set[str]],
+    chaos_families: Optional[Set[str]],
+    findings: List[Finding],
+) -> None:
+    line = min(rc.fields.values())
+    if rc.src.suppressed(line, CHECK):
+        return
+    if rc.fault is None:
+        findings.append(
+            Finding(
+                CHECK, rc.src.relpath, line, rc.name,
+                "resident declares no 'fault=' point (every resident "
+                "needs a registered chaos fault point)",
+            )
+        )
+    elif known_points is not None and rc.fault not in known_points:
+        findings.append(
+            Finding(
+                CHECK, rc.src.relpath, line, rc.name,
+                f"resident fault point '{rc.fault}' is not declared in "
+                "testing/faults.py KNOWN_POINTS",
+            )
+        )
+    if rc.chaos is None:
+        findings.append(
+            Finding(
+                CHECK, rc.src.relpath, line, rc.name,
+                "resident declares no 'chaos=' seed family (every "
+                "resident needs a chaos-seed family)",
+            )
+        )
+    elif chaos_families is not None and rc.chaos not in chaos_families:
+        findings.append(
+            Finding(
+                CHECK, rc.src.relpath, line, rc.name,
+                f"resident chaos family '{rc.chaos}' not found in "
+                "tests/test_chaos.py",
+            )
+        )
+
+
+def _iter_functions(src: SourceFile):
+    """(qualname, fn node, enclosing class name or None); each function
+    yielded exactly once (methods are not re-yielded as bare names)."""
+    methods: Set[ast.AST] = set()
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef):
+            for stmt in node.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    methods.add(stmt)
+                    yield f"{node.name}.{stmt.name}", stmt, node.name
+    for node in ast.walk(src.tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node not in methods
+        ):
+            yield node.name, node, None
+
+
+def _check_choke_points(
+    files: List[SourceFile],
+    residents: List[ResidentClass],
+    bindings: Dict[str, str],
+    findings: List[Finding],
+) -> None:
+    all_classes = {rc.name for rc in residents}
+    if len(all_classes) < 2:
+        return  # parity is trivially satisfied with one resident
+    resident_names = {rc.name for rc in residents}
+    for src in files:
+        for qual, fn, cls in _iter_functions(src):
+            if cls in resident_names:
+                continue  # a resident's own methods manage only itself
+            locals_ = _local_bindings(fn, bindings)
+            calls: Dict[str, Dict[str, int]] = {}  # method -> class -> line
+            suppressed = False
+            for node in ast.walk(fn):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in DISCIPLINE_METHODS
+                ):
+                    continue
+                target = _resolve_receiver(
+                    node.func.value, bindings, locals_
+                )
+                if target is None:
+                    continue
+                if src.suppressed(node.lineno, CHECK):
+                    suppressed = True
+                    continue
+                calls.setdefault(node.func.attr, {}).setdefault(
+                    target, node.lineno
+                )
+            for method, touched in sorted(calls.items()):
+                missing = sorted(all_classes - set(touched))
+                if not missing or suppressed:
+                    continue
+                line = min(touched.values())
+                findings.append(
+                    Finding(
+                        CHECK, src.relpath, line, qual,
+                        f"calls {method}() on "
+                        f"{', '.join(sorted(touched))} but not on "
+                        f"{', '.join(missing)}: registered residents "
+                        f"must {method} together (discipline matrix)",
+                    )
+                )
+
+
+def _is_hot_path(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        name = dotted_name(dec)
+        if name is not None and name.split(".")[-1] == "hot_path":
+            return True
+    return False
+
+
+def _check_hot_path_reads(
+    files: List[SourceFile],
+    residents: List[ResidentClass],
+    bindings: Dict[str, str],
+    findings: List[Finding],
+) -> None:
+    fields_by_class = {rc.name: set(rc.fields) for rc in residents}
+    resident_names = set(fields_by_class)
+    for src in files:
+        for qual, fn, cls in _iter_functions(src):
+            if cls in resident_names or not _is_hot_path(fn):
+                continue
+            locals_ = _local_bindings(fn, bindings)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Attribute):
+                    continue
+                target = _resolve_receiver(node.value, bindings, locals_)
+                if target is None:
+                    continue
+                if node.attr not in fields_by_class.get(target, ()):
+                    continue
+                if src.suppressed(node.lineno, CHECK):
+                    continue
+                findings.append(
+                    Finding(
+                        CHECK, src.relpath, node.lineno, qual,
+                        f"@hot_path function reads resident field "
+                        f"'{target}.{node.attr}' directly — residents "
+                        "are consumed through sync()/gather accessors",
+                    )
+                )
+
+
+def _rebuild_declared(src: SourceFile, fn: ast.AST) -> bool:
+    """The rebuilt-per-solve marker sits on the def line or one of the
+    two lines above it (covering a decorator line)."""
+    for lineno in range(max(fn.lineno - 2, 1), fn.lineno + 1):
+        if lineno - 1 < len(src.lines) and _REBUILD_RE.search(
+            src.lines[lineno - 1]
+        ):
+            return True
+    return False
+
+
+def _check_rebuilds(
+    files: List[SourceFile], findings: List[Finding]
+) -> None:
+    declared: Set[str] = set()
+    for src in files:
+        for qual, fn, cls in _iter_functions(src):
+            if not _rebuild_declared(src, fn):
+                continue
+            declared.add(fn.name)
+            # a declared per-solve rebuild must not persist state
+            for node in ast.walk(fn):
+                what = None
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    what = "a global/nonlocal statement"
+                elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    targets = (
+                        node.targets if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    if any(
+                        isinstance(t, ast.Attribute) for t in targets
+                    ):
+                        what = "an attribute store"
+                if what and not src.suppressed(node.lineno, CHECK):
+                    findings.append(
+                        Finding(
+                            CHECK, src.relpath, node.lineno, qual,
+                            f"declared rebuilt-per-solve function "
+                            f"persists state through {what} — convert "
+                            "it to a registered resident instead",
+                        )
+                    )
+            for dec in getattr(fn, "decorator_list", []):
+                name = dotted_name(dec) or dotted_name(
+                    getattr(dec, "func", dec)
+                )
+                if name and "cache" in name.split(".")[-1]:
+                    if not src.suppressed(dec.lineno, CHECK):
+                        findings.append(
+                            Finding(
+                                CHECK, src.relpath, fn.lineno, qual,
+                                "declared rebuilt-per-solve function "
+                                f"carries caching decorator '{name}' — "
+                                "it would cache across solves",
+                            )
+                        )
+    # the seeded prep builders must be declared
+    for src in files:
+        for qual, fn, cls in _iter_functions(src):
+            if (
+                fn.name in REQUIRED_REBUILDS
+                and fn.name not in declared
+                and not src.suppressed(fn.lineno, CHECK)
+            ):
+                findings.append(
+                    Finding(
+                        CHECK, src.relpath, fn.lineno, qual,
+                        f"per-solve prep rebuild '{fn.name}' must carry "
+                        "'# coherence: rebuilt-per-solve' (declared "
+                        "non-resident hot rebuild)",
+                    )
+                )
+    # a rebuild's call result stored on an attribute = silent caching
+    for src in files:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Call):
+                continue
+            cname = dotted_name(value.func)
+            if cname is None or cname.split(".")[-1] not in declared:
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            if not any(isinstance(t, ast.Attribute) for t in targets):
+                continue
+            if src.suppressed(node.lineno, CHECK):
+                continue
+            findings.append(
+                Finding(
+                    CHECK, src.relpath, node.lineno,
+                    cname.split(".")[-1],
+                    "result of a declared per-solve rebuild stored on "
+                    "an attribute — silently caching across solves; "
+                    "register it as a resident instead",
+                )
+            )
+
+
+def check(
+    files: List[SourceFile],
+    chaos_families: Optional[Set[str]] = None,
+) -> List[Finding]:
+    findings: List[Finding] = []
+    residents = _discover_residents(files)
+    known_points = _known_points(files)
+    if chaos_families is None:
+        chaos_families = _chaos_families(files)
+
+    # seeded registry: the known resident classes must stay declared
+    found = {rc.name for rc in residents}
+    for src in files:
+        for node in ast.walk(src.tree):
+            if (
+                isinstance(node, ast.ClassDef)
+                and node.name in REQUIRED_RESIDENTS
+                and node.name not in found
+                and not src.suppressed(node.lineno, CHECK)
+            ):
+                findings.append(
+                    Finding(
+                        CHECK, src.relpath, node.lineno, node.name,
+                        "class holds device-resident state (seeded "
+                        "registry) but declares no '# resident:' field "
+                        "annotation",
+                    )
+                )
+
+    for rc in residents:
+        _check_discipline_methods(rc, findings)
+        _check_registrations(rc, known_points, chaos_families, findings)
+
+    bindings = _constructor_bindings(files, {rc.name for rc in residents})
+    _check_choke_points(files, residents, bindings, findings)
+    _check_hot_path_reads(files, residents, bindings, findings)
+    _check_rebuilds(files, findings)
+    return findings

@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..analysis import retrace
+from ..analysis import epochs, retrace
 from ..analysis.markers import hot_path
 from ..api import types as api
 from ..ops import assign as assign_ops
@@ -1093,6 +1093,7 @@ class TPUBatchScheduler:
         self, snap: schema.Snapshot, meta: Optional[schema.SnapshotMeta] = None
     ) -> Result:
         meta = meta or schema.SnapshotMeta(0, 0, [], [], self.builder.limits)
+        epochs.audit_dispatch(meta)
         features = meta.features or assign_ops.features_of(
             snap, slice_policy=self.carveout_policy
         )
@@ -1219,6 +1220,7 @@ class TPUBatchScheduler:
             # both layouts.
             if self.use_mirror:
                 dev_cluster = self._mirror.sync()
+                epochs.audit_mirror(self._mirror, self.state)
                 if (
                     self._partials is not None
                     and meta.route in ("greedy", "wavefront")
@@ -1233,13 +1235,22 @@ class TPUBatchScheduler:
                     # invalidates the residents.
                     try:
                         meta.statics = self._partials.sync(
-                            dev_cluster, snap, meta
+                            dev_cluster, snap, meta,
+                            cluster_epoch=self._mirror.epoch(),
                         )
                     except Exception:  # noqa: BLE001 — cold solve instead
-                        self._partials.invalidate()
+                        self._partials.invalidate()  # graftlint: disable=coherence -- partials-only fault: the mirror synced cleanly above and is not a suspect
                         logging.getLogger(__name__).exception(
                             "partials sync failed; cold solve for this "
                             "batch"
+                        )
+                    if meta.statics is not None:
+                        # a MAX_SLOTS decline (statics None) leaves the
+                        # store legitimately behind the cache — audit
+                        # only what this solve actually consumes
+                        epochs.audit_partials(self._partials, self.state)
+                        meta.coherence_stamp = (
+                            self._mirror.epoch(), self._partials.epoch()
                         )
                 snap = snap._replace(cluster=dev_cluster)
                 snap = _device_fill_shortcut(
@@ -1352,9 +1363,18 @@ class TPUBatchScheduler:
                 ds = self.solve_encoded_async(snap, meta)
             except Exception:  # noqa: BLE001
                 self.breaker.record_failure()
-                if self._partials is not None:
-                    with lock if lock is not None else contextlib.nullcontext():
+                # resident partials AND the resident mirror are fault
+                # suspects here, exactly as on finalize_pending's heal
+                # wire: a dispatch-time fault can be a poisoned resident
+                # surfacing at trace time, and the host fallback below
+                # doesn't read either — dropping both also frees their
+                # HBM while the breaker cools down (graftcoh finding:
+                # this site invalidated only the partials)
+                with lock if lock is not None else contextlib.nullcontext():
+                    if self._partials is not None:
                         self._partials.invalidate()
+                    if self.use_mirror:
+                        self._mirror.invalidate()
                 logging.getLogger(__name__).exception(
                     "device solve retry failed; breaker open, host fallback"
                 )

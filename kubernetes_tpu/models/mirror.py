@@ -54,7 +54,7 @@ from typing import Optional, Tuple
 import jax
 import numpy as np
 
-from ..analysis import retrace
+from ..analysis import epochs, retrace
 from ..ops import schema
 from ..testing import faults
 from ..utils import vocab as vb
@@ -141,10 +141,26 @@ class DeviceClusterMirror:
     def __init__(self, state: schema.ClusterState, mesh=None):
         self.state = state
         self.mesh = mesh
-        self._dev: Optional[schema.ClusterTensors] = None
+        # graftcoh-registered device-resident buffer (docs/static_analysis.md)
+        self._dev: Optional[schema.ClusterTensors] = None  # resident: fault=mirror.grow chaos=NODE_CHURN_SEEDS oracle=full-resync
         self._synced_gen = 0
         self._struct_gen = 0
         self._shape: Optional[Tuple] = None
+        # epoch stamp of the resident buffer (analysis/epochs.py): the
+        # GRAFTLINT_COHERENCE auditor compares it against the state's
+        # CURRENT generations at consume time.  buffer id is the
+        # lineage token: minted per full upload, carried by delta
+        # scatters and in-place grows, restored by rollback.
+        self._epoch: Optional[epochs.EpochStamp] = None
+        self._buffer_id = 0
+        # invalidation fence: a rollback() whose bookmark predates a
+        # later invalidate() must NOT resurrect the dropped buffer
+        # (leadership reconcile / the finalize_pending heal wire
+        # invalidate deliberately; a mis-speculation rollback racing
+        # them would restore exactly the state they dropped — a
+        # graftcoh true positive, regression-pinned in
+        # tests/test_coherence.py)
+        self._inval_gen = 0
         # transfer accounting (read by the scheduler's metric mirror and
         # bench c7's O(changed-rows) gate); mutated under the cache lock
         # — sync() is called inside encode_pending's locked section
@@ -283,6 +299,10 @@ class DeviceClusterMirror:
         self._synced_gen = state.generation
         self._struct_gen = state.struct_generation
         self._shape = shape
+        self._epoch = epochs.EpochStamp(
+            "mirror", self._struct_gen, None, self._synced_gen,
+            self._buffer_id,
+        )
         return dev
 
     def _resize_resident(self, shape) -> Optional[schema.ClusterTensors]:
@@ -371,6 +391,12 @@ class DeviceClusterMirror:
             "grow_rows_total": self.grow_rows_total,
         }
 
+    def epoch(self) -> Optional[epochs.EpochStamp]:
+        """The resident buffer's epoch stamp (None when invalidated or
+        never synced) — read by the GRAFTLINT_COHERENCE auditor and by
+        PartialsCache.sync's lineage stamping."""
+        return self._epoch
+
     def speculation_point(self) -> tuple:
         """Bookmark the resident buffer for a SPECULATIVE encode: the
         current device tensors + generations.  Device arrays are
@@ -380,7 +406,8 @@ class DeviceClusterMirror:
         cache lock (same contract as sync())."""
         return (
             self._dev, self._synced_gen, self._struct_gen, self._shape,
-            self._resident_sharded,
+            self._resident_sharded, self._epoch, self._buffer_id,
+            self._inval_gen,
         )
 
     def rollback(self, point: tuple) -> None:
@@ -392,11 +419,27 @@ class DeviceClusterMirror:
         row dirtied since the bookmarked generation, so the next sync()
         re-scatters anything the dropped buffer carried (or performs a
         full upload when the struct generation moved past the
-        bookmark).  Caller holds the cache lock."""
+        bookmark).  Caller holds the cache lock.
+
+        EXCEPT after an intervening invalidate(): a bookmark taken
+        before a leadership reconcile or the finalize_pending heal wire
+        dropped the resident must not resurrect the dropped buffer —
+        the invalidation fence keeps the mirror invalidated and the
+        next sync() performs the full re-upload instead."""
         (
-            self._dev, self._synced_gen, self._struct_gen, self._shape,
-            self._resident_sharded,
+            dev, synced_gen, struct_gen, shape, resident_sharded,
+            epoch_stamp, buffer_id, inval_gen,
         ) = point
+        if inval_gen != self._inval_gen:
+            epochs.note_rollback_blocked("mirror")
+            return
+        self._dev = dev
+        self._synced_gen = synced_gen
+        self._struct_gen = struct_gen
+        self._shape = shape
+        self._resident_sharded = resident_sharded
+        self._epoch = epoch_stamp
+        self._buffer_id = buffer_id
 
     def invalidate(self) -> None:
         """Drop the resident copy so the next sync() performs a full
@@ -410,12 +453,16 @@ class DeviceClusterMirror:
         self._synced_gen = 0
         self._struct_gen = 0
         self._shape = None
+        self._epoch = None
+        self._buffer_id = 0
+        self._inval_gen += 1
 
     def _full_upload(self, host: schema.ClusterTensors) -> schema.ClusterTensors:
         # host-copy before device_put: on the CPU backend device_put can
         # zero-copy a numpy view, which would alias live cache state
         # (see TPUBatchScheduler.encode_pending's aliasing note)
         self.resync_total += 1
+        self._buffer_id = epochs.fresh_buffer_id()
         copied = jax.tree.map(np.array, host)
         if self._shardings is None:
             return jax.device_put(copied)

@@ -55,7 +55,7 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-from ..analysis import retrace
+from ..analysis import epochs, retrace
 from ..ops import partials as pops
 from ..ops import schema
 from ..testing import faults
@@ -116,7 +116,8 @@ class PartialsCache:
         self.state = state
         self.mesh = mesh
         self.resync_interval = max(int(resync_interval), 1)
-        self._store: Optional[pops.PartialsStore] = None
+        # graftcoh-registered device-resident buffer (docs/static_analysis.md)
+        self._store: Optional[pops.PartialsStore] = None  # resident: fault=solve.partials chaos=PARTIALS_SEEDS
         self._specs: Optional[pops.ClassSpecs] = None
         self._slots: Dict[tuple, int] = {}
         self._cap = 0
@@ -125,6 +126,11 @@ class PartialsCache:
         self._struct_gen = 0
         self._vocab_key: Optional[tuple] = None
         self._since_full = 0
+        # epoch stamp + invalidation fence (analysis/epochs.py;
+        # models/mirror.py carries the same pair and documents the
+        # rollback-resurrection hazard the fence closes)
+        self._epoch: Optional[epochs.EpochStamp] = None
+        self._inval_gen = 0
         # counters (mirrored into scheduler_partials_* each cycle and
         # read by bench's hit-rate reporting); mutated under the cache
         # lock — sync() runs inside encode_pending's locked section
@@ -203,6 +209,12 @@ class PartialsCache:
             "grows": self.grows,
         }
 
+    def epoch(self) -> Optional[epochs.EpochStamp]:
+        """The resident store's epoch stamp (None when invalidated,
+        declined, or never synced) — read by the GRAFTLINT_COHERENCE
+        auditor."""
+        return self._epoch
+
     def speculation_point(self) -> tuple:
         """Bookmark the resident buffers for a speculative encode —
         device arrays are immutable, so holding the references IS the
@@ -212,7 +224,8 @@ class PartialsCache:
         return (
             self._store, self._specs, dict(self._slots), self._cap,
             self._n, self._synced_gen, self._struct_gen, self._vocab_key,
-            self._since_full, self._resident_sharded,
+            self._since_full, self._resident_sharded, self._epoch,
+            self._inval_gen,
         )
 
     def rollback(self, point: tuple) -> None:
@@ -220,13 +233,28 @@ class PartialsCache:
         was invalidated, so the rows refreshed/inserted for it are
         dropped whole; the next sync re-evaluates every row dirtied
         since the bookmarked generation.  Counted into
-        scheduler_partials_rollbacks_total."""
+        scheduler_partials_rollbacks_total.  Refused (stays
+        invalidated) when an invalidate() landed after the bookmark —
+        the fence contract documented on DeviceClusterMirror.rollback."""
         (
-            self._store, self._specs, slots, self._cap, self._n,
-            self._synced_gen, self._struct_gen, self._vocab_key,
-            self._since_full, self._resident_sharded,
+            store, specs, slots, cap, n, synced_gen, struct_gen,
+            vocab_key, since_full, resident_sharded, epoch_stamp,
+            inval_gen,
         ) = point
+        if inval_gen != self._inval_gen:
+            epochs.note_rollback_blocked("partials")
+            return
+        self._store = store
+        self._specs = specs
         self._slots = dict(slots)
+        self._cap = cap
+        self._n = n
+        self._synced_gen = synced_gen
+        self._struct_gen = struct_gen
+        self._vocab_key = vocab_key
+        self._since_full = since_full
+        self._resident_sharded = resident_sharded
+        self._epoch = epoch_stamp
         self.rollbacks += 1
 
     def invalidate(self) -> None:
@@ -245,6 +273,8 @@ class PartialsCache:
         self._struct_gen = 0
         self._vocab_key = None
         self._since_full = 0
+        self._epoch = None
+        self._inval_gen += 1
 
     def _vocab_watermark(self) -> tuple:
         """Selector/preferred rows expand Exists/NotIn/Gt/Lt against the
@@ -412,6 +442,7 @@ class PartialsCache:
         cluster,
         snap: schema.Snapshot,
         meta: schema.SnapshotMeta,
+        cluster_epoch: Optional[epochs.EpochStamp] = None,
     ) -> Optional[pops.ClassStatics]:
         """Warm statics for this batch, or None when the cache declines
         (capacity overflow past MAX_SLOTS with more classes than fit).
@@ -419,7 +450,11 @@ class PartialsCache:
         state's CURRENT generation — the exact tensors the solve
         consumes, so warm rows are evaluated against what the cold path
         would see.  Caller holds the cache lock (mirror.sync contract);
-        `snap` is still host-resident (pre-transfer)."""
+        `snap` is still host-resident (pre-transfer).  `cluster_epoch`
+        is the mirror's epoch stamp for `cluster` — the resident store's
+        stamp inherits its buffer lineage so the GRAFTLINT_COHERENCE
+        auditor can tie the rows to the exact mirror buffer they were
+        evaluated against."""
         state = self.state
         class_rep = np.asarray(snap.pods.class_rep)
         c_dim = class_rep.shape[0]
@@ -529,6 +564,15 @@ class PartialsCache:
                 self.delta_syncs += 1
                 self._since_full += 1
                 self._synced_gen = state.generation
+        # stamp AFTER both paths: the store now matches the cache's
+        # current generations, and its lineage follows the mirror buffer
+        # the rows were evaluated against (a CORRUPT fault below poisons
+        # CONTENT, not epochs — the parity gate / heal wire owns that)
+        self._epoch = epochs.EpochStamp(
+            "partials", self._struct_gen, self._vocab_key,
+            self._synced_gen,
+            cluster_epoch.buffer_id if cluster_epoch is not None else 0,
+        )
 
         if act == faults.CORRUPT:
             # poison the RESIDENT partials: the warm solve's scores go

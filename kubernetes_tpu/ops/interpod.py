@@ -81,6 +81,8 @@ def _unpack_bits_t(bits: jnp.ndarray, t_dim: int) -> jnp.ndarray:
     return flat[..., :t_dim].astype(bool)
 
 
+# coherence: rebuilt-per-solve -- affinity term grids derive from THIS
+# snapshot's cluster tensors; a cached copy would score a stale generation
 def prep_terms(
     cluster: ClusterTensors,
     terms: TermTable,

@@ -339,6 +339,11 @@ class SnapshotMeta:
     # TPUBatchScheduler.encode_pending, consumed by _dispatch — None
     # means cold: the solver recomputes class_statics in-program)
     statics: Optional[object] = None
+    # (mirror EpochStamp, partials EpochStamp) pair recorded when
+    # `statics` was gathered — consumed by the GRAFTLINT_COHERENCE
+    # auditor's dispatch-time cross-resident audit (analysis/epochs.py);
+    # None when the solve is cold or the auditor is disarmed
+    coherence_stamp: Optional[tuple] = None
 
     def node_name(self, idx: int) -> Optional[str]:
         if 0 <= idx < self.num_nodes:

@@ -91,6 +91,8 @@ def free_devices(cluster: ClusterTensors) -> jnp.ndarray:
     )
 
 
+# coherence: rebuilt-per-solve -- the occupancy grid tightens as the solve
+# places gangs; a copy cached across solves would double-place
 def _cell_grid(
     cluster: ClusterTensors,
     free: jnp.ndarray,

@@ -223,6 +223,10 @@ class MetricsCollector:
         "scheduler_partials_recomputed_rows",
         "scheduler_partials_full_recomputes_total",
         "scheduler_partials_rollbacks_total",
+        # graftcoh runtime epoch auditor (GRAFTLINT_COHERENCE=1; 0 when
+        # disarmed — docs/static_analysis.md coherence section)
+        "scheduler_coherence_audits_total",
+        "scheduler_coherence_violations_total",
         "scheduler_binder_restarts_total",
         "scheduler_binder_poison_waves_total",
         "scheduler_journal_recovered_records",

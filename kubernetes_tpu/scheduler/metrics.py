@@ -340,6 +340,15 @@ class Registry:
         self.partials_rollbacks = Gauge(
             "scheduler_partials_rollbacks_total"
         )
+        # graftcoh runtime epoch auditor (analysis/epochs.py), mirrored
+        # each cycle when GRAFTLINT_COHERENCE=1 arms it (0 disarmed):
+        # consume-time resident-epoch audits performed and violations
+        # recorded — chaos and BENCH_STRICT runs gate violations == 0
+        # with audits > 0
+        self.coherence_audits = Gauge("scheduler_coherence_audits_total")
+        self.coherence_violations = Gauge(
+            "scheduler_coherence_violations_total"
+        )
         # -- overload-protection surface (docs/robustness.md) -------------
         # deepest per-watcher coalescing backlog at the last cycle mirror
         self.watch_queue_depth = Gauge("scheduler_watch_queue_depth")

@@ -43,6 +43,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..analysis import epochs as _epochs
 from ..analysis import retrace as _retrace
 from ..api import store as st
 from ..api import types as api
@@ -1567,6 +1568,12 @@ class Scheduler:
         # solver executable traces, when the recompile-discipline
         # runtime tracker is armed (bench / GRAFTLINT_SHAPES=1 runs)
         self.metrics.solve_retrace_total.set(float(_retrace.total()))
+        # graftcoh resident-epoch audits, when the coherence auditor is
+        # armed (bench / GRAFTLINT_COHERENCE=1 runs; 0 disarmed)
+        self.metrics.coherence_audits.set(float(_epochs.audits_total()))
+        self.metrics.coherence_violations.set(
+            float(_epochs.violations_total())
+        )
         # sharded-solve surface: mesh size in use, device-mirror
         # host→device transfer accounting, and single-chip fallbacks
         self.metrics.solve_shard_count.set(

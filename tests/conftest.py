@@ -44,6 +44,21 @@ if os.environ.get("GRAFTLINT_LOCK_ORDER") == "1":
         tracker.assert_no_inversions()
 
 
+if os.environ.get("GRAFTLINT_COHERENCE") == "1":
+    # opt-in runtime resident-epoch auditing (docs/static_analysis.md
+    # coherence section): every resident buffer a solve consumes is
+    # checked against the scheduler cache's current generations at
+    # consume time, and the session fails on any divergent
+    # (resident, field, epoch) triple.
+    @pytest.fixture(autouse=True, scope="session")
+    def _graftlint_coherence():
+        from kubernetes_tpu.analysis import epochs
+
+        with epochs.tracked() as auditor:
+            yield auditor
+        auditor.assert_clean()
+
+
 if os.environ.get("GRAFTLINT_SHAPES") == "1":
     # opt-in runtime recompile-discipline tracking (docs/
     # static_analysis.md): every solver jit dispatch reports to the

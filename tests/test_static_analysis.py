@@ -5,12 +5,13 @@ Two layers:
   * fixture tests: per-checker good/bad snippets (constructed as
     in-memory SourceFiles) prove each pass flags seeded violations and
     stays quiet on conforming code;
-  * the real-tree gate: all six static passes run over the actual
+  * the real-tree gate: all seven static passes run over the actual
     repository and must produce nothing beyond the reviewed baseline —
     the tier-1 regression wire for lock discipline, lock atomicity,
-    hot-path purity, registry consistency, lock ordering and tensor
-    contracts.  (The JAX-backed recompile-discipline pass has its own
-    tier-1 gate in tests/test_shapes.py.)
+    hot-path purity, registry consistency, lock ordering, tensor
+    contracts and resident-cache coherence.  (The JAX-backed
+    recompile-discipline pass has its own tier-1 gate in
+    tests/test_shapes.py.)
 
 Plus the runtime lock-order tracker's inversion regression tests
 (analysis/runtime.py).
@@ -29,7 +30,14 @@ from kubernetes_tpu.analysis import (
     load_baseline,
     run_all,
 )
-from kubernetes_tpu.analysis import atomicity, guarded, lockorder, purity, registry
+from kubernetes_tpu.analysis import (
+    atomicity,
+    coherence,
+    guarded,
+    lockorder,
+    purity,
+    registry,
+)
 from kubernetes_tpu.analysis import runtime as rt
 from kubernetes_tpu.analysis import tensorcontract
 
@@ -798,6 +806,299 @@ def test_tracked_lock_supports_condition():
             cv.notify_all()
         t.join(timeout=5)
         assert hit == [True]
+
+
+# -- coherence ---------------------------------------------------------------
+
+# fixture chaos families (the real pass reads tests/test_chaos.py from
+# disk; fixtures pass the set explicitly so they never depend on CWD)
+COH_FAMILIES = {"NODE_CHURN_SEEDS", "PARTIALS_SEEDS"}
+
+COH_FAULTS = '''
+KNOWN_POINTS = frozenset({"mirror.grow", "solve.partials"})
+'''
+
+COH_GOOD = '''
+class Mirror:
+    def __init__(self):
+        self._dev = None  # resident: fault=mirror.grow chaos=NODE_CHURN_SEEDS oracle=full-resync
+
+    def speculation_point(self):
+        return (self._dev,)
+
+    def rollback(self, point):
+        (self._dev,) = point
+
+    def invalidate(self):
+        self._dev = None
+
+    def sync(self):
+        return self._dev
+
+
+class Partials:
+    def __init__(self):
+        self._store = None  # resident: fault=solve.partials chaos=PARTIALS_SEEDS
+
+    def speculation_point(self):
+        return (self._store,)
+
+    def rollback(self, point):
+        (self._store,) = point
+
+    def invalidate(self):
+        self._store = None
+
+    def verify(self):
+        return True
+
+    def sync(self):
+        return self._store
+
+
+class Sched:
+    def __init__(self):
+        self._mirror = Mirror()
+        self._partials = Partials()
+
+    def heal(self):
+        self._partials.invalidate()
+        self._mirror.invalidate()
+
+    def bookmark(self):
+        return (
+            self._mirror.speculation_point(),
+            self._partials.speculation_point(),
+        )
+
+    def solo(self):
+        self._partials.invalidate()  # graftlint: disable=coherence -- partials-only fault
+'''
+
+COH_MISSING_ROLLBACK = '''
+class Mirror:
+    def __init__(self):
+        self._dev = None  # resident: fault=mirror.grow chaos=NODE_CHURN_SEEDS oracle=full-resync
+
+    def speculation_point(self):
+        return (self._dev,)
+
+    def invalidate(self):
+        self._dev = None
+'''
+
+COH_BAD_FAULT = '''
+class Mirror:
+    def __init__(self):
+        self._dev = None  # resident: fault=not.a.point chaos=NODE_CHURN_SEEDS oracle=full-resync
+
+    def speculation_point(self):
+        return (self._dev,)
+
+    def rollback(self, point):
+        (self._dev,) = point
+
+    def invalidate(self):
+        self._dev = None
+'''
+
+COH_HOT_READ = '''
+from .markers import hot_path
+
+
+class Mirror:
+    def __init__(self):
+        self._dev = None  # resident: fault=mirror.grow chaos=NODE_CHURN_SEEDS oracle=full-resync
+
+    def speculation_point(self):
+        return (self._dev,)
+
+    def rollback(self, point):
+        (self._dev,) = point
+
+    def invalidate(self):
+        self._dev = None
+
+    def sync(self):
+        return self._dev
+
+
+class Solver:
+    def __init__(self):
+        self._mirror = Mirror()
+
+    @hot_path
+    def solve(self):
+        return self._mirror._dev
+'''
+
+COH_CHOKE_BAD = '''
+class Mirror:
+    def __init__(self):
+        self._dev = None  # resident: fault=mirror.grow chaos=NODE_CHURN_SEEDS oracle=full-resync
+
+    def speculation_point(self):
+        return (self._dev,)
+
+    def rollback(self, point):
+        (self._dev,) = point
+
+    def invalidate(self):
+        self._dev = None
+
+
+class Partials:
+    def __init__(self):
+        self._store = None  # resident: fault=solve.partials chaos=PARTIALS_SEEDS oracle=resync
+
+    def speculation_point(self):
+        return (self._store,)
+
+    def rollback(self, point):
+        (self._store,) = point
+
+    def invalidate(self):
+        self._store = None
+
+
+class Sched:
+    def __init__(self):
+        self._mirror = Mirror()
+        self._partials = Partials()
+
+    def retry(self):
+        self._partials.invalidate()
+'''
+
+COH_REBUILD_CACHED = '''
+# coherence: rebuilt-per-solve -- derives from this snapshot only
+def prep_grid(cluster):
+    return cluster
+
+
+class Solver:
+    def __init__(self, cluster):
+        self._grid = prep_grid(cluster)
+'''
+
+COH_REBUILD_PERSISTS = '''
+# coherence: rebuilt-per-solve -- derives from this snapshot only
+def prep_grid(cluster, scratch):
+    scratch.grid = cluster
+    return cluster
+'''
+
+COH_REBUILD_UNDECLARED = '''
+def prep_spread(cluster):
+    return cluster
+'''
+
+
+def test_coherence_clean_on_conforming_tree():
+    files = [
+        src("kubernetes_tpu/models/m.py", COH_GOOD),
+        src("kubernetes_tpu/testing/faults.py", COH_FAULTS),
+    ]
+    assert coherence.check(files, chaos_families=COH_FAMILIES) == []
+
+
+def test_coherence_flags_missing_rollback_wire():
+    files = [src("kubernetes_tpu/models/m.py", COH_MISSING_ROLLBACK)]
+    findings = coherence.check(files, chaos_families=COH_FAMILIES)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.check == "coherence"
+    assert f.symbol == "Mirror"
+    assert "missing discipline method 'rollback'" in f.message
+
+
+def test_coherence_flags_unregistered_fault_point():
+    files = [
+        src("kubernetes_tpu/models/m.py", COH_BAD_FAULT),
+        src("kubernetes_tpu/testing/faults.py", COH_FAULTS),
+    ]
+    findings = coherence.check(files, chaos_families=COH_FAMILIES)
+    assert len(findings) == 1
+    assert "'not.a.point' is not declared" in findings[0].message
+
+
+def test_coherence_flags_unknown_chaos_family():
+    bad = COH_BAD_FAULT.replace("not.a.point", "mirror.grow").replace(
+        "NODE_CHURN_SEEDS", "NOPE_SEEDS"
+    )
+    files = [
+        src("kubernetes_tpu/models/m.py", bad),
+        src("kubernetes_tpu/testing/faults.py", COH_FAULTS),
+    ]
+    findings = coherence.check(files, chaos_families=COH_FAMILIES)
+    assert len(findings) == 1
+    assert "'NOPE_SEEDS' not found" in findings[0].message
+
+
+def test_coherence_flags_hot_path_resident_read():
+    files = [src("kubernetes_tpu/models/m.py", COH_HOT_READ)]
+    findings = coherence.check(files, chaos_families=COH_FAMILIES)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.symbol == "Solver.solve"
+    assert "reads resident field 'Mirror._dev' directly" in f.message
+
+
+def test_coherence_flags_asymmetric_choke_point():
+    files = [src("kubernetes_tpu/models/m.py", COH_CHOKE_BAD)]
+    findings = coherence.check(files, chaos_families=COH_FAMILIES)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.symbol == "Sched.retry"
+    assert "invalidate() on Partials but not on Mirror" in f.message
+
+
+def test_coherence_suppression_covers_justified_solo_site():
+    # COH_GOOD's Sched.solo invalidates one resident with a justified
+    # disable on the call line — exercised by the clean test above; here
+    # the same site WITHOUT the pragma must be flagged
+    stripped = COH_GOOD.replace(
+        "  # graftlint: disable=coherence -- partials-only fault", ""
+    )
+    files = [
+        src("kubernetes_tpu/models/m.py", stripped),
+        src("kubernetes_tpu/testing/faults.py", COH_FAULTS),
+    ]
+    findings = coherence.check(files, chaos_families=COH_FAMILIES)
+    assert [f.symbol for f in findings] == ["Sched.solo"]
+
+
+def test_coherence_flags_rebuild_cached_on_attribute():
+    files = [src("kubernetes_tpu/ops/o.py", COH_REBUILD_CACHED)]
+    findings = coherence.check(files, chaos_families=COH_FAMILIES)
+    assert len(findings) == 1
+    assert "silently caching across solves" in findings[0].message
+
+
+def test_coherence_flags_rebuild_persisting_state():
+    files = [src("kubernetes_tpu/ops/o.py", COH_REBUILD_PERSISTS)]
+    findings = coherence.check(files, chaos_families=COH_FAMILIES)
+    assert len(findings) == 1
+    assert "persists state through an attribute store" in findings[0].message
+
+
+def test_coherence_requires_declaration_on_known_prep_builders():
+    files = [src("kubernetes_tpu/ops/o.py", COH_REBUILD_UNDECLARED)]
+    findings = coherence.check(files, chaos_families=COH_FAMILIES)
+    assert len(findings) == 1
+    assert "must carry '# coherence: rebuilt-per-solve'" in findings[0].message
+
+
+def test_coherence_seeded_registry_requires_annotation():
+    code = '''
+class DeviceClusterMirror:
+    def __init__(self):
+        self._dev = None
+'''
+    files = [src("kubernetes_tpu/models/m.py", code)]
+    findings = coherence.check(files, chaos_families=COH_FAMILIES)
+    assert len(findings) == 1
+    assert "declares no '# resident:'" in findings[0].message
 
 
 # -- the real-tree gate ------------------------------------------------------
