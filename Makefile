@@ -6,13 +6,13 @@
 PY ?= python
 
 .PHONY: test chaos chaos-restart chaos-serving bench lint lint-shapes \
-	lint-coherence multichip race native-ext test-journal
+	lint-coherence lint-obligations multichip race native-ext test-journal
 
 # graftlint: the project-native static analysis suite (guarded-by,
 # hot-path purity, registry drift, lock-order, tensor-contract,
-# atomicity, coherence — docs/static_analysis.md).  Exits non-zero on
-# any finding outside kubernetes_tpu/analysis/baseline.json and on
-# stale baseline entries.  Import-light: no JAX init.
+# atomicity, coherence, obligations — docs/static_analysis.md).  Exits
+# non-zero on any finding outside kubernetes_tpu/analysis/baseline.json
+# and on stale baseline entries.  Import-light: no JAX init.
 lint:
 	$(PY) -m kubernetes_tpu.analysis
 
@@ -27,6 +27,13 @@ lint-shapes:
 # is the GRAFTLINT_COHERENCE=1 epoch auditor (analysis/epochs.py).
 lint-coherence:
 	$(PY) -m kubernetes_tpu.analysis --coherence
+
+# graftobl focused mode: the linear-obligation engine alone
+# (analysis/obligations.py; it also rides `make lint`).  The runtime
+# half is the GRAFTLINT_OBLIGATIONS=1 exactly-once ledger
+# (analysis/ledger.py).
+lint-obligations:
+	$(PY) -m kubernetes_tpu.analysis --obligations
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow and not chaos' \

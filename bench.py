@@ -1997,7 +1997,7 @@ def config13():
 def main() -> None:
     import sys
 
-    from kubernetes_tpu.analysis import epochs, retrace
+    from kubernetes_tpu.analysis import epochs, ledger, retrace
     from kubernetes_tpu.utils import trace as tracemod
 
     tracemod.drain_overruns()  # measure only this run's traces
@@ -2010,8 +2010,13 @@ def main() -> None:
     # retraces.  The graftcoh epoch auditor is armed alongside it: every
     # resident buffer a solve consumes is audited against the scheduler
     # cache's current generations, and BENCH_STRICT fails on any
-    # violation (docs/static_analysis.md coherence section).
-    with retrace.tracked(), epochs.tracked() as coh:
+    # violation (docs/static_analysis.md coherence section).  The
+    # graftobl exactly-once ledger rides along: every popped pod, cache
+    # assume, APF seat, arbiter slot and inflight counter must discharge
+    # exactly once, and BENCH_STRICT fails on any leak or
+    # double-discharge (docs/static_analysis.md obligations section).
+    with retrace.tracked(), epochs.tracked() as coh, \
+            ledger.tracked() as led:
         extra = {
             "c1_fit_500": config1(),
             "c2_balanced_5k": config2(),
@@ -2087,6 +2092,16 @@ def main() -> None:
         "rollbacks_blocked": coh.rollbacks_blocked,
         "violations": coh.violations[:5],
     }
+    # graftobl ledger totals for the whole run (leaks are computed at
+    # this point — after every runner quiesced, so anything still held
+    # really is leaked, not merely in flight)
+    extra["obligations"] = {
+        "tracked_total": led.tracked_total,
+        "leaks_total": led.leaks_total,
+        "double_discharge_total": led.double_discharge_total,
+        "leaks": led.outstanding()[:5],
+        "double_discharges": led.double[:5],
+    }
     c5 = extra["c5_gang_50k"]
     pods_per_s = 10_000 / c5["latency_s"]
     print(
@@ -2134,6 +2149,25 @@ def main() -> None:
             failures.append(
                 "coherence auditor armed but performed 0 audits (warm "
                 "path never reached an audited consume site)"
+            )
+        # graftobl gates: the armed ledger must have tracked real
+        # acquisitions and seen every one discharged exactly once
+        obl = extra["obligations"]
+        if obl["leaks_total"]:
+            failures.append(
+                f"{obl['leaks_total']} leaked obligation(s): "
+                + "; ".join(obl["leaks"][:3])
+            )
+        if obl["double_discharge_total"]:
+            failures.append(
+                f"{obl['double_discharge_total']} obligation "
+                "double-discharge(s): "
+                + "; ".join(obl["double_discharges"][:3])
+            )
+        if not obl["tracked_total"]:
+            failures.append(
+                "obligation ledger armed but tracked 0 acquisitions "
+                "(hooks never reached)"
             )
         # overload-protection gates: NO scenario may destructively
         # terminate a watcher (backpressure must absorb the load), and

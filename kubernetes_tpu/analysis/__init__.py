@@ -1,6 +1,6 @@
 """graftlint — project-native static analysis for the scheduler tree.
 
-Seven import-light passes (plus the JAX-backed ``--shapes`` mode)
+Eight import-light passes (plus the JAX-backed ``--shapes`` mode)
 enforce the conventions the solve→assume→bind pipeline's correctness
 rests on (docs/static_analysis.md):
 
@@ -48,6 +48,18 @@ rests on (docs/static_analysis.md):
                ``# coherence: rebuilt-per-solve``.  The runtime half is
                the GRAFTLINT_COHERENCE=1 epoch auditor
                (analysis/epochs.py).
+  obligations  linear obligations: a resource acquired on one line
+               (popped pod, DispatchArbiter slot, APF seat, cache
+               assume, ``*_inflight`` increment, armed fault registry)
+               must be discharged exactly once on every outgoing path
+               — including exception edges and ``finally`` blocks —
+               with call-summary propagation so discharge via a helper
+               (``_fail_bind``, ``_salvage_cycle``, ``release_slot``)
+               counts, and ownership transfer (return / attribute
+               store / hand-off callee) discharging without a local
+               release.  The runtime half is the
+               GRAFTLINT_OBLIGATIONS=1 exactly-once ledger
+               (analysis/ledger.py).
   recompile-discipline
                (``--shapes`` mode / ``make lint-shapes``: imports JAX)
                every @hot_path kernel driven through ``jax.eval_shape``
@@ -76,18 +88,18 @@ import re
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-#: every check id the suppression syntax accepts.  The first seven run
+#: every check id the suppression syntax accepts.  The first eight run
 #: in the default import-light CLI; "recompile-discipline" imports JAX
 #: and runs only under `python -m kubernetes_tpu.analysis --shapes`.
 CHECK_IDS = (
     "guarded-by", "purity", "registry", "lock-order", "tensor-contract",
-    "atomicity", "coherence", "recompile-discipline",
+    "atomicity", "coherence", "obligations", "recompile-discipline",
 )
 
 #: the stdlib-ast subset run_all executes (no JAX initialization)
 STATIC_CHECK_IDS = (
     "guarded-by", "purity", "registry", "lock-order", "tensor-contract",
-    "atomicity", "coherence",
+    "atomicity", "coherence", "obligations",
 )
 
 # check ids after `disable=`, comma-separated; anything after the ids
@@ -267,13 +279,13 @@ def run_all(
     checks: Optional[Sequence[str]] = None,
     package: str = "kubernetes_tpu",
 ) -> List[Finding]:
-    """Run the selected static passes (default: all seven import-light
+    """Run the selected static passes (default: all eight import-light
     checks) over root/<package>.  The JAX-backed recompile-discipline
     pass is NOT run here — it lives behind the CLI's ``--shapes`` mode
     (analysis/shapes.py) so ``make lint`` stays import-light."""
     from . import (
-        atomicity, coherence, guarded, lockorder, purity, registry,
-        tensorcontract,
+        atomicity, coherence, guarded, lockorder, obligations, purity,
+        registry, tensorcontract,
     )
 
     files = load_sources(root, [package])
@@ -293,5 +305,7 @@ def run_all(
         findings.extend(atomicity.check(files))
     if "coherence" in selected:
         findings.extend(coherence.check(files))
+    if "obligations" in selected:
+        findings.extend(obligations.check(files))
     findings.sort(key=lambda f: (f.file, f.line, f.check, f.message))
     return findings

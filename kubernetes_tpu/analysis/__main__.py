@@ -1,10 +1,10 @@
 """graftlint CLI: ``python -m kubernetes_tpu.analysis`` (or ``make lint``).
 
-Default mode runs the seven import-light static passes (guarded-by,
-purity, registry, lock-order, tensor-contract, atomicity, coherence)
-over the repository's ``kubernetes_tpu`` tree, subtracts the reviewed
-baseline, and exits non-zero on any new finding OR any stale baseline
-entry (the baseline only shrinks).
+Default mode runs the eight import-light static passes (guarded-by,
+purity, registry, lock-order, tensor-contract, atomicity, coherence,
+obligations) over the repository's ``kubernetes_tpu`` tree, subtracts
+the reviewed baseline, and exits non-zero on any new finding OR any
+stale baseline entry (the baseline only shrinks).
 
 ``--shapes`` mode (``make lint-shapes``) runs the JAX-backed
 recompile-discipline pass instead — eval_shape over the pad-bucket
@@ -22,6 +22,14 @@ coherence.py).  It stays import-light and also rides the default mode;
 the focused mode exists for triage symmetry with ``--shapes`` /
 ``--interleave``.  The runtime half is the GRAFTLINT_COHERENCE=1 epoch
 auditor (analysis/epochs.py).
+
+``--obligations`` mode (``make lint-obligations``) runs graftobl's
+static half alone — the linear-obligation engine (analysis/
+obligations.py): every popped pod / arbiter slot / APF seat / cache
+assume / inflight counter / armed fault registry must be discharged
+exactly once on every outgoing path.  Also import-light, also rides
+the default mode.  The runtime half is the GRAFTLINT_OBLIGATIONS=1
+exactly-once ledger (analysis/ledger.py).
 """
 
 from __future__ import annotations
@@ -73,6 +81,13 @@ def main(argv=None) -> int:
         "the default mode)",
     )
     parser.add_argument(
+        "--obligations",
+        action="store_true",
+        help="run only the obligations (graftobl) static pass — the "
+        "linear-obligation engine over pods/slots/seats/assumes "
+        "(import-light; it also rides the default mode)",
+    )
+    parser.add_argument(
         "--interleave",
         action="store_true",
         help="run the graftsched interleaving explorer over the scenario "
@@ -116,6 +131,9 @@ def main(argv=None) -> int:
         findings = shapes.check(root)
     elif args.coherence:
         checks = ["coherence"]
+        findings = run_all(root, checks=checks)
+    elif args.obligations:
+        checks = ["obligations"]
         findings = run_all(root, checks=checks)
     else:
         checks = [c.strip() for c in args.checks.split(",") if c.strip()]

@@ -1,0 +1,912 @@
+"""graftobl — linear-obligation lint (static pass: "obligations").
+
+A *linear obligation* is a resource acquired on one line that must be
+discharged exactly once on every outgoing path of the acquiring
+function — bind/requeue/park a popped pod, release an acquired
+DispatchArbiter slot or APF seat, confirm-or-forget a cache assume,
+decrement an ``*_inflight`` counter, disarm an armed fault registry.
+The chaos suites enforce these invariants probabilistically (~75
+seeds); this pass enforces them structurally, path by path.
+
+Model (docs/static_analysis.md#obligations has the full grammar):
+
+  * Each :class:`Spec` names the acquire shape (method name + receiver
+    regex + which value carries the obligation: the call result, the
+    receiver, or the first argument) and the discharge surface (method
+    names that retire the obligation when the obligated value is their
+    receiver or an argument).
+  * The engine abstract-interprets each acquiring function's statement
+    tree path-sensitively: ``if``/``else`` fork the obligation state,
+    loops join it, ``try`` routes the states observed at every
+    statement boundary of the body into the handlers, ``finally``
+    transforms every outgoing edge (fall-through, ``return``,
+    ``raise``, ``break``/``continue``), and a handler-less
+    ``try/finally`` adds the escaping-exception edge explicitly.
+  * Ownership TRANSFER discharges without a local release: returning
+    or yielding the obligated value, storing it into an attribute
+    (``ds._slot = slot`` — the DeviceSolve owns the slot now),
+    passing it to a declared hand-off callee (``pool.submit``,
+    ``wave.append``, ``threading.Thread``), or iterating a popped
+    batch into a loop variable the body discharges.
+  * CALL SUMMARIES propagate discharge through helpers: a function
+    whose body discharges kind K (seeded for the pipeline's containment
+    helpers — ``_fail_bind``, ``_salvage_cycle``, ``release_slot`` —
+    and computed for everything else) discharges K when the obligated
+    value is passed to it.
+  * ``exception_safe`` specs must also discharge on ``raise`` edges
+    (explicit ``raise`` statements and the handler-less-``try`` escape
+    edge); non-exception-safe kinds (pods, assumes) are contained at
+    cycle level by ``_salvage_cycle`` — the runtime ledger
+    (analysis/ledger.py, GRAFTLINT_OBLIGATIONS=1) owns that cross-
+    function half.
+
+Counter obligations (``_stream_inflight += 1`` / ``_dispatch_inflight
+= True``) use the same engine with increment/decrement events instead
+of call matching; a decrement with no in-function increment is ignored
+(the increment lives in another function — the runtime ledger pairs
+those).
+
+The fault-registry spec additionally scans ``tests/*.py`` from disk
+(the package walk run_all hands us never includes tests — same trick
+as coherence's chaos-family scan); ``with faults.armed(...)`` is
+self-discharging and never acquires.
+
+Escape hatch: ``# graftlint: disable=obligations -- <why>`` on the
+acquiring line (or its ``def`` line).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+
+from . import Finding, SourceFile, dotted_name
+
+CHECK = "obligations"
+
+# cap on distinct path states tracked per statement boundary; beyond it
+# the engine collapses to the union of held obligations (conservative)
+_MAX_STATES = 64
+
+
+@dataclass(frozen=True)
+class Spec:
+    kind: str
+    #: method names whose call acquires the obligation
+    acquire_methods: Tuple[str, ...]
+    #: regex the receiver's dotted name must match ("" receiver text
+    #: for plain-name calls)
+    acquire_recv: str
+    #: which value carries the obligation: "result" (the assign
+    #: target), "receiver", "arg0" (first positional argument), or
+    #: "global" (process-global resource, e.g. the fault registry)
+    bind: str
+    #: method names that retire the obligation when the obligated value
+    #: is their receiver or among their arguments (for bind="global":
+    #: any call of this name on an acquire_recv-matching receiver)
+    discharge_methods: Tuple[str, ...]
+    #: callee name tails that take ownership when the value is passed
+    transfer_calls: Tuple[str, ...] = ()
+    #: helper names seeded as must-discharge for this kind
+    summary_seeds: Tuple[str, ...] = ()
+    #: relpath substrings the spec applies to (() = every module)
+    modules: Tuple[str, ...] = ()
+    #: must the obligation also be discharged on raise edges?
+    exception_safe: bool = False
+    #: treat EVERY call made while the obligation is held as a
+    #: potential raise edge (fault registries exist to make arbitrary
+    #: calls raise — so any statement between arm and disarm is one)
+    calls_may_raise: bool = False
+
+
+@dataclass(frozen=True)
+class CounterSpec:
+    kind: str
+    #: regex matched against the incremented attribute's dotted name
+    attr_re: str
+    #: callee name tails whose invocation (or whose passing as an
+    #: argument, e.g. ``pool.submit(self._commit_stream_subwave, …)``)
+    #: hands the decrement off
+    handoff: Tuple[str, ...] = ()
+    modules: Tuple[str, ...] = ()
+
+
+SPECS: Tuple[Spec, ...] = (
+    # (a) popped pods: a batch leaving the queue's inflight tier must
+    # reach a disposition — dispatched onward, or requeued per-pod
+    Spec(
+        kind="pod",
+        acquire_methods=("pop_batch", "pop"),
+        acquire_recv=r"queue",
+        bind="result",
+        discharge_methods=(
+            "done", "delete", "requeue_backoff", "add_unschedulable", "add",
+        ),
+        transfer_calls=("append", "submit", "put", "extend"),
+        summary_seeds=("_dispatch_batch", "_fail_bind", "_salvage_cycle"),
+        modules=("scheduler/scheduler.py",),
+        exception_safe=False,
+    ),
+    # (b) DispatchArbiter slot: acquire() admission must be released
+    # (directly, via release_slot(), or by handing the slot to the
+    # DeviceSolve that releases in its decode finally)
+    Spec(
+        kind="slot",
+        acquire_methods=("acquire",),
+        acquire_recv=r"slot|arb",
+        bind="receiver",
+        discharge_methods=("release", "release_slot"),
+        summary_seeds=("release_slot",),
+        modules=("models/batch_scheduler.py", "scheduler/scheduler.py"),
+        exception_safe=True,
+    ),
+    # (c) APF seat: a granted Seat must be released exactly once
+    Spec(
+        kind="seat",
+        acquire_methods=("acquire",),
+        acquire_recv=r"apf|gate|flow",
+        bind="result",
+        discharge_methods=("release", "_release"),
+        modules=("api/",),
+        exception_safe=True,
+    ),
+    # (d) cache assume: confirm (finish_binding/add_pod) or forget
+    Spec(
+        kind="assume",
+        acquire_methods=("assume",),
+        acquire_recv=r"cache",
+        bind="arg0",
+        discharge_methods=(
+            "forget", "forget_key", "finish_binding", "add_pod",
+        ),
+        transfer_calls=("append", "Thread", "submit", "put", "extend"),
+        summary_seeds=("_fail_bind", "_salvage_cycle", "_misspeculate_group"),
+        modules=("scheduler/",),
+        exception_safe=False,
+    ),
+    # (f) fault registry: testing/faults.arm() must be disarmed on
+    # every path out of the arming test (``with faults.armed(...)`` is
+    # self-discharging and never matches)
+    Spec(
+        kind="fault",
+        acquire_methods=("arm",),
+        acquire_recv=r"faults|^$",
+        bind="global",
+        discharge_methods=("disarm",),
+        modules=("tests/",),
+        exception_safe=True,
+        calls_may_raise=True,
+    ),
+)
+
+COUNTER_SPECS: Tuple[CounterSpec, ...] = (
+    # (e) streamed sub-wave inflight gauge: += 1 at hand-off, -= 1 in
+    # the commit helper's finally (or the hand-off-failure handler)
+    CounterSpec(
+        kind="stream_inflight",
+        attr_re=r"\._stream_inflight$",
+        handoff=("_commit_stream_subwave",),
+        modules=("scheduler/scheduler.py",),
+    ),
+    # (e') watch-dispatch busy flag: armed before fanout, cleared in
+    # the loop's finally
+    CounterSpec(
+        kind="dispatch_inflight",
+        attr_re=r"\._dispatch_inflight$",
+        modules=("api/store.py",),
+    ),
+)
+
+
+# -- obligation state --------------------------------------------------------
+
+# one live obligation: (spec_index, obligated value name, acquire line).
+# spec_index < len(SPECS) → keyed spec; else counter spec.
+_Ob = Tuple[int, str, int]
+_State = FrozenSet[_Ob]
+
+
+def _spec_of(ob: _Ob):
+    idx = ob[0]
+    if idx < len(SPECS):
+        return SPECS[idx]
+    return COUNTER_SPECS[idx - len(SPECS)]
+
+
+def _root_match(var: str, name: Optional[str]) -> bool:
+    """Does `name` denote `var` or an enclosing/enclosed value of it?
+    ("info" matches "info.pod"; "info.pod" matches "info.pod")."""
+    if not name:
+        return False
+    return (
+        var == name
+        or var.startswith(name + ".")
+        or name.startswith(var + ".")
+    )
+
+
+@dataclass
+class _CallSite:
+    tail: str                      # method/function name
+    recv: Optional[str]            # dotted receiver ("a.b" of a.b.f())
+    arg_names: Tuple[str, ...]     # dotted names appearing in the args
+    arg_tails: Tuple[str, ...]     # last components of those names
+    line: int
+
+
+def _calls_in(node: ast.AST) -> List[_CallSite]:
+    out: List[_CallSite] = []
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        func = sub.func
+        if isinstance(func, ast.Attribute):
+            tail = func.attr
+            recv = dotted_name(func.value)
+        elif isinstance(func, ast.Name):
+            tail, recv = func.id, None
+        else:
+            continue
+        names: List[str] = []
+        for arg in list(sub.args) + [kw.value for kw in sub.keywords]:
+            for n in ast.walk(arg):
+                if isinstance(n, (ast.Attribute, ast.Name)):
+                    d = dotted_name(n)
+                    if d:
+                        names.append(d)
+        out.append(
+            _CallSite(
+                tail=tail,
+                recv=recv,
+                arg_names=tuple(names),
+                arg_tails=tuple(n.rsplit(".", 1)[-1] for n in names),
+                line=getattr(sub, "lineno", getattr(node, "lineno", 0)),
+            )
+        )
+    return out
+
+
+def _names_in(node: Optional[ast.AST]) -> List[str]:
+    if node is None:
+        return []
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, (ast.Attribute, ast.Name)):
+            d = dotted_name(n)
+            if d:
+                out.append(d)
+    return out
+
+
+# -- call summaries ----------------------------------------------------------
+
+def compute_summaries(
+    files: Sequence[SourceFile],
+) -> Dict[str, FrozenSet[str]]:
+    """name -> kinds the function discharges when the obligated value
+    is handed to it.  Seeded for the pipeline's containment helpers,
+    computed for everything else: a function whose body calls a
+    discharge method of kind K (or decrements a K counter) summarizes
+    as discharging K.  Deliberately may-discharge rather than
+    must-discharge — looseness here can only hide a leak from the
+    static half (the runtime ledger still catches it), never invent
+    one."""
+    summaries: Dict[str, Set[str]] = {}
+    for spec in SPECS:
+        for seed in spec.summary_seeds:
+            summaries.setdefault(seed, set()).add(spec.kind)
+    for cspec in COUNTER_SPECS:
+        for seed in cspec.handoff:
+            summaries.setdefault(seed, set()).add(cspec.kind)
+    for src in files:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            kinds: Set[str] = set()
+            for call in _calls_in(node):
+                for spec in SPECS:
+                    if call.tail in spec.discharge_methods:
+                        kinds.add(spec.kind)
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.AugAssign) and isinstance(
+                    sub.op, ast.Sub
+                ):
+                    tgt = dotted_name(sub.target)
+                    for cspec in COUNTER_SPECS:
+                        if tgt and re.search(cspec.attr_re, tgt):
+                            kinds.add(cspec.kind)
+            if kinds:
+                summaries.setdefault(node.name, set()).update(kinds)
+    return {k: frozenset(v) for k, v in summaries.items()}
+
+
+# -- the path-sensitive engine ----------------------------------------------
+
+class _Engine:
+    """Abstract interpreter for ONE function body: tracks the set of
+    live obligations per path, forking at branches and routing
+    exception edges through handlers and finally blocks."""
+
+    def __init__(
+        self,
+        src: SourceFile,
+        symbol: str,
+        specs: Sequence[Tuple[int, Spec]],
+        cspecs: Sequence[Tuple[int, CounterSpec]],
+        summaries: Dict[str, FrozenSet[str]],
+    ):
+        self.src = src
+        self.symbol = symbol
+        self.specs = specs
+        self.cspecs = cspecs
+        self.summaries = summaries
+        # acquire line -> (ob, set of leak-edge descriptions)
+        self.leaks: Dict[_Ob, Set[str]] = {}
+        self.discarded: List[Tuple[int, Spec]] = []
+
+    # .. statement effects ..................................................
+
+    def _exprs_of(self, stmt: ast.stmt) -> List[ast.AST]:
+        if isinstance(stmt, ast.Expr):
+            return [stmt.value]
+        if isinstance(stmt, ast.Assign):
+            return [stmt.value]
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            return [stmt.value]
+        if isinstance(stmt, ast.AugAssign):
+            return [stmt.value]
+        if isinstance(stmt, (ast.If, ast.While)):
+            return [stmt.test]
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return [stmt.iter]
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return [item.context_expr for item in stmt.items]
+        if isinstance(stmt, ast.Assert):
+            return [stmt.test]
+        if isinstance(stmt, ast.Raise) and stmt.exc is not None:
+            return [stmt.exc]
+        return []
+
+    def _apply_simple(self, stmt: ast.stmt, state: _State) -> _State:
+        """Discharges/transfers, then acquires, for one non-compound
+        statement (or the expression part of a compound one)."""
+        held = set(state)
+        calls: List[_CallSite] = []
+        for part in self._exprs_of(stmt):
+            calls.extend(_calls_in(part))
+
+        # 1) discharges + transfers against currently-held obligations
+        for ob in list(held):
+            spec = _spec_of(ob)
+            var = ob[1]
+            if isinstance(spec, CounterSpec):
+                for call in calls:
+                    if call.tail in spec.handoff or any(
+                        t in spec.handoff for t in call.arg_tails
+                    ):
+                        held.discard(ob)
+                continue
+            for call in calls:
+                if call.tail in spec.discharge_methods:
+                    if spec.bind == "global":
+                        if re.search(spec.acquire_recv, call.recv or ""):
+                            held.discard(ob)
+                    elif _root_match(var, call.recv) or any(
+                        _root_match(var, n) for n in call.arg_names
+                    ):
+                        held.discard(ob)
+                elif call.tail in spec.transfer_calls and any(
+                    _root_match(var, n) for n in call.arg_names
+                ):
+                    held.discard(ob)
+                elif spec.kind in self.summaries.get(call.tail, ()) and any(
+                    _root_match(var, n) for n in call.arg_names
+                ):
+                    held.discard(ob)
+                elif call.tail in spec.summary_seeds:
+                    # seeded CONTAINMENT helpers (_salvage_cycle,
+                    # _fail_bind, …) sweep everything in flight of
+                    # their kind — they reach the obligated objects
+                    # through pipeline state, not through arguments
+                    held.discard(ob)
+            # attribute store transfers ownership: ds._slot = slot
+            if isinstance(stmt, ast.Assign) and any(
+                isinstance(t, ast.Attribute) for t in stmt.targets
+            ):
+                if any(_root_match(var, n) for n in _names_in(stmt.value)):
+                    held.discard(ob)
+
+        # 2) counter increment/decrement events
+        cev = self._counter_event(stmt)
+        if cev is not None:
+            idx, var, line, is_push = cev
+            if is_push:
+                held.add((idx, var, line))
+            else:
+                for ob in sorted(held, key=lambda o: -o[2]):
+                    if ob[0] == idx and ob[1] == var:
+                        held.discard(ob)
+                        break
+                # no matching increment in this function: the pair is
+                # cross-function — the runtime ledger's job, not ours
+
+        # 3) acquires
+        for idx, spec in self.specs:
+            for call in calls:
+                if call.tail not in spec.acquire_methods:
+                    continue
+                if not re.search(spec.acquire_recv, call.recv or ""):
+                    continue
+                var = self._bind_var(spec, stmt, call)
+                if var is None:
+                    # bind="result" with the result discarded: the
+                    # obligation is unreachable the moment it exists
+                    self.discarded.append((call.line, spec))
+                    continue
+                held.add((idx, var, call.line))
+        return frozenset(held)
+
+    def _bind_var(
+        self, spec: Spec, stmt: ast.stmt, call: _CallSite
+    ) -> Optional[str]:
+        if spec.bind == "receiver":
+            return call.recv
+        if spec.bind == "global":
+            return f"<{spec.kind}>"
+        if spec.bind == "arg0":
+            return call.arg_names[0] if call.arg_names else None
+        # bind == "result": the assign target
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            return dotted_name(stmt.targets[0])
+        if isinstance(stmt, ast.AnnAssign):
+            return dotted_name(stmt.target)
+        return None
+
+    def _counter_event(
+        self, stmt: ast.stmt
+    ) -> Optional[Tuple[int, str, int, bool]]:
+        if isinstance(stmt, ast.AugAssign):
+            tgt = dotted_name(stmt.target)
+            if not tgt:
+                return None
+            for idx, cspec in self.cspecs:
+                if re.search(cspec.attr_re, tgt):
+                    if isinstance(stmt.op, ast.Add):
+                        return (idx, tgt, stmt.lineno, True)
+                    if isinstance(stmt.op, ast.Sub):
+                        return (idx, tgt, stmt.lineno, False)
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            tgt = dotted_name(stmt.targets[0])
+            val = stmt.value
+            if (
+                tgt
+                and isinstance(val, ast.Constant)
+                and isinstance(val.value, bool)
+            ):
+                for idx, cspec in self.cspecs:
+                    if re.search(cspec.attr_re, tgt):
+                        return (idx, tgt, stmt.lineno, bool(val.value))
+        return None
+
+    # .. branch refinement ...................................................
+
+    def _drop_vars(self, test: ast.AST, branch: bool) -> Set[str]:
+        """Value names whose obligations are VACUOUS inside the given
+        branch of `test`: ``if x is None`` / ``if not batch`` mean no
+        seat was granted / the popped collection is empty, so an
+        obligation bound to that name cannot exist on that path (the
+        acquire and the guard talk about the same value)."""
+        if isinstance(test, ast.BoolOp):
+            out: Set[str] = set()
+            if isinstance(test.op, ast.And) and branch:
+                for v in test.values:
+                    out |= self._drop_vars(v, True)
+            elif isinstance(test.op, ast.Or) and not branch:
+                for v in test.values:
+                    out |= self._drop_vars(v, False)
+            return out
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._drop_vars(test.operand, not branch)
+        if (
+            isinstance(test, ast.Compare)
+            and len(test.ops) == 1
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None
+        ):
+            n = dotted_name(test.left)
+            if n:
+                if isinstance(test.ops[0], ast.Is) and branch:
+                    return {n}
+                if isinstance(test.ops[0], ast.IsNot) and not branch:
+                    return {n}
+            return set()
+        n = dotted_name(test)
+        if n and not branch:
+            return {n}
+        return set()
+
+    def _refine(
+        self, test: ast.AST, states: Set[_State], branch: bool
+    ) -> Set[_State]:
+        drops = self._drop_vars(test, branch)
+        if not drops:
+            return set(states)
+        out: Set[_State] = set()
+        for s in states:
+            out.add(
+                frozenset(
+                    ob
+                    for ob in s
+                    if isinstance(_spec_of(ob), CounterSpec)
+                    or not any(_root_match(ob[1], d) for d in drops)
+                )
+            )
+        return out
+
+    # .. control flow ........................................................
+
+    def _join(self, states: Iterable[_State]) -> Set[_State]:
+        out = set(states)
+        if len(out) > _MAX_STATES:
+            merged: Set[_Ob] = set()
+            for s in out:
+                merged.update(s)
+            out = {frozenset(merged)}
+        return out
+
+    def exec_block(
+        self,
+        stmts: Sequence[ast.stmt],
+        states: Set[_State],
+        mid: Optional[Set[_State]] = None,
+    ) -> Tuple[Set[_State], List[Tuple[str, Set[_State]]]]:
+        """Returns (fall-through states, exits).  Exit kinds: "return",
+        "raise", "break", "continue".  `mid` collects the states at
+        every statement boundary (the handler-entry approximation)."""
+        exits: List[Tuple[str, Set[_State]]] = []
+        for stmt in stmts:
+            if mid is not None:
+                mid.update(states)
+            states, ex = self.exec_stmt(stmt, states, mid)
+            exits.extend(ex)
+            if not states:
+                break
+        return states, exits
+
+    def exec_stmt(
+        self,
+        stmt: ast.stmt,
+        states: Set[_State],
+        mid: Optional[Set[_State]],
+    ) -> Tuple[Set[_State], List[Tuple[str, Set[_State]]]]:
+        if isinstance(stmt, ast.Return):
+            out: Set[_State] = set()
+            for s in states:
+                kept = frozenset(
+                    ob
+                    for ob in s
+                    if not any(
+                        _root_match(ob[1], n) for n in _names_in(stmt.value)
+                    )
+                )
+                out.add(kept)
+            return set(), [("return", out)]
+        if isinstance(stmt, ast.Raise):
+            states = {self._apply_simple(stmt, s) for s in states}
+            return set(), [("raise", set(states))]
+        if isinstance(stmt, ast.Break):
+            return set(), [("break", set(states))]
+        if isinstance(stmt, ast.Continue):
+            return set(), [("continue", set(states))]
+
+        if isinstance(stmt, ast.If):
+            pre = {self._apply_simple(stmt, s) for s in states}
+            then_in = self._refine(stmt.test, pre, True)
+            else_in = self._refine(stmt.test, pre, False)
+            then_out, then_ex = self.exec_block(stmt.body, then_in, mid)
+            else_out, else_ex = self.exec_block(stmt.orelse, else_in, mid)
+            return self._join(then_out | else_out), then_ex + else_ex
+
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            pre = {self._apply_simple(stmt, s) for s in states}
+            body_in = set(pre)
+            renamed: Dict[_Ob, _Ob] = {}
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                # iterating an obligated collection moves the per-item
+                # obligation onto the loop target for the body's scope
+                iter_names = _names_in(stmt.iter)
+                tgt = dotted_name(stmt.target)
+                if tgt:
+                    body_in = set()
+                    for s in pre:
+                        cur = set(s)
+                        for ob in list(cur):
+                            if not isinstance(_spec_of(ob), CounterSpec) and any(
+                                _root_match(ob[1], n) for n in iter_names
+                            ):
+                                alias = (ob[0], tgt, ob[2])
+                                renamed[alias] = ob
+                                cur.discard(ob)
+                                cur.add(alias)
+                        body_in.add(frozenset(cur))
+            body_out, body_ex = self.exec_block(stmt.body, set(body_in), mid)
+            # one more pass from the joined state approximates the loop
+            body_out2, body_ex2 = self.exec_block(
+                stmt.body, self._join(body_in | body_out), mid
+            )
+            loop_ex: List[Tuple[str, Set[_State]]] = []
+            after: Set[_State] = set(body_out | body_out2)
+            for kind, sts in body_ex + body_ex2:
+                if kind in ("break", "continue"):
+                    after |= sts
+                else:
+                    loop_ex.append((kind, sts))
+            if renamed:
+                restored: Set[_State] = set()
+                for s in after:
+                    cur = set(s)
+                    for alias, orig in renamed.items():
+                        if alias in cur:
+                            # the body left a loop-item obligation
+                            # live: the collection is still charged
+                            cur.discard(alias)
+                            cur.add(orig)
+                    restored.add(frozenset(cur))
+                after = restored
+                # zero iterations means the obligated collection was
+                # empty — the per-item obligation is vacuously met on
+                # the skip path
+                pre = {
+                    frozenset(ob for ob in s if ob not in renamed.values())
+                    for s in pre
+                }
+            out = self._join(pre | after)
+            if stmt.orelse:
+                out, else_ex = self.exec_block(stmt.orelse, out, mid)
+                loop_ex.extend(else_ex)
+            return out, loop_ex
+
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            pre = {self._apply_simple(stmt, s) for s in states}
+            return self.exec_block(stmt.body, set(pre), mid)
+
+        if isinstance(stmt, ast.Try):
+            body_mid: Set[_State] = set(states)
+            body_out, body_ex = self.exec_block(
+                stmt.body, set(states), body_mid
+            )
+            handler_in = self._join(body_mid)
+            # handlers consume the body's raise edges (over-approx:
+            # assume typed handlers catch — biases toward fewer
+            # findings); return/break/continue always pass through
+            exits: List[Tuple[str, Set[_State]]] = [
+                (k, s)
+                for k, s in body_ex
+                if k != "raise" or not stmt.handlers
+            ]
+            fall: Set[_State] = set()
+            for handler in stmt.handlers:
+                h_out, h_ex = self.exec_block(
+                    handler.body, set(handler_in), mid
+                )
+                fall |= h_out
+                exits.extend(h_ex)
+            if stmt.orelse:
+                body_out, else_ex = self.exec_block(stmt.orelse, body_out, mid)
+                exits.extend(else_ex)
+            fall |= body_out
+            if not stmt.handlers:
+                # try/finally with no except: the exception escapes —
+                # an explicit raise edge carrying the mid-body states
+                exits.append(("raise", handler_in))
+            if stmt.finalbody:
+                fall, fin_ex = self.exec_block(stmt.finalbody, fall, mid)
+                exits = [
+                    (kind, self.exec_block(stmt.finalbody, sts, mid)[0])
+                    for kind, sts in exits
+                ] + fin_ex
+            return self._join(fall), exits
+
+        if isinstance(
+            stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            return set(states), []  # nested defs analyzed separately
+
+        exits: List[Tuple[str, Set[_State]]] = []
+        has_call = any(isinstance(n, ast.Call) for n in ast.walk(stmt))
+        out: Set[_State] = set()
+        risky: Set[_State] = set()
+        for s in states:
+            post = self._apply_simple(stmt, s)
+            out.add(post)
+            if has_call and any(
+                ob in post
+                and isinstance(_spec_of(ob), Spec)
+                and _spec_of(ob).calls_may_raise
+                for ob in s
+            ):
+                # the call may raise while the obligation is held on
+                # BOTH sides of the statement (strictly between the
+                # acquire and the discharge — the acquiring and
+                # discharging statements themselves are exempt)
+                risky.add(s)
+        if risky:
+            exits.append(("raise", risky))
+        return out, exits
+
+    # .. driver ..............................................................
+
+    def run(self, body: Sequence[ast.stmt]) -> None:
+        init: Set[_State] = {frozenset()}
+        fall, exits = self.exec_block(body, init)
+        for s in fall:
+            for ob in s:
+                self.leaks.setdefault(ob, set()).add("fall-through return")
+        for kind, sts in exits:
+            for s in sts:
+                for ob in s:
+                    spec = _spec_of(ob)
+                    if kind == "return":
+                        self.leaks.setdefault(ob, set()).add("return")
+                    elif kind == "raise":
+                        exc_safe = (
+                            spec.exception_safe
+                            if isinstance(spec, Spec)
+                            else True
+                        )
+                        if exc_safe:
+                            self.leaks.setdefault(ob, set()).add("exception")
+                    # break/continue at function level: unreachable
+
+
+# -- module walk -------------------------------------------------------------
+
+def _iter_functions(src: SourceFile):
+    """Yield (symbol, node) for every function/method, including
+    nested ones (symbol is dotted through the enclosing scopes)."""
+
+    def walk(node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                sym = f"{prefix}{child.name}"
+                yield sym, child
+                yield from walk(child, f"{sym}.")
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.")
+
+    yield from walk(src.tree, "")
+
+
+def _specs_for(src: SourceFile):
+    rel = src.relpath.replace(os.sep, "/")
+    specs = [
+        (i, s)
+        for i, s in enumerate(SPECS)
+        if not s.modules or any(m in rel for m in s.modules)
+    ]
+    cspecs = [
+        (len(SPECS) + i, c)
+        for i, c in enumerate(COUNTER_SPECS)
+        if not c.modules or any(m in rel for m in c.modules)
+    ]
+    return specs, cspecs
+
+
+def _has_acquire_shape(node: ast.AST, specs, cspecs) -> bool:
+    """Cheap pre-filter: does this function mention any acquire method
+    name / counter attribute at all?"""
+    names = {s.kind for _ in ()}  # noqa: F841 — clarity only
+    meths = {m for _, s in specs for m in s.acquire_methods}
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in meths:
+            return True
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+            if sub.func.id in meths:
+                return True
+        if isinstance(sub, (ast.AugAssign, ast.Assign)):
+            tgt = (
+                sub.target
+                if isinstance(sub, ast.AugAssign)
+                else (sub.targets[0] if len(sub.targets) == 1 else None)
+            )
+            d = dotted_name(tgt) if tgt is not None else None
+            if d and any(re.search(c.attr_re, d) for _, c in cspecs):
+                return True
+    return False
+
+
+def _check_source(
+    src: SourceFile,
+    summaries: Dict[str, FrozenSet[str]],
+    findings: List[Finding],
+) -> None:
+    specs, cspecs = _specs_for(src)
+    if not specs and not cspecs:
+        return
+    for symbol, node in _iter_functions(src):
+        if not _has_acquire_shape(node, specs, cspecs):
+            continue
+        eng = _Engine(src, symbol, specs, cspecs, summaries)
+        eng.run(node.body)
+        def_line = node.lineno
+        for (idx, var, line), edges in sorted(
+            eng.leaks.items(), key=lambda kv: (kv[0][2], kv[0][1])
+        ):
+            spec = _spec_of((idx, var, line))
+            if src.suppressed(line, CHECK) or src.suppressed(def_line, CHECK):
+                continue
+            findings.append(
+                Finding(
+                    check=CHECK,
+                    file=src.relpath,
+                    line=line,
+                    symbol=symbol,
+                    message=(
+                        f"{spec.kind} obligation on '{var}' acquired here "
+                        f"leaks on {', '.join(sorted(edges))} path(s): "
+                        "every outgoing path must discharge it exactly "
+                        "once (release/requeue/forget/decrement, a "
+                        "summarized helper, or an ownership transfer)"
+                    ),
+                )
+            )
+        for line, spec in eng.discarded:
+            if src.suppressed(line, CHECK) or src.suppressed(def_line, CHECK):
+                continue
+            findings.append(
+                Finding(
+                    check=CHECK,
+                    file=src.relpath,
+                    line=line,
+                    symbol=symbol,
+                    message=(
+                        f"{spec.kind} obligation acquired here discards "
+                        "the obligated result: nothing can ever "
+                        "discharge it"
+                    ),
+                )
+            )
+
+
+def _test_sources(files: Sequence[SourceFile]) -> List[SourceFile]:
+    """Load tests/*.py from disk for the fault-registry spec (the
+    package walk never includes them — same root-recovery trick as
+    coherence's chaos-family scan).  Returns [] for fixture runs whose
+    synthetic paths don't resolve."""
+    for src in files:
+        if not src.path.endswith(src.relpath):
+            continue
+        root = src.path[: -len(src.relpath)]
+        tests = os.path.join(root, "tests")
+        if not os.path.isdir(tests):
+            return []
+        out: List[SourceFile] = []
+        for fn in sorted(os.listdir(tests)):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(tests, fn)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    text = f.read()
+                out.append(SourceFile(path, os.path.join("tests", fn), text))
+            except (SyntaxError, UnicodeDecodeError, OSError):
+                continue
+        return out
+    return []
+
+
+def check(
+    files: Sequence[SourceFile],
+    test_files: Optional[Sequence[SourceFile]] = None,
+) -> List[Finding]:
+    if test_files is None:
+        test_files = _test_sources(files)
+    everything = list(files) + list(test_files)
+    summaries = compute_summaries(everything)
+    findings: List[Finding] = []
+    for src in everything:
+        _check_source(src, summaries, findings)
+    return findings

@@ -52,6 +52,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from . import auth as authmod
+from ..analysis import ledger as _ledger
 from ..testing import faults
 
 
@@ -79,6 +80,7 @@ class Seat:
         self.level = level
         self.donor = donor
         self._released = False
+        _ledger.acquire("seat", id(self))
 
     # compat: callers that logged the old PriorityLevel return value's
     # name keep working
@@ -349,6 +351,7 @@ class APFGate:
             if seat._released:
                 return
             seat._released = True
+            _ledger.discharge("seat", id(seat))
             seat.level.in_flight -= 1
             seat.donor.seats_used -= 1
             if self._dispatch_locked():

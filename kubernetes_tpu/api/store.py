@@ -79,6 +79,7 @@ from typing import (
     Any, Callable, Dict, Iterator, List, NamedTuple, Optional, Tuple,
 )
 
+from ..analysis import ledger as _ledger
 from ..testing import faults
 from . import framing
 from . import types as api
@@ -2127,7 +2128,8 @@ def _watch_dispatch_loop(store_ref: "weakref.ref[Store]", sid: int) -> None:
                 batch = shard._dispatch_backlog.popleft()
                 # close() waits for backlog-empty AND not-inflight, so a
                 # batch mid-fan-out still blocks a graceful shutdown
-                shard._dispatch_inflight = True
+                shard._dispatch_inflight = True  # graftlint: disable=obligations -- armed only when a batch popped; the fan-out finally below clears it under the same cv (the batch-is-None correlation is beyond the engine)
+                _ledger.push("dispatch_inflight", id(shard))
         if batch is not None:
             try:
                 store._fan_out(*batch)
@@ -2138,6 +2140,7 @@ def _watch_dispatch_loop(store_ref: "weakref.ref[Store]", sid: int) -> None:
             finally:
                 with shard._dispatch_cv:
                     shard._dispatch_inflight = False
+                    _ledger.pop("dispatch_inflight", id(shard))
                     shard._dispatch_cv.notify_all()
         # drop the strong references before sleeping so GC can collect
         # an otherwise-abandoned store

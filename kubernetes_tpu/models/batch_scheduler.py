@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..analysis import epochs, retrace
+from ..analysis import ledger as _ledger
 from ..analysis.markers import hot_path
 from ..api import types as api
 from ..ops import assign as assign_ops
@@ -187,13 +188,20 @@ class DispatchArbiter:
                 if remaining <= 0:
                     self.forced += 1
                     self._inflight += 1
+                    _ledger.push("slot", id(self))
                     return False
                 self._cv.wait(min(remaining, 0.2))
             self._inflight += 1
+            _ledger.push("slot", id(self))
             return True
 
     def release(self) -> None:  # graftlint: disable=purity -- slot return; reached from the decode path, not between dispatch and readback
         with self._cv:
+            # the ledger pop sits BEFORE the below-zero guard on purpose:
+            # the guard keeps production counters sane, but a release with
+            # no matching acquire is exactly the double-discharge the
+            # GRAFTLINT_OBLIGATIONS ledger exists to surface
+            _ledger.pop("slot", id(self))
             if self._inflight > 0:
                 self._inflight -= 1
             self._cv.notify_all()

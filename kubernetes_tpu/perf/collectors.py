@@ -227,6 +227,12 @@ class MetricsCollector:
         # disarmed — docs/static_analysis.md coherence section)
         "scheduler_coherence_audits_total",
         "scheduler_coherence_violations_total",
+        # graftobl runtime exactly-once ledger (GRAFTLINT_OBLIGATIONS=1;
+        # all 0 when disarmed — docs/static_analysis.md obligations
+        # section)
+        "scheduler_obligations_tracked_total",
+        "scheduler_obligation_leaks_total",
+        "scheduler_obligation_double_discharge_total",
         "scheduler_binder_restarts_total",
         "scheduler_binder_poison_waves_total",
         "scheduler_journal_recovered_records",

@@ -349,6 +349,18 @@ class Registry:
         self.coherence_violations = Gauge(
             "scheduler_coherence_violations_total"
         )
+        # graftobl runtime exactly-once ledger (analysis/ledger.py),
+        # mirrored each cycle when GRAFTLINT_OBLIGATIONS=1 arms it (all
+        # 0 disarmed): obligations tracked, leaked past discharge, and
+        # double-discharged — chaos and BENCH_STRICT runs gate leaks ==
+        # double-discharges == 0
+        self.obligations_tracked = Gauge(
+            "scheduler_obligations_tracked_total"
+        )
+        self.obligation_leaks = Gauge("scheduler_obligation_leaks_total")
+        self.obligation_double_discharge = Gauge(
+            "scheduler_obligation_double_discharge_total"
+        )
         # -- overload-protection surface (docs/robustness.md) -------------
         # deepest per-watcher coalescing backlog at the last cycle mirror
         self.watch_queue_depth = Gauge("scheduler_watch_queue_depth")

@@ -32,6 +32,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ..analysis import ledger as _ledger
 from ..api import types as api
 from ..ops import assign as assign_ops
 
@@ -364,6 +365,11 @@ class SchedulingQueue:
             info.pod = pod
             if pod.spec.scheduling_gates:
                 info.gated = True
+                if self._tier.get(key) == "inflight":
+                    # re-gated mid-cycle: parking IS the pod's
+                    # disposition — the in-flight cycle's later
+                    # requeue/park callbacks see the gate and no-op
+                    _ledger.discharge("pod", key)
                 self._gated[key] = info
                 self._tier[key] = "gated"
                 return
@@ -487,7 +493,8 @@ class SchedulingQueue:
             self._unschedulable.pop(key, None)
             self._gated.pop(key, None)
             self._gang_staged.pop(key, None)
-            self._tier.pop(key, None)
+            if self._tier.pop(key, None) == "inflight":
+                _ledger.discharge("pod", key)
             self._drop_group_member(pod, key)
             # lazy heap deletion: stale keys skipped on pop
             group = gang_key(pod)
@@ -573,6 +580,7 @@ class SchedulingQueue:
                 # backoff/active heap entries are lazily skipped via
                 # the tier check on their eventual pop
                 self._tier[key] = "inflight"
+                _ledger.acquire("pod", key)
                 info.attempts += 1
                 info.popped_event_seq = self._event_seq
                 batch.append(info)
@@ -674,7 +682,8 @@ class SchedulingQueue:
         with self._cond:
             key = pod_key(pod)
             self._infos.pop(key, None)
-            self._tier.pop(key, None)
+            if self._tier.pop(key, None) == "inflight":
+                _ledger.discharge("pod", key)
             self._drop_group_member(pod, key)
             # a departing member can unblock a skipped gang in pop_batch
             self._cond.notify_all()
@@ -690,6 +699,14 @@ class SchedulingQueue:
             key = pod_key(info.pod)
             if key not in self._infos:
                 return  # deleted meanwhile
+            if self._tier.get(key) == "gated":
+                # re-gated mid-cycle (an update added scheduling gates
+                # while the pod was inflight): the gate parked it —
+                # overriding to "unsched" would let move_for_event
+                # requeue a gated pod into a solve
+                return
+            if self._tier.get(key) == "inflight":
+                _ledger.discharge("pod", key)
             info.unschedulable_since = self._clock()
             info.unschedulable_reason = reason
             if self._missed_event_locked(info, reason):
@@ -723,6 +740,13 @@ class SchedulingQueue:
             key = pod_key(info.pod)
             if key not in self._infos:
                 return
+            if self._tier.get(key) == "gated":
+                # re-gated mid-cycle: the gate parked it — pushing to
+                # backoff would clobber the gate and pop a gated pod
+                # into the next solve
+                return
+            if self._tier.get(key) == "inflight":
+                _ledger.discharge("pod", key)
             self._push_backoff(info)
 
     def move_all_to_active_or_backoff(self, event: str = "") -> None:

@@ -44,6 +44,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..analysis import epochs as _epochs
+from ..analysis import ledger as _ledger
 from ..analysis import retrace as _retrace
 from ..api import store as st
 from ..api import types as api
@@ -575,6 +576,10 @@ class Scheduler:
         scheduler leaves behind (the successor's reconciliation and the
         store's durable state are what recover them).  Never use outside
         crash-restart tests; stop() is the graceful path."""
+        # a SIGKILL takes the in-memory obligation ledger with it: the
+        # popped/assumed state this instance held is recovered by TTL
+        # expiry and successor reconciliation, not discharged
+        _ledger.abandon()
         self._stop.set()
         self.queue.close()
         with self._wave_cv:
@@ -859,12 +864,14 @@ class Scheduler:
             while self._stream_inflight >= cap and not self._binder_stop:
                 self._wave_cv.wait(0.2)
             self._stream_inflight += 1
+            _ledger.push("stream_inflight", id(self))
             self._wave_cv.notify_all()
         try:
             self._commit_pool.submit(self._commit_stream_subwave, entries)
         except BaseException:
             with self._wave_cv:
                 self._stream_inflight -= 1
+                _ledger.pop("stream_inflight", id(self))
                 self._wave_cv.notify_all()
             raise
 
@@ -895,6 +902,7 @@ class Scheduler:
         finally:
             with self._wave_cv:
                 self._stream_inflight -= 1
+                _ledger.pop("stream_inflight", id(self))
                 self._wave_cv.notify_all()
 
     def _solve_window(self, start: float, end: float) -> None:
@@ -1286,7 +1294,23 @@ class Scheduler:
             (name, group, self.profiles.frameworks.get(name))
             for name, group in by_fwk.items()
         ]
-        # another scheduler's pod slipped in; drop
+        # another scheduler's pod slipped in.  Normally unreachable (the
+        # informer and the reconcile sweep both filter on profile), but a
+        # popped pod is an obligation: dropping the group silently would
+        # strand its members on the inflight tier forever.  Retire each
+        # with an explicit disposition instead.
+        for name, group, fwk in groups:
+            if fwk is not None:
+                continue
+            for info in group:
+                key = pod_key(info.pod)
+                cycle.handled.add(key)
+                self.metrics.schedule_attempts.inc("error")
+                self.queue.done(info.pod)
+                self.events.eventf(
+                    info.pod, "Warning", "FailedScheduling",
+                    f"no framework profile for scheduler {name!r}",
+                )
         groups = [g for g in groups if g[2] is not None]
         for idx, (sched_name, group, fwk) in enumerate(groups):
             solved = self._solve_group_async(cycle, fwk, sched_name, group)
@@ -1573,6 +1597,15 @@ class Scheduler:
         self.metrics.coherence_audits.set(float(_epochs.audits_total()))
         self.metrics.coherence_violations.set(
             float(_epochs.violations_total())
+        )
+        # graftobl exactly-once ledger, when armed (bench /
+        # GRAFTLINT_OBLIGATIONS=1 runs; all 0 disarmed)
+        self.metrics.obligations_tracked.set(
+            float(_ledger.tracked_total())
+        )
+        self.metrics.obligation_leaks.set(float(_ledger.leaks_total()))
+        self.metrics.obligation_double_discharge.set(
+            float(_ledger.double_discharge_total())
         )
         # sharded-solve surface: mesh size in use, device-mirror
         # host→device transfer accounting, and single-chip fallbacks
@@ -2063,7 +2096,7 @@ class Scheduler:
             clone.meta.namespace = pods[0].meta.namespace or "default"
             node0 = next(iter(self.tpu.state._rows))
             try:
-                self.cache.assume(clone, node0)
+                self.cache.assume(clone, node0)  # graftlint: disable=obligations -- the warm_all finally forgets the clone; if THAT forget fails it is logged and cleanup_expired retires the synthetic assume by TTL
             except Exception:
                 return self._clock() - t0  # no usable node; round A ran
             try:

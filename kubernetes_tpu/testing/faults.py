@@ -42,6 +42,8 @@ from dataclasses import dataclass, field
 from random import Random
 from typing import Dict, List, Optional
 
+from ..analysis import ledger as _ledger
+
 # Every fault point the hot path exposes.  fail()/crash()/... validate
 # against this set so a typo'd point name fails the test loudly instead
 # of silently never firing.
@@ -291,12 +293,19 @@ _registry: Optional[FaultRegistry] = None
 
 def arm(registry: FaultRegistry) -> FaultRegistry:
     global _registry
+    if _registry is not None:
+        # re-arm over a live registry: the previous arming's obligation
+        # is retired by being overwritten, not leaked
+        _ledger.discharge("fault", 0)
     _registry = registry
+    _ledger.acquire("fault", 0)
     return registry
 
 
 def disarm() -> None:
     global _registry
+    if _registry is not None:
+        _ledger.discharge("fault", 0)
     _registry = None
 
 

@@ -44,6 +44,31 @@ if os.environ.get("GRAFTLINT_LOCK_ORDER") == "1":
         tracker.assert_no_inversions()
 
 
+if os.environ.get("GRAFTLINT_OBLIGATIONS") == "1":
+    # opt-in runtime exactly-once obligation tracking
+    # (docs/static_analysis.md obligations section): every popped pod /
+    # cache assume / APF seat / arbiter slot / inflight counter / armed
+    # fault registry acquisition is recorded with its call chain; a
+    # double-discharge raises at the offending call and the session
+    # fails on any obligation still held at teardown.
+    @pytest.fixture(autouse=True, scope="session")
+    def _graftlint_obligations():
+        from kubernetes_tpu.analysis import ledger
+
+        with ledger.tracked() as led:
+            yield led
+        led.assert_clean()
+
+    @pytest.fixture(autouse=True)
+    def _graftlint_obligations_boundary(_graftlint_obligations):
+        # pod keys recur across tests: reset the double-discharge
+        # lookback window at each boundary so one test's retired
+        # 'default/p3' never taints the next test's own 'default/p3'
+        # (held obligations and recorded violations survive the reset)
+        _graftlint_obligations.reset_cycles()
+        yield
+
+
 if os.environ.get("GRAFTLINT_COHERENCE") == "1":
     # opt-in runtime resident-epoch auditing (docs/static_analysis.md
     # coherence section): every resident buffer a solve consumes is

@@ -1,0 +1,452 @@
+"""graftobl runtime half — the exactly-once obligation ledger
+(analysis/ledger.py).
+
+Proves the ledger's semantics (leaks carry acquiring call chains, a
+double-discharge raises at the offending call, mid-flight arming stays
+silent on unknown keys), that the production hooks observe the real
+lifecycles (queue pod tiers, cache assumes, APF seats, arbiter slots,
+fault-registry arming) with zero false positives on the legitimate
+idempotent paths, and pins the true positives the obligations work
+surfaced:
+
+  * requeue_backoff / add_unschedulable clobbered a mid-cycle re-gate:
+    a pod popped inflight, re-gated by an update that added scheduling
+    gates, then requeued by the failing cycle landed in backoff/unsched
+    — from where a GATED pod could pop straight into a solve.  Both
+    methods now treat tier=="gated" as the pod's disposition;
+  * _dispatch_batch dropped no-framework groups silently, stranding
+    popped pods on the inflight tier with no disposition (unreachable
+    through the filtered informer paths, pinned as hardening);
+  * DispatchArbiter.release() swallows below-zero releases to keep the
+    production counter sane — the ledger hook sits BEFORE that guard,
+    so a masked double-release surfaces as a double-discharge.
+
+The smoke subset rides tier-1 ('obligations and not slow'); chaos runs
+arm the ledger session-wide via GRAFTLINT_OBLIGATIONS=1 (conftest) and
+the quiesce blocks call assert_quiesced per seed.
+"""
+
+import contextlib
+
+import pytest
+
+from kubernetes_tpu.analysis import ledger
+from kubernetes_tpu.api import auth
+from kubernetes_tpu.api import flowcontrol
+from kubernetes_tpu.models.batch_scheduler import DispatchArbiter
+from kubernetes_tpu.ops import schema
+from kubernetes_tpu.scheduler.cache import SchedulerCache
+from kubernetes_tpu.scheduler.queue import SchedulingQueue, pod_key
+from kubernetes_tpu.testing import faults
+from kubernetes_tpu.testing.wrappers import GI, make_node, make_pod
+
+pytestmark = pytest.mark.obligations
+
+
+@contextlib.contextmanager
+def _isolated():
+    """A private armed ledger, even when the GRAFTLINT_OBLIGATIONS=1
+    session ledger is active — the injected-violation tests must not
+    poison the session-teardown assert_clean()."""
+    prev = ledger._active
+    ledger._active = None
+    try:
+        with ledger.tracked() as led:
+            yield led
+    finally:
+        ledger._active = prev
+
+
+# -- ledger semantics --------------------------------------------------------
+
+def test_acquire_discharge_exactly_once_is_clean():
+    with _isolated() as led:
+        led.acquire("pod", "default/p0")
+        led.discharge("pod", "default/p0")
+        led.assert_clean()
+        assert led.tracked_total == 1
+        assert led.leaks_total == 0
+
+
+def test_leak_reports_acquiring_call_chain():
+    with _isolated() as led:
+        led.acquire("assume", "default/p0")
+        leaks = led.outstanding()
+        assert len(leaks) == 1
+        assert "leaked assume 'default/p0'" in leaks[0]
+        # the chain names THIS test as the acquirer
+        assert "test_obligations.py" in leaks[0]
+        with pytest.raises(ledger.ObligationViolation):
+            led.assert_clean()
+
+
+def test_double_discharge_raises_immediately_and_is_recorded():
+    with _isolated() as led:
+        led.acquire("seat", 42)
+        led.discharge("seat", 42)
+        with pytest.raises(ledger.ObligationViolation, match="double-discharge"):
+            led.discharge("seat", 42)
+        assert led.double_discharge_total == 1
+        # the record names both discharge sites
+        assert "already discharged at" in led.double[0]
+
+
+def test_unknown_key_discharge_is_silent():
+    """Arming mid-flight (a session fixture around a warm process) must
+    not misattribute pre-arming acquisitions."""
+    with _isolated() as led:
+        led.discharge("pod", "default/never-seen")
+        led.assert_clean()
+        assert led.double_discharge_total == 0
+
+
+def test_reacquire_retires_previous_cycle():
+    """A requeued pod popped again starts a fresh obligation: its new
+    discharge is not a double against the previous cycle's."""
+    with _isolated() as led:
+        led.acquire("pod", "default/p0")
+        led.discharge("pod", "default/p0")
+        led.acquire("pod", "default/p0")
+        led.discharge("pod", "default/p0")
+        led.assert_clean()
+
+
+def test_reset_cycles_clears_double_discharge_lookback():
+    """Pod keys recur across tests in a session-armed run: the per-test
+    conftest boundary calls reset_cycles() so a key retired by one test
+    never turns the next test's discharge-without-acquire of the SAME
+    key (informer delete of a never-assumed pod) into a false double."""
+    with _isolated() as led:
+        led.acquire("pod", "default/p0")
+        led.discharge("pod", "default/p0")
+        led.reset_cycles()
+        # same key, next "test": never acquired here, so silent
+        led.discharge("pod", "default/p0")
+        led.assert_clean()
+        assert led.double_discharge_total == 0
+
+
+def test_abandon_drops_held_state_without_discharging():
+    """Scheduler.kill() (the SIGKILL analogue) abandons the ledger:
+    held obligations vanish without counting as discharged, a late
+    discharge from a half-dead thread is silent, and a successor's
+    fresh cycle on the same key tracks normally."""
+    with _isolated() as led:
+        led.acquire("assume", "default/p0")
+        led.acquire("pod", "default/p1")
+        led.discharge("pod", "default/p1")
+        led.push("slot", 0xA)
+        led.push("stream_inflight", 0xB)
+        led.abandon()
+        led.assert_clean()
+        # late stragglers from half-dead threads: all silent — kill()
+        # shuts the commit pool down without waiting, so a hand-off's
+        # finally-decrement can land after the abandon
+        led.discharge("assume", "default/p0")
+        led.discharge("pod", "default/p1")
+        led.pop("slot", 0xA)
+        led.pop("stream_inflight", 0xB)
+        assert led.double_discharge_total == 0
+        led.acquire("assume", "default/p0")  # successor's fresh cycle
+        led.discharge("assume", "default/p0")
+        led.assert_clean()
+
+
+def test_counter_push_pop_balanced_is_clean():
+    with _isolated() as led:
+        led.push("slot", 0xA)
+        led.push("slot", 0xA)
+        led.pop("slot", 0xA)
+        led.pop("slot", 0xA)
+        led.assert_clean()
+
+
+def test_counter_pop_below_zero_raises():
+    with _isolated() as led:
+        led.push("slot", 0xA)
+        led.pop("slot", 0xA)
+        with pytest.raises(ledger.ObligationViolation, match="below zero"):
+            led.pop("slot", 0xA)
+        assert led.double_discharge_total == 1
+
+
+def test_counter_pop_unknown_owner_is_silent():
+    with _isolated() as led:
+        led.pop("dispatch_inflight", 0xBEEF)
+        led.assert_clean()
+
+
+def test_assert_quiesced_filters_by_kind():
+    with _isolated() as led:
+        led.acquire("seat", 1)        # still legitimately in flight
+        led.acquire("assume", "default/p0")
+        with pytest.raises(ledger.ObligationViolation, match="assume"):
+            led.assert_quiesced(("pod", "assume"), context="t")
+        led.discharge("assume", "default/p0")
+        led.assert_quiesced(("pod", "assume"), context="t")  # seat excluded
+        led.discharge("seat", 1)
+
+
+def test_disarmed_hooks_are_noops():
+    prev = ledger._active
+    ledger._active = None
+    try:
+        ledger.acquire("pod", "x")
+        ledger.discharge("pod", "x")
+        ledger.push("slot", 1)
+        ledger.pop("slot", 1)
+        assert ledger.tracked_total() == 0
+        assert ledger.leaks_total() == 0
+        assert ledger.double_discharge_total() == 0
+    finally:
+        ledger._active = prev
+
+
+def test_nested_tracked_shares_outer_ledger():
+    with _isolated() as outer:
+        with ledger.tracked() as inner:
+            assert inner is outer
+            inner.acquire("pod", "x")
+        # inner exit must not disarm the outer extent
+        assert ledger.active() is outer
+        outer.discharge("pod", "x")
+
+
+# -- queue pod-tier hooks ----------------------------------------------------
+
+def _pop_one(q, name="p0"):
+    q.add(make_pod(name).req(cpu_milli=100).obj())
+    batch = q.pop_batch(10, timeout=0.5)
+    assert len(batch) == 1
+    return batch[0]
+
+
+def test_pod_pop_then_each_disposition_is_clean():
+    for disposition in ("done", "delete", "requeue", "unsched"):
+        q = SchedulingQueue(backoff_base=0.01, backoff_max=0.02)
+        with _isolated() as led:
+            info = _pop_one(q)
+            if disposition == "done":
+                q.done(info.pod)
+            elif disposition == "delete":
+                q.delete(info.pod)
+            elif disposition == "requeue":
+                q.requeue_backoff(info)
+            else:
+                q.add_unschedulable(info, reason=-1)
+            led.assert_quiesced(("pod",), context=disposition)
+            assert led.tracked_total == 1, disposition
+        q.close()
+
+
+def test_pod_without_disposition_leaks():
+    q = SchedulingQueue()
+    with _isolated() as led:
+        info = _pop_one(q)
+        leaks = led.outstanding(("pod",))
+        assert len(leaks) == 1
+        assert pod_key(info.pod) in leaks[0]
+    q.close()
+
+
+def test_idempotent_done_after_requeue_is_not_a_double():
+    """The production guards make a second disposition a no-op (the pod
+    already left the inflight tier) — the tier-guarded hooks must agree
+    and never report it as a double-discharge."""
+    q = SchedulingQueue(backoff_base=0.01, backoff_max=0.02)
+    with _isolated() as led:
+        info = _pop_one(q)
+        q.requeue_backoff(info)
+        q.done(info.pod)          # informer-driven done after requeue
+        q.delete(info.pod)        # and a delete on top
+        assert led.double_discharge_total == 0
+        led.assert_quiesced(("pod",), context="idempotent")
+    q.close()
+
+
+def test_regate_mid_cycle_is_the_pods_disposition():
+    """Regression pin (true positive): a pod popped inflight then
+    re-gated by an update must (1) count the re-gate as its disposition
+    and (2) NOT be clobbered back to backoff/unsched by the failing
+    cycle's later callbacks — tier stays 'gated' and the pod cannot pop
+    into a solve."""
+    for callback in ("requeue", "unsched"):
+        q = SchedulingQueue(backoff_base=0.01, backoff_max=0.02)
+        with _isolated() as led:
+            info = _pop_one(q)
+            key = pod_key(info.pod)
+            # an update adds scheduling gates while the pod is mid-cycle
+            gated = make_pod("p0").req(cpu_milli=100).obj()
+            gated.spec.scheduling_gates = ["hold"]
+            q.add(gated)
+            assert q._tier.get(key) == "gated"
+            led.assert_quiesced(("pod",), context="regate")
+            # the cycle fails afterwards and fires its park callback
+            if callback == "requeue":
+                q.requeue_backoff(info)
+            else:
+                q.add_unschedulable(info, reason=-1)
+            assert q._tier.get(key) == "gated", (
+                f"{callback} clobbered the re-gate"
+            )
+            assert q.pop_batch(10, timeout=0.05) == []
+            assert led.double_discharge_total == 0
+        q.close()
+
+
+# -- cache assume hooks ------------------------------------------------------
+
+def _cache(ttl=30.0, clock=None):
+    state = schema.ClusterState(schema.SnapshotBuilder())
+    kw = {"ttl": ttl}
+    if clock is not None:
+        kw["clock"] = clock
+    cache = SchedulerCache(state, **kw)
+    cache.add_node(
+        make_node("n0").capacity(cpu_milli=8000, mem=16 * GI, pods=110).obj()
+    )
+    return cache
+
+
+def test_assume_then_forget_confirm_expire_are_clean():
+    # forget
+    cache = _cache()
+    pod = make_pod("p0").req(cpu_milli=100).obj()
+    with _isolated() as led:
+        cache.assume(pod, "n0")
+        assert cache.forget(pod)
+        led.assert_quiesced(("assume",), context="forget")
+    # confirm via informer add_pod
+    cache = _cache()
+    with _isolated() as led:
+        cache.assume(pod, "n0")
+        cache.add_pod(make_pod("p0").req(cpu_milli=100).node_name("n0").obj())
+        led.assert_quiesced(("assume",), context="confirm")
+    # TTL expiry
+    now = [0.0]
+    cache = _cache(ttl=0.5, clock=lambda: now[0])
+    with _isolated() as led:
+        cache.assume(pod, "n0")
+        cache.finish_binding(pod)
+        now[0] = 10.0
+        expired = cache.cleanup_expired()
+        assert [p.meta.name for p in expired] == ["p0"]
+        led.assert_quiesced(("assume",), context="expire")
+        assert led.double_discharge_total == 0
+
+
+def test_assume_without_disposition_leaks_with_chain():
+    cache = _cache()
+    with _isolated() as led:
+        cache.assume(make_pod("p0").req(cpu_milli=100).obj(), "n0")
+        leaks = led.outstanding(("assume",))
+        assert len(leaks) == 1
+        assert "default/p0" in leaks[0]
+        assert "cache.py" in leaks[0]  # the chain names the acquire site
+
+
+def test_forget_then_remove_pod_is_not_a_double():
+    """remove_pod after a forget finds no assumed entry — the guarded
+    hook must not fire a second discharge."""
+    cache = _cache()
+    pod = make_pod("p0").req(cpu_milli=100).obj()
+    with _isolated() as led:
+        cache.assume(pod, "n0")
+        cache.forget(pod)
+        cache.remove_pod(pod)
+        assert led.double_discharge_total == 0
+        led.assert_quiesced(("assume",), context="forget+remove")
+
+
+# -- arbiter slot hooks ------------------------------------------------------
+
+def test_arbiter_acquire_release_is_clean():
+    arb = DispatchArbiter(depth=2, timeout=0.1)
+    with _isolated() as led:
+        assert arb.acquire()
+        assert arb.acquire()
+        arb.release()
+        arb.release()
+        led.assert_quiesced(("slot",), context="arbiter")
+        assert led.tracked_total == 2
+
+
+def test_arbiter_forced_admission_still_tracks_the_slot():
+    arb = DispatchArbiter(depth=1, timeout=0.0)
+    with _isolated() as led:
+        assert arb.acquire()
+        assert arb.acquire() is False  # deadline expired: forced
+        assert led.outstanding(("slot",))  # both held
+        arb.release()
+        arb.release()
+        led.assert_quiesced(("slot",), context="forced")
+
+
+def test_arbiter_masked_double_release_surfaces():
+    """Regression pin: release() swallows below-zero releases to keep
+    the production counter sane; the ledger hook sits BEFORE that
+    guard, so the armed ledger turns the masked double-release into an
+    immediate ObligationViolation."""
+    arb = DispatchArbiter(depth=2, timeout=0.1)
+    with _isolated() as led:
+        assert arb.acquire()
+        arb.release()
+        with pytest.raises(ledger.ObligationViolation, match="below zero"):
+            arb.release()
+        assert led.double_discharge_total == 1
+    # disarmed, the same double-release stays a production no-op
+    arb2 = DispatchArbiter(depth=2, timeout=0.1)
+    assert arb2.acquire()
+    arb2.release()
+    arb2.release()
+    assert arb2.inflight() == 0
+
+
+# -- APF seat hooks ----------------------------------------------------------
+
+def test_seat_grant_release_is_clean_and_idempotent():
+    gate = flowcontrol.APFGate(queue_wait_s=0.1)
+    subject = auth.Subject("system:kube-scheduler", ("system:schedulers",))
+    with _isolated() as led:
+        seat = gate.acquire(subject, "list")
+        assert seat is not None
+        assert led.outstanding(("seat",))
+        seat.release()
+        led.assert_quiesced(("seat",), context="seat")
+        # Seat.release is deliberately idempotent: the _released guard
+        # sits ahead of the ledger hook, so a second release is silent
+        seat.release()
+        assert led.double_discharge_total == 0
+
+
+# -- fault-registry hooks ----------------------------------------------------
+
+def test_fault_arm_disarm_and_rearm_are_clean():
+    with _isolated() as led:
+        faults.arm(faults.FaultRegistry(seed=1))
+        try:
+            faults.arm(faults.FaultRegistry(seed=2))  # re-arm overwrites
+        finally:
+            faults.disarm()
+        faults.disarm()  # idempotent
+        led.assert_quiesced(("fault",), context="faults")
+        assert led.double_discharge_total == 0
+
+
+def test_fault_armed_context_discharges_on_exception():
+    with _isolated() as led:
+        with pytest.raises(RuntimeError):
+            with faults.armed(faults.FaultRegistry(seed=3)):
+                raise RuntimeError("boom")
+        led.assert_quiesced(("fault",), context="armed-ctx")
+
+
+def test_fault_left_armed_leaks():
+    with _isolated() as led:
+        faults.arm(faults.FaultRegistry(seed=4))
+        try:
+            leaks = led.outstanding(("fault",))
+            assert len(leaks) == 1
+            assert "faults.py" in leaks[0]
+        finally:
+            faults.disarm()

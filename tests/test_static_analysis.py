@@ -5,12 +5,12 @@ Two layers:
   * fixture tests: per-checker good/bad snippets (constructed as
     in-memory SourceFiles) prove each pass flags seeded violations and
     stays quiet on conforming code;
-  * the real-tree gate: all seven static passes run over the actual
+  * the real-tree gate: all eight static passes run over the actual
     repository and must produce nothing beyond the reviewed baseline —
     the tier-1 regression wire for lock discipline, lock atomicity,
     hot-path purity, registry consistency, lock ordering, tensor
-    contracts and resident-cache coherence.  (The JAX-backed
-    recompile-discipline pass has its own tier-1 gate in
+    contracts, resident-cache coherence and linear obligations.  (The
+    JAX-backed recompile-discipline pass has its own tier-1 gate in
     tests/test_shapes.py.)
 
 Plus the runtime lock-order tracker's inversion regression tests
@@ -35,6 +35,7 @@ from kubernetes_tpu.analysis import (
     coherence,
     guarded,
     lockorder,
+    obligations,
     purity,
     registry,
 )
@@ -1099,6 +1100,284 @@ class DeviceClusterMirror:
     findings = coherence.check(files, chaos_families=COH_FAMILIES)
     assert len(findings) == 1
     assert "declares no '# resident:'" in findings[0].message
+
+
+# -- obligations -------------------------------------------------------------
+# fixture tests always pass test_files=[] explicitly so the fault-spec
+# disk scan never runs against the real tests/ tree from a fixture
+
+def _obl(relpath, code):
+    return obligations.check([src(relpath, code)], test_files=[])
+
+
+OBL_POD_BAD = '''
+class S:
+    def run_once(self):
+        batch = self.queue.pop_batch(64, timeout=0.1)
+        if self.lost_leadership():
+            return
+        for info in batch:
+            self.queue.requeue_backoff(info)
+'''
+
+OBL_POD_GOOD = '''
+class S:
+    def run_once(self):
+        batch = self.queue.pop_batch(64, timeout=0.1)
+        if not batch:
+            return
+        if self.lost_leadership():
+            for info in batch:
+                self.queue.requeue_backoff(info)
+            return
+        self._dispatch_batch(batch)
+'''
+
+
+def test_obligations_flags_pod_batch_dropped_on_branch():
+    findings = _obl("kubernetes_tpu/scheduler/scheduler.py", OBL_POD_BAD)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.check == "obligations"
+    assert "pod obligation on 'batch'" in f.message
+    assert "return" in f.message
+
+
+def test_obligations_pod_clean_on_refined_branches_and_loop_requeue():
+    assert _obl("kubernetes_tpu/scheduler/scheduler.py", OBL_POD_GOOD) == []
+
+
+OBL_SLOT_RETURN_BAD = '''
+class D:
+    def dispatch(self, snap):
+        self.arbiter.acquire()
+        if snap is None:
+            return None
+        fut = self.submit(snap)
+        self.arbiter.release()
+        return fut
+'''
+
+OBL_SLOT_RAISE_BAD = '''
+class D:
+    def dispatch(self, snap):
+        self.arbiter.acquire()
+        if self.closed:
+            raise RuntimeError("closed")
+        self.arbiter.release()
+'''
+
+OBL_SLOT_GOOD = '''
+class D:
+    def dispatch(self, snap):
+        self.arbiter.acquire()
+        try:
+            fut = self.submit(snap)
+        except Exception:
+            self.arbiter.release()
+            raise
+        ds = DeviceSolve(fut)
+        ds._slot = self.arbiter
+        return ds
+'''
+
+
+def test_obligations_flags_slot_leak_on_early_return():
+    findings = _obl(
+        "kubernetes_tpu/models/batch_scheduler.py", OBL_SLOT_RETURN_BAD
+    )
+    assert len(findings) == 1
+    assert "slot obligation on 'self.arbiter'" in findings[0].message
+
+
+def test_obligations_flags_slot_leak_on_raise_edge():
+    findings = _obl(
+        "kubernetes_tpu/models/batch_scheduler.py", OBL_SLOT_RAISE_BAD
+    )
+    assert len(findings) == 1
+    assert "exception" in findings[0].message
+
+
+def test_obligations_slot_clean_on_handler_release_and_ownership_store():
+    assert _obl(
+        "kubernetes_tpu/models/batch_scheduler.py", OBL_SLOT_GOOD
+    ) == []
+
+
+OBL_SEAT_DISCARDED = '''
+class H:
+    def handle(self, subject, verb):
+        self.apf.acquire(subject, verb)
+        self.process(subject)
+'''
+
+OBL_SEAT_GOOD = '''
+class H:
+    def handle(self, subject, verb):
+        seat = self.apf.acquire(subject, verb)
+        if seat is None:
+            return False
+        try:
+            return self.process(subject)
+        finally:
+            seat.release()
+'''
+
+
+def test_obligations_flags_discarded_seat_result():
+    findings = _obl("kubernetes_tpu/api/server.py", OBL_SEAT_DISCARDED)
+    assert len(findings) == 1
+    assert "discards the obligated result" in findings[0].message
+
+
+def test_obligations_seat_clean_on_none_guard_and_finally():
+    assert _obl("kubernetes_tpu/api/server.py", OBL_SEAT_GOOD) == []
+
+
+OBL_ASSUME_BAD = '''
+class S:
+    def stage(self, info, node):
+        self.cache.assume(info.pod, node)
+        verdict = self.permit(info.pod, node)
+        if verdict == "reject":
+            self.queue.requeue_backoff(info)
+            return None
+        return node
+'''
+
+OBL_ASSUME_GOOD = '''
+class S:
+    def stage(self, info, node):
+        self.cache.assume(info.pod, node)
+        verdict = self.permit(info.pod, node)
+        if verdict == "reject":
+            self.cache.forget(info.pod)
+            self.queue.requeue_backoff(info)
+            return None
+        return (info, node)
+'''
+
+
+def test_obligations_flags_assume_without_forget_on_reject():
+    findings = _obl("kubernetes_tpu/scheduler/scheduler.py", OBL_ASSUME_BAD)
+    assert len(findings) == 1
+    assert "assume obligation on 'info.pod'" in findings[0].message
+
+
+def test_obligations_assume_clean_on_forget_and_return_transfer():
+    assert _obl(
+        "kubernetes_tpu/scheduler/scheduler.py", OBL_ASSUME_GOOD
+    ) == []
+
+
+OBL_COUNTER_BAD = '''
+class S:
+    def hand_off(self, entries):
+        with self._cv:
+            self._stream_inflight += 1
+        if not entries:
+            return
+        self.pool.submit(self.deliver, entries)
+'''
+
+OBL_COUNTER_GOOD = '''
+class S:
+    def hand_off(self, entries):
+        with self._cv:
+            self._stream_inflight += 1
+        try:
+            self.pool.submit(self._commit_stream_subwave, entries)
+        except BaseException:
+            with self._cv:
+                self._stream_inflight -= 1
+            raise
+'''
+
+
+def test_obligations_flags_inflight_increment_without_decrement():
+    findings = _obl("kubernetes_tpu/scheduler/scheduler.py", OBL_COUNTER_BAD)
+    assert len(findings) == 1
+    assert "stream_inflight" in findings[0].message
+
+
+def test_obligations_counter_clean_on_handoff_and_failure_decrement():
+    assert _obl(
+        "kubernetes_tpu/scheduler/scheduler.py", OBL_COUNTER_GOOD
+    ) == []
+
+
+OBL_FAULT_BAD = '''
+from kubernetes_tpu.testing import faults
+
+def test_chaos_run(tmp_path):
+    reg = faults.FaultRegistry(seed=1)
+    faults.arm(reg)
+    run_cluster(tmp_path)
+    faults.disarm()
+'''
+
+OBL_FAULT_GOOD = '''
+from kubernetes_tpu.testing import faults
+
+def test_chaos_run(tmp_path):
+    reg = faults.FaultRegistry(seed=1)
+    faults.arm(reg)
+    try:
+        run_cluster(tmp_path)
+    finally:
+        faults.disarm()
+'''
+
+OBL_FAULT_CTX_GOOD = '''
+from kubernetes_tpu.testing import faults
+
+def test_chaos_run(tmp_path):
+    with faults.armed(faults.FaultRegistry(seed=1)):
+        run_cluster(tmp_path)
+'''
+
+
+def test_obligations_flags_unprotected_armed_registry():
+    """Any call between arm() and disarm() is a potential raise edge —
+    a fault registry exists to make arbitrary calls raise."""
+    findings = obligations.check(
+        [], test_files=[src("tests/test_fixture_chaos.py", OBL_FAULT_BAD)]
+    )
+    assert len(findings) == 1
+    assert "fault obligation" in findings[0].message
+
+
+def test_obligations_fault_clean_on_try_finally_and_armed_context():
+    for code in (OBL_FAULT_GOOD, OBL_FAULT_CTX_GOOD):
+        findings = obligations.check(
+            [], test_files=[src("tests/test_fixture_chaos.py", code)]
+        )
+        assert findings == [], code
+
+
+def test_obligations_suppression_covers_justified_site():
+    code = OBL_SLOT_RETURN_BAD.replace(
+        "self.arbiter.acquire()",
+        "self.arbiter.acquire()  # graftlint: disable=obligations"
+        " -- slot handed to the watchdog out of band",
+    )
+    assert _obl("kubernetes_tpu/models/batch_scheduler.py", code) == []
+
+
+def test_obligations_summary_propagates_through_local_helper():
+    """A helper whose body discharges a kind summarizes as discharging
+    it — calling the helper with the obligated value counts."""
+    code = '''
+class S:
+    def _park(self, info):
+        self.queue.requeue_backoff(info)
+
+    def run_once(self):
+        batch = self.queue.pop_batch(64, timeout=0.1)
+        for info in batch:
+            self._park(info)
+'''
+    assert _obl("kubernetes_tpu/scheduler/scheduler.py", code) == []
 
 
 # -- the real-tree gate ------------------------------------------------------
